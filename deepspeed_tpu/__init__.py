@@ -1,0 +1,84 @@
+"""deepspeed_tpu — a TPU-native training/inference framework.
+
+Re-designed from scratch for JAX/XLA/Pallas on TPU device meshes, with the
+capability surface of the reference DeepSpeed (``deepspeed/__init__.py``):
+``initialize()`` / ``init_inference()`` / ``add_config_arguments()``.
+"""
+
+from deepspeed_tpu.version import __version__, __version_info__
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mesh=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None):
+    """Build a training engine around ``model``.
+
+    Capability parity with reference ``deepspeed.initialize``
+    (``deepspeed/__init__.py:52``). ``model`` is a flax module or any object
+    exposing ``init(rng, batch)``/``apply(params, batch)``; ``mesh`` replaces
+    the reference's ``mpu`` argument (a ``jax.sharding.Mesh`` or a
+    ``deepspeed_tpu.parallel.MeshTopology``).
+
+    Returns a tuple of ``engine, optimizer, training_dataloader, lr_scheduler``.
+    """
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.utils.logging import log_dist
+
+    log_dist(f"DeepSpeed-TPU info: version={__version__}", ranks=[0])
+
+    if config is None:
+        config = config_params
+    if config is None and args is not None and hasattr(args, "deepspeed_config"):
+        config = args.deepspeed_config
+
+    engine = DeepSpeedEngine(args=args,
+                             model=model,
+                             optimizer=optimizer,
+                             model_parameters=model_parameters,
+                             training_data=training_data,
+                             lr_scheduler=lr_scheduler,
+                             mesh=mesh,
+                             dist_init_required=dist_init_required,
+                             collate_fn=collate_fn,
+                             config=config)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model, config=None, **kwargs):
+    """Build an inference engine (reference ``deepspeed/__init__.py:233``)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+    if config is None:
+        config = {}
+    if isinstance(config, dict):
+        ds_inference_config = DeepSpeedInferenceConfig(**{**config, **kwargs})
+    else:
+        ds_inference_config = config
+    return InferenceEngine(model, config=ds_inference_config)
+
+
+def add_config_arguments(parser):
+    """Add ``--deepspeed``/``--deepspeed_config`` args (reference ``:159-207``)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag to indicate usage)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeed json configuration")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated enable flag")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated config path")
+    group.add_argument("--deepspeed_mpi", default=False, action="store_true",
+                       help="Run via MPI discovery")
+    return parser

@@ -1,0 +1,328 @@
+"""Master DeepSpeed-style JSON config.
+
+Capability parity with the reference ``deepspeed/runtime/config.py``
+(``DeepSpeedConfig``, batch-size triangle at ``:918-989``, ~70 ``get_*``
+helpers), re-based on a pydantic tree plus a TPU-native ``mesh`` section that
+declares named mesh axis sizes (data/model/pipe/expert/seq) instead of the
+reference's implicit world-size + mpu plumbing.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import (
+    DeepSpeedConfigModel,
+    dict_raise_error_on_duplicate_keys,
+)
+from deepspeed_tpu.runtime.precision_config import AMPConfig, BF16Config, FP16Config
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class MeshConfig(DeepSpeedConfigModel):
+    """TPU-native: named mesh axis sizes. ``data`` may be -1 (fill remaining
+    devices). The reference derives parallel dims from world size + an external
+    mpu (``deepspeed/utils/groups.py``); here the mesh is declared."""
+
+    data: int = -1
+    model: int = 1
+    pipe: int = 1
+    expert: int = 1
+    seq: int = 1
+    axis_order: tuple = ("pipe", "data", "expert", "seq", "model")
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """Reference ``runtime/activation_checkpointing/config.py``. On TPU this
+    selects a ``jax.checkpoint`` (remat) policy; partition_activations maps to
+    sharding the saved residuals over the model axis."""
+
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    """Reference ``deepspeed/comm/config.py``."""
+
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: list = Field(default_factory=list)
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    """Reference ``deepspeed/profiling/config.py``."""
+
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class MonitorConfig(DeepSpeedConfigModel):
+    """Reference ``deepspeed/monitor/config.py`` (flattened sections)."""
+
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+
+    @property
+    def enabled(self):
+        return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"
+
+    @model_validator(mode="after")
+    def _check_tag_validation(self):
+        from deepspeed_tpu.runtime.constants import CHECKPOINT_TAG_VALIDATION_MODES
+
+        normalized = self.tag_validation.capitalize()
+        if normalized not in CHECKPOINT_TAG_VALIDATION_MODES:
+            raise ValueError(
+                f"checkpoint.tag_validation must be one of {CHECKPOINT_TAG_VALIDATION_MODES}, "
+                f"got {self.tag_validation!r}")
+        if normalized != self.tag_validation:
+            object.__setattr__(self, "tag_validation", normalized)
+        return self
+
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: dict = Field(default_factory=dict)
+    async_save: bool = False  # TPU-native: orbax-style async checkpointing
+
+
+class DataTypesConfig(DeepSpeedConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+def _resolve_batch_triangle(train_batch, micro_batch, gas, dp_world_size):
+    """Resolve/validate train_batch = micro_batch * gas * dp_world.
+
+    Mirrors reference ``DeepSpeedConfig._configure_train_batch_size``
+    (``runtime/config.py:918-989``): any two given determine the third; one
+    given fills the others with sensible defaults; none given is an error.
+    """
+    tb, mb, g = train_batch, micro_batch, gas
+    if tb is not None and mb is not None and g is not None:
+        if tb != mb * g * dp_world_size:
+            raise DeepSpeedConfigError(
+                f"Check batch related parameters. train_batch_size is not equal to "
+                f"micro_batch_per_gpu * gradient_acc_step * world_size "
+                f"{tb} != {mb} * {g} * {dp_world_size}"
+            )
+    elif tb is not None and mb is not None:
+        g, rem = divmod(tb, mb * dp_world_size)
+        if rem != 0:
+            raise DeepSpeedConfigError(
+                f"train_batch_size {tb} not divisible by micro_batch {mb} * world size {dp_world_size}"
+            )
+    elif tb is not None and g is not None:
+        mb, rem = divmod(tb, g * dp_world_size)
+        if rem != 0:
+            raise DeepSpeedConfigError(
+                f"train_batch_size {tb} not divisible by gas {g} * world size {dp_world_size}"
+            )
+    elif mb is not None and g is not None:
+        tb = mb * g * dp_world_size
+    elif tb is not None:
+        g = 1
+        mb, rem = divmod(tb, dp_world_size)
+        if rem != 0:
+            raise DeepSpeedConfigError(f"train_batch_size {tb} not divisible by world size {dp_world_size}")
+    elif mb is not None:
+        g = 1
+        tb = mb * dp_world_size
+    else:
+        raise DeepSpeedConfigError(
+            "Either train_batch_size or train_micro_batch_size_per_gpu needs to be provided"
+        )
+    for name, v in (("train_batch_size", tb), ("train_micro_batch_size_per_gpu", mb),
+                    ("gradient_accumulation_steps", g)):
+        if v <= 0:
+            raise DeepSpeedConfigError(f"{name} must be positive, got {v}")
+    return tb, mb, g
+
+
+class DeepSpeedConfig:
+    """Parsed master config.
+
+    ``config`` may be a dict, a path to a JSON file, or None. ``world_size``
+    here means the *data-parallel* world size used in batch arithmetic
+    (reference passes ``mpu.get_data_parallel_world_size()``).
+    """
+
+    def __init__(self, config: Any, mpu=None, world_size: Optional[int] = None):
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise DeepSpeedConfigError(f"DeepSpeed config path does not exist: {config}")
+            with open(config) as f:
+                self._param_dict = json.load(f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        elif config is None:
+            self._param_dict = {}
+        else:
+            raise DeepSpeedConfigError(
+                f"Expected a string path to a JSON file or a dict, got: {type(config)}")
+
+        d = self._param_dict
+        # --- sub-models ---
+        self.fp16 = FP16Config(**d.get(C.FP16, {}))
+        self.bf16 = BF16Config(**d.get(C.BF16, d.get("bfloat16", {})))
+        self.amp = AMPConfig(**d.get(C.AMP, {}))
+        self.zero_config = DeepSpeedZeroConfig(**d.get(C.ZERO_OPTIMIZATION, {}))
+        self.mesh = MeshConfig(**d.get(C.MESH, {}))
+        self.activation_checkpointing_config = ActivationCheckpointingConfig(
+            **d.get("activation_checkpointing", {}))
+        self.comms_config = CommsLoggerConfig(**d.get("comms_logger", {}))
+        self.flops_profiler_config = FlopsProfilerConfig(**d.get("flops_profiler", {}))
+        self.monitor_config = MonitorConfig(
+            tensorboard=d.get("tensorboard", {}),
+            wandb=d.get("wandb", {}),
+            csv_monitor=d.get("csv_monitor", {}),
+        )
+        self.checkpoint_config = CheckpointConfig(**d.get(C.CHECKPOINT, {}))
+        self.data_types_config = DataTypesConfig(**d.get(C.DATA_TYPES, {}))
+
+        if self.fp16.enabled and self.bf16.enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+
+        # --- scalars ---
+        self.optimizer_name = None
+        self.optimizer_params = None
+        self.optimizer_legacy_fusion = False
+        opt = d.get(C.OPTIMIZER)
+        if opt:
+            self.optimizer_name = opt.get(C.TYPE)
+            if self.optimizer_name:
+                self.optimizer_name = self.optimizer_name.lower()
+            self.optimizer_params = opt.get(C.OPTIMIZER_PARAMS, {})
+            self.optimizer_legacy_fusion = opt.get(C.LEGACY_FUSION, False)
+        sched = d.get(C.SCHEDULER)
+        self.scheduler_name = sched.get(C.TYPE) if sched else None
+        self.scheduler_params = sched.get(C.SCHEDULER_PARAMS, {}) if sched else None
+
+        self.zero_allow_untested_optimizer = d.get(
+            C.ZERO_ALLOW_UNTESTED_OPTIMIZER, C.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
+        self.steps_per_print = d.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = d.get(C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.disable_allgather = d.get(C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT)
+        self.gradient_predivide_factor = d.get(
+            C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.prescale_gradients = d.get(C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_clipping = d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+        self.communication_data_type = d.get(
+            C.COMMUNICATION_DATA_TYPE, C.COMMUNICATION_DATA_TYPE_DEFAULT)
+        self.sparse_gradients_enabled = d.get(C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        self.wall_clock_breakdown = d.get(C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = d.get(C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
+        self.dataloader_drop_last = d.get(C.DATALOADER_DROP_LAST, C.DATALOADER_DROP_LAST_DEFAULT)
+
+        self.pld_enabled = d.get(C.PLD, {}).get(C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT)
+        self.pld_params = d.get(C.PLD, {}) if self.pld_enabled else False
+        self.curriculum_enabled_legacy = d.get(C.CURRICULUM_LEARNING, {}).get(
+            C.CURRICULUM_ENABLED, C.CURRICULUM_ENABLED_DEFAULT)
+        self.curriculum_params_legacy = d.get(C.CURRICULUM_LEARNING, {})
+        self.data_efficiency_config = d.get(C.DATA_EFFICIENCY, {})
+
+        self.eigenvalue_enabled = d.get(C.EIGENVALUE, {}).get(
+            C.EIGENVALUE_ENABLED, C.EIGENVALUE_ENABLED_DEFAULT)
+        self.eigenvalue_params = d.get(C.EIGENVALUE, {})
+        self.sparse_attention = d.get(C.SPARSE_ATTENTION)
+        self.autotuning_config = d.get(C.AUTOTUNING, {})
+        self.elasticity_config = d.get(C.ELASTICITY, {})
+        self.compression_config = d.get("compression_training", {})
+        self.aio_config = d.get("aio", {})
+
+        # --- batch triangle ---
+        if world_size is None:
+            if mpu is not None:
+                world_size = mpu.get_data_parallel_world_size()
+            else:
+                # Data-parallel world = devices not consumed by model/pipe/seq.
+                # (The expert axis folds into data for batch purposes: ep <= dp,
+                # as in the reference's expert+data group factory.)
+                non_data = self.mesh.model * self.mesh.pipe * self.mesh.seq
+                world_size = int(os.environ.get("WORLD_SIZE", 1)) // max(1, non_data)
+                world_size = max(1, world_size)
+        self.world_size = world_size
+        tb = d.get(C.TRAIN_BATCH_SIZE)
+        mb = d.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        gas = d.get(C.GRADIENT_ACCUMULATION_STEPS)
+        tb = None if tb == "auto" else tb
+        mb = None if mb == "auto" else mb
+        gas = None if gas == "auto" else gas
+        (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+         self.gradient_accumulation_steps) = _resolve_batch_triangle(tb, mb, gas, world_size)
+
+        # checkpoint knobs (flattened accessors used by the engine)
+        self.checkpoint_tag_validation_enabled = self.checkpoint_config.tag_validation != "Ignore"
+        self.checkpoint_tag_validation_fail = self.checkpoint_config.tag_validation == "Fail"
+        self.load_universal_checkpoint = self.checkpoint_config.load_universal
+        self.use_node_local_storage = self.checkpoint_config.use_node_local_storage
+
+    # ------------------------------------------------------------------
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self) -> int:
+        return self.zero_config.stage
+
+    @property
+    def precision_dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    def print_user_config(self):
+        logger.info("  json = {}".format(
+            json.dumps(self._param_dict, sort_keys=True, indent=4, default=str)))
+
+    def print(self, name):
+        logger.info(f"{name}:")
+        for key in sorted(self.__dict__):
+            if key != "_param_dict":
+                logger.info(f"  {key} {self.__dict__[key]}")
+        self.print_user_config()

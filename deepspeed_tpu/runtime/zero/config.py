@@ -1,0 +1,105 @@
+"""ZeRO configuration.
+
+Capability parity with the reference ``deepspeed/runtime/zero/config.py``
+(``DeepSpeedZeroConfig``) and ``offload_config.py``. On TPU the stages map to
+GSPMD sharding policies over the ``data`` mesh axis rather than explicit
+partition bookkeeping:
+
+- stage 1: optimizer state sharded over ``data`` ("weight-update sharding")
+- stage 2: + gradients reduce-scattered over ``data``
+- stage 3: + parameters sharded over ``data`` (gather-per-use by XLA)
+
+Bucket sizes / overlap knobs are accepted for config compatibility; where XLA
+already performs the optimization (e.g. comm/compute overlap via the
+latency-hiding scheduler) they are recorded but have no direct effect.
+"""
+
+from enum import Enum
+from typing import Optional
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class OffloadDeviceEnum(str, Enum):
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    """Parameter offload (ZeRO-3): reference ``offload_config.py:19``."""
+
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(5, ge=0)
+    buffer_size: int = Field(100_000_000, ge=0)
+    max_in_cpu: int = Field(1_000_000_000, ge=0)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    """Optimizer offload (ZeRO-1/2/3): reference ``offload_config.py:50``."""
+
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(4, ge=0)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+
+    @property
+    def pipeline(self):
+        return self.pipeline_read or self.pipeline_write
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    """``zero_optimization`` section: reference ``runtime/zero/config.py:76``."""
+
+    stage: int = Field(0, ge=0, le=3)
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = Field(500_000_000, ge=0)
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(500_000_000, ge=0)
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+    sub_group_size: int = Field(1_000_000_000, ge=0)
+    cpu_offload_param: Optional[bool] = Field(
+        None, json_schema_extra={"deprecated": True, "new_param": "offload_param", "set_new_param": False}
+    )
+    cpu_offload_use_pin_memory: Optional[bool] = Field(
+        None, json_schema_extra={"deprecated": True, "set_new_param": False}
+    )
+    cpu_offload: Optional[bool] = Field(
+        None, json_schema_extra={"deprecated": True, "new_param": "offload_optimizer", "set_new_param": False}
+    )
+    prefetch_bucket_size: int = Field(50_000_000, ge=0, alias="stage3_prefetch_bucket_size")
+    param_persistence_threshold: int = Field(100_000, ge=0, alias="stage3_param_persistence_threshold")
+    model_persistence_threshold: int = Field(2**63 - 1, ge=0, alias="stage3_model_persistence_threshold")
+    max_live_parameters: int = Field(1_000_000_000, ge=0, alias="stage3_max_live_parameters")
+    max_reuse_distance: int = Field(1_000_000_000, ge=0, alias="stage3_max_reuse_distance")
+    gather_16bit_weights_on_model_save: bool = Field(False, alias="stage3_gather_16bit_weights_on_model_save")
+    stage3_gather_fp16_weights_on_model_save: bool = Field(
+        False, json_schema_extra={"deprecated": True, "new_param": "gather_16bit_weights_on_model_save"}
+    )
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False
+
+    @model_validator(mode="after")
+    def _legacy_offload_flags(self):
+        # object.__setattr__: plain assignment would re-run validators
+        # (validate_assignment) and double-log deprecation warnings.
+        if self.cpu_offload is True and self.offload_optimizer is None:
+            object.__setattr__(self, "offload_optimizer",
+                               DeepSpeedZeroOffloadOptimizerConfig(device="cpu"))
+        if self.cpu_offload_param is True and self.offload_param is None:
+            object.__setattr__(self, "offload_param",
+                               DeepSpeedZeroOffloadParamConfig(device="cpu"))
+        return self

@@ -1,0 +1,104 @@
+"""Rank-aware logging utilities.
+
+Capability parity with the reference ``deepspeed/utils/logging.py`` (logger,
+``log_dist`` rank filtering, ``print_rank_0``), re-based on JAX process indices
+instead of ``torch.distributed`` ranks.
+"""
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class _RankFilter(logging.Filter):
+    """Prepend the process index to every record (lazy: jax may not be up yet)."""
+
+    def filter(self, record):
+        record.rank = _process_index()
+        return True
+
+
+def _process_index() -> int:
+    """Current process index without forcing distributed init.
+
+    Only asks JAX once a backend already exists — logging must never be the
+    thing that initializes the runtime (that would break a later
+    ``jax.distributed.initialize()`` on multi-host pods). Before that, falls
+    back to env vars (mirrors the reference reading ``RANK`` from the env).
+    """
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            from jax._src import xla_bridge
+
+            if xla_bridge._backends:  # backend already up: safe & authoritative
+                return jax.process_index()
+        except Exception:
+            pass
+    return int(os.environ.get("RANK", os.environ.get("JAX_PROCESS_INDEX", 0)))
+
+
+def create_logger(name="deepspeed_tpu", level=logging.INFO) -> logging.Logger:
+    logger_ = logging.getLogger(name)
+    if logger_.handlers:
+        return logger_
+    logger_.setLevel(level)
+    logger_.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setLevel(level)
+    formatter = logging.Formatter(
+        "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d:%(funcName)s] %(message)s"
+    )
+    handler.setFormatter(formatter)
+    handler.addFilter(_RankFilter())
+    logger_.addHandler(handler)
+    return logger_
+
+
+logger = create_logger()
+
+
+@functools.lru_cache(None)
+def warn_once(msg: str):
+    logger.warning(msg)
+
+
+def _should_log(ranks) -> bool:
+    my_rank = _process_index()
+    if ranks is None:
+        return True
+    return my_rank in ranks or (-1 in ranks)
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the given process indices (None → all)."""
+    if _should_log(ranks):
+        logger.log(level, f"[Rank {_process_index()}] {message}")
+
+
+def print_rank_0(message):
+    if _process_index() == 0:
+        print(message, flush=True)
+
+
+def get_current_level() -> int:
+    return logger.getEffectiveLevel()
+
+
+def should_log_le(max_log_level_str: str) -> bool:
+    """True if the logger's effective level is <= the named level."""
+    if not isinstance(max_log_level_str, str):
+        raise ValueError("max_log_level_str must be a string")
+    level = LOG_LEVELS.get(max_log_level_str.lower())
+    if level is None:
+        raise ValueError(f"Unknown log level: {max_log_level_str}")
+    return get_current_level() <= level

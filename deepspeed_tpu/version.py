@@ -1,0 +1,4 @@
+"""Version of the deepspeed_tpu framework."""
+
+__version__ = "0.1.0"
+__version_info__ = tuple(int(p) for p in __version__.split("."))

@@ -1,0 +1,29 @@
+"""Test harness: fake an 8-device TPU-like mesh on CPU.
+
+The reference simulates a cluster with N forked NCCL processes on one node
+(``tests/unit/common.py``). The TPU-native equivalent is XLA's virtual host
+devices: one process, 8 CPU devices, real GSPMD partitioning + collectives.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    assert jax.device_count() >= 8, (
+        "tests expect >=8 virtual CPU devices; got "
+        f"{jax.device_count()} ({jax.devices()[0].platform})"
+    )

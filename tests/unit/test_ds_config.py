@@ -1,0 +1,133 @@
+"""Config-system tests (mirrors reference ``tests/unit/runtime/test_ds_config_dict.py``
+and ``test_ds_config_model.py``)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+
+class TestBatchTriangle:
+    def test_all_given_consistent(self):
+        cfg = DeepSpeedConfig(
+            {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+             "gradient_accumulation_steps": 2}, world_size=4)
+        assert cfg.train_batch_size == 32
+        assert cfg.train_micro_batch_size_per_gpu == 4
+        assert cfg.gradient_accumulation_steps == 2
+
+    def test_all_given_inconsistent(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig(
+                {"train_batch_size": 33, "train_micro_batch_size_per_gpu": 4,
+                 "gradient_accumulation_steps": 2}, world_size=4)
+
+    def test_infer_gas(self):
+        cfg = DeepSpeedConfig(
+            {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4}, world_size=4)
+        assert cfg.gradient_accumulation_steps == 2
+
+    def test_infer_micro(self):
+        cfg = DeepSpeedConfig(
+            {"train_batch_size": 32, "gradient_accumulation_steps": 2}, world_size=4)
+        assert cfg.train_micro_batch_size_per_gpu == 4
+
+    def test_infer_train(self):
+        cfg = DeepSpeedConfig(
+            {"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
+            world_size=4)
+        assert cfg.train_batch_size == 32
+
+    def test_only_train(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 32}, world_size=4)
+        assert cfg.train_micro_batch_size_per_gpu == 8
+        assert cfg.gradient_accumulation_steps == 1
+
+    def test_only_micro(self):
+        cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4}, world_size=4)
+        assert cfg.train_batch_size == 16
+
+    def test_none_given(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({}, world_size=4)
+
+    def test_indivisible(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_batch_size": 33}, world_size=4)
+
+
+class TestZeroConfig:
+    def test_defaults(self):
+        z = DeepSpeedZeroConfig()
+        assert z.stage == 0
+        assert z.reduce_bucket_size == 500_000_000
+
+    def test_stage_range(self):
+        with pytest.raises(Exception):
+            DeepSpeedZeroConfig(stage=4)
+
+    def test_aliases(self):
+        z = DeepSpeedZeroConfig(**{"stage": 3, "stage3_prefetch_bucket_size": 123})
+        assert z.prefetch_bucket_size == 123
+
+    def test_deprecated_cpu_offload(self):
+        z = DeepSpeedZeroConfig(**{"stage": 2, "cpu_offload": True})
+        assert z.offload_optimizer is not None
+        assert z.offload_optimizer.device == "cpu"
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(Exception):
+            DeepSpeedZeroConfig(not_a_real_key=1)
+
+
+class TestMasterConfig:
+    def test_json_file(self, tmp_path):
+        p = tmp_path / "ds_config.json"
+        p.write_text(json.dumps({
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "fp16": {"enabled": False},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2},
+            "gradient_clipping": 1.0,
+        }))
+        cfg = DeepSpeedConfig(str(p), world_size=8)
+        assert cfg.optimizer_name == "adam"
+        assert cfg.bf16.enabled
+        assert not cfg.fp16.enabled
+        assert cfg.zero_optimization_stage == 2
+        assert cfg.gradient_clipping == 1.0
+        assert cfg.zero_enabled
+
+    def test_duplicate_keys_rejected(self, tmp_path):
+        p = tmp_path / "dup.json"
+        p.write_text('{"train_batch_size": 8, "train_batch_size": 4}')
+        with pytest.raises(ValueError):
+            DeepSpeedConfig(str(p), world_size=1)
+
+    def test_fp16_and_bf16_conflict(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_batch_size": 8,
+                             "fp16": {"enabled": True},
+                             "bf16": {"enabled": True}}, world_size=1)
+
+    def test_auto_values_ignored(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8,
+                               "gradient_accumulation_steps": "auto"}, world_size=1)
+        assert cfg.gradient_accumulation_steps == 1
+
+    def test_loss_scale_props(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8,
+                               "fp16": {"enabled": True, "initial_scale_power": 8}},
+                              world_size=1)
+        assert cfg.fp16.dynamic_loss_scale
+        assert cfg.fp16.initial_dynamic_scale == 256
+
+    def test_mesh_section(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8,
+                               "mesh": {"data": 2, "model": 4}}, world_size=2)
+        assert cfg.mesh.data == 2
+        assert cfg.mesh.model == 4
+        assert cfg.mesh.pipe == 1

@@ -1,0 +1,51 @@
+"""Pluggable communication backend (reference ``deepspeed/comm/backend.py:21``).
+
+The default (and on TPU, only sensible) backend is :class:`XlaBackend`: inside
+traced code, collectives lower to XLA HLO collectives over ICI/DCN; outside
+traced code, host-level agreement goes through the JAX distributed runtime
+(coordination service), replacing the reference's torch.distributed/NCCL
+``TorchBackend`` (``deepspeed/comm/torch.py:11``).
+"""
+
+
+class Backend:
+    def __init__(self, name="backend", rank=0, size=1):
+        self.name = name
+        self.world_group = None
+        self.world_size = size
+        self.world_rank = rank
+        self.process_groups = []
+        self.initialized = False
+
+    def is_initialized(self):
+        return self.initialized
+
+    def new_group(self, ranks):
+        raise NotImplementedError
+
+    def init_process_group(self):
+        self.initialized = True
+
+
+class XlaBackend(Backend):
+    """JAX/XLA-native backend.
+
+    "Ranks" map as: device-level parallelism is expressed through the mesh
+    (one Python process drives many devices), while process-level rank/size
+    come from ``jax.process_index()/process_count()`` for multi-host pods.
+    """
+
+    def __init__(self, name="xla"):
+        import jax
+
+        super().__init__(name=name,
+                         rank=jax.process_index(),
+                         size=jax.process_count())
+        self.initialized = True
+
+    def new_group(self, ranks):
+        # Process groups are mesh axis names on TPU; arbitrary rank-list
+        # groups are not meaningful under GSPMD.
+        raise NotImplementedError(
+            "XlaBackend does not create rank-list groups; use mesh axis names "
+            "(see deepspeed_tpu.parallel.topology)")

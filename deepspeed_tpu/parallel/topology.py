@@ -1,0 +1,276 @@
+"""Process topology → JAX device mesh.
+
+Capability parity with the reference ``deepspeed/runtime/pipe/topology.py``
+(``ProcessTopology``, ``PipeDataParallelTopology``,
+``PipeModelDataParallelTopology``) and ``deepspeed/utils/groups.py`` (process
+group factory). The TPU-native design collapses "process groups" into named
+axes of a single ``jax.sharding.Mesh``: a reference process group along axis X
+is simply the mesh axis name ``"X"``, and collectives over it are
+``jax.lax.*`` ops bound to that name (or shardings referencing it).
+
+Axis names (canonical order, outermost first):
+    pipe > data > expert > seq > model
+
+- ``data``: ZeRO/DP axis — batch sharded, grads reduced here.
+- ``model``: tensor parallelism — weight dims sharded here (innermost: TP
+  collectives are latency-sensitive, so they ride the fastest ICI loops).
+- ``expert``: MoE all-to-all axis (folds into ``data`` for batch math).
+- ``seq``: sequence/context parallelism (ring attention).
+- ``pipe``: pipeline stages (outermost: only p2p neighbor traffic).
+"""
+
+import collections
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+AXIS_PIPE = "pipe"
+AXIS_DATA = "data"
+AXIS_EXPERT = "expert"
+AXIS_SEQ = "seq"
+AXIS_MODEL = "model"
+
+CANONICAL_AXIS_ORDER = (AXIS_PIPE, AXIS_DATA, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
+
+ProcessCoord = collections.namedtuple  # built per-topology below
+
+
+class ProcessTopology:
+    """Cartesian topology mapping ranks <-> axis coordinates.
+
+    Mirrors the reference ``ProcessTopology`` (``runtime/pipe/topology.py:9``):
+    axes is a list of axis names, dims the sizes. Rank 0 is coordinate
+    (0, ..., 0) and the *last* axis varies fastest.
+    """
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        assert len(axes) == len(dims)
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = collections.namedtuple("ProcessCoord", self.axes)
+        self.mapping = {}
+        ranges = [range(d) for d in self.dims]
+        for global_rank, coord in enumerate(itertools.product(*ranges)):
+            key = dict(zip(self.axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = global_rank
+
+    def get_rank(self, **coord_kwargs) -> int:
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() does not support slices, got {coord_kwargs}")
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"coord {coord_kwargs} not in topology"
+        return self.mapping[key]
+
+    def get_axis_names(self) -> List[str]:
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=(AXIS_DATA, AXIS_PIPE), inner_sep="_", outer_sep="-"):
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.get_axis_names() if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis: str) -> int:
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank: int):
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Groups of ranks that would communicate along ``axis``
+        (reference ``get_axis_comm_lists``)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for combo in itertools.product(*ranges):
+            other_keys = dict(zip(other_axes, combo))
+            sub = [self.get_rank(**other_keys, **{axis: i}) for i in range(self.get_dim(axis))]
+            lists.append(sub)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        """All ranks whose coordinates match the given axis values."""
+
+        def _matches(coord):
+            for k, v in filter_kwargs.items():
+                if getattr(coord, k) != v:
+                    return False
+            return True
+
+        return [self.mapping[c] for c in sorted(self.mapping.keys(), key=lambda c: self.mapping[c])
+                if _matches(c)]
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        return self.filter_match(**{axis: idx})
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(self.dims)) if self.dims else 1
+
+    def __str__(self):
+        return f"ProcessTopology(axes={self.axes}, dims={self.dims})"
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """Reference ``topology.py:232`` — pipe outer, data inner."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=[AXIS_PIPE, AXIS_DATA], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """Reference ``topology.py:243`` — pipe > data > model."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=[AXIS_PIPE, AXIS_DATA, AXIS_MODEL], dims=[num_pp, num_dp, num_mp])
+
+
+def _normalize_axis_sizes(axis_sizes: Dict[str, int], n_devices: int) -> Dict[str, int]:
+    """Resolve -1 (fill) entries and validate the product against n_devices."""
+    unknown = set(axis_sizes) - set(CANONICAL_AXIS_ORDER)
+    if unknown:
+        raise ValueError(
+            f"Unknown mesh axis name(s) {sorted(unknown)}; valid axes are "
+            f"{list(CANONICAL_AXIS_ORDER)}")
+    sizes = {a: int(axis_sizes.get(a, 1)) for a in CANONICAL_AXIS_ORDER}
+    fill_axes = [a for a, s in sizes.items() if s == -1]
+    if len(fill_axes) > 1:
+        raise ValueError(f"At most one mesh axis may be -1 (fill); got {fill_axes}")
+    fixed = int(np.prod([s for s in sizes.values() if s != -1]))
+    if fill_axes:
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"Device count {n_devices} not divisible by fixed axes product {fixed}")
+        sizes[fill_axes[0]] = n_devices // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total != n_devices:
+        raise ValueError(
+            f"Mesh axis sizes {sizes} multiply to {total}, but {n_devices} devices are present")
+    return sizes
+
+
+class MeshTopology:
+    """Named-axis device mesh for the whole job.
+
+    The TPU-native analog of the reference ``PipelineParallelGrid``
+    (``runtime/pipe/topology.py:249``): owns the ``jax.sharding.Mesh`` and
+    answers the group-query API (``get_data_parallel_world_size()`` etc.).
+
+    The physical device order is chosen by ``mesh_utils.create_device_mesh``
+    so that inner axes (model/seq) land on the fastest ICI loops.
+    """
+
+    def __init__(self,
+                 axis_sizes: Optional[Dict[str, int]] = None,
+                 devices=None,
+                 mesh=None):
+        import jax
+        from jax.sharding import Mesh
+
+        if mesh is not None:
+            self.mesh = mesh
+            self.axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            for a in CANONICAL_AXIS_ORDER:
+                self.axis_sizes.setdefault(a, 1)
+        else:
+            devices = devices if devices is not None else jax.devices()
+            axis_sizes = dict(axis_sizes or {})
+            axis_sizes.setdefault(AXIS_DATA, -1)
+            sizes = _normalize_axis_sizes(axis_sizes, len(devices))
+            self.axis_sizes = sizes
+            shape = tuple(sizes[a] for a in CANONICAL_AXIS_ORDER)
+            try:
+                from jax.experimental import mesh_utils
+
+                device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+            except Exception:  # non-TPU platforms (CPU test meshes)
+                device_array = np.asarray(devices).reshape(shape)
+            self.mesh = Mesh(device_array, CANONICAL_AXIS_ORDER)
+
+        self.topology = ProcessTopology(
+            axes=list(self.mesh.axis_names),
+            dims=[self.axis_sizes[a] for a in self.mesh.axis_names])
+
+    # ------------------------------------------------------------------
+    # group-query API (reference deepspeed/utils/groups.py surface)
+    def get_data_parallel_world_size(self) -> int:
+        return self.axis_sizes[AXIS_DATA] * self.axis_sizes[AXIS_EXPERT]
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.axis_sizes[AXIS_MODEL]
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.axis_sizes[AXIS_PIPE]
+
+    def get_expert_parallel_world_size(self) -> int:
+        return self.axis_sizes[AXIS_EXPERT]
+
+    def get_sequence_parallel_world_size(self) -> int:
+        return self.axis_sizes[AXIS_SEQ]
+
+    def get_slice_parallel_world_size(self) -> int:  # reference alias of MP
+        return self.get_model_parallel_world_size()
+
+    def get_data_parallel_group(self):
+        """Groups are axis names on TPU. Batch/grad math spans data+expert."""
+        return (AXIS_DATA, AXIS_EXPERT)
+
+    def get_model_parallel_group(self):
+        return AXIS_MODEL
+
+    def get_pipe_parallel_group(self):
+        return AXIS_PIPE
+
+    def get_expert_parallel_group(self):
+        return AXIS_EXPERT
+
+    def get_sequence_parallel_group(self):
+        return AXIS_SEQ
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.size
+
+    def axis_size(self, axis: str) -> int:
+        return self.axis_sizes.get(axis, 1)
+
+    def __repr__(self):
+        live = {a: s for a, s in self.axis_sizes.items() if s > 1}
+        return f"MeshTopology({live or {AXIS_DATA: 1}}, world_size={self.world_size})"
+
+
+# ----------------------------------------------------------------------
+# Global topology registry (reference deepspeed/utils/groups.py module state)
+_WORLD_TOPOLOGY: Optional[MeshTopology] = None
+
+
+def set_topology(topo: MeshTopology):
+    global _WORLD_TOPOLOGY
+    if _WORLD_TOPOLOGY is not None and _WORLD_TOPOLOGY.mesh is not topo.mesh:
+        logger.info(f"Replacing global mesh topology with {topo}")
+    _WORLD_TOPOLOGY = topo
+
+
+def get_topology(create_if_missing: bool = True) -> Optional[MeshTopology]:
+    global _WORLD_TOPOLOGY
+    if _WORLD_TOPOLOGY is None and create_if_missing:
+        _WORLD_TOPOLOGY = MeshTopology()
+    return _WORLD_TOPOLOGY
+
+
+def reset_topology():
+    global _WORLD_TOPOLOGY
+    _WORLD_TOPOLOGY = None

@@ -1,0 +1,96 @@
+"""Process-group query API (reference ``deepspeed/utils/groups.py``).
+
+Thin module-level wrappers over the global :class:`MeshTopology`; "groups"
+are mesh axis names. Kept as a separate module so user code porting from the
+reference (`from deepspeed.utils import groups`) maps one-to-one.
+"""
+
+from deepspeed_tpu.parallel import topology as _topo
+from deepspeed_tpu.parallel.topology import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+    AXIS_PIPE,
+    AXIS_SEQ,
+)
+
+
+def _t():
+    t = _topo.get_topology(create_if_missing=False)
+    if t is None:
+        raise RuntimeError(
+            "mesh topology not initialized — call deepspeed_tpu.initialize() or "
+            "deepspeed_tpu.parallel.topology.set_topology() first")
+    return t
+
+
+def initialize(topology=None, axis_sizes=None, mesh=None):
+    """Create and register the global topology (reference ``groups.initialize``)."""
+    if topology is None:
+        topology = _topo.MeshTopology(axis_sizes=axis_sizes, mesh=mesh)
+    _topo.set_topology(topology)
+    return topology
+
+
+def get_data_parallel_group():
+    return _t().get_data_parallel_group()
+
+
+def get_data_parallel_world_size():
+    return _t().get_data_parallel_world_size()
+
+
+def get_data_parallel_rank():
+    # Under single-controller SPMD there is no per-device Python rank; the
+    # engine is rank-free. Host-level rank is the process index.
+    import jax
+
+    return jax.process_index()
+
+
+def get_model_parallel_group():
+    return _t().get_model_parallel_group()
+
+
+def get_model_parallel_world_size():
+    return _t().get_model_parallel_world_size()
+
+
+def get_expert_parallel_group(name=None):
+    return _t().get_expert_parallel_group()
+
+
+def get_expert_parallel_world_size(name=None):
+    return _t().get_expert_parallel_world_size()
+
+
+def get_expert_data_parallel_group(name=None):
+    return AXIS_DATA
+
+
+def get_expert_data_parallel_world_size(name=None):
+    return _t().axis_size(AXIS_DATA)
+
+
+def get_pipe_parallel_group():
+    return _t().get_pipe_parallel_group()
+
+
+def get_pipe_parallel_world_size():
+    return _t().get_pipe_parallel_world_size()
+
+
+def get_sequence_parallel_group():
+    return _t().get_sequence_parallel_group()
+
+
+def get_sequence_parallel_world_size():
+    return _t().get_sequence_parallel_world_size()
+
+
+def get_slice_parallel_group():
+    return _t().get_model_parallel_group()
+
+
+def get_max_expert_size():
+    return _t().get_expert_parallel_world_size()

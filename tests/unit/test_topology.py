@@ -1,0 +1,85 @@
+"""Topology tests (mirrors reference ``tests/unit/runtime/pipe/test_topology.py``)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (
+    MeshTopology,
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    ProcessTopology,
+    reset_topology,
+)
+
+
+class TestProcessTopology:
+    def test_topology_2d(self):
+        topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+        assert topo.world_size == 4
+        assert topo.get_rank(row=0, col=0) == 0
+        assert topo.get_rank(row=0, col=1) == 1
+        assert topo.get_rank(row=1, col=0) == 2
+        assert topo.get_rank(row=1, col=1) == 3
+        assert topo.get_axis_list("row", 0) == [0, 1]
+        assert topo.get_axis_list("col", 0) == [0, 2]
+
+    def test_topology_comm_lists(self):
+        topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+        assert topo.get_axis_comm_lists("pipe") == [[0, 2], [1, 3]]
+        assert topo.get_axis_comm_lists("data") == [[0, 1], [2, 3]]
+        assert topo.get_axis_comm_lists("model") == []
+
+    def test_topology_3d(self):
+        topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+        assert topo.world_size == 8
+        coord = topo.get_coord(5)
+        assert topo.get_rank(pipe=coord.pipe, data=coord.data, model=coord.model) == 5
+
+    def test_filter_match(self):
+        topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+        ranks = topo.filter_match(pipe=0, model=1)
+        assert all(topo.get_coord(r).pipe == 0 and topo.get_coord(r).model == 1 for r in ranks)
+        assert len(ranks) == 2
+
+    def test_get_rank_repr(self):
+        topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+        assert "model_00" in topo.get_rank_repr(rank=0)
+
+
+class TestMeshTopology:
+    def setup_method(self):
+        reset_topology()
+
+    def test_default_all_data(self):
+        t = MeshTopology()
+        assert t.get_data_parallel_world_size() == 8
+        assert t.get_model_parallel_world_size() == 1
+        assert t.world_size == 8
+
+    def test_data_model_split(self):
+        t = MeshTopology(axis_sizes={"data": 2, "model": 4})
+        assert t.get_data_parallel_world_size() == 2
+        assert t.get_model_parallel_world_size() == 4
+        assert t.mesh.shape["model"] == 4
+
+    def test_fill_axis(self):
+        t = MeshTopology(axis_sizes={"model": 2})
+        assert t.get_data_parallel_world_size() == 4
+
+    def test_bad_product(self):
+        with pytest.raises(ValueError):
+            MeshTopology(axis_sizes={"data": 3, "model": 2})
+
+    def test_expert_counts_in_dp(self):
+        t = MeshTopology(axis_sizes={"data": 2, "expert": 4})
+        assert t.get_expert_parallel_world_size() == 4
+        assert t.get_data_parallel_world_size() == 8  # expert folds into data
+
+    def test_from_existing_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+        import numpy as np
+
+        mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "model"))
+        t = MeshTopology(mesh=mesh)
+        assert t.get_data_parallel_world_size() == 4
+        assert t.get_model_parallel_world_size() == 2

@@ -1,0 +1,242 @@
+"""GPT-2 family, TPU-first.
+
+The flagship training model (BASELINE.json config #1: GPT-2 125M). Built for
+the sharded engine: weights carry logical partitioning metadata (consumed by
+the ZeRO/TP partitioner), layers can run under ``lax.scan`` (one compiled
+layer body — fast compiles, per-layer ZeRO-3 gather), and attention routes
+through ``deepspeed_tpu.ops.attention`` (Pallas flash kernel on TPU).
+
+Capability reference: the reference wraps HF/Megatron GPT-2 via
+``DeepSpeedEngine`` and injects fused kernels
+(``deepspeed/ops/transformer/transformer.py:459``); here the model is native.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16  # compute/activation dtype (params kept fp32)
+    scan_layers: bool = True
+    remat: bool = False  # activation checkpointing over blocks
+    use_flash: Optional[bool] = None
+
+    @staticmethod
+    def gpt2_125m(**kw):
+        return GPT2Config(n_embd=768, n_layer=12, n_head=12, **kw)
+
+    @staticmethod
+    def gpt2_350m(**kw):
+        return GPT2Config(n_embd=1024, n_layer=24, n_head=16, **kw)
+
+    @staticmethod
+    def tiny(**kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("n_positions", 64)
+        return GPT2Config(n_embd=64, n_layer=2, n_head=4, **kw)
+
+
+def _dense_init(scale=0.02):
+    return nn.initializers.normal(stddev=scale)
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        B, T, C = x.shape
+        head_dim = cfg.n_embd // cfg.n_head
+        # fused QKV projection: one big matmul for the MXU
+        qkv = nn.Dense(3 * cfg.n_embd, dtype=cfg.dtype, kernel_init=_dense_init(),
+                       name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
+        y = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
+        y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
+        y = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
+                     kernel_init=_dense_init(0.02 / (2 * cfg.n_layer) ** 0.5),
+                     name="c_proj")(y)
+        if cfg.dropout > 0:
+            y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return y
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, kernel_init=_dense_init(),
+                     name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
+                     kernel_init=_dense_init(0.02 / (2 * cfg.n_layer) ** 0.5),
+                     name="c_proj")(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_1")(x),
+            deterministic=deterministic)
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_2")(x),
+            deterministic=deterministic)
+        return x
+
+
+class _ScanBody(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic):
+        cfg = self.config
+        block_cls = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
+        x = block_cls(cfg, name="block")(x, deterministic=deterministic)
+        return x, None
+
+
+class ScanBlocks(nn.Module):
+    """All transformer blocks as one scanned body: params get a leading
+    ``n_layer`` axis, XLA compiles a single block, ZeRO-3 gathers one layer's
+    params per scan step instead of the whole stack."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        ScannedBlock = nn.scan(
+            _ScanBody,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=nn.broadcast,
+            length=cfg.n_layer,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"},
+        )
+        x, _ = ScannedBlock(cfg, name="h")(x, deterministic)
+        return x
+
+
+class LoopBlocks(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        block_cls = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
+        for i in range(cfg.n_layer):
+            x = block_cls(cfg, name=f"h_{i}")(x, deterministic=deterministic)
+        return x
+
+
+class GPT2LMHeadModel(nn.Module):
+    """GPT-2 with tied-embedding LM head.
+
+    ``__call__(input_ids)`` → logits. ``loss(params, batch)`` (via
+    :func:`gpt2_loss_fn`) is the engine-facing objective.
+    """
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        wte = self.param("wte", _dense_init(), (cfg.vocab_size, cfg.n_embd), jnp.float32)
+        wpe = self.param("wpe", _dense_init(0.01), (cfg.n_positions, cfg.n_embd), jnp.float32)
+        x = wte[input_ids].astype(cfg.dtype) + wpe[None, :T].astype(cfg.dtype)
+        if cfg.dropout > 0:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        blocks = ScanBlocks if cfg.scan_layers else LoopBlocks
+        x = blocks(cfg, name="transformer")(x, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
+        # tied LM head; logits in fp32 for a stable softmax-xent
+        logits = jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits
+
+
+def cross_entropy_loss(logits, labels, ignore_index: int = -100):
+    """Mean token cross-entropy, masked where ``labels == ignore_index``."""
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    gold = jnp.take_along_axis(logits.astype(jnp.float32),
+                               safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * valid
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+class GPT2ForTraining:
+    """Engine-ready wrapper: ``initialize(model=GPT2ForTraining(cfg))``.
+
+    Exposes the engine contract — ``loss_fn(params, batch, rngs)`` and
+    ``init(rng, batch)`` — around :class:`GPT2LMHeadModel`.
+    """
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+        self.model = GPT2LMHeadModel(config)
+        self.loss_fn = gpt2_loss_fn(self.model)
+
+    @staticmethod
+    def _input_ids(batch):
+        if isinstance(batch, dict):
+            return batch["input_ids"]
+        if isinstance(batch, (tuple, list)):
+            return batch[0]
+        return batch
+
+    def init(self, rng, batch):
+        return self.model.init(rng, self._input_ids(batch))
+
+    def apply(self, variables, batch, rngs=None):
+        return self.model.apply(variables, self._input_ids(batch), rngs=rngs)
+
+
+def gpt2_loss_fn(model: GPT2LMHeadModel):
+    """Engine-facing loss: ``fn(params, batch, rngs=None) -> loss``.
+
+    ``batch`` is ``(input_ids, labels)`` or a dict with those keys; standard
+    next-token objective (labels shifted internally).
+    """
+
+    def loss_fn(params, batch, rngs=None):
+        if isinstance(batch, dict):
+            input_ids, labels = batch["input_ids"], batch.get("labels")
+        else:
+            input_ids, labels = batch
+        if labels is None:
+            labels = input_ids
+        logits = model.apply({"params": params}, input_ids,
+                             deterministic=rngs is None, rngs=rngs)
+        return cross_entropy_loss(logits[:, :-1], labels[:, 1:])
+
+    return loss_fn

@@ -1,0 +1,65 @@
+"""Attention ops.
+
+The XLA reference implementation lives here; the Pallas flash-attention
+kernel (replacing the reference's fused CUDA attention in
+``csrc/transformer/softmax_kernels.cu`` + ``transform_kernels.cu``) plugs in
+behind the same signature and is selected automatically on TPU.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def attention_reference(q, k, v, mask=None, causal=True, softmax_scale=None,
+                        dropout_rate=0.0, dropout_rng=None):
+    """Plain XLA attention: q,k,v [batch, heads, seq, head_dim].
+
+    Softmax in fp32 regardless of input dtype (the reference CUDA softmax
+    also accumulates in fp32: ``csrc/transformer/softmax_kernels.cu``).
+    """
+    *_, q_len, head_dim = q.shape
+    k_len = k.shape[-2]
+    scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
+    logits = jnp.einsum("...qd,...kd->...qk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((q_len, k_len), dtype=bool), k=k_len - q_len)
+        logits = jnp.where(causal_mask, logits, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("...qk,...kd->...qd", probs, v)
+
+
+def attention(q, k, v, mask=None, causal=True, softmax_scale=None,
+              dropout_rate=0.0, dropout_rng=None, use_flash: Optional[bool] = None):
+    """Dispatching attention entry point.
+
+    ``use_flash=None`` → Pallas flash kernel on TPU when shapes allow,
+    XLA reference otherwise.
+    """
+    if use_flash is None:
+        use_flash = _on_tpu() and dropout_rate == 0.0 and mask is None
+    if use_flash:
+        try:
+            from deepspeed_tpu.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+        except (ImportError, NotImplementedError):
+            pass
+    return attention_reference(q, k, v, mask=mask, causal=causal,
+                               softmax_scale=softmax_scale,
+                               dropout_rate=dropout_rate, dropout_rng=dropout_rng)

@@ -1,0 +1,125 @@
+"""Checkpoint engine abstraction (reference
+``runtime/checkpoint_engine/checkpoint_engine.py``: pluggable
+save/load/commit used by the engine; Torch and Nebula impls).
+
+Implementations here:
+- :class:`ArrayCheckpointEngine` — synchronous npz+json format (the
+  ``TorchCheckpointEngine`` equivalent).
+- :class:`OrbaxCheckpointEngine` — async sharded checkpointing via orbax
+  (the Nebula-equivalent async tier), used when ``checkpoint.async_save``.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class CheckpointEngine:
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag):
+        log_dist(f"[ckpt] Saving checkpoint: {tag}", ranks=[0])
+
+    def save(self, state_dict: Dict[str, Any], path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def commit(self, tag):
+        return True
+
+    def makedirs(self, path, exist_ok=False):
+        os.makedirs(path, exist_ok=exist_ok)
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    """Flatten nested dict/tuple/list/namedtuple structure to {path: leaf}."""
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    elif hasattr(tree, "_fields"):  # namedtuple
+        for k in tree._fields:
+            out.update(_flatten(getattr(tree, k), f"{prefix}{k}/"))
+    elif isinstance(tree, (tuple, list)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}/"))
+    elif tree is None:
+        out[prefix.rstrip("/") + "#none"] = None
+    else:
+        out[prefix.rstrip("/")] = tree
+    return out
+
+
+class ArrayCheckpointEngine(CheckpointEngine):
+    """npz (arrays) + json (structure/scalars) on the filesystem.
+
+    ``save`` expects a dict whose leaves are arrays / python scalars /
+    strings; arbitrary nesting (incl. namedtuples) is flattened with
+    path-keys, so ``load`` returns a flat ``{path: value}`` mapping plus the
+    original metadata — the engine re-assembles pytrees from its own treedef.
+    """
+
+    def save(self, state_dict: Dict[str, Any], path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        flat = _flatten(state_dict)
+        arrays, meta = {}, {}
+        for k, v in flat.items():
+            if k.endswith("#none"):
+                meta[k] = None
+            elif hasattr(v, "shape"):
+                arrays[k] = np.asarray(v)
+            else:
+                meta[k] = v
+        np.savez(path + ".npz", **arrays)
+        with open(path + ".json", "w") as f:
+            json.dump(meta, f, default=str)
+
+    def load(self, path: str, map_location=None) -> Dict[str, Any]:
+        flat = {}
+        with np.load(path + ".npz", allow_pickle=False) as z:
+            for k in z.files:
+                flat[k] = z[k]
+        if os.path.exists(path + ".json"):
+            with open(path + ".json") as f:
+                meta = json.load(f)
+            for k, v in meta.items():
+                if k.endswith("#none"):
+                    flat[k[:-len("#none")]] = None
+                else:
+                    flat[k] = v
+        return flat
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Async sharded checkpointing (orbax) — the reference's Nebula slot
+    (``nebula_checkpoint_engine.py:15``): commit() waits for the async save."""
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._manager = None
+        self._pending = []
+
+    def save(self, state_dict, path):
+        ckptr = self._ocp.StandardCheckpointer()
+        ckptr.save(os.path.abspath(path) + ".orbax", state_dict, force=True)
+        self._pending.append(ckptr)
+
+    def load(self, path, map_location=None):
+        ckptr = self._ocp.StandardCheckpointer()
+        return ckptr.restore(os.path.abspath(path) + ".orbax")
+
+    def commit(self, tag):
+        for c in self._pending:
+            c.wait_until_finished()
+        self._pending.clear()
+        return True

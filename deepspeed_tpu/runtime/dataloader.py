@@ -1,0 +1,127 @@
+"""Data loading (reference ``deepspeed/runtime/dataloader.py``:
+``DeepSpeedDataLoader:39``, ``RepeatingLoader:16``).
+
+TPU-native: one process feeds the whole mesh (single-controller), so the
+loader yields *global* batches of ``train_micro_batch_size_per_gpu x
+dp_world`` and the engine shards them over the data axes on device_put. On
+multi-host pods each process loads its slice and the engine assembles a
+global array (``make_array_from_process_local_data``).
+"""
+
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference ``:16``)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    """Batched loader over an indexable dataset.
+
+    ``dataset`` may be: a numpy array / jax array (first dim = samples), a
+    tuple/dict of such arrays, or any object with ``__len__`` +
+    ``__getitem__``. ``collate_fn`` assembles a batch from a list of samples
+    (defaults to np.stack per leaf for array-like samples).
+    """
+
+    def __init__(self,
+                 dataset,
+                 batch_size: int,
+                 local_rank: int = 0,
+                 collate_fn: Optional[Callable] = None,
+                 num_local_io_workers: Optional[int] = None,
+                 data_sampler=None,
+                 data_parallel_world_size: Optional[int] = None,
+                 data_parallel_rank: Optional[int] = None,
+                 dataloader_drop_last: bool = False,
+                 shuffle: bool = False,
+                 seed: int = 0):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self.drop_last = dataloader_drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self.data_sampler = data_sampler
+        self.epoch = 0
+        self._len = self._num_batches()
+
+    def _dataset_len(self) -> int:
+        # tuple → columns of arrays; list → list of samples (torch-style)
+        if isinstance(self.dataset, tuple):
+            return len(self.dataset[0])
+        if isinstance(self.dataset, dict):
+            return len(next(iter(self.dataset.values())))
+        return len(self.dataset)
+
+    def _num_batches(self) -> int:
+        n = self._dataset_len()
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __len__(self):
+        return self._len
+
+    def _index(self, idx):
+        d = self.dataset
+        if isinstance(d, tuple):
+            return tuple(x[idx] for x in d)
+        if isinstance(d, dict):
+            return {k: v[idx] for k, v in d.items()}
+        return d[idx]
+
+    def _samplewise(self) -> bool:
+        """True when the dataset yields one sample per __getitem__ (lists and
+        generic map-style datasets) rather than supporting fancy indexing."""
+        return isinstance(self.dataset, list) or not (
+            isinstance(self.dataset, (np.ndarray, tuple, dict))
+            or hasattr(self.dataset, "dtype"))
+
+    def __iter__(self):
+        n = self._dataset_len()
+        order = np.arange(n)
+        if self.data_sampler is not None:
+            order = np.fromiter(iter(self.data_sampler), dtype=np.int64)
+        elif self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        self.epoch += 1
+        nb = self._len
+        for b in range(nb):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            if len(idx) < self.batch_size and self.drop_last:
+                return
+            if self._samplewise():
+                samples = [self.dataset[int(i)] for i in idx]
+                if self.collate_fn is not None:
+                    yield self.collate_fn(samples)
+                else:
+                    yield _default_collate(samples)
+            else:
+                yield self._index(idx)
+
+
+def _default_collate(samples):
+    first = samples[0]
+    if isinstance(first, tuple):
+        return tuple(np.stack([s[i] for s in samples]) for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: np.stack([s[k] for s in samples]) for k in first}
+    return np.stack(samples)

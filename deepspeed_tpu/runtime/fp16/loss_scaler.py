@@ -1,0 +1,85 @@
+"""Loss scaling (reference ``deepspeed/runtime/fp16/loss_scaler.py``:
+``LossScaler`` static, ``DynamicLossScaler:77``).
+
+TPU-native: scaler *state* is a small pytree updated inside the jitted step
+with ``lax.cond`` — no host sync for the overflow check (the reference pays a
+``.item()`` device→host round-trip per step; here skip/update compile into
+the step). Static policy knobs live in :class:`LossScalerConfig` (closed over
+by the step function, not traced).
+"""
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LossScalerConfig:
+    dynamic: bool = False
+    scale_window: int = 1000
+    scale_factor: float = 2.0
+    min_scale: float = 1.0
+    max_hysteresis: int = 2
+
+
+class LossScaleState(NamedTuple):
+    loss_scale: jnp.ndarray   # f32 scalar
+    good_steps: jnp.ndarray   # i32 scalar, steps since last overflow
+    hysteresis: jnp.ndarray   # i32 scalar, remaining tolerated overflows
+
+
+def create_loss_scaler(static_loss_scale: float = 1.0,
+                       dynamic: bool = False,
+                       initial_scale: float = 2.0**16,
+                       scale_window: int = 1000,
+                       scale_factor: float = 2.0,
+                       min_scale: float = 1.0,
+                       hysteresis: int = 2):
+    """Returns ``(config, state)``."""
+    config = LossScalerConfig(dynamic=dynamic, scale_window=scale_window,
+                              scale_factor=scale_factor, min_scale=min_scale,
+                              max_hysteresis=hysteresis)
+    scale = initial_scale if dynamic else static_loss_scale
+    state = LossScaleState(
+        loss_scale=jnp.asarray(scale, jnp.float32),
+        good_steps=jnp.asarray(0, jnp.int32),
+        hysteresis=jnp.asarray(hysteresis, jnp.int32))
+    return config, state
+
+
+def has_inf_or_nan(tree) -> jnp.ndarray:
+    """Overflow probe over a grad pytree (reference ``_has_inf_or_nan``,
+    ``stage_1_and_2.py:1966``)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [~jnp.isfinite(l.astype(jnp.float32)).all() for l in leaves]
+    return jnp.stack(flags).any()
+
+
+def update_scale(config: LossScalerConfig, state: LossScaleState,
+                 overflow: jnp.ndarray) -> LossScaleState:
+    """Post-step scale adjustment (reference ``DynamicLossScaler.update_scale``)."""
+    if not config.dynamic:
+        return state
+
+    def on_overflow(s):
+        new_hyst = s.hysteresis - 1
+        drop = new_hyst <= 0
+        new_scale = jnp.where(
+            drop, jnp.maximum(s.loss_scale / config.scale_factor, config.min_scale),
+            s.loss_scale)
+        return LossScaleState(loss_scale=new_scale,
+                              good_steps=jnp.asarray(0, jnp.int32),
+                              hysteresis=jnp.maximum(new_hyst, 0))
+
+    def on_good(s):
+        grow = (s.good_steps + 1) % config.scale_window == 0
+        new_scale = jnp.where(grow, s.loss_scale * config.scale_factor, s.loss_scale)
+        return LossScaleState(loss_scale=new_scale,
+                              good_steps=s.good_steps + 1,
+                              hysteresis=jnp.asarray(config.max_hysteresis, jnp.int32))
+
+    return jax.lax.cond(overflow, on_overflow, on_good, state)

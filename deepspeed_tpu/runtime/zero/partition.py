@@ -1,0 +1,129 @@
+"""ZeRO stages as GSPMD sharding policies.
+
+The reference implements ZeRO with explicit bookkeeping: flat-buffer
+round-robin partitions (``runtime/zero/stage_1_and_2.py:609``), grad-hook IPG
+buckets (``:836-942``), and for stage 3 per-param ``ds_tensor`` shards with
+gather/release hooks (``runtime/zero/partition_parameters.py:1042``,
+``partitioned_param_coordinator.py:239``). On TPU all of that collapses into
+*where each array lives on the mesh*:
+
+- **stage 1**: optimizer state (m/v) sharded over the data axes; params
+  replicated. XLA's weight-update sharding: grads reduce-scatter into the
+  owner shard, updated weights all-gather back — the reference's
+  ``allgather_bucket`` loop (``stage_1_and_2.py:1821``) becomes an output
+  sharding spec.
+- **stage 2**: same program — gradients never materialize replicated because
+  the only consumer (the sharded update) needs 1/N of them; XLA's scheduler
+  plays the role of the IPG overlap stream.
+- **stage 3**: params themselves sharded; every use triggers a (scan-scoped)
+  all-gather, every grad a reduce-scatter — the fetch/release coordinator
+  becomes dataflow.
+
+Sharding rule: shard the largest dimension divisible by the axis size; params
+smaller than ``param_persistence_threshold`` stay replicated (mirrors
+``stage3_param_persistence_threshold``).
+"""
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import AXIS_DATA, AXIS_EXPERT
+
+
+def _shardable_dim(shape: Tuple[int, ...], axis_size: int,
+                   taken: Sequence[Optional[str]]) -> Optional[int]:
+    """Largest dim divisible by axis_size and not already sharded."""
+    best, best_size = None, 0
+    for i, d in enumerate(shape):
+        if taken[i] is None and d % axis_size == 0 and d >= axis_size and d > best_size:
+            best, best_size = i, d
+    return best
+
+
+def zero_partition_spec(shape: Tuple[int, ...],
+                        mesh: Mesh,
+                        data_axes: Sequence[str] = (AXIS_DATA, AXIS_EXPERT),
+                        base_spec: Optional[P] = None,
+                        persistence_threshold: int = 0) -> P:
+    """PartitionSpec sharding ``shape`` over the (flattened) data axes,
+    layered on top of ``base_spec`` (TP/expert specs from the model).
+
+    Returns ``base_spec`` unchanged if the array is too small (persistence
+    threshold) or no dim divides evenly.
+    """
+    data_axes = [a for a in data_axes if mesh.shape.get(a, 1) > 1]
+    if not data_axes:
+        return base_spec if base_spec is not None else P()
+    axis_size = int(np.prod([mesh.shape[a] for a in data_axes]))
+    entries = list(base_spec) if base_spec is not None else []
+    entries += [None] * (len(shape) - len(entries))
+    if int(np.prod(shape)) < max(persistence_threshold, axis_size):
+        return P(*entries) if base_spec is not None else P()
+    dim = _shardable_dim(shape, axis_size, entries)
+    if dim is None:
+        return P(*entries) if base_spec is not None else P()
+    group = tuple(data_axes) if len(data_axes) > 1 else data_axes[0]
+    entries[dim] = group
+    return P(*entries)
+
+
+def build_zero_shardings(params_shapes,
+                         mesh: Mesh,
+                         stage: int,
+                         param_specs=None,
+                         persistence_threshold: int = 0):
+    """Shardings for (params, optimizer state) given a ZeRO stage.
+
+    ``params_shapes``: pytree of ``jax.ShapeDtypeStruct`` (or arrays).
+    ``param_specs``: optional pytree of base PartitionSpecs (TP rules).
+    Returns ``(param_shardings, opt_shardings)`` pytrees of NamedSharding.
+    """
+
+    def base_spec_of(leaf_spec):
+        return leaf_spec if leaf_spec is not None else None
+
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(lambda _: None, params_shapes)
+
+    def param_sharding(leaf, spec):
+        base = base_spec_of(spec)
+        if stage >= 3:
+            s = zero_partition_spec(leaf.shape, mesh,
+                                    base_spec=base,
+                                    persistence_threshold=persistence_threshold)
+        else:
+            s = base if base is not None else P()
+        return NamedSharding(mesh, s)
+
+    def opt_sharding(leaf, spec):
+        base = base_spec_of(spec)
+        if stage >= 1:
+            s = zero_partition_spec(leaf.shape, mesh, base_spec=base)
+        else:
+            s = base if base is not None else P()
+        return NamedSharding(mesh, s)
+
+    param_shardings = jax.tree_util.tree_map(
+        param_sharding, params_shapes, param_specs,
+        is_leaf=lambda x: hasattr(x, "shape"))
+    opt_shardings = jax.tree_util.tree_map(
+        opt_sharding, params_shapes, param_specs,
+        is_leaf=lambda x: hasattr(x, "shape"))
+    return param_shardings, opt_shardings
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, data_axes: Sequence[str] = (AXIS_DATA, AXIS_EXPERT),
+                   ndim: int = 2) -> NamedSharding:
+    """Batch arrays: leading dim sharded over the data axes."""
+    axes = tuple(a for a in data_axes if mesh.shape.get(a, 1) > 1)
+    if not axes:
+        return NamedSharding(mesh, P())
+    lead = axes if len(axes) > 1 else axes[0]
+    return NamedSharding(mesh, P(lead, *([None] * (ndim - 1))))

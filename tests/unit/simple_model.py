@@ -1,0 +1,40 @@
+"""Shared test model fixtures (mirrors reference ``tests/unit/simple_model.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def simple_loss_fn(params, batch, rngs=None):
+    """Linear-stack regression loss (reference ``SimpleModel``)."""
+    x, y = batch
+    h = x
+    for i in range(len([k for k in params if k.startswith("w")])):
+        h = h @ params[f"w{i}"] + params[f"b{i}"]
+        if i < len(params) // 2 - 1:
+            h = jax.nn.relu(h)
+    return jnp.mean((h - y) ** 2)
+
+
+def simple_params(hidden_dim=8, n_layers=2, seed=0):
+    rng = np.random.default_rng(seed)
+    params = {}
+    for i in range(n_layers):
+        params[f"w{i}"] = jnp.asarray(
+            rng.normal(scale=0.3, size=(hidden_dim, hidden_dim)).astype(np.float32))
+        params[f"b{i}"] = jnp.zeros((hidden_dim,), jnp.float32)
+    return params
+
+
+def random_dataset(n=256, hidden_dim=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, hidden_dim)).astype(np.float32)
+    w = rng.normal(size=(hidden_dim, hidden_dim)).astype(np.float32)
+    y = np.tanh(x @ w)
+    return x, y
+
+
+def random_dataloader(model_dim=8, total_samples=256, batch_size=32, seed=0):
+    x, y = random_dataset(total_samples, model_dim, seed)
+    for i in range(0, total_samples - batch_size + 1, batch_size):
+        yield (x[i:i + batch_size], y[i:i + batch_size])

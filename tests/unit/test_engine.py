@@ -1,0 +1,280 @@
+"""Engine tests (mirrors reference ``tests/unit/runtime/test_ds_initialize.py``
+and parts of ``test_zero.py``/``half_precision``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.topology import reset_topology
+
+from tests.unit.simple_model import random_dataset, simple_loss_fn, simple_params
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    reset_topology()
+    import deepspeed_tpu.comm as dist
+
+    dist.destroy_process_group()
+    yield
+    reset_topology()
+
+
+def _base_config(**over):
+    cfg = {
+        "train_batch_size": 32,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.05}},
+        "steps_per_print": 10_000,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _train(engine, n_steps=30, batch_size=32, seed=0):
+    x, y = random_dataset(256, 8, seed)
+    losses = []
+    for i in range(n_steps):
+        b0 = (i * batch_size) % (len(x) - batch_size)
+        loss = engine((x[b0:b0 + batch_size], y[b0:b0 + batch_size]))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+class TestInitialize:
+    def test_returns_tuple(self):
+        engine, opt, loader, sched = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config())
+        assert engine is not None and opt is not None
+        assert loader is None and sched is None
+
+    def test_client_optimizer_wins(self):
+        from deepspeed_tpu.ops.optimizer import FusedSGD
+
+        client = FusedSGD(lr=0.1)
+        engine, opt, _, _ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            optimizer=client, config=_base_config())
+        assert opt is client
+
+    def test_missing_model_raises(self):
+        with pytest.raises(ValueError):
+            deepspeed_tpu.initialize(model=None, config=_base_config())
+
+    def test_scheduler_from_config(self):
+        engine, _, _, sched = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config(scheduler={
+                "type": "WarmupLR",
+                "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 0.05,
+                           "warmup_num_steps": 10}}))
+        assert sched is not None
+
+
+class TestTraining:
+    def test_loss_decreases(self):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config())
+        losses = _train(engine)
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_gradient_accumulation_boundary(self):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config(train_batch_size=64, gradient_accumulation_steps=2))
+        x, y = random_dataset(256, 8)
+        assert engine.is_gradient_accumulation_boundary() is False
+        engine((x[:32], y[:32])); engine.backward(None); engine.step()
+        assert engine.global_steps == 0  # first micro step: no boundary yet
+        assert engine.is_gradient_accumulation_boundary() is True
+        engine((x[32:64], y[32:64])); engine.backward(None); engine.step()
+        assert engine.global_steps == 1
+
+    def test_gas_equivalence(self):
+        """gas=2 with micro batches == gas=1 with the combined batch."""
+        x, y = random_dataset(128, 8)
+
+        e1, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config(train_batch_size=64, gradient_accumulation_steps=1))
+        e1((x[:64], y[:64])); e1.backward(None); e1.step()
+        p1 = jax.device_get(e1.state.params)
+
+        reset_topology()
+        e2, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config(train_batch_size=64, gradient_accumulation_steps=2))
+        for s in range(2):
+            e2((x[s * 32:(s + 1) * 32], y[s * 32:(s + 1) * 32]))
+            e2.backward(None)
+            e2.step()
+        p2 = jax.device_get(e2.state.params)
+        for k in p1:
+            np.testing.assert_allclose(p1[k], p2[k], rtol=2e-5, atol=2e-6)
+
+    def test_eval_batch(self):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config())
+        x, y = random_dataset(64, 8)
+        l1 = float(engine.eval_batch((x[:32], y[:32])))
+        l2 = float(engine.eval_batch((x[:32], y[:32])))
+        assert l1 == l2  # eval does not mutate state
+        assert engine.global_steps == 0
+
+    def test_lazy_param_init(self):
+        """Params initialized on first forward when not given (zero.Init path)."""
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+
+        model = GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32))
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, config=_base_config(
+                optimizer={"type": "Adam", "params": {"lr": 1e-3}}))
+        assert engine.state is None
+        ids = np.ones((32, 16), dtype=np.int32)
+        loss = engine({"input_ids": ids})
+        assert engine.state is not None
+        assert np.isfinite(float(loss))
+
+
+class TestPrecision:
+    def test_fp16_dynamic_loss_scale(self):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config(fp16={"enabled": True, "initial_scale_power": 8}))
+        assert engine.loss_scale == 256.0
+        losses = _train(engine, n_steps=10)
+        assert all(np.isfinite(l) for l in losses)
+
+    def test_fp16_overflow_skips_step(self):
+        def exploding_loss(params, batch, rngs=None):
+            x, y = batch
+            return jnp.sum(x @ params["w0"] * 1e30) * 1e30
+
+        engine, *_ = deepspeed_tpu.initialize(
+            model=exploding_loss, model_parameters=simple_params(),
+            config=_base_config(fp16={"enabled": True, "initial_scale_power": 4,
+                                      "hysteresis": 1}))
+        x, y = random_dataset(64, 8)
+        p_before = jax.device_get(engine.state.params)
+        engine((x[:32], y[:32])); engine.backward(None); engine.step()
+        p_after = jax.device_get(engine.state.params)
+        for k in p_before:  # step skipped → params unchanged
+            np.testing.assert_array_equal(p_before[k], p_after[k])
+        assert engine.get_skipped_steps() == 1
+        assert engine.loss_scale == 8.0  # halved (hysteresis exhausted)
+
+    def test_bf16_no_scaling(self):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config(bf16={"enabled": True}))
+        assert engine.loss_scale == 1.0
+        losses = _train(engine, n_steps=10)
+        assert losses[-1] < losses[0]
+
+
+class TestCheckpoint:
+    def test_save_load_roundtrip(self, tmp_path):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config())
+        _train(engine, n_steps=5)
+        p_saved = jax.device_get(engine.state.params)
+        engine.save_checkpoint(str(tmp_path), tag="t5")
+
+        reset_topology()
+        engine2, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(seed=123),
+            config=_base_config())
+        tag, _ = engine2.load_checkpoint(str(tmp_path))
+        assert tag == "t5"
+        p_loaded = jax.device_get(engine2.state.params)
+        for k in p_saved:
+            np.testing.assert_array_equal(p_saved[k], p_loaded[k])
+        assert engine2.global_steps == engine.global_steps
+
+    def test_resume_training_matches(self, tmp_path):
+        """Training 10 steps == training 5, checkpoint, resume, 5 more."""
+        e1, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config())
+        _train(e1, n_steps=10)
+        p_ref = jax.device_get(e1.state.params)
+
+        reset_topology()
+        e2, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config())
+        _train(e2, n_steps=5)
+        e2.save_checkpoint(str(tmp_path), tag="mid")
+
+        reset_topology()
+        e3, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(seed=9),
+            config=_base_config())
+        e3.load_checkpoint(str(tmp_path), tag="mid")
+        # continue with the same data stream (steps 5..10)
+        x, y = random_dataset(256, 8, 0)
+        for i in range(5, 10):
+            b0 = (i * 32) % (len(x) - 32)
+            loss = e3((x[b0:b0 + 32], y[b0:b0 + 32]))
+            e3.backward(loss)
+            e3.step()
+        p_resumed = jax.device_get(e3.state.params)
+        for k in p_ref:
+            np.testing.assert_allclose(p_ref[k], p_resumed[k], rtol=1e-6, atol=1e-7)
+
+    def test_load_missing_returns_none(self, tmp_path):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_base_config())
+        tag, state = engine.load_checkpoint(str(tmp_path))
+        assert tag is None
+
+
+class TestCheckpointNonAdam:
+    def test_sgd_roundtrip_and_continue(self, tmp_path):
+        """Regression: optimizers with None state leaves must roundtrip
+        (exp_avg_sq=None previously became {} and broke the next step)."""
+        from deepspeed_tpu.ops.optimizer import FusedSGD
+
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            optimizer=FusedSGD(lr=0.05, momentum=0.9),
+            config={"train_batch_size": 32, "steps_per_print": 10_000})
+        _train(engine, n_steps=3)
+        engine.save_checkpoint(str(tmp_path), tag="sgd")
+
+        reset_topology()
+        engine2, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(seed=7),
+            optimizer=FusedSGD(lr=0.05, momentum=0.9),
+            config={"train_batch_size": 32, "steps_per_print": 10_000})
+        engine2.load_checkpoint(str(tmp_path), tag="sgd")
+        losses = _train(engine2, n_steps=3)  # must not crash
+        assert all(np.isfinite(l) for l in losses)
+
+
+class TestDataLoaderShapes:
+    def test_list_of_sample_dicts(self):
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+        data = [{"input_ids": np.arange(4) + i} for i in range(10)]
+        dl = DeepSpeedDataLoader(data, batch_size=4)
+        batches = list(dl)
+        assert len(dl) == 3
+        assert batches[0]["input_ids"].shape == (4, 4)
+
+    def test_tuple_columns(self):
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+        x = np.zeros((10, 3)); y = np.ones((10,))
+        dl = DeepSpeedDataLoader((x, y), batch_size=4, dataloader_drop_last=True)
+        batches = list(dl)
+        assert len(batches) == 2
+        assert batches[0][0].shape == (4, 3)

@@ -1,0 +1,72 @@
+"""LR schedule tests (mirrors reference ``tests/unit/runtime/test_lr_schedulers.py``)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest,
+    OneCycle,
+    WarmupDecayLR,
+    WarmupLR,
+    get_lr_schedule_fn,
+)
+
+
+class TestWarmupLR:
+    def test_reaches_max(self):
+        s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10)
+        for _ in range(15):
+            s.step()
+        assert s.get_lr()[0] == pytest.approx(0.1, rel=1e-5)
+
+    def test_monotonic_warmup(self):
+        fn = get_lr_schedule_fn("WarmupLR", {
+            "warmup_min_lr": 0.0, "warmup_max_lr": 0.1, "warmup_num_steps": 20})
+        vals = [float(fn(i)) for i in range(25)]
+        assert all(b >= a - 1e-9 for a, b in zip(vals, vals[1:]))
+
+
+class TestWarmupDecayLR:
+    def test_decays_to_zero(self):
+        fn = get_lr_schedule_fn("WarmupDecayLR", {
+            "total_num_steps": 100, "warmup_min_lr": 0.0,
+            "warmup_max_lr": 0.1, "warmup_num_steps": 10})
+        assert float(fn(100)) == pytest.approx(0.0, abs=1e-6)
+        assert float(fn(55)) == pytest.approx(0.05, rel=0.1)
+
+    def test_peak_at_warmup_end(self):
+        fn = get_lr_schedule_fn("WarmupDecayLR", {
+            "total_num_steps": 100, "warmup_max_lr": 0.1, "warmup_num_steps": 10})
+        peak = max(float(fn(i)) for i in range(100))
+        assert peak == pytest.approx(0.1, rel=0.05)
+
+
+class TestOneCycle:
+    def test_triangle(self):
+        fn = get_lr_schedule_fn("OneCycle", {
+            "cycle_min_lr": 0.01, "cycle_max_lr": 0.1,
+            "cycle_first_step_size": 10, "cycle_second_step_size": 10})
+        assert float(fn(0)) == pytest.approx(0.01, rel=1e-4)
+        assert float(fn(10)) == pytest.approx(0.1, rel=1e-4)
+        assert float(fn(20)) == pytest.approx(0.01, rel=1e-4)
+
+
+class TestLRRangeTest:
+    def test_continuous_increase(self):
+        fn = get_lr_schedule_fn("LRRangeTest", {
+            "lr_range_test_min_lr": 0.01, "lr_range_test_step_size": 10,
+            "lr_range_test_step_rate": 1.0})
+        assert float(fn(0)) == pytest.approx(0.01)
+        assert float(fn(10)) == pytest.approx(0.02, rel=1e-4)
+
+    def test_staircase(self):
+        fn = get_lr_schedule_fn("LRRangeTest", {
+            "lr_range_test_min_lr": 0.01, "lr_range_test_step_size": 10,
+            "lr_range_test_step_rate": 1.0, "lr_range_test_staircase": True})
+        assert float(fn(5)) == pytest.approx(0.01)
+        assert float(fn(15)) == pytest.approx(0.02, rel=1e-4)
+
+
+def test_unknown_schedule_raises():
+    with pytest.raises(ValueError):
+        get_lr_schedule_fn("NotASchedule", {})

@@ -44,7 +44,7 @@ def main():
         cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=768,
                          n_layer=12, n_head=12, dtype=jnp.bfloat16,
                          scan_layers=True, remat=True)
-        batch, seq, steps = 8, 1024, 10
+        batch, seq, steps = 16, 1024, 10
     else:  # local CPU smoke: tiny proxy so the script stays runnable anywhere
         cfg = GPT2Config.tiny(dtype=jnp.float32)
         batch, seq, steps = 8, 64, 3

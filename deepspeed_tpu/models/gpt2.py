@@ -166,7 +166,7 @@ class GPT2LMHeadModel(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, deterministic=True):
+    def __call__(self, input_ids, deterministic=True, return_hidden=False):
         cfg = self.config
         B, T = input_ids.shape
         wte = self.param("wte", _dense_init(), (cfg.vocab_size, cfg.n_embd), jnp.float32)
@@ -177,10 +177,48 @@ class GPT2LMHeadModel(nn.Module):
         blocks = ScanBlocks if cfg.scan_layers else LoopBlocks
         x = blocks(cfg, name="transformer")(x, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
+        if return_hidden:
+            return x, wte
         # tied LM head; logits in fp32 for a stable softmax-xent
         logits = jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype),
                             preferred_element_type=jnp.float32)
         return logits
+
+
+def chunked_softmax_xent(hidden, wte, labels, chunk: int = 128,
+                         ignore_index: int = -100):
+    """Softmax cross-entropy against a tied embedding WITHOUT materializing
+    the full [B, T, V] fp32 logits — the LM-head memory hog on long
+    sequences. Computes per-sequence-chunk logits inside a remat'd scan, so
+    peak memory is [B, chunk, V] and backward recomputes each chunk.
+    """
+    B, T, C = hidden.shape
+    if T % chunk:
+        # largest divisor of T <= chunk keeps peak memory bounded
+        chunk = next(d for d in range(min(chunk, T), 0, -1) if T % d == 0)
+    n_chunks = T // chunk
+    h = hidden.reshape(B, n_chunks, chunk, C).transpose(1, 0, 2, 3)
+    lab = labels.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+    w = wte.astype(hidden.dtype)
+
+    @jax.checkpoint
+    def chunk_loss(hc, lc):
+        logits = jnp.einsum("btc,vc->btv", hc, w,
+                            preferred_element_type=jnp.float32)
+        valid = lc != ignore_index
+        safe = jnp.where(valid, lc, 0)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum((logz - gold) * valid), jnp.sum(valid)
+
+    def body(carry, xs):
+        total, count = carry
+        l, n = chunk_loss(*xs)
+        return (total + l, count + n), None
+
+    (total, count), _ = jax.lax.scan(body, (jnp.zeros((), jnp.float32),
+                                            jnp.zeros((), jnp.int32)), (h, lab))
+    return total / jnp.maximum(count, 1)
 
 
 def cross_entropy_loss(logits, labels, ignore_index: int = -100):
@@ -235,8 +273,15 @@ def gpt2_loss_fn(model: GPT2LMHeadModel):
             input_ids, labels = batch
         if labels is None:
             labels = input_ids
-        logits = model.apply({"params": params}, input_ids,
-                             deterministic=rngs is None, rngs=rngs)
-        return cross_entropy_loss(logits[:, :-1], labels[:, 1:])
+        hidden, wte = model.apply({"params": params}, input_ids,
+                                  deterministic=rngs is None, rngs=rngs,
+                                  return_hidden=True)
+        # shift for next-token prediction by padding the label stream (keeps
+        # T divisible for the chunked head, which avoids the full [B, T, V]
+        # fp32 logits tensor)
+        shifted = jnp.concatenate(
+            [labels[:, 1:], jnp.full((labels.shape[0], 1), -100, labels.dtype)],
+            axis=1)
+        return chunked_softmax_xent(hidden, wte, shifted)
 
     return loss_fn

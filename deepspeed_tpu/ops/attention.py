@@ -58,7 +58,9 @@ def attention(q, k, v, mask=None, causal=True, softmax_scale=None,
             from deepspeed_tpu.ops.flash_attention import flash_attention
 
             return flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
-        except (ImportError, NotImplementedError):
+        except (ImportError, NotImplementedError, ValueError):
+            # ValueError: shapes the kernel can't tile (e.g. seq not divisible
+            # by the block size) — fall back to the XLA path
             pass
     return attention_reference(q, k, v, mask=mask, causal=causal,
                                softmax_scale=softmax_scale,

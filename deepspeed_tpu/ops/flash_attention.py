@@ -1,0 +1,312 @@
+"""Flash attention — Pallas TPU kernels (fwd + bwd).
+
+Replaces the reference's fused CUDA attention path
+(``csrc/transformer/softmax_kernels.cu``, ``transform_kernels.cu``,
+``csrc/transformer/inference/csrc/softmax.cu``) with an online-softmax tiled
+kernel: O(T) memory (never materializes the [T, T] score matrix), fp32
+accumulation on the MXU, causal block skipping.
+
+Layout: q, k, v are [batch, heads, seq, head_dim]. The grid walks
+(batch*heads, q_block, k_block) with the k dimension innermost — TPU grids
+execute sequentially, so the online-softmax state (m, l, acc) lives in VMEM
+scratch carried across k steps.
+
+Backward is the standard two-kernel flash bwd (dq by rows, dk/dv by columns)
+using the saved logsumexp and D = rowsum(dO * O).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+NEG_INF = -1e30
+
+
+def _block_sizes(seq_q, seq_k, block_q, block_k):
+    bq = min(block_q, seq_q)
+    bk = min(block_k, seq_k)
+    if seq_q % bq or seq_k % bk:
+        raise ValueError(
+            f"flash_attention requires seq divisible by block sizes: "
+            f"seq_q={seq_q} bq={bq}, seq_k={seq_k} bk={bk}")
+    return bq, bk
+
+
+# ----------------------------------------------------------------------
+# forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, num_kb, off):
+    # ``off = seq_k - seq_q``: causal masks are bottom-right aligned (row i
+    # attends to cols <= i + off), matching ``attention_reference``'s
+    # ``tril(k=k_len-q_len)`` for kv-cache style seq_q != seq_k calls.
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: skip blocks entirely above the diagonal
+    run = True
+    if causal:
+        run = (ki * bk) <= (qi * bq + bq - 1 + off)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                               # [bq, d] input dtype
+        k = k_ref[0]                               # [bk, d]
+        v = v_ref[0]                               # [bk, d]
+        # multiply at input precision (bf16 on the MXU's native rate),
+        # accumulate fp32 — the flash-attention standard
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            s = jnp.where(rows + off >= cols, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]                     # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
+        l_new = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_kb - 1)
+    def _finish():
+        l = l_scr[:, 0:1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[...] = (m_scr[:, 0:1] + jnp.log(safe_l)).reshape(1, 1, bq)
+
+
+def _flash_forward(q, k, v, scale, causal, block_q, block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
+    num_kb = sk // bk
+    grid = (b * h, sq // bq, num_kb)
+
+    qs = pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                      memory_space=pltpu.VMEM)
+    ks = pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
+                      memory_space=pltpu.VMEM)
+    vs = pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0),
+                      memory_space=pltpu.VMEM)
+    os_ = pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                       memory_space=pltpu.VMEM)
+    ls = pl.BlockSpec((1, 1, bq), lambda bh, qi, ki: (bh, 0, qi),
+                      memory_space=pltpu.VMEM)
+
+    q3 = q.reshape(b * h, sq, d)
+    k3 = k.reshape(b * h, sk, d)
+    v3 = v.reshape(b * h, sk, d)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, num_kb=num_kb, off=sk - sq)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qs, ks, vs],
+        out_specs=(os_, ls),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # m
+            pltpu.VMEM((bq, 128), jnp.float32),   # l
+            pltpu.VMEM((bq, d), jnp.float32),     # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q3, k3, v3)
+    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+# ----------------------------------------------------------------------
+# backward
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, bq, bk, num_kb, off):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = True
+    if causal:
+        run = (ki * bk) <= (qi * bq + bq - 1 + off)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[...].reshape(bq, 1)
+        delta = delta_ref[...].reshape(bq, 1)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            s = jnp.where(rows + off >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk] f32
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        dq_scr[:] += jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kb - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, bq, bk, num_qb, off):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = True
+    if causal:  # q block must reach the (offset) diagonal
+        run = (qi * bq + bq - 1 + off) >= (ki * bk)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[...].reshape(bq, 1)
+        delta = delta_ref[...].reshape(bq, 1)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            s = jnp.where(rows + off >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                        # [bq, bk] f32
+        p_lp = p.astype(do.dtype)
+        dv_scr[:] += jax.lax.dot_general(p_lp, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bq, bk]
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_qb - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(res, g, scale, causal, block_q, block_k):
+    q, k, v, o, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
+    num_qb, num_kb = sq // bq, sk // bk
+
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [b,h,sq]
+
+    q3 = q.reshape(b * h, sq, d)
+    k3 = k.reshape(b * h, sk, d)
+    v3 = v.reshape(b * h, sk, d)
+    do3 = g.reshape(b * h, sq, d)
+    lse3 = lse.reshape(b * h, 1, sq)
+    delta3 = delta.reshape(b * h, 1, sq)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, num_kb=num_kb, off=sk - sq),
+        grid=(b * h, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi, ki: (bh, 0, qi), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi, ki: (bh, 0, qi), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, num_qb=num_qb, off=sk - sq),
+        grid=(b * h, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, qi), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, qi), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0), memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ),
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+# ----------------------------------------------------------------------
+# public op
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=True, softmax_scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Tiled online-softmax attention. q,k,v: [batch, heads, seq, head_dim]."""
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    o, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _fa_fwd(q, k, v, causal, softmax_scale, block_q, block_k):
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    o, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _fa_bwd(causal, softmax_scale, block_q, block_k, res, g):
+    q = res[0]
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    dq, dk, dv = _flash_backward(res, g, scale, causal, block_q, block_k)
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -1,0 +1,85 @@
+"""Op kernel tests vs references (mirrors reference ``tests/unit/ops/``).
+
+Flash-attention Pallas kernels run in interpreter mode on the CPU test mesh
+(real-hardware correctness is exercised by the TPU bench runs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.ops.attention import attention_reference
+from deepspeed_tpu.ops.flash_attention import flash_attention
+from deepspeed_tpu.ops.quantizer import dequantize, fake_quantize, quantize
+
+
+def _qkv(B=1, H=2, T=256, D=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+                 for _ in range(3))
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_fwd_matches_reference(self, causal):
+        q, k, v = _qkv()
+        with pltpu.force_tpu_interpret_mode():
+            o = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+        o_ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_grads_match_reference(self):
+        q, k, v = _qkv(T=128, D=64)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           block_q=64, block_k=64) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+        with pltpu.force_tpu_interpret_mode():  # covers the custom_vjp bwd too
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            scale = float(jnp.max(jnp.abs(b))) + 1e-9
+            np.testing.assert_allclose(np.asarray(a) / scale, np.asarray(b) / scale,
+                                       rtol=0, atol=5e-3)
+
+    def test_indivisible_seq_raises(self):
+        q, k, v = _qkv(T=100)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, v, block_q=64, block_k=64)
+
+
+class TestQuantizer:
+    def test_symmetric_roundtrip(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 256)), jnp.float32)
+        q, s = quantize(x, num_groups=4, num_bits=8)
+        assert q.dtype == jnp.int8
+        x2 = dequantize(q, s, num_groups=4)
+        err = float(jnp.max(jnp.abs(x - x2)))
+        assert err < float(jnp.max(jnp.abs(x))) / 127 * 1.01
+
+    def test_asymmetric_roundtrip(self):
+        x = jnp.asarray(np.random.default_rng(0).uniform(2, 5, size=(2, 128)), jnp.float32)
+        q, s, z = quantize(x, num_groups=2, num_bits=8, symmetric=False)
+        x2 = dequantize(q, s, z, num_groups=2)
+        assert float(jnp.max(jnp.abs(x - x2))) < 0.02
+
+    def test_int4(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 64)), jnp.float32)
+        q, s = quantize(x, num_bits=4)
+        assert int(jnp.max(q)) <= 7 and int(jnp.min(q)) >= -8
+
+    def test_fake_quant_straight_through(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 128)), jnp.float32)
+        g = jax.grad(lambda x: jnp.sum(fake_quantize(x) * 2.0))(x)
+        np.testing.assert_allclose(g, np.full_like(g, 2.0))
+
+    def test_zero_input(self):
+        x = jnp.zeros((1, 128))
+        q, s = quantize(x)
+        np.testing.assert_array_equal(dequantize(q, s), x)

@@ -193,9 +193,12 @@ def chunked_softmax_xent(hidden, wte, labels, chunk: int = 128,
     peak memory is [B, chunk, V] and backward recomputes each chunk.
     """
     B, T, C = hidden.shape
-    if T % chunk:
-        # largest divisor of T <= chunk keeps peak memory bounded
-        chunk = next(d for d in range(min(chunk, T), 0, -1) if T % d == 0)
+    chunk = min(chunk, T)
+    pad = (-T) % chunk
+    if pad:  # pad to a chunk multiple; padded tokens are ignore_index
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)), constant_values=ignore_index)
+        T += pad
     n_chunks = T // chunk
     h = hidden.reshape(B, n_chunks, chunk, C).transpose(1, 0, 2, 3)
     lab = labels.reshape(B, n_chunks, chunk).transpose(1, 0, 2)

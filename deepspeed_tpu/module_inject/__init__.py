@@ -1,0 +1,15 @@
+from deepspeed_tpu.module_inject.policies import (
+    AUTO_POLICY,
+    TPPolicy,
+    get_tp_policy,
+    register_tp_policy,
+    specs_from_policy,
+)
+
+__all__ = [
+    "AUTO_POLICY",
+    "TPPolicy",
+    "get_tp_policy",
+    "register_tp_policy",
+    "specs_from_policy",
+]

@@ -1,0 +1,142 @@
+"""Tensor-parallel injection policies.
+
+Capability parity with the reference ``deepspeed/module_inject``: where the
+reference *rewrites modules* — ``ReplaceWithTensorSlicing`` physically slices
+weights across ranks (``module_inject/replace_module.py:20``) and swaps
+``nn.Linear`` for ``LinearLayer``/``LinearAllreduce`` (``module_inject/
+layers.py:9,25``) guided by per-architecture ``replace_policy.py`` classes —
+the TPU-native design only *annotates*: a policy maps parameter paths to
+``PartitionSpec``s over the ``model`` mesh axis, and GSPMD inserts the
+column/row-parallel collectives (the row-parallel output ``all_reduce``
+becomes an XLA ``psum`` chosen by the partitioner).
+
+Roles:
+- ``column``: output-dim sharded (reference ``LinearLayer``) — no collective
+  on forward; activations become model-sharded.
+- ``row``: input-dim sharded (reference ``LinearAllreduce``) — GSPMD emits the
+  psum that ``LinearAllreduce.forward`` issues explicitly.
+- ``vocab``: embedding tables — shard the largest (vocab) dim; lookups become
+  masked-gather + psum.
+- ``replicate``: everything else (layernorms, small biases).
+
+Policies match *path segments* (module names along the flax param path), so
+the same rules apply whether layers are scanned (leading ``layers`` dim) or
+unrolled.
+"""
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import AXIS_MODEL
+
+COLUMN = "column"
+ROW = "row"
+VOCAB = "vocab"
+REPLICATE = "replicate"
+
+
+class TPPolicy:
+    """Maps parameter paths to TP roles.
+
+    ``rules``: ordered ``(segment_name, role)`` pairs; a parameter whose path
+    contains ``segment_name`` as a full segment gets that role (first match
+    wins). The analog of one reference ``replace_policy.py`` class, expressed
+    as sharding rules instead of weight-slicing instructions.
+    """
+
+    def __init__(self, name: str, rules: Sequence[Tuple[str, str]]):
+        self.name = name
+        self.rules = list(rules)
+
+    def role_for(self, path: str) -> str:
+        segments = set(path.split("/"))
+        for seg, role in self.rules:
+            if seg in segments:
+                return role
+        return REPLICATE
+
+    def spec_for(self, path: str, shape: Tuple[int, ...], tp_size: int,
+                 axis: str = AXIS_MODEL) -> Optional[P]:
+        """PartitionSpec for one param, or None (replicated)."""
+        role = self.role_for(path)
+        if role == REPLICATE or tp_size <= 1 or not shape:
+            return None
+        leaf = path.rsplit("/", 1)[-1]
+        is_bias = leaf in ("bias", "b") or len(shape) == 1
+        if role == COLUMN:
+            dim = len(shape) - 1  # output dim (bias included: its only dim)
+        elif role == ROW:
+            if is_bias:
+                return None  # row-parallel bias applies after the psum
+            dim = len(shape) - 2
+        elif role == VOCAB:
+            dim = max(range(len(shape)), key=lambda i: shape[i])
+        else:
+            raise ValueError(f"unknown TP role {role!r}")
+        if dim < 0 or shape[dim] % tp_size != 0:
+            return None
+        entries = [None] * len(shape)
+        entries[dim] = axis
+        return P(*entries)
+
+
+# ----------------------------------------------------------------------
+# Built-in policies (reference replace_policy.py arch classes)
+
+_QKV_UP = [  # column-parallel: qkv projections and MLP up-projections
+    "c_attn", "q_proj", "k_proj", "v_proj", "qkv_proj", "query", "key",
+    "value", "query_key_value", "c_fc", "fc1", "fc_in", "gate_proj",
+    "up_proj", "dense_h_to_4h", "wi", "wi_0", "wi_1", "in_proj", "w1", "w3",
+]
+_OUT_DOWN = [  # row-parallel: attention output and MLP down-projections
+    "o_proj", "out_proj", "c_proj", "fc2", "fc_out", "down_proj",
+    "dense_4h_to_h", "wo", "dense", "w2",
+]
+_EMBED = ["wte", "embed_tokens", "word_embeddings", "embedding", "lm_head",
+          "shared", "embed_out"]
+
+AUTO_POLICY = TPPolicy(
+    "auto",
+    [(s, ROW) for s in _OUT_DOWN]
+    + [(s, COLUMN) for s in _QKV_UP]
+    + [(s, VOCAB) for s in _EMBED])
+
+GPT2_POLICY = TPPolicy(
+    "gpt2",
+    [("c_proj", ROW), ("c_attn", COLUMN), ("c_fc", COLUMN), ("wte", VOCAB)])
+
+_POLICIES: Dict[str, TPPolicy] = {"auto": AUTO_POLICY, "gpt2": GPT2_POLICY}
+
+
+def register_tp_policy(policy: TPPolicy):
+    """User plug point (reference ``injection_policy`` kwarg)."""
+    _POLICIES[policy.name] = policy
+
+
+def get_tp_policy(name: str = "auto") -> TPPolicy:
+    if isinstance(name, TPPolicy):
+        return name
+    if name not in _POLICIES:
+        raise ValueError(f"unknown TP policy {name!r}; have {sorted(_POLICIES)}")
+    return _POLICIES[name]
+
+
+def specs_from_policy(policy: TPPolicy, params_abstract, mesh,
+                      axis: str = AXIS_MODEL):
+    """Pytree of base PartitionSpecs (or None) for each param.
+
+    Feed as ``param_specs`` to ``build_zero_shardings`` — ZeRO layers its
+    data-axis sharding on the dims TP left alone.
+    """
+    import jax
+
+    tp_size = int(mesh.shape.get(axis, 1))
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params_abstract)
+    specs = []
+    for key_path, leaf in flat:
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in key_path)
+        specs.append(policy.spec_for(path, tuple(leaf.shape), tp_size, axis))
+    return jax.tree_util.tree_unflatten(treedef, specs)

@@ -75,7 +75,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_prev = m_scr[:, 0:1]                     # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                     # [bq, bk]
+        # fully-masked rows (seq_q > seq_k with causal): m_new stays NEG_INF
+        # and exp(s - m_new) would be exp(0)=1 per masked col — force p to 0
+        p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m_new), 0.0)  # [bq, bk]
         alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
         l_new = alpha * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
@@ -163,7 +165,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
             s = jnp.where(rows + off >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                       # [bq, bk] f32
+        # masked cols → p=0 (incl. fully-masked rows where lse is NEG_INF)
+        p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)  # [bq, bk] f32
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * scale).astype(k.dtype)
@@ -203,7 +206,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
             s = jnp.where(rows + off >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                        # [bq, bk] f32
+        # masked cols → p=0 (incl. fully-masked rows where lse is NEG_INF)
+        p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)  # [bq, bk] f32
         p_lp = p.astype(do.dtype)
         dv_scr[:] += jax.lax.dot_general(p_lp, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)

@@ -266,6 +266,9 @@ class DeepSpeedConfig:
         self.eigenvalue_params = d.get(C.EIGENVALUE, {})
         self.sparse_attention = d.get(C.SPARSE_ATTENTION)
         self.autotuning_config = d.get(C.AUTOTUNING, {})
+        # TP policy selection (reference: injection_policy / replace_policy);
+        # TP *degree* comes from mesh.model
+        self.tensor_parallel_config = d.get("tensor_parallel", {})
         self.elasticity_config = d.get(C.ELASTICITY, {})
         self.compression_config = d.get("compression_training", {})
         self.aio_config = d.get("aio", {})

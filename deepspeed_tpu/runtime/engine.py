@@ -49,6 +49,7 @@ from deepspeed_tpu.runtime.fp16.loss_scaler import (
 from deepspeed_tpu.runtime.lr_schedules import LRScheduler, get_lr_schedule_fn
 from deepspeed_tpu.runtime.zero.partition import (
     batch_sharding,
+    build_opt_state_shardings,
     build_zero_shardings,
     replicated,
 )
@@ -254,10 +255,29 @@ class DeepSpeedEngine:
         with self.mesh:
             return init_fn(jax.random.PRNGKey(self._config._param_dict.get("seed", 42)))
 
+    def _tp_base_specs(self, params_abstract):
+        """Tensor-parallel base PartitionSpecs (or None when model axis is 1).
+
+        The model may supply its own (``model.param_specs(abstract)``); else a
+        module_inject policy maps param paths to specs (reference
+        ``module_inject/replace_policy.py`` per-arch classes)."""
+        from deepspeed_tpu.parallel.topology import AXIS_MODEL
+
+        if self.topology.axis_size(AXIS_MODEL) <= 1:
+            return None
+        if hasattr(self.module, "param_specs"):
+            return self.module.param_specs(params_abstract)
+        from deepspeed_tpu.module_inject import get_tp_policy, specs_from_policy
+
+        policy = get_tp_policy(self._config.tensor_parallel_config.get(
+            "policy", "auto"))
+        return specs_from_policy(policy, params_abstract, self.mesh)
+
     def _shardings_for(self, params_abstract):
         return build_zero_shardings(
             params_abstract, self.mesh,
             stage=self.zero_optimization_stage(),
+            param_specs=self._tp_base_specs(params_abstract),
             persistence_threshold=self._config.zero_config.param_persistence_threshold
             if self.zero_optimization_stage() >= 3 else 0)
 
@@ -270,23 +290,19 @@ class DeepSpeedEngine:
         params = jax.device_put(params, param_shardings)
         rep = replicated(self.mesh)
         stage = self.zero_optimization_stage()
-
-        # optimizer-state shardings: leafwise over the *actual* opt-state
-        # structure (works for any optimizer, incl. stateless/momentum-only)
-        from deepspeed_tpu.runtime.zero.partition import zero_partition_spec
-
-        def _stage_shard(leaf):
-            if stage >= 1 and getattr(leaf, "ndim", 0) > 0:
-                return NamedSharding(self.mesh, zero_partition_spec(leaf.shape, self.mesh))
-            return rep
+        base_specs = self._tp_base_specs(abstract)
 
         opt_abstract = jax.eval_shape(self.optimizer.init, abstract)
-        opt_state_shardings = jax.tree_util.tree_map(_stage_shard, opt_abstract)
+        opt_state_shardings = build_opt_state_shardings(
+            opt_abstract, abstract, self.mesh, stage=stage, param_specs=base_specs)
         with self.mesh:
             opt_state = jax.jit(self.optimizer.init,
                                 out_shardings=opt_state_shardings)(params)
         if stage >= 2:
-            grad_shardings = jax.tree_util.tree_map(_stage_shard, abstract)
+            # grads live reduce-scattered over the data axes (ZeRO-2), on top
+            # of any TP sharding
+            _, grad_shardings = build_zero_shardings(
+                abstract, self.mesh, stage=stage, param_specs=base_specs)
         else:
             grad_shardings = param_shardings
         with self.mesh:

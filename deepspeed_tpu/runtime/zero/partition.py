@@ -115,6 +115,44 @@ def build_zero_shardings(params_shapes,
     return param_shardings, opt_shardings
 
 
+def build_opt_state_shardings(opt_abstract, params_abstract, mesh: Mesh,
+                              stage: int, param_specs=None):
+    """Shardings for an arbitrary optimizer-state pytree.
+
+    Optimizer states are built of (a) subtrees that mirror the params tree
+    (Adam m/v, momentum buffers) — those get the per-param ZeRO⊕TP spec —
+    and (b) scalars/None — replicated. Subtree matching is structural, so any
+    optimizer whose state contains params-shaped pytrees works.
+    """
+    params_leaves, params_def = jax.tree_util.tree_flatten(params_abstract)
+    _, mirrored = build_zero_shardings(params_abstract, mesh, stage=stage,
+                                       param_specs=param_specs)
+    rep = replicated(mesh)
+
+    def _mirrors_params(sub) -> bool:
+        if sub is None:
+            return False
+        try:
+            leaves, treedef = jax.tree_util.tree_flatten(sub)
+        except Exception:
+            return False
+        return (treedef == params_def
+                and all(tuple(l.shape) == tuple(p.shape)
+                        for l, p in zip(leaves, params_leaves)))
+
+    def handle(sub):
+        if _mirrors_params(sub):
+            return mirrored
+        # lone leaf without a params mirror: shard by its own shape
+        if stage >= 1 and getattr(sub, "ndim", 0) > 0:
+            return NamedSharding(mesh, zero_partition_spec(tuple(sub.shape), mesh))
+        return rep
+
+    # tree_map recursion handles any registered pytree node (FrozenDict,
+    # struct dataclasses, ...); is_leaf stops at params-mirroring subtrees
+    return jax.tree_util.tree_map(handle, opt_abstract, is_leaf=_mirrors_params)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

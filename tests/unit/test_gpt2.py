@@ -92,3 +92,33 @@ class TestEndToEnd:
             losses.append(float(loss))
         assert losses[-1] < 0.5, f"did not memorize pattern: {losses[-5:]}"
         assert losses[-1] < losses[0] / 4
+
+
+class TestChunkedXent:
+    @pytest.mark.parametrize("T", [64, 100, 127])  # incl. prime T
+    def test_matches_full_logits(self, T):
+        from deepspeed_tpu.models.gpt2 import chunked_softmax_xent
+
+        rng = np.random.default_rng(0)
+        B, C, V = 2, 16, 50
+        hidden = jnp.asarray(rng.normal(size=(B, T, C)), jnp.float32)
+        wte = jnp.asarray(rng.normal(size=(V, C)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, V, (B, T)), jnp.int32)
+        labels = labels.at[0, :5].set(-100)  # masked tokens
+        full_logits = jnp.einsum("btc,vc->btv", hidden, wte)
+        expect = cross_entropy_loss(full_logits, labels)
+        got = chunked_softmax_xent(hidden, wte, labels, chunk=32)
+        np.testing.assert_allclose(float(got), float(expect), rtol=1e-5)
+
+    def test_padding_not_sequential(self):
+        """Odd T must pad up to the chunk size, not degrade to chunk=1."""
+        from deepspeed_tpu.models.gpt2 import chunked_softmax_xent
+
+        hidden = jnp.ones((1, 127, 8), jnp.float32)
+        wte = jnp.ones((16, 8), jnp.float32)
+        labels = jnp.zeros((1, 127), jnp.int32)
+        jaxpr = jax.make_jaxpr(
+            lambda h, w, l: chunked_softmax_xent(h, w, l, chunk=64))(
+                hidden, wte, labels)
+        scans = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "scan"]
+        assert scans and scans[0].params["length"] == 2  # ceil(127/64)

@@ -132,11 +132,10 @@ def specs_from_policy(policy: TPPolicy, params_abstract, mesh,
     """
     import jax
 
+    from deepspeed_tpu.utils.pytree import flatten_with_path_strings
+
     tp_size = int(mesh.shape.get(axis, 1))
-    flat, treedef = jax.tree_util.tree_flatten_with_path(params_abstract)
-    specs = []
-    for key_path, leaf in flat:
-        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                        for k in key_path)
-        specs.append(policy.spec_for(path, tuple(leaf.shape), tp_size, axis))
+    flat, treedef = flatten_with_path_strings(params_abstract)
+    specs = [policy.spec_for(path, tuple(leaf.shape), tp_size, axis)
+             for path, leaf in flat]
     return jax.tree_util.tree_unflatten(treedef, specs)

@@ -1,0 +1,18 @@
+from deepspeed_tpu.moe.experts import ExpertMLP, make_experts
+from deepspeed_tpu.moe.layer import MoE
+from deepspeed_tpu.moe.sharded_moe import (
+    moe_dispatch_combine,
+    top1gating,
+    top2gating,
+)
+from deepspeed_tpu.moe.utils import (
+    has_moe_layers,
+    is_moe_param_path,
+    split_params_into_different_moe_groups_for_optimizer,
+)
+
+__all__ = [
+    "ExpertMLP", "MoE", "make_experts", "moe_dispatch_combine",
+    "top1gating", "top2gating", "has_moe_layers", "is_moe_param_path",
+    "split_params_into_different_moe_groups_for_optimizer",
+]

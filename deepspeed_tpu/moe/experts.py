@@ -1,0 +1,41 @@
+"""Expert modules.
+
+Reference ``deepspeed/moe/experts.py:9`` keeps ``num_local_experts`` deep
+copies in a ModuleList; TPU-native experts are ONE module vmapped over a
+leading expert axis — params get shape ``[E, ...]`` and are sharded over the
+``expert`` mesh axis (the engine's spec builder keys on the ``experts`` path
+segment), so each chip holds and runs only its local experts.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ExpertMLP(nn.Module):
+    """One expert FFN (GShard-style two-layer MLP)."""
+
+    hidden_dim: int
+    model_dim: int
+    activation: str = "gelu"
+    dtype: object = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        act = {"gelu": nn.gelu, "relu": nn.relu, "silu": nn.silu}[self.activation]
+        h = nn.Dense(self.hidden_dim, dtype=self.dtype, name="wi")(x)
+        h = act(h)
+        return nn.Dense(self.model_dim, dtype=self.dtype, name="wo")(h)
+
+
+def make_experts(num_experts: int, hidden_dim: int, model_dim: int,
+                 activation: str = "gelu", dtype=jnp.float32):
+    """Vmapped expert stack: input/output ``[E, tokens, M]``; params ``[E, ...]``."""
+    VmappedExperts = nn.vmap(
+        ExpertMLP,
+        in_axes=0, out_axes=0,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+        metadata_params={nn.meta.PARTITION_NAME: "expert"},
+    )
+    return VmappedExperts(hidden_dim=hidden_dim, model_dim=model_dim,
+                          activation=activation, dtype=dtype, name="experts")

@@ -1,0 +1,78 @@
+"""MoE layer (reference ``deepspeed/moe/layer.py:15``).
+
+``MoE(...)`` wires gate + experts + dispatch; ``use_residual=True`` is
+DeepSpeed-MoE's residual mode (``layer.py:27,100-133``): a dense MLP runs in
+parallel and a learned 2-way coefficient mixes its output with the expert
+output.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.moe.experts import ExpertMLP, make_experts
+from deepspeed_tpu.moe.sharded_moe import moe_dispatch_combine
+
+
+class MoE(nn.Module):
+    """Mixture-of-experts FFN block.
+
+    ``__call__(x, used_token_mask=None, deterministic=True)`` with
+    ``x [B, S, M]`` returns ``(out [B, S, M], l_aux, exp_counts)``.
+    """
+
+    model_dim: int
+    num_experts: int
+    expert_hidden_dim: Optional[int] = None
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None  # None | 'Jitter' | 'RSample'
+    drop_tokens: bool = True
+    use_rts: bool = True
+    use_residual: bool = False
+    activation: str = "gelu"
+    dtype: object = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, used_token_mask=None, deterministic: bool = True):
+        hidden = self.expert_hidden_dim or 4 * self.model_dim
+        gate_in = x
+        rng = None
+        needs_rng = (not deterministic) and (
+            self.use_rts or self.noisy_gate_policy in ("Jitter", "RSample"))
+        if needs_rng:
+            rng = self.make_rng("gating")
+        if self.noisy_gate_policy == "Jitter" and not deterministic:
+            rng, sub = jax.random.split(rng)
+            gate_in = gate_in * jax.random.uniform(
+                sub, gate_in.shape, minval=0.99, maxval=1.01).astype(gate_in.dtype)
+        # gate in fp32 for a stable softmax (reference TopKGate wg is fp32)
+        logits = nn.Dense(self.num_experts, use_bias=False, dtype=jnp.float32,
+                          name="gate")(gate_in.astype(jnp.float32))
+
+        experts = make_experts(self.num_experts, hidden, self.model_dim,
+                               self.activation, self.dtype)
+        out, l_aux, exp_counts = moe_dispatch_combine(
+            x, logits, experts,
+            k=self.k,
+            used_token_mask=used_token_mask,
+            capacity_factor=(self.capacity_factor if not deterministic
+                             else self.eval_capacity_factor),
+            min_capacity=self.min_capacity,
+            noisy_gate_policy=self.noisy_gate_policy if not deterministic else None,
+            drop_tokens=self.drop_tokens,
+            use_rts=self.use_rts and not deterministic,
+            rng=rng)
+
+        if self.use_residual:
+            dense = ExpertMLP(hidden, self.model_dim, self.activation,
+                              self.dtype, name="residual_mlp")(x)
+            coef = nn.Dense(2, dtype=jnp.float32, name="coefficient")(
+                x.astype(jnp.float32))
+            coef = jax.nn.softmax(coef, axis=-1).astype(x.dtype)
+            out = out * coef[..., 0:1] + dense * coef[..., 1:2]
+        return out, l_aux, exp_counts

@@ -1,0 +1,233 @@
+"""GShard MoE math — gating, dispatch, combine.
+
+Capability parity with the reference ``deepspeed/moe/sharded_moe.py``:
+``top1gating`` (``:177``), ``top2gating`` (``:278``), ``TopKGate`` (``:351``),
+``MOELayer`` (``:439``). The reference dispatches with einsums and an explicit
+``_AllToAll`` autograd op (``:89``) over the expert process group; here the
+all-to-all is *implicit*: dispatch/combine einsums move tokens between a
+``[group, seq, ...]`` layout (sharded over data) and an ``[expert, ...]``
+layout (sharded over the ``expert`` mesh axis), and GSPMD lowers the
+resharding to ``all_to_all`` over ICI — the best-fitting subsystem for TPU.
+
+Shapes follow GShard: tokens ``[G, S, M]`` (G = batch rows, the data-sharded
+dim; S tokens per row; M model dim), gate logits ``[G, S, E]``, dispatch and
+combine tensors ``[G, S, E, C]`` with per-group capacity
+``C = ceil(k * S * capacity_factor / E)``.
+"""
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.parallel.topology import AXIS_DATA, AXIS_EXPERT
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int, k: int = 1) -> int:
+    """Per-group expert capacity (reference ``_capacity``, sharded_moe.py:120)."""
+    cap = int(np.ceil(k * num_tokens * capacity_factor / num_experts))
+    return max(cap, int(min_capacity))
+
+
+def _one_hot(x, depth, dtype=jnp.float32):
+    return jax.nn.one_hot(x, depth, dtype=dtype)
+
+
+def _rts_priority_locations(mask, rng):
+    """Random Token Selection (reference ``top1gating`` RTS path,
+    sharded_moe.py:229): tokens compete for capacity in a *random* order
+    instead of sequence order, debiasing dropped tokens. Implemented by
+    computing the within-expert cumsum along a random permutation of S."""
+    G, S, E = mask.shape
+    perm = jax.random.uniform(rng, (G, S)).argsort(axis=1)              # [G,S]
+    inv = perm.argsort(axis=1)
+    permuted = jnp.take_along_axis(mask, perm[:, :, None], axis=1)
+    loc_perm = jnp.cumsum(permuted, axis=1) - permuted
+    return jnp.take_along_axis(loc_perm, inv[:, :, None], axis=1)       # [G,S,E]
+
+
+def top1gating(logits: jnp.ndarray,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               used_token_mask: Optional[jnp.ndarray] = None,
+               noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True,
+               use_rts: bool = True,
+               rng: Optional[jnp.ndarray] = None):
+    """Top-1 gating (reference sharded_moe.py:177).
+
+    Returns ``(l_aux, combine_weights [G,S,E,C], dispatch_mask [G,S,E,C],
+    exp_counts [E])``.
+    """
+    G, S, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    if noisy_gate_policy == "RSample":
+        if rng is None:
+            raise ValueError("RSample gate needs an rng")
+        rng, sub = jax.random.split(rng)
+        logits_w_noise = logits + jax.random.gumbel(sub, logits.shape)
+    else:
+        logits_w_noise = logits
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    capacity = _capacity(S, E, capacity_factor, min_capacity, k=1)
+    if not drop_tokens:
+        capacity = S  # every token fits
+
+    idx1 = jnp.argmax(logits_w_noise, axis=-1)                          # [G,S]
+    mask1 = _one_hot(idx1, E)                                           # [G,S,E]
+    if used_token_mask is not None:
+        mask1 = mask1 * used_token_mask[..., None].astype(mask1.dtype)
+
+    exp_counts = jnp.sum(mask1, axis=(0, 1)).astype(jnp.int32)          # [E]
+
+    # load-balancing aux loss (reference :219): E * <fraction routed, mean gate>
+    me = jnp.mean(gates, axis=1)                                        # [G,E]
+    ce = jnp.mean(mask1, axis=1)                                        # [G,E]
+    l_aux = jnp.mean(jnp.sum(me * ce, axis=-1)) * E
+
+    if use_rts and drop_tokens:
+        if rng is None:
+            raise ValueError("use_rts needs an rng")
+        rng, sub = jax.random.split(rng)
+        locations1 = _rts_priority_locations(mask1, sub)
+    else:
+        locations1 = jnp.cumsum(mask1, axis=1) - mask1                  # [G,S,E]
+    mask1 = mask1 * (locations1 < capacity)
+
+    gates1 = jnp.sum(gates * mask1, axis=-1)                            # [G,S]
+    loc1 = jnp.sum(locations1 * mask1, axis=-1).astype(jnp.int32)       # [G,S]
+    combine = (gates1[:, :, None, None]
+               * mask1[:, :, :, None]
+               * _one_hot(loc1, capacity)[:, :, None, :])               # [G,S,E,C]
+    dispatch = combine > 0
+    return l_aux, combine, dispatch, exp_counts
+
+
+def top2gating(logits: jnp.ndarray,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               used_token_mask: Optional[jnp.ndarray] = None,
+               noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True,
+               rng: Optional[jnp.ndarray] = None):
+    """Top-2 gating (reference sharded_moe.py:278): second expert sampled from
+    the residual distribution; combine weights renormalized over the pair."""
+    G, S, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    capacity = _capacity(S, E, capacity_factor, min_capacity, k=2)
+    if not drop_tokens:
+        capacity = S
+
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(idx1, E)
+    if noisy_gate_policy == "RSample":
+        if rng is None:
+            raise ValueError("RSample gate needs an rng")
+        rng, sub = jax.random.split(rng)
+        logits_for_2nd = logits + jax.random.gumbel(sub, logits.shape)
+    else:
+        logits_for_2nd = logits
+    logits_no_top1 = jnp.where(mask1 > 0, -jnp.inf, logits_for_2nd)
+    idx2 = jnp.argmax(logits_no_top1, axis=-1)
+    mask2 = _one_hot(idx2, E)
+    if used_token_mask is not None:
+        m = used_token_mask[..., None].astype(mask1.dtype)
+        mask1, mask2 = mask1 * m, mask2 * m
+
+    # aux loss uses top-1 routing fractions (reference :300)
+    me = jnp.mean(gates, axis=1)
+    ce = jnp.mean(mask1, axis=1)
+    l_aux = jnp.mean(jnp.sum(me * ce, axis=-1)) * E
+
+    # pre-drop routing counts, matching top1gating's semantics
+    exp_counts = jnp.sum(mask1 + mask2, axis=(0, 1)).astype(jnp.int32)
+
+    locations1 = jnp.cumsum(mask1, axis=1) - mask1
+    # second choices queue behind ALL first choices of that expert
+    locations2 = jnp.cumsum(mask2, axis=1) - mask2 + jnp.sum(mask1, axis=1,
+                                                             keepdims=True)
+    mask1 = mask1 * (locations1 < capacity)
+    mask2 = mask2 * (locations2 < capacity)
+
+    gates1 = jnp.sum(gates * mask1, axis=-1)                            # [G,S]
+    gates2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.clip(gates1 + gates2, 1e-9, None)
+    gates1, gates2 = gates1 / denom, gates2 / denom
+
+    loc1 = jnp.sum(locations1 * mask1, axis=-1).astype(jnp.int32)
+    loc2 = jnp.sum(locations2 * mask2, axis=-1).astype(jnp.int32)
+    combine = (gates1[:, :, None, None] * mask1[:, :, :, None]
+               * _one_hot(loc1, capacity)[:, :, None, :]
+               + gates2[:, :, None, None] * mask2[:, :, :, None]
+               * _one_hot(loc2, capacity)[:, :, None, :])
+    dispatch = combine > 0
+    return l_aux, combine, dispatch, exp_counts
+
+
+def moe_dispatch_combine(x: jnp.ndarray,
+                         gate_logits: jnp.ndarray,
+                         expert_apply: Callable[[jnp.ndarray], jnp.ndarray],
+                         k: int = 1,
+                         capacity_factor: float = 1.0,
+                         min_capacity: int = 4,
+                         used_token_mask: Optional[jnp.ndarray] = None,
+                         noisy_gate_policy: Optional[str] = None,
+                         drop_tokens: bool = True,
+                         use_rts: bool = True,
+                         rng: Optional[jnp.ndarray] = None,
+                         use_sharding_constraints: bool = True):
+    """The MOELayer hot path (reference ``MOELayer.forward``,
+    sharded_moe.py:439): gate → dispatch einsum → [all_to_all] → experts →
+    [all_to_all] → combine einsum.
+
+    ``expert_apply``: maps ``[E, G*C, M] → [E, G*C, M]`` (vmapped experts;
+    params sharded over the ``expert`` axis). Returns ``(out [G,S,M], l_aux,
+    exp_counts)``.
+    """
+    G, S, M = x.shape
+    E = gate_logits.shape[-1]
+    if k == 1:
+        l_aux, combine, dispatch, exp_counts = top1gating(
+            gate_logits, capacity_factor, min_capacity,
+            used_token_mask=used_token_mask,
+            noisy_gate_policy=noisy_gate_policy, drop_tokens=drop_tokens,
+            use_rts=use_rts, rng=rng)
+    elif k == 2:
+        l_aux, combine, dispatch, exp_counts = top2gating(
+            gate_logits, capacity_factor, min_capacity,
+            used_token_mask=used_token_mask,
+            noisy_gate_policy=noisy_gate_policy, drop_tokens=drop_tokens,
+            rng=rng)
+    else:
+        raise ValueError(f"k must be 1 or 2, got {k}")
+
+    C = combine.shape[-1]
+
+    def _expert_layout_constraint(t):
+        if not use_sharding_constraints:
+            return t
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu.parallel.topology import get_topology
+
+        topo = get_topology(create_if_missing=False)
+        if topo is None:
+            return t
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(topo.mesh,
+                             P(AXIS_EXPERT, AXIS_DATA, *([None] * (t.ndim - 2)))))
+
+    # dispatch: [G,S,E,C] x [G,S,M] -> [E,G,C,M]; the layout change from
+    # G-sharded to E-sharded is the all_to_all (GSPMD inserts it over ICI)
+    expert_in = jnp.einsum("gsec,gsm->egcm", dispatch.astype(x.dtype), x)
+    expert_in = _expert_layout_constraint(expert_in)
+    expert_out = expert_apply(expert_in.reshape(E, G * C, M)).reshape(E, G, C, M)
+    expert_out = _expert_layout_constraint(expert_out)
+    out = jnp.einsum("gsec,egcm->gsm", combine.astype(x.dtype), expert_out)
+    return out, l_aux, exp_counts

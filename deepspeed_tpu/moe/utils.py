@@ -1,0 +1,34 @@
+"""MoE param utilities (reference ``deepspeed/moe/utils.py``).
+
+The reference splits model params into MoE/non-MoE optimizer groups so the
+engine can reduce expert grads over EP-DP groups only (``engine.py:2431``).
+With GSPMD the gradient partitioning is automatic (expert params are sharded
+over the ``expert`` axis, so their grads reduce over the remaining axes), but
+the classification surface is kept for checkpointing and param-group logic.
+"""
+
+from typing import Any, Dict, Tuple
+
+from deepspeed_tpu.utils.pytree import flatten_with_path_strings
+
+EXPERT_PATH_SEGMENT = "experts"
+
+
+def is_moe_param_path(path: str) -> bool:
+    return EXPERT_PATH_SEGMENT in path.split("/")
+
+
+def split_params_into_different_moe_groups_for_optimizer(
+        params: Any) -> Tuple[Dict, Dict]:
+    """Returns ``(non_moe_params, moe_params)`` as flat ``{path: leaf}`` dicts."""
+    flat, _ = flatten_with_path_strings(params)
+    moe, dense = {}, {}
+    for path, leaf in flat:
+        (moe if is_moe_param_path(path) else dense)[path] = leaf
+    return dense, moe
+
+
+def has_moe_layers(params: Any) -> bool:
+    """Reference ``engine.py:233-236`` detection."""
+    flat, _ = flatten_with_path_strings(params)
+    return any(is_moe_param_path(path) for path, _leaf in flat)

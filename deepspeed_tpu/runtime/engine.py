@@ -256,22 +256,42 @@ class DeepSpeedEngine:
             return init_fn(jax.random.PRNGKey(self._config._param_dict.get("seed", 42)))
 
     def _tp_base_specs(self, params_abstract):
-        """Tensor-parallel base PartitionSpecs (or None when model axis is 1).
+        """Model-parallel base PartitionSpecs: TP (model axis) via
+        module_inject policy and EP (expert axis) via the ``experts`` path
+        rule. Returns None when neither axis is active.
 
         The model may supply its own (``model.param_specs(abstract)``); else a
         module_inject policy maps param paths to specs (reference
         ``module_inject/replace_policy.py`` per-arch classes)."""
-        from deepspeed_tpu.parallel.topology import AXIS_MODEL
+        from deepspeed_tpu.parallel.topology import AXIS_EXPERT, AXIS_MODEL
 
-        if self.topology.axis_size(AXIS_MODEL) <= 1:
+        tp = self.topology.axis_size(AXIS_MODEL)
+        ep = self.topology.axis_size(AXIS_EXPERT)
+        if tp <= 1 and ep <= 1:
             return None
         if hasattr(self.module, "param_specs"):
             return self.module.param_specs(params_abstract)
-        from deepspeed_tpu.module_inject import get_tp_policy, specs_from_policy
+        from deepspeed_tpu.module_inject import get_tp_policy
+        from deepspeed_tpu.moe.utils import is_moe_param_path
+        from deepspeed_tpu.utils.pytree import flatten_with_path_strings
 
         policy = get_tp_policy(self._config.tensor_parallel_config.get(
             "policy", "auto"))
-        return specs_from_policy(policy, params_abstract, self.mesh)
+        flat, treedef = flatten_with_path_strings(params_abstract)
+        specs = []
+        for path, leaf in flat:
+            if ep > 1 and is_moe_param_path(path) and leaf.ndim > 0 \
+                    and leaf.shape[0] % ep == 0:
+                # expert params: leading E dim over the expert axis; TP can
+                # still shard the remaining dims
+                inner = policy.spec_for(path, tuple(leaf.shape[1:]), tp) if tp > 1 else None
+                inner_entries = list(inner) if inner is not None else \
+                    [None] * (leaf.ndim - 1)
+                specs.append(P(AXIS_EXPERT, *inner_entries))
+            else:
+                specs.append(policy.spec_for(path, tuple(leaf.shape), tp)
+                             if tp > 1 else None)
+        return jax.tree_util.tree_unflatten(treedef, specs)
 
     def _shardings_for(self, params_abstract):
         return build_zero_shardings(
@@ -340,10 +360,10 @@ class DeepSpeedEngine:
         grad_shardings = self._state_shardings.grad_acc
 
         def micro_step(state: TrainState, batch):
-            rng, sub = jax.random.split(state.rng)
+            rng, sub, sub2 = jax.random.split(state.rng, 3)
 
             def scaled_loss(p):
-                loss = loss_fn(p, batch, rngs={"dropout": sub})
+                loss = loss_fn(p, batch, rngs={"dropout": sub, "gating": sub2})
                 return loss * (state.loss_scale.loss_scale if fp16 else 1.0) / gas
 
             loss_scaled, grads = jax.value_and_grad(scaled_loss)(state.params)

@@ -54,12 +54,15 @@ def zero_partition_spec(shape: Tuple[int, ...],
     Returns ``base_spec`` unchanged if the array is too small (persistence
     threshold) or no dim divides evenly.
     """
-    data_axes = [a for a in data_axes if mesh.shape.get(a, 1) > 1]
+    entries = list(base_spec) if base_spec is not None else []
+    entries += [None] * (len(shape) - len(entries))
+    used = {a for e in entries for a in (e if isinstance(e, tuple) else (e,)) if a}
+    # a mesh axis may appear at most once in a spec: e.g. expert params carry
+    # "expert" in their base spec, so ZeRO shards them over "data" only
+    data_axes = [a for a in data_axes if mesh.shape.get(a, 1) > 1 and a not in used]
     if not data_axes:
         return base_spec if base_spec is not None else P()
     axis_size = int(np.prod([mesh.shape[a] for a in data_axes]))
-    entries = list(base_spec) if base_spec is not None else []
-    entries += [None] * (len(shape) - len(entries))
     if int(np.prod(shape)) < max(persistence_threshold, axis_size):
         return P(*entries) if base_spec is not None else P()
     dim = _shardable_dim(shape, axis_size, entries)

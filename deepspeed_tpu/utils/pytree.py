@@ -1,0 +1,17 @@
+"""Pytree path utilities shared by the sharding-policy machinery."""
+
+from typing import Any, List, Tuple
+
+import jax
+
+
+def key_entry_str(k) -> str:
+    """One path component of a jax KeyPath entry (DictKey/SequenceKey/...)."""
+    return str(getattr(k, "key", getattr(k, "idx", k)))
+
+
+def flatten_with_path_strings(tree: Any) -> Tuple[List[Tuple[str, Any]], Any]:
+    """Flatten a pytree to ``([(\"a/b/c\", leaf), ...], treedef)``."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return [("/".join(key_entry_str(k) for k in key_path), leaf)
+            for key_path, leaf in flat], treedef

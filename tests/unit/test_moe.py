@@ -1,0 +1,187 @@
+"""MoE tests (mirror reference ``tests/unit/moe/test_moe.py``)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.moe import (
+    MoE,
+    has_moe_layers,
+    is_moe_param_path,
+    moe_dispatch_combine,
+    split_params_into_different_moe_groups_for_optimizer,
+    top1gating,
+    top2gating,
+)
+from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    reset_topology()
+    yield
+    reset_topology()
+
+
+def _logits(G=2, S=16, E=4, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).normal(size=(G, S, E)),
+                       jnp.float32)
+
+
+class TestGating:
+    def test_top1_dispatch_within_capacity(self):
+        logits = _logits()
+        l_aux, combine, dispatch, counts = top1gating(
+            logits, capacity_factor=1.0, min_capacity=1, use_rts=False)
+        # each token goes to <=1 expert slot; each (expert, slot) <=1 token
+        per_token = jnp.sum(dispatch, axis=(2, 3))
+        assert float(jnp.max(per_token)) <= 1.0
+        per_slot = jnp.sum(dispatch, axis=1)
+        assert float(jnp.max(per_slot)) <= 1.0
+        assert float(l_aux) > 0
+        assert int(jnp.sum(counts)) == 2 * 16  # pre-drop routing counts
+
+    def test_top1_capacity_drops(self):
+        # all tokens prefer expert 0 → only `capacity` dispatched
+        logits = jnp.zeros((1, 16, 4)).at[:, :, 0].set(5.0)
+        _, _, dispatch, _ = top1gating(logits, capacity_factor=1.0,
+                                       min_capacity=1, use_rts=False)
+        assert int(jnp.sum(dispatch)) == 4  # ceil(16/4)
+
+    def test_top1_no_drop(self):
+        logits = jnp.zeros((1, 16, 4)).at[:, :, 0].set(5.0)
+        _, _, dispatch, _ = top1gating(logits, capacity_factor=1.0,
+                                       min_capacity=1, drop_tokens=False,
+                                       use_rts=False)
+        assert int(jnp.sum(dispatch)) == 16
+
+    def test_top1_rts_respects_capacity(self):
+        logits = jnp.zeros((1, 16, 4)).at[:, :, 0].set(5.0)
+        _, _, dispatch, _ = top1gating(logits, capacity_factor=1.0,
+                                       min_capacity=1, use_rts=True,
+                                       rng=jax.random.PRNGKey(0))
+        assert int(jnp.sum(dispatch)) == 4
+
+    def test_top2_combine_normalized(self):
+        logits = _logits()
+        _, combine, dispatch, _ = top2gating(logits, capacity_factor=2.0,
+                                             min_capacity=16)
+        # with ample capacity every token keeps both experts; weights sum to 1
+        sums = jnp.sum(combine, axis=(2, 3))
+        np.testing.assert_allclose(np.asarray(sums), 1.0, atol=1e-5)
+
+    def test_used_token_mask(self):
+        logits = _logits()
+        mask = jnp.zeros((2, 16)).at[:, :8].set(1.0)
+        _, _, dispatch, _ = top1gating(logits, capacity_factor=4.0,
+                                       min_capacity=16, use_rts=False,
+                                       used_token_mask=mask)
+        routed = jnp.sum(dispatch, axis=(2, 3))
+        assert float(jnp.max(routed[:, 8:])) == 0.0
+
+
+class TestDispatchCombine:
+    def test_identity_experts_roundtrip(self):
+        """With identity experts and ample capacity, top-2 combine must
+        reconstruct ~the input (weights sum to 1)."""
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 16, 8)),
+                        jnp.float32)
+        logits = _logits(E=4)
+        out, l_aux, _ = moe_dispatch_combine(
+            x, logits, lambda t: t, k=2, capacity_factor=4.0, min_capacity=32,
+            use_sharding_constraints=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-5)
+
+
+class _MoEClassifier(nn.Module):
+    """Reference ``SimpleMoEModel`` analog: dense in → MoE → dense out."""
+
+    dim: int = 16
+    num_experts: int = 4
+    k: int = 1
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        h = nn.Dense(self.dim, name="in_proj")(x)
+        h, l_aux, _ = MoE(model_dim=self.dim, num_experts=self.num_experts,
+                          expert_hidden_dim=4 * self.dim, k=self.k,
+                          capacity_factor=2.0, min_capacity=4,
+                          name="moe")(h, deterministic=deterministic)
+        out = nn.Dense(self.dim, name="out_proj")(h)
+        return out, l_aux
+
+
+class _MoEForTraining:
+    def __init__(self, **kw):
+        self.model = _MoEClassifier(**kw)
+
+    def init(self, rng, batch):
+        x, _ = batch
+        return self.model.init(rng, x)
+
+    def loss_fn(self, params, batch, rngs=None):
+        x, y = batch
+        out, l_aux = self.model.apply({"params": params}, x,
+                                      deterministic=rngs is None, rngs=rngs)
+        return jnp.mean((out - y) ** 2) + 0.01 * l_aux
+
+
+def _batch(rng, B=8, S=8, D=16):
+    x = rng.normal(size=(B, S, D)).astype(np.float32)
+    return x, np.tanh(x * 2.0)
+
+
+class TestMoETraining:
+    @pytest.mark.parametrize("axis_sizes,k", [
+        ({"data": 8}, 1),
+        ({"data": 2, "expert": 4}, 1),
+        ({"data": 2, "expert": 4}, 2),
+    ])
+    def test_trains(self, axis_sizes, k):
+        topo = MeshTopology(axis_sizes=axis_sizes, devices=jax.devices()[:8])
+        engine, *_ = deepspeed_tpu.initialize(
+            model=_MoEForTraining(k=k), mesh=topo,
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+                    "zero_optimization": {"stage": 1},
+                    "steps_per_print": 10_000})
+        rng = np.random.default_rng(0)
+        losses = []
+        for _ in range(30):
+            loss = engine(_batch(rng))
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_expert_params_sharded(self):
+        topo = MeshTopology(axis_sizes={"data": 2, "expert": 4},
+                            devices=jax.devices()[:8])
+        engine, *_ = deepspeed_tpu.initialize(
+            model=_MoEForTraining(), mesh=topo,
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "steps_per_print": 10_000})
+        rng = np.random.default_rng(0)
+        engine(_batch(rng))
+        wi = engine.state.params["moe"]["experts"]["wi"]["kernel"]
+        assert wi.shape[0] == 4
+        flat_axes = [a for e in wi.sharding.spec
+                     for a in (e if isinstance(e, tuple) else (e,)) if a]
+        assert "expert" in flat_axes, wi.sharding.spec
+
+
+class TestMoEUtils:
+    def test_param_split(self):
+        params = {"moe": {"experts": {"wi": {"kernel": jnp.zeros((4, 2, 2))}},
+                          "gate": {"kernel": jnp.zeros((2, 4))}},
+                  "out": {"kernel": jnp.zeros((2, 2))}}
+        dense, moe = split_params_into_different_moe_groups_for_optimizer(params)
+        assert set(moe) == {"moe/experts/wi/kernel"}
+        assert "out/kernel" in dense and "moe/gate/kernel" in dense
+        assert has_moe_layers(params)
+        assert is_moe_param_path("moe/experts/wi/kernel")
+        assert not is_moe_param_path("moe/gate/kernel")

@@ -48,9 +48,23 @@ def attention(q, k, v, mask=None, causal=True, softmax_scale=None,
               dropout_rate=0.0, dropout_rng=None, use_flash: Optional[bool] = None):
     """Dispatching attention entry point.
 
-    ``use_flash=None`` → Pallas flash kernel on TPU when shapes allow,
-    XLA reference otherwise.
+    Auto mode (``use_flash=None``): seq axis active on the mesh → ring
+    attention (sequence parallelism) when shapes allow; else the Pallas flash
+    kernel on TPU; else the XLA reference. An explicit ``use_flash`` bool
+    bypasses ring dispatch (the escape hatch for numerics comparison).
     """
+    from deepspeed_tpu.parallel.topology import AXIS_SEQ, get_topology
+
+    topo = get_topology(create_if_missing=False)
+    if (use_flash is None and topo is not None
+            and topo.axis_size(AXIS_SEQ) > 1
+            and mask is None and dropout_rate == 0.0
+            and q.shape[-2] == k.shape[-2]
+            and q.shape[-2] % topo.axis_size(AXIS_SEQ) == 0):
+        from deepspeed_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, causal=causal,
+                              softmax_scale=softmax_scale, mesh=topo.mesh)
     if use_flash is None:
         use_flash = _on_tpu() and dropout_rate == 0.0 and mask is None
     if use_flash:

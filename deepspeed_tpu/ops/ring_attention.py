@@ -1,0 +1,116 @@
+"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+
+The reference (v0.8.0) has NO sequence parallelism (SURVEY.md §5.7: no
+Ulysses/ring/context-parallel; only block-sparse attention and activation
+partitioning). This is the capability upgrade the TPU build treats as
+first-class: sequences shard over the ``seq`` mesh axis, each device holds a
+``T/n`` block of q/k/v, and k/v blocks rotate around the ring via
+``ppermute`` over ICI while each device accumulates online-softmax state —
+attention over sequences far beyond one chip's HBM, with communication
+overlapped by the per-step matmuls.
+
+Differentiable end-to-end (scan + ppermute transpose rules); wrap the caller
+in ``jax.checkpoint`` for long-sequence memory if needed.
+"""
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+    AXIS_SEQ,
+)
+
+NEG_INF = -1e30
+
+
+def _ring_body(q, k, v, *, axis_name, n, causal, scale):
+    """Per-device ring loop. q/k/v local blocks: [B, H, Tl, D]."""
+    idx = jax.lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    qf = q.astype(jnp.float32)
+    rows = idx * Tl + jnp.arange(Tl)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def accum(state, k_cur, v_cur, kv_idx):
+        m, l, acc = state
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32)) * scale
+        if causal:
+            cols = kv_idx * Tl + jnp.arange(Tl)
+            s = jnp.where(rows[:, None] >= cols[None, :], s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # fully-masked blocks: keep p at 0 (same guard as the flash kernel)
+        p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        return m_new, l_new, acc_new
+
+    def step(carry, i):
+        state, k_cur, v_cur = carry
+        # permute at the top: n-1 hops total, no dead final collective
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        state = accum(state, k_cur, v_cur, kv_idx=(idx - i) % n)
+        return (state, k_cur, v_cur), None
+
+    state0 = (jnp.full((B, H, Tl), NEG_INF, jnp.float32),
+              jnp.zeros((B, H, Tl), jnp.float32),
+              jnp.zeros((B, H, Tl, D), jnp.float32))
+    state = accum(state0, k, v, kv_idx=idx)  # step 0: the local block
+    if n > 1:
+        (state, _, _), _ = jax.lax.scan(step, (state, k, v), jnp.arange(1, n))
+    m, l, acc = state
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / safe_l[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v,
+                   causal: bool = True,
+                   softmax_scale: Optional[float] = None,
+                   axis_name: str = AXIS_SEQ,
+                   mesh=None,
+                   batch_axes: Sequence[str] = (AXIS_DATA, AXIS_EXPERT)):
+    """Sequence-parallel attention. q,k,v: [batch, heads, seq, head_dim],
+    with seq sharded over ``axis_name`` on the mesh.
+
+    Falls back to the XLA reference path when the seq axis is absent/1.
+    """
+    from deepspeed_tpu.ops.attention import attention_reference
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    if mesh is None:
+        topo = get_topology(create_if_missing=False)
+        mesh = topo.mesh if topo is not None else None
+    if mesh is None or mesh.shape.get(axis_name, 1) <= 1:
+        return attention_reference(q, k, v, causal=causal,
+                                   softmax_scale=softmax_scale)
+    n = int(mesh.shape[axis_name])
+    if q.shape[2] != k.shape[2]:
+        raise ValueError(
+            f"ring_attention requires seq_q == seq_k (got {q.shape[2]} vs "
+            f"{k.shape[2]}); cross-length (kv-cache) attention uses the "
+            "decode path")
+    if q.shape[2] % n:
+        raise ValueError(f"seq len {q.shape[2]} not divisible by seq axis {n}")
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+
+    from deepspeed_tpu.parallel.topology import axis_spec_entry
+
+    bspec = axis_spec_entry(mesh, batch_axes, q.shape[0])
+    # heads shard over the model axis when TP is active (column-parallel qkv)
+    hspec = axis_spec_entry(mesh, (AXIS_MODEL,), q.shape[1])
+    spec = P(bspec, hspec, axis_name, None)
+    body = functools.partial(_ring_body, axis_name=axis_name, n=n,
+                             causal=causal, scale=scale)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)

@@ -252,6 +252,20 @@ class MeshTopology:
         return f"MeshTopology({live or {AXIS_DATA: 1}}, world_size={self.world_size})"
 
 
+def axis_spec_entry(mesh, axes: Sequence[str], dim_size: Optional[int] = None):
+    """One PartitionSpec entry sharding a dim over the active subset of
+    ``axes`` — None when no axis is active or ``dim_size`` isn't divisible.
+    Shared by batch sharding and shard_map spec builders so divisibility
+    handling can't diverge."""
+    active = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
+    if not active:
+        return None
+    size = int(np.prod([mesh.shape[a] for a in active]))
+    if dim_size is not None and dim_size % size != 0:
+        return None
+    return active if len(active) > 1 else active[0]
+
+
 # ----------------------------------------------------------------------
 # Global topology registry (reference deepspeed/utils/groups.py module state)
 _WORLD_TOPOLOGY: Optional[MeshTopology] = None

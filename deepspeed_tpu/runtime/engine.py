@@ -421,7 +421,7 @@ class DeepSpeedEngine:
     def _shard_batch(self, batch):
         def put(x):
             x = np.asarray(x) if not isinstance(x, jax.Array) else x
-            sh = batch_sharding(self.mesh, ndim=x.ndim)
+            sh = batch_sharding(self.mesh, ndim=x.ndim, shape=x.shape)
             return jax.device_put(x, sh)
 
         return jax.tree_util.tree_map(put, batch)

@@ -161,10 +161,17 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def batch_sharding(mesh: Mesh, data_axes: Sequence[str] = (AXIS_DATA, AXIS_EXPERT),
-                   ndim: int = 2) -> NamedSharding:
-    """Batch arrays: leading dim sharded over the data axes."""
-    axes = tuple(a for a in data_axes if mesh.shape.get(a, 1) > 1)
-    if not axes:
-        return NamedSharding(mesh, P())
-    lead = axes if len(axes) > 1 else axes[0]
-    return NamedSharding(mesh, P(lead, *([None] * (ndim - 1))))
+                   ndim: int = 2, shape: Optional[Tuple[int, ...]] = None) -> NamedSharding:
+    """Batch arrays: leading dim sharded over the data axes; with sequence
+    parallelism active, dim 1 (tokens) additionally shards over ``seq``.
+    Dims not divisible by their axis product stay unsharded (requires
+    ``shape``)."""
+    from deepspeed_tpu.parallel.topology import AXIS_SEQ, axis_spec_entry
+
+    entries = [None] * ndim
+    entries[0] = axis_spec_entry(mesh, data_axes,
+                                 shape[0] if shape is not None else None)
+    if ndim >= 2:
+        entries[1] = axis_spec_entry(mesh, (AXIS_SEQ,),
+                                     shape[1] if shape is not None else None)
+    return NamedSharding(mesh, P(*entries))

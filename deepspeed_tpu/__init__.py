@@ -32,6 +32,8 @@ def initialize(args=None,
     Returns a tuple of ``engine, optimizer, training_dataloader, lr_scheduler``.
     """
     from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
     from deepspeed_tpu.utils.logging import log_dist
 
     log_dist(f"DeepSpeed-TPU info: version={__version__}", ranks=[0])
@@ -41,7 +43,9 @@ def initialize(args=None,
     if config is None and args is not None and hasattr(args, "deepspeed_config"):
         config = args.deepspeed_config
 
-    engine = DeepSpeedEngine(args=args,
+    engine_cls = PipelineEngine if isinstance(model, PipelineModule) \
+        else DeepSpeedEngine
+    engine = engine_cls(args=args,
                              model=model,
                              optimizer=optimizer,
                              model_parameters=model_parameters,

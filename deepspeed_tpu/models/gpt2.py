@@ -47,7 +47,10 @@ class GPT2Config:
     def tiny(**kw):
         kw.setdefault("vocab_size", 256)
         kw.setdefault("n_positions", 64)
-        return GPT2Config(n_embd=64, n_layer=2, n_head=4, **kw)
+        kw.setdefault("n_embd", 64)
+        kw.setdefault("n_layer", 2)
+        kw.setdefault("n_head", 4)
+        return GPT2Config(**kw)
 
 
 def _dense_init(scale=0.02):
@@ -260,6 +263,69 @@ class GPT2ForTraining:
 
     def apply(self, variables, batch, rngs=None):
         return self.model.apply(variables, self._input_ids(batch), rngs=rngs)
+
+
+class GPT2Embed(nn.Module):
+    """Input embedding layer for the pipeline layout (stage-0 work). Its
+    parameters are tied with the LM head via ``TiedLayerSpec(key="embed")``.
+    """
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True):
+        cfg = self.config
+        wte = self.param("wte", _dense_init(), (cfg.vocab_size, cfg.n_embd),
+                         jnp.float32)
+        wpe = self.param("wpe", _dense_init(0.01), (cfg.n_positions, cfg.n_embd),
+                         jnp.float32)
+        T = input_ids.shape[-1]
+        x = wte[input_ids].astype(cfg.dtype) + wpe[None, :T].astype(cfg.dtype)
+        if cfg.dropout > 0:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        return x
+
+
+class GPT2FinalNorm(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        return nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                            name="ln_f")(x)
+
+
+def gpt2_pipe(config: GPT2Config):
+    """GPT-2 as a :class:`PipelineModule` layer list (reference: GPT2 built
+    from ``LayerSpec`` lists for ``PipelineModule`` in Megatron-DeepSpeed).
+
+    Layout: tied embedding → n_layer Blocks (sharded over ``pipe``) →
+    final LN → tied LM head. Loss shifts labels internally.
+    """
+    from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                                   TiedLayerSpec)
+
+    def head_fn(embed_params, x):
+        wte = embed_params["wte"]
+        return jnp.einsum("btc,vc->btv", x, wte.astype(x.dtype),
+                          preferred_element_type=jnp.float32)
+
+    def loss_fn(logits, labels):
+        shifted = jnp.concatenate(
+            [labels[:, 1:], jnp.full((labels.shape[0], 1), -100, labels.dtype)],
+            axis=1)
+        return cross_entropy_loss(logits, shifted)
+
+    layers = [
+        TiedLayerSpec(GPT2Embed, config, key="embed"),
+        *[LayerSpec(Block, config) for _ in range(config.n_layer)],
+        LayerSpec(GPT2FinalNorm, config),
+        TiedLayerSpec(GPT2Embed, config, key="embed", forward_fn=head_fn),
+    ]
+    return PipelineModule(layers=layers, loss_fn=loss_fn,
+                          partition_method="parameters",
+                          use_rngs=config.dropout > 0)
 
 
 def gpt2_loss_fn(model: GPT2LMHeadModel):

@@ -373,6 +373,17 @@ class DeepSpeedEngine:
             loss = loss_scaled * gas / (state.loss_scale.loss_scale if fp16 else 1.0)
             return state._replace(grad_acc=grad_acc, rng=rng), loss
 
+        shardings = self._state_shardings
+        self._jit_micro = jax.jit(
+            micro_step,
+            in_shardings=(shardings, None),
+            out_shardings=(shardings, replicated(self.mesh)),
+            donate_argnums=(0,))
+        self._compile_steps_apply_only()
+
+    def _compile_steps_apply_only(self):
+        """Compile the optimizer-apply program (shared with PipelineEngine)."""
+        fp16 = self.fp16_enabled_
         clip = self._config.gradient_clipping
         optimizer = self.optimizer
         schedule_fn = self._schedule_fn
@@ -407,11 +418,6 @@ class DeepSpeedEngine:
             ), overflow, grad_norm
 
         shardings = self._state_shardings
-        self._jit_micro = jax.jit(
-            micro_step,
-            in_shardings=(shardings, None),
-            out_shardings=(shardings, replicated(self.mesh)),
-            donate_argnums=(0,))
         self._jit_apply = jax.jit(
             apply_step,
             in_shardings=(shardings, replicated(self.mesh)),
@@ -694,12 +700,15 @@ class DeepSpeedEngine:
                 tag = f.read().strip()
         ckpt_dir = os.path.join(load_dir, str(tag))
         flat_module = self.checkpoint_engine.load(os.path.join(ckpt_dir, "module"))
-        params = _unflatten_by_paths(flat_module, prefix="params/")
-        if self.state is None:
-            self._build_state(params)
-        else:
+        if self.state is not None:
+            # rebuild against the live tree (handles lists/namedtuples —
+            # e.g. the PipelineModule param layout)
+            params = _fill_template(self.state.params, flat_module, "params/")
             params = jax.device_put(params, self._state_shardings.params)
             self.state = self.state._replace(params=params)
+        else:
+            params = _unflatten_by_paths(flat_module, prefix="params/")
+            self._build_state(params)
         if load_module_only:
             return tag, {}
         if load_optimizer_states:

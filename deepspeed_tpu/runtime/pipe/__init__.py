@@ -1,0 +1,9 @@
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec,
+                                               partition_balanced)
+from deepspeed_tpu.runtime.pipe.schedule import (DataParallelSchedule,
+                                                 InferenceSchedule,
+                                                 TrainSchedule)
+
+__all__ = ["LayerSpec", "TiedLayerSpec", "PipelineModule", "partition_balanced",
+           "TrainSchedule", "InferenceSchedule", "DataParallelSchedule"]

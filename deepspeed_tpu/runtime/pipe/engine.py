@@ -1,0 +1,307 @@
+"""Pipeline-parallel training engine — the compiled 1F1B/GPipe schedule.
+
+Capability parity with the reference ``PipelineEngine``
+(``deepspeed/runtime/pipe/engine.py:36``): ``train_batch(data_iter)`` runs
+``gas`` micro-batches through the stage pipeline and applies the optimizer.
+The reference interprets a ``TrainSchedule`` instruction list with imperative
+P2P sends (``pipe/p2p.py``) and per-buffer autograd; on TPU the *entire*
+schedule is one XLA program:
+
+- stages live on the ``pipe`` mesh axis; the model's repeated blocks are
+  sharded over it (``PipelineModule``);
+- a ``shard_map`` manual over ``pipe`` (auto/GSPMD over data/model/seq axes)
+  runs ``M + P - 1`` "clock ticks"; each tick every stage applies its blocks
+  and passes its activation to the next stage via ``lax.ppermute`` — the
+  SendActivation/RecvActivation instructions;
+- stage 0 injects micro-batch ``t`` (LoadMicroBatch) and the last stage
+  computes the loss for micro-batch ``t - (P-1)`` under ``lax.cond`` so other
+  stages skip the embedding/head FLOPs;
+- ``jax.grad`` through the scan-of-ticks *is* the backward schedule: the
+  transpose of ``ppermute`` sends grads backwards (SendGrad/RecvGrad), the
+  transpose of the replicated-in tied/pre/post params is the tied-grad
+  all-reduce over ``pipe`` (ReduceTiedGrads), and GSPMD's data-axis psum is
+  ReduceGrads. Each tick is ``jax.checkpoint``-ed, so backward recomputes one
+  tick's activations at a time (activation-checkpoint-per-micro-batch — the
+  1F1B memory profile rather than GPipe's all-activations-live).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import AXIS_PIPE
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState
+from deepspeed_tpu.runtime.pipe.module import PipelineModule
+from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
+from deepspeed_tpu.runtime.zero.partition import replicated
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def pipeline_loss_fn(module: PipelineModule, mesh, n_micro: int):
+    """Build ``loss(params, (inputs, labels), rng) -> mean loss`` running the
+    pipelined schedule over ``n_micro`` micro-batches.
+
+    ``inputs``/``labels`` are [M, mb, ...]; blocks params are [L, ...] sharded
+    over ``pipe`` (L/P per stage).
+    """
+    n_stages = mesh.shape[AXIS_PIPE]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    use_rngs = module.use_rngs
+
+    def body(params, inputs, labels, rng):
+        stage = jax.lax.axis_index(AXIS_PIPE)
+        extras = {"pre": params["pre"], "post": params["post"],
+                  "tied": params["tied"]}
+        blocks = params["blocks"]  # local view: [L/P, ...]
+
+        def stage_rngs(t):
+            if not use_rngs:
+                return None
+            k = jax.random.fold_in(jax.random.fold_in(rng, t), stage)
+            return {"dropout": k}
+
+        def run_blocks(x, t):
+            def blk(x, bp):
+                return module.block_apply(bp, x, rngs=stage_rngs(t)), None
+
+            x, _ = jax.lax.scan(blk, x, blocks)
+            return x
+
+        mb0 = jax.tree_util.tree_map(lambda a: a[0], inputs)
+        act_shape = jax.eval_shape(
+            lambda p, b: module.pre_apply(p, b), extras, mb0)
+        zero_act = jnp.zeros(act_shape.shape, act_shape.dtype)
+
+        def stage_select(pred, true_fn, false_val):
+            # lax.cond skips the untaken branch's FLOPs (embedding/head run
+            # only on their stage). With dropout rngs active, grad-of-cond
+            # under remat trips a JAX partial-eval assertion (mismatched
+            # branch residuals), so fall back to a both-sides where-select.
+            if not use_rngs:
+                return jax.lax.cond(pred, true_fn, lambda: false_val)
+            return jnp.where(pred, true_fn(), false_val)
+
+        @jax.checkpoint
+        def tick(carry, t):
+            state, loss_sum, count = carry
+            in_idx = jnp.clip(t, 0, n_micro - 1)
+            mb = jax.tree_util.tree_map(lambda a: a[in_idx], inputs)
+            # LoadMicroBatch on stage 0; other stages use the received act
+            x = stage_select(
+                stage == 0,
+                lambda: module.pre_apply(extras, mb, rngs=stage_rngs(t)),
+                state)
+            y = run_blocks(x, t)
+            # last stage: loss of micro-batch t-(P-1) (if one has arrived)
+            out_idx = t - (n_stages - 1)
+            lab = jax.tree_util.tree_map(
+                lambda a: a[jnp.clip(out_idx, 0, n_micro - 1)], labels)
+            take = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+            loss_t = stage_select(
+                take,
+                lambda: module.loss_fn(
+                    module.post_apply(extras, y, rngs=stage_rngs(t)),
+                    lab).astype(jnp.float32),
+                jnp.zeros((), jnp.float32))
+            loss_sum = loss_sum + loss_t
+            count = count + take.astype(jnp.int32)
+            # SendActivation/RecvActivation: rotate stage outputs forward
+            state = jax.lax.ppermute(y, AXIS_PIPE, perm)
+            return (state, loss_sum, count), None
+
+        total_ticks = n_micro + n_stages - 1
+        (_, loss_sum, count), _ = jax.lax.scan(
+            tick, (zero_act, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+            jnp.arange(total_ticks))
+        # broadcast the last stage's mean loss to all stages
+        loss_sum = jax.lax.psum(loss_sum, AXIS_PIPE)
+        count = jax.lax.psum(count, AXIS_PIPE)
+        return loss_sum / count.astype(jnp.float32)
+
+    spec_params = {"pre": P(), "blocks": P(AXIS_PIPE), "post": P(), "tied": P()}
+    smapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec_params, P(), P(), P()),
+        out_specs=P(),
+        axis_names={AXIS_PIPE},
+        check_vma=False)
+
+    def loss_fn(params, batch, rngs=None):
+        inputs, labels = batch
+        rng = rngs["dropout"] if isinstance(rngs, dict) else (
+            rngs if rngs is not None else jax.random.PRNGKey(0))
+        return smapped(params, inputs, labels, rng)
+
+    return loss_fn
+
+
+class PipelineEngine(DeepSpeedEngine):
+    """Training engine for :class:`PipelineModule` models.
+
+    ``forward``/``train_batch`` consume a *full* batch (``gas`` micro-batches
+    at once) because the pipelined schedule over all micro-batches is a
+    single compiled program; ``is_gradient_accumulation_boundary`` is
+    therefore always True (reference parity: ``PipelineEngine.train_batch``
+    also hides micro-batching from the user).
+    """
+
+    def __init__(self, *args, **kwargs):
+        model = kwargs.get("model")
+        if model is None and len(args) >= 2:
+            model = args[1]
+        assert isinstance(model, PipelineModule), \
+            "PipelineEngine requires a PipelineModule"
+        self._pipe_module = model
+        self._pipe_ready = False
+        # super().__init__ may already build state (model_parameters given),
+        # which routes through _compile_steps → _finalize_pipe_setup
+        super().__init__(*args, **kwargs)
+        self._finalize_pipe_setup()
+
+    def _finalize_pipe_setup(self):
+        """Validate topology/config once both are parsed. Called from both
+        ``__init__`` and ``_compile_steps`` (whichever runs first — state may
+        be built inside ``super().__init__`` when params are passed in)."""
+        if self._pipe_ready:
+            return
+        if self.zero_optimization_stage() > 2:
+            raise ValueError(
+                "ZeRO-3 is incompatible with pipeline parallelism "
+                "(reference parity: engine.py asserts the same); use stage<=2")
+        n_stages = self.topology.get_pipe_parallel_world_size()
+        self._pipe_module.validate_stages(n_stages)
+        self.num_stages = n_stages
+        self.micro_batches = self.gradient_accumulation_steps()
+        self._pipe_ready = True
+        log_dist(
+            f"PipelineEngine: stages={n_stages} micro_batches="
+            f"{self.micro_batches} blocks/stage="
+            f"{self._pipe_module.n_blocks // n_stages}", ranks=[0])
+
+    # the PipelineModule is not a plain loss fn — the pipelined loss is
+    # built in _compile_steps
+    def _resolve_loss_fn(self, model):
+        def unavailable(*a, **k):
+            raise RuntimeError("pipeline loss is compiled in _compile_steps")
+
+        return unavailable
+
+    def _tp_base_specs(self, params_abstract):
+        """Blocks carry the leading layer axis sharded over ``pipe``; pre/
+        post/tied replicated (tied-layer replication, ``module.py:420``)."""
+        def spec_blocks(leaf):
+            return P(AXIS_PIPE, *([None] * (leaf.ndim - 1)))
+
+        return {
+            "pre": jax.tree_util.tree_map(lambda _: None, params_abstract["pre"]),
+            "blocks": jax.tree_util.tree_map(
+                spec_blocks, params_abstract["blocks"],
+                is_leaf=lambda x: hasattr(x, "shape")),
+            "post": jax.tree_util.tree_map(lambda _: None, params_abstract["post"]),
+            "tied": jax.tree_util.tree_map(lambda _: None, params_abstract["tied"]),
+        }
+
+    def _init_params(self, batch):
+        inputs, _ = self._split_batch_labels(batch)
+        mb = jax.tree_util.tree_map(
+            lambda a: np.asarray(a)[: self._micro_batch_rows()], inputs)
+        seed = self._config._param_dict.get("seed", 42)
+        params = self._pipe_module.init_params(jax.random.PRNGKey(seed), mb)
+        return params
+
+    def _micro_batch_rows(self) -> int:
+        return (self.train_micro_batch_size_per_gpu()
+                * self.topology.get_data_parallel_world_size())
+
+    @staticmethod
+    def _split_batch_labels(batch):
+        if isinstance(batch, dict):
+            inputs = batch["input_ids"] if "input_ids" in batch else batch["inputs"]
+            labels = batch.get("labels", inputs)
+            return inputs, labels
+        if isinstance(batch, (tuple, list)) and len(batch) == 2:
+            return batch[0], batch[1]
+        return batch, batch
+
+    def _compile_steps(self):
+        self._finalize_pipe_setup()
+        n_micro = self.micro_batches
+        mesh = self.mesh
+        pipe_loss = pipeline_loss_fn(self._pipe_module, mesh, n_micro)
+        fp16 = self.fp16_enabled_
+        grad_shardings = self._state_shardings.grad_acc
+        mb_rows = self._micro_batch_rows()
+
+        def to_micro(a):
+            return a.reshape((n_micro, mb_rows) + a.shape[1:])
+
+        self._pipe_loss = pipe_loss
+        self._to_micro = to_micro
+
+        def micro_step(state: TrainState, batch):
+            rng, sub = jax.random.split(state.rng)
+            inputs, labels = self._split_batch_labels(batch)
+            inputs = jax.tree_util.tree_map(to_micro, inputs)
+            labels = jax.tree_util.tree_map(to_micro, labels)
+
+            def scaled_loss(p):
+                loss = pipe_loss(p, (inputs, labels),
+                                 rngs={"dropout": sub}
+                                 if self._pipe_module.use_rngs else None)
+                return loss * (state.loss_scale.loss_scale if fp16 else 1.0)
+
+            loss_scaled, grads = jax.value_and_grad(scaled_loss)(state.params)
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+            grad_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), state.grad_acc, grads)
+            loss = loss_scaled / (state.loss_scale.loss_scale if fp16 else 1.0)
+            return state._replace(grad_acc=grad_acc, rng=rng), loss
+
+        shardings = self._state_shardings
+        self._jit_micro = jax.jit(
+            micro_step,
+            in_shardings=(shardings, None),
+            out_shardings=(shardings, replicated(mesh)),
+            donate_argnums=(0,))
+        # reuse the base apply_step (optimizer/clip/loss-scale machinery)
+        super()._compile_steps_apply_only()
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return True
+
+    def train_batch(self, data_iter=None, batch=None):
+        """One full optimizer step: ``gas`` micro-batches through the
+        pipeline (reference ``pipe/engine.py:294``)."""
+        if batch is None:
+            parts = [next(data_iter) for _ in range(self.micro_batches)]
+            batch = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *parts)
+        loss = self.forward(batch)
+        self.backward(loss)
+        self.step()
+        return float(loss)
+
+    def eval_batch(self, batch):
+        batch = self._shard_batch(batch)
+        self._ensure_state(batch)
+        if not hasattr(self, "_jit_eval"):
+            pipe_loss, to_micro = self._pipe_loss, self._to_micro
+
+            def eval_loss(params, batch):
+                inputs, labels = self._split_batch_labels(batch)
+                return pipe_loss(params,
+                                 (jax.tree_util.tree_map(to_micro, inputs),
+                                  jax.tree_util.tree_map(to_micro, labels)))
+
+            self._jit_eval = jax.jit(
+                eval_loss,
+                in_shardings=(self._state_shardings.params, None),
+                out_shardings=replicated(self.mesh))
+        return self._jit_eval(self.state.params, batch)
+
+    def train_schedule(self, stage_id: int = 0) -> TrainSchedule:
+        """The instruction schedule this engine's compiled program realizes
+        (for inspection/validation — reference ``TrainSchedule``)."""
+        return TrainSchedule(micro_batches=self.micro_batches,
+                             stages=self.num_stages, stage_id=stage_id)

@@ -1,0 +1,218 @@
+"""Pipeline instruction schedules.
+
+Capability parity with the reference ``deepspeed/runtime/pipe/schedule.py``
+(``TrainSchedule:182``, ``InferenceSchedule:129``, ``DataParallelSchedule:292``
+and the ``PipeInstruction`` vocabulary). The reference *interprets* these
+instruction lists imperatively (``pipe/engine.py:1359`` dispatch table); on
+TPU the whole train schedule is compiled into one XLA program by
+``runtime/pipe/engine.py`` — these classes remain the canonical description
+of the schedule (used for validation, cost modeling, and by any future MPMD
+multi-controller executor), and the compiled program is equivalent to
+executing them.
+
+The 1F1B clock construction: forward of micro-batch ``m`` at stage ``s``
+happens at clock ``s + 2m``; backward at clock ``2(P-1) - s + 2m + 1``.
+Forwards occupy clocks with parity ``s % 2`` and backwards the opposite
+parity, so each stage alternates one-forward-one-backward in steady state,
+and at most ``P - s`` forward activations are alive at stage ``s`` — the
+1F1B memory profile.
+"""
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    """A single step of work for one pipeline stage."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({inner})"
+
+    def __eq__(self, other):
+        return (self.__class__ is other.__class__
+                and self.kwargs == other.kwargs)
+
+    def __hash__(self):
+        return hash((self.__class__, tuple(sorted(self.kwargs.items()))))
+
+
+class OptimizerStep(PipeInstruction):
+    """Apply the optimizer (all stages, end of batch)."""
+
+
+class ReduceGrads(PipeInstruction):
+    """Data-parallel gradient reduction."""
+
+
+class ReduceTiedGrads(PipeInstruction):
+    """All-reduce grads of tied (pipe-replicated) weights over the pipe axis."""
+
+
+class BufferOpInstruction(PipeInstruction):
+    """An instruction operating on a pipeline buffer slot."""
+
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    """Stage 0: load micro-batch ``micro_batch_id`` into a buffer."""
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Generator of per-clock instruction lists for one stage.
+
+    Mirrors the reference ``PipeSchedule`` ABC surface: ``micro_batches``,
+    ``stages``, ``stage_id``, ``steps()``, ``num_pipe_buffers()``.
+    """
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, mb: int) -> bool:
+        return 0 <= mb < self.micro_batches
+
+    def __iter__(self):
+        return self.steps()
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipelined sweep (reference ``schedule.py:129``)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for clock in range(total):
+            cmds: List[PipeInstruction] = []
+            mb = clock - self.stage_id
+            if self._valid_micro_batch(mb):
+                buf = mb % self.num_pipe_buffers()
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buf, micro_batch_id=mb))
+                else:
+                    cmds.append(RecvActivation(buf, micro_batch_id=mb))
+                cmds.append(ForwardPass(buf, micro_batch_id=mb))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf, micro_batch_id=mb))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B training schedule (reference ``schedule.py:182``).
+
+    Clock formulas (see module docstring): ``fwd(s, m) = s + 2m`` and
+    ``bwd(s, m) = 2(P-1) - s + 2m + 1``. A send at clock ``c`` pairs with
+    the neighbor's recv at clock ``c + 1``.
+    """
+
+    def num_pipe_buffers(self) -> int:
+        return min(self.stages - self.stage_id, self.micro_batches)
+
+    def _fwd_clock(self, mb: int) -> int:
+        return self.stage_id + 2 * mb
+
+    def _bwd_clock(self, mb: int) -> int:
+        return 2 * (self.stages - 1) - self.stage_id + 2 * mb + 1
+
+    def steps(self):
+        P, M, s = self.stages, self.micro_batches, self.stage_id
+        total_clocks = 2 * (M + P - 1)
+        n_buf = self.num_pipe_buffers()
+        for clock in range(total_clocks):
+            cmds: List[PipeInstruction] = []
+            # forward work this clock?
+            mb_f = (clock - s) // 2 if (clock - s) % 2 == 0 else None
+            if mb_f is not None and self._valid_micro_batch(mb_f) \
+                    and self._fwd_clock(mb_f) == clock:
+                buf = mb_f % n_buf
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buf, micro_batch_id=mb_f))
+                else:
+                    cmds.append(RecvActivation(buf, micro_batch_id=mb_f))
+                cmds.append(ForwardPass(buf, micro_batch_id=mb_f))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf, micro_batch_id=mb_f))
+            # backward work this clock?
+            rem = clock - (2 * (P - 1) - s + 1)
+            mb_b = rem // 2 if rem >= 0 and rem % 2 == 0 else None
+            if mb_b is not None and self._valid_micro_batch(mb_b) \
+                    and self._bwd_clock(mb_b) == clock:
+                buf = mb_b % n_buf
+                if not self.is_last_stage:
+                    cmds.append(RecvGrad(buf, micro_batch_id=mb_b))
+                cmds.append(BackwardPass(buf, micro_batch_id=mb_b))
+                if not self.is_first_stage:
+                    cmds.append(SendGrad(buf, micro_batch_id=mb_b))
+            # final clock: reductions + step
+            if clock == total_clocks - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            yield cmds
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference ``schedule.py:292``)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+    def steps(self):
+        for mb in range(self.micro_batches):
+            cmds = [LoadMicroBatch(0, micro_batch_id=mb),
+                    ForwardPass(0, micro_batch_id=mb),
+                    BackwardPass(0, micro_batch_id=mb)]
+            if mb == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds

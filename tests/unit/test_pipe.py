@@ -1,0 +1,215 @@
+"""Pipeline-parallelism tests.
+
+Mirrors the reference suite ``tests/unit/runtime/pipe`` (pipeline vs
+non-pipeline loss parity) plus schedule-invariant checks on the 1F1B
+instruction stream (reference ``runtime/pipe/schedule.py``).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, gpt2_pipe
+from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology
+from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass,
+                                                 InferenceSchedule,
+                                                 LoadMicroBatch, OptimizerStep,
+                                                 RecvActivation, RecvGrad,
+                                                 SendActivation, SendGrad,
+                                                 TrainSchedule)
+from deepspeed_tpu.runtime.pipe.module import partition_balanced
+
+
+def _collect(schedule):
+    return [cmds for cmds in schedule.steps()]
+
+
+class TestPartitionBalanced:
+    def test_uniform(self):
+        assert partition_balanced([1.0] * 8, 4) == [0, 2, 4, 6, 8]
+
+    def test_weighted(self):
+        # heavy layer forces its own part
+        bounds = partition_balanced([10.0, 1.0, 1.0, 1.0], 2)
+        assert bounds[0] == 0 and bounds[-1] == 4
+        sums = [sum([10, 1, 1, 1][bounds[i]:bounds[i + 1]]) for i in range(2)]
+        assert max(sums) == 10.0
+
+    def test_more_parts_than_items(self):
+        bounds = partition_balanced([1.0, 1.0], 2)
+        assert bounds == [0, 1, 2]
+
+
+class TestTrainSchedule:
+    @pytest.mark.parametrize("stages,micro", [(4, 6), (2, 2), (1, 3), (3, 8)])
+    def test_invariants(self, stages, micro):
+        all_steps = {}
+        for s in range(stages):
+            sched = TrainSchedule(micro_batches=micro, stages=stages, stage_id=s)
+            steps = _collect(sched)
+            all_steps[s] = steps
+            flat = [c for cmds in steps for c in cmds]
+            fwd = [c.micro_batch_id for c in flat if isinstance(c, ForwardPass)]
+            bwd = [c.micro_batch_id for c in flat if isinstance(c, BackwardPass)]
+            # every micro-batch forwarded and backwarded exactly once
+            assert sorted(fwd) == list(range(micro))
+            assert sorted(bwd) == list(range(micro))
+            # each mb's forward precedes its backward
+            order = [(type(c), c.micro_batch_id) for c in flat
+                     if isinstance(c, (ForwardPass, BackwardPass))]
+            for m in range(micro):
+                assert order.index((ForwardPass, m)) < order.index((BackwardPass, m))
+            # exactly one optimizer step, at the last clock
+            assert sum(isinstance(c, OptimizerStep) for c in flat) == 1
+            assert any(isinstance(c, OptimizerStep) for c in steps[-1])
+            # stage 0 loads, never recvs activations
+            if s == 0:
+                assert any(isinstance(c, LoadMicroBatch) for c in flat)
+                assert not any(isinstance(c, RecvActivation) for c in flat)
+
+        # cross-stage pairing: a send at clock c matches the neighbor's recv
+        # at clock c+1
+        for s in range(stages - 1):
+            sends = [(t, c.micro_batch_id) for t, cmds in enumerate(all_steps[s])
+                     for c in cmds if isinstance(c, SendActivation)]
+            recvs = [(t, c.micro_batch_id) for t, cmds in enumerate(all_steps[s + 1])
+                     for c in cmds if isinstance(c, RecvActivation)]
+            assert len(sends) == len(recvs) == micro
+            for (ts, m1), (tr, m2) in zip(sends, recvs):
+                assert m1 == m2 and tr == ts + 1
+            gsends = [(t, c.micro_batch_id) for t, cmds in enumerate(all_steps[s + 1])
+                      for c in cmds if isinstance(c, SendGrad)]
+            grecvs = [(t, c.micro_batch_id) for t, cmds in enumerate(all_steps[s])
+                      for c in cmds if isinstance(c, RecvGrad)]
+            for (ts, m1), (tr, m2) in zip(gsends, grecvs):
+                assert m1 == m2 and tr == ts + 1
+
+    def test_1f1b_memory(self):
+        # outstanding forwards at any time <= num_pipe_buffers
+        stages, micro = 4, 16
+        for s in range(stages):
+            sched = TrainSchedule(micro_batches=micro, stages=stages, stage_id=s)
+            outstanding, peak = 0, 0
+            for cmds in sched.steps():
+                for c in cmds:
+                    if isinstance(c, ForwardPass):
+                        outstanding += 1
+                    if isinstance(c, BackwardPass):
+                        outstanding -= 1
+                peak = max(peak, outstanding)
+            assert peak <= sched.num_pipe_buffers()
+            assert peak <= stages - s  # 1F1B profile, not GPipe's M
+
+
+class TestInferenceSchedule:
+    def test_forward_only(self):
+        sched = InferenceSchedule(micro_batches=4, stages=2, stage_id=1)
+        flat = [c for cmds in sched.steps() for c in cmds]
+        assert sum(isinstance(c, ForwardPass) for c in flat) == 4
+        assert not any(isinstance(c, BackwardPass) for c in flat)
+
+
+def _make_engine(pipe, data, devices, zero_stage=0, gas=4, micro=2):
+    model = gpt2_pipe(GPT2Config.tiny(n_layer=4, dtype=np.float32))
+    topo = MeshTopology(axis_sizes={"pipe": pipe, "data": data},
+                        devices=devices)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        mesh=topo,
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": zero_stage},
+            "steps_per_print": 10_000,
+        })
+    return engine
+
+
+def _batch(rows, seq=32, seed=0):
+    ids = np.random.default_rng(seed).integers(0, 256, (rows, seq))
+    return {"input_ids": ids.astype(np.int32)}
+
+
+class TestPipelineEngine:
+    def test_matches_single_stage(self):
+        reset_topology()
+        devs = jax.devices()
+        e4 = _make_engine(pipe=4, data=2, devices=devs[:8])
+        batch = _batch(rows=4 * 2 * 2)  # gas * micro * dp
+        loss4 = float(e4.forward(batch))
+        e4.step()
+        p4 = jax.device_get(e4.state.params)
+
+        reset_topology()
+        e1 = _make_engine(pipe=1, data=2, devices=devs[:2])
+        loss1 = float(e1.forward(batch))
+        e1.step()
+        p1 = jax.device_get(e1.state.params)
+
+        assert np.isclose(loss4, loss1, rtol=1e-4), (loss4, loss1)
+        for a, b in zip(jax.tree_util.tree_leaves(p4),
+                        jax.tree_util.tree_leaves(p1)):
+            np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-5)
+
+    def test_train_batch_decreases_loss(self):
+        reset_topology()
+        engine = _make_engine(pipe=2, data=2, devices=jax.devices()[:4],
+                              zero_stage=1)
+        batch = _batch(rows=4 * 2 * 2, seed=1)
+        losses = [engine.train_batch(batch=batch) for _ in range(5)]
+        assert losses[-1] < losses[0]
+        assert engine.global_steps == 5
+
+    def test_zero3_rejected(self):
+        reset_topology()
+        with pytest.raises(ValueError, match="ZeRO-3"):
+            _make_engine(pipe=2, data=2, devices=jax.devices()[:4],
+                         zero_stage=3)
+
+    def test_model_parameters_eager_init(self):
+        # regression: state built inside super().__init__ (model_parameters
+        # given) must not crash on pipeline setup ordering
+        reset_topology()
+        model = gpt2_pipe(GPT2Config.tiny(n_layer=4, dtype=np.float32))
+        params = model.init_params(
+            jax.random.PRNGKey(0), np.zeros((2, 32), np.int32))
+        topo = MeshTopology(axis_sizes={"pipe": 2, "data": 2},
+                            devices=jax.devices()[:4])
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, mesh=topo, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "gradient_accumulation_steps": 2,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "steps_per_print": 10_000})
+        loss = engine.forward(_batch(rows=2 * 2 * 2))
+        engine.step()
+        assert np.isfinite(float(loss))
+
+    def test_dropout_active_in_pipeline(self):
+        # regression: dropout must actually fire on the pipeline path
+        reset_topology()
+        model = gpt2_pipe(GPT2Config.tiny(n_layer=2, dtype=np.float32,
+                                          dropout=0.5))
+        assert model.use_rngs
+        topo = MeshTopology(axis_sizes={"pipe": 2}, devices=jax.devices()[:2])
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, mesh=topo,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "gradient_accumulation_steps": 2,
+                    "optimizer": {"type": "Adam", "params": {"lr": 0.0}},
+                    "steps_per_print": 10_000})
+        batch = _batch(rows=2 * 2)
+        train_loss = float(engine.forward(batch))
+        engine.step()  # lr=0: params unchanged
+        eval_loss = float(engine.eval_batch(batch))
+        # with dropout active, train loss != deterministic eval loss
+        assert abs(train_loss - eval_loss) > 1e-4, (train_loss, eval_loss)
+
+    def test_engine_schedule_accessor(self):
+        reset_topology()
+        engine = _make_engine(pipe=2, data=1, devices=jax.devices()[:2])
+        sched = engine.train_schedule(stage_id=1)
+        assert isinstance(sched, TrainSchedule)
+        assert sched.micro_batches == engine.micro_batches

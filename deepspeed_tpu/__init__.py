@@ -61,15 +61,9 @@ def initialize(args=None,
 def init_inference(model, config=None, **kwargs):
     """Build an inference engine (reference ``deepspeed/__init__.py:233``)."""
     from deepspeed_tpu.inference.engine import InferenceEngine
-    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 
-    if config is None:
-        config = {}
-    if isinstance(config, dict):
-        ds_inference_config = DeepSpeedInferenceConfig(**{**config, **kwargs})
-    else:
-        ds_inference_config = config
-    return InferenceEngine(model, config=ds_inference_config)
+    # config coercion (None/dict/instance + kwargs merge) lives in the engine
+    return InferenceEngine(model, config=config, **kwargs)
 
 
 def add_config_arguments(parser):

@@ -1,0 +1,6 @@
+"""Inference stack (reference ``deepspeed/inference/``)."""
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.inference.engine import InferenceEngine
+
+__all__ = ["DeepSpeedInferenceConfig", "InferenceEngine"]

@@ -1,0 +1,133 @@
+"""Inference configuration.
+
+Capability parity with the reference ``deepspeed/inference/config.py:121``
+(``DeepSpeedInferenceConfig``). CUDA-specific knobs are kept in the surface
+(accepted, deprecated-or-ignored) so reference configs load unchanged;
+TPU-native fields drive the jit/sharding behavior instead:
+
+- ``enable_cuda_graph`` → jit compile-cache (always on under XLA; accepted
+  and ignored).
+- ``replace_with_kernel_inject`` → selects Pallas attention/fused paths.
+- ``tensor_parallel.tp_size`` → size of the ``model`` mesh axis.
+"""
+
+from typing import Any, Dict, Optional, Union
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+import jax.numpy as jnp
+
+_DTYPE_MAP = {
+    "fp32": jnp.float32, "float32": jnp.float32, "float": jnp.float32,
+    "fp16": jnp.float16, "float16": jnp.float16, "half": jnp.float16,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
+
+
+def resolve_dtype(dtype) -> Any:
+    if isinstance(dtype, str):
+        key = dtype.lower().replace("torch.", "").replace("jnp.", "")
+        if key not in _DTYPE_MAP:
+            raise ValueError(f"unknown inference dtype {dtype!r}")
+        return _DTYPE_MAP[key]
+    return dtype
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """Reference ``class DeepSpeedTPConfig`` (``inference/config.py:27``)."""
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None   # reference torch mpu — accepted, unused
+    tp_group: Optional[Any] = None
+
+
+class QuantTypeEnum:
+    asym = "asymmetric"
+    sym = "symmetric"
+
+
+class BaseQuantConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    num_bits: int = 8
+    q_type: str = QuantTypeEnum.sym
+    q_groups: int = 1
+
+
+class WeightQuantConfig(BaseQuantConfig):
+    enabled: bool = True
+    quantized_initialization: Dict = {}
+    post_init_quant: Dict = {}
+
+
+class ActivationQuantConfig(BaseQuantConfig):
+    enabled: bool = True
+
+
+class QKVQuantConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    activation: ActivationQuantConfig = ActivationQuantConfig()
+    weight: WeightQuantConfig = WeightQuantConfig()
+    qkv: QKVQuantConfig = QKVQuantConfig()
+
+
+class MoEConfig(DeepSpeedConfigModel):
+    """Reference ``class DeepSpeedMoEConfig`` (``inference/config.py:64``)."""
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: list = Field([1], alias="num_experts")
+    type: str = "standard"
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Master inference config (reference ``inference/config.py:121``)."""
+
+    replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
+    dtype: Any = jnp.bfloat16
+    tensor_parallel: DeepSpeedTPConfig = Field(DeepSpeedTPConfig(), alias="tp")
+    enable_cuda_graph: bool = False  # accepted; XLA jit-cache supersedes it
+    zero: Dict = {}
+    triangular_masking: bool = Field(True, alias="tm")
+    moe: Union[bool, MoEConfig] = False
+    quant: QuantizationConfig = QuantizationConfig()
+    checkpoint: Optional[Union[str, Dict]] = None
+    base_dir: str = ""
+    set_empty_params: bool = False
+    save_mp_checkpoint_path: Optional[str] = None
+    checkpoint_config: Optional[Dict] = Field(None, alias="ckpt_config")
+    return_tuple: bool = True
+    training_mp_size: int = 1
+    replace_method: str = Field("auto", json_schema_extra={"deprecated": True})
+    injection_policy: Optional[Any] = Field(None, alias="injection_dict")
+    injection_policy_tuple: Optional[tuple] = None
+    config: Optional[Dict] = Field(None, alias="args")
+    max_out_tokens: int = Field(1024, alias="max_tokens")
+    min_out_tokens: int = Field(1, alias="min_tokens")
+    transposed_mode: bool = Field(False, alias="transposed_mode")
+    mp_size: int = Field(1, json_schema_extra={
+        "deprecated": True, "new_param": "tensor_parallel.tp_size"})
+    mpu: Optional[Any] = Field(None, json_schema_extra={
+        "deprecated": True, "new_param": "tensor_parallel.mpu"})
+    ep_size: int = Field(1, json_schema_extra={
+        "deprecated": True, "new_param": "moe.ep_size"})
+    ep_group: Optional[Any] = Field(None, alias="expert_group",
+                                    json_schema_extra={"deprecated": True})
+    ep_mp_group: Optional[Any] = Field(None, alias="expert_mp_group",
+                                       json_schema_extra={"deprecated": True})
+    moe_experts: list = Field([1], json_schema_extra={
+        "deprecated": True, "new_param": "moe.moe_experts"})
+    moe_type: str = Field("standard", json_schema_extra={
+        "deprecated": True, "new_param": "moe.type"})
+
+    def __init__(self, strict=False, **data):
+        if "mp_size" in data and "tensor_parallel" not in data and "tp" not in data:
+            # reference deprecation path: mp_size → tensor_parallel.tp_size
+            data["tensor_parallel"] = {"tp_size": data.pop("mp_size")}
+        super().__init__(strict=strict, **data)
+        object.__setattr__(self, "dtype", resolve_dtype(self.dtype))

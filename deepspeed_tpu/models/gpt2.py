@@ -34,6 +34,13 @@ class GPT2Config:
     scan_layers: bool = True
     remat: bool = False  # activation checkpointing over blocks
     use_flash: Optional[bool] = None
+    # decode mode: attention reads/writes a KV cache (mutable "cache"
+    # collection) — the TPU-native form of the reference's inference
+    # workspace (csrc/transformer/inference/includes/inference_context.h)
+    decode: bool = False
+
+    def for_decode(self):
+        return dataclasses.replace(self, decode=True, dropout=0.0)
 
     @staticmethod
     def gpt2_125m(**kw):
@@ -70,9 +77,42 @@ class CausalSelfAttention(nn.Module):
                        name="c_attn")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
-        k = k.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
-        v = v.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
-        y = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
+        cached_attn = False
+        if cfg.decode:
+            # KV cache: [B, n_positions, H, D] append buffer (the TPU-native
+            # form of the reference's softmax_context KV workspace,
+            # csrc/transformer/inference/csrc/softmax.cu). Prefill — the call
+            # that creates the cache — is a separate compiled program; it
+            # writes the cache but attends causally over only its own T keys
+            # (the plain path below), not the zero-padded window.
+            is_prefill = not self.has_variable("cache", "cached_key")
+            k4 = k.reshape(B, T, cfg.n_head, head_dim)
+            v4 = v.reshape(B, T, cfg.n_head, head_dim)
+            cache_shape = (B, cfg.n_positions, cfg.n_head, head_dim)
+            ck = self.variable("cache", "cached_key", jnp.zeros, cache_shape,
+                               cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros, cache_shape,
+                               cfg.dtype)
+            cidx = self.variable("cache", "cache_index",
+                                 lambda: jnp.zeros((), jnp.int32))
+            idx = cidx.value  # 0 on prefill (freshly created)
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k4, (0, idx, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v4, (0, idx, 0, 0))
+            cidx.value = idx + T
+            if not is_prefill:
+                kc = ck.value.transpose(0, 2, 1, 3)
+                vc = cv.value.transpose(0, 2, 1, 3)
+                # query at global position idx+t sees keys at positions <= idx+t
+                key_pos = jnp.arange(cfg.n_positions)
+                q_pos = idx + jnp.arange(T)
+                mask = key_pos[None, :] <= q_pos[:, None]
+                y = attention(q, kc, vc, mask=mask[None, None], causal=False,
+                              use_flash=False)
+                cached_attn = True
+        if not cached_attn:  # training forward, or decode-mode prefill
+            k = k.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
+            v = v.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
+            y = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
         y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
         y = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
                      kernel_init=_dense_init(0.02 / (2 * cfg.n_layer) ** 0.5),
@@ -137,7 +177,7 @@ class ScanBlocks(nn.Module):
         cfg = self.config
         ScannedBlock = nn.scan(
             _ScanBody,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True, "dropout": True},
             in_axes=nn.broadcast,
             length=cfg.n_layer,
@@ -174,7 +214,16 @@ class GPT2LMHeadModel(nn.Module):
         B, T = input_ids.shape
         wte = self.param("wte", _dense_init(), (cfg.vocab_size, cfg.n_embd), jnp.float32)
         wpe = self.param("wpe", _dense_init(0.01), (cfg.n_positions, cfg.n_embd), jnp.float32)
-        x = wte[input_ids].astype(cfg.dtype) + wpe[None, :T].astype(cfg.dtype)
+        if cfg.decode:
+            # track the absolute position across prefill/decode calls
+            pos_var = self.variable("cache", "position",
+                                    lambda: jnp.zeros((), jnp.int32))
+            pos = pos_var.value
+            pos_var.value = pos + T
+            pos_emb = jax.lax.dynamic_slice(wpe, (pos, 0), (T, cfg.n_embd))[None]
+        else:
+            pos_emb = wpe[None, :T]
+        x = wte[input_ids].astype(cfg.dtype) + pos_emb.astype(cfg.dtype)
         if cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
         blocks = ScanBlocks if cfg.scan_layers else LoopBlocks

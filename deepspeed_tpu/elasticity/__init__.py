@@ -1,0 +1,17 @@
+"""Elastic training support (reference ``deepspeed/elasticity/``)."""
+
+from deepspeed_tpu.elasticity.config import (ElasticityConfig,
+                                             ElasticityConfigError,
+                                             ElasticityError,
+                                             ElasticityIncompatibleWorldSize)
+from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
+                                                 elasticity_enabled,
+                                                 get_compatible_chips,
+                                                 get_compatible_chips_with_slices)
+
+__all__ = [
+    "ElasticityConfig", "ElasticityConfigError", "ElasticityError",
+    "ElasticityIncompatibleWorldSize", "compute_elastic_config",
+    "elasticity_enabled", "get_compatible_chips",
+    "get_compatible_chips_with_slices",
+]

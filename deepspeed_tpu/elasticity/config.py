@@ -1,0 +1,57 @@
+"""Elasticity config (reference ``deepspeed/elasticity/config.py``)."""
+
+from typing import List, Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class ElasticityError(Exception):
+    """Base error (reference ``elasticity/config.py``)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    """``elasticity`` block of the master JSON config.
+
+    Same field surface as the reference (``elasticity/constants.py``):
+    ``max_train_batch_size``, ``micro_batch_sizes``, ``min_gpus``/``max_gpus``
+    (chips on TPU, names kept for config portability), ``min_time``,
+    ``prefer_larger_batch``, ``ignore_non_elastic_batch_info``, ``version``.
+    """
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field([2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    prefer_larger_batch: bool = Field(True, alias="prefer_larger")
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.1
+    model_parallel_size: int = 1
+    num_gpus_per_node: int = 1
+
+    def __init__(self, param_dict=None, strict=False, **kwargs):
+        if param_dict is not None:
+            kwargs = {**param_dict, **kwargs}
+        super().__init__(strict=strict, **kwargs)
+        if not self.micro_batch_sizes:
+            raise ElasticityConfigError("micro_batch_sizes must be non-empty")
+        if any(m <= 0 for m in self.micro_batch_sizes):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive: {self.micro_batch_sizes}")
+        if self.min_gpus < 1 or self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(
+                f"invalid chip range [{self.min_gpus}, {self.max_gpus}]")

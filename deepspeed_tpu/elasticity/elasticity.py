@@ -1,0 +1,231 @@
+"""Elastic batch-size planning.
+
+Capability parity with the reference ``deepspeed/elasticity/elasticity.py``
+(``compute_elastic_config``, ``:287``): given a micro-batch menu and a chip
+range, choose one global batch size that stays constant while the job scales
+across chip counts (TPU preemption/rescale is the motivating case — the
+reference's is GPU-pool elasticity, same math).
+
+Design (not a translation): a batch size B is *compatible* with chip count
+g if B = mb * gas * g for some menu micro-batch mb and integer gas. We score
+each candidate B by how many chip counts in [min, max] it is compatible
+with. Candidates are built by scaling each micro-batch (and the menu LCM)
+by smooth, divisor-rich multipliers so the winner divides evenly at many
+chip counts — the same role the reference's highly-composite-number table
+plays, computed here instead of hard-coded.
+"""
+
+import math
+from functools import reduce
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.elasticity.config import (ElasticityConfig,
+                                             ElasticityConfigError,
+                                             ElasticityError,
+                                             ElasticityIncompatibleWorldSize,
+                                             LATEST_ELASTICITY_VERSION)
+from deepspeed_tpu.utils.logging import logger
+
+ELASTICITY = "elasticity"
+
+
+def _highly_composite_up_to(limit: int) -> List[int]:
+    """Numbers with a record divisor count, ascending (1, 2, 4, 6, 12, ...).
+
+    Computed rather than hard-coded (the reference ships a 38-entry table,
+    ``elasticity.py:19``): every highly composite number is a product of
+    consecutive primes with non-increasing exponents, so enumerate those
+    and keep the divisor-count record holders.
+    """
+    if limit < 1:
+        return [1]
+    primes = (2, 3, 5, 7, 11, 13, 17, 19, 23)
+    found: List[Tuple[int, int]] = []  # (value, divisor_count)
+
+    def rec(i: int, val: int, max_exp: int, divisors: int):
+        found.append((val, divisors))
+        if i >= len(primes):
+            return
+        p, e, v = primes[i], 1, val * primes[i]
+        while v <= limit and e <= max_exp:
+            rec(i + 1, v, e, divisors * (e + 1))
+            e += 1
+            v *= p
+    rec(0, 1, 64, 1)
+
+    out, best = [], 0
+    for val, d in sorted(found):
+        if d > best:
+            out.append(val)
+            best = d
+    return out
+
+
+def _candidate_batch_sizes(bases: List[int], max_batch: int) -> List[int]:
+    hcns = _highly_composite_up_to(max_batch)
+    cands = set()
+    for base in bases:
+        if base > max_batch:
+            # unlike the reference (which admits an oversized LCM verbatim,
+            # elasticity.py:64-67), never exceed the user's batch ceiling
+            continue
+        k = max_batch // base
+        # largest record-holder multiplier that keeps base*m <= max_batch
+        m = max((h for h in hcns if h <= k), default=1)
+        cands.add(base * m)
+    return sorted(cands)
+
+
+def _compatible_chips(batch_size: int, micro_batches: List[int],
+                      min_chips: int, max_chips: int) -> List[int]:
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        per_mb = batch_size // mb  # gas * chips
+        g = 1
+        while g * g <= per_mb:
+            if per_mb % g == 0:
+                for c in (g, per_mb // g):
+                    if min_chips <= c <= max_chips:
+                        valid.add(c)
+            g += 1
+    return sorted(valid)
+
+
+def _best_candidate(cands: List[int], micro_batches: List[int],
+                    min_chips: int, max_chips: int,
+                    prefer_larger: bool) -> Tuple[int, List[int]]:
+    best_b, best_valid = min(micro_batches), []
+    for b in cands:
+        valid = _compatible_chips(b, micro_batches, min_chips, max_chips)
+        better = len(valid) > len(best_valid) or (
+            len(valid) == len(best_valid)
+            and ((prefer_larger and b > best_b)
+                 or (not prefer_larger and b < best_b)))
+        if better:
+            best_b, best_valid = b, valid
+    return best_b, best_valid
+
+
+def get_compatible_chips(micro_batches: List[int],
+                         max_acceptable_batch_size: int,
+                         min_chips: Optional[int] = None,
+                         max_chips: Optional[int] = None,
+                         prefer_larger: bool = True) -> Tuple[int, List[int]]:
+    """v0.1 planner (reference ``_get_compatible_gpus_v01:125``)."""
+    min_chips = min_chips or 1
+    max_chips = max_chips or max_acceptable_batch_size // min(micro_batches)
+    if any(mb > max_acceptable_batch_size for mb in micro_batches):
+        raise ElasticityConfigError(
+            f"all micro batches {micro_batches} must be <= "
+            f"max_acceptable_batch_size {max_acceptable_batch_size}")
+    lcm = reduce(math.lcm, micro_batches)
+    bases = list(dict.fromkeys([*micro_batches, lcm]))
+    cands = _candidate_batch_sizes(bases, max_acceptable_batch_size)
+    return _best_candidate(cands, micro_batches, min_chips, max_chips,
+                           prefer_larger)
+
+
+def get_compatible_chips_with_slices(micro_batches: List[int],
+                                     max_acceptable_batch_size: int,
+                                     current_num_chips: int,
+                                     min_chips: Optional[int] = None,
+                                     max_chips: Optional[int] = None,
+                                     prefer_larger: bool = True,
+                                     chips_per_host: int = 1,
+                                     model_parallel_size: int = 1):
+    """v0.2 planner (reference ``_get_compatible_gpus_v02:173``): elasticity
+    at slice/host granularity with model parallelism carved out of each host.
+
+    Returns ``(final_batch_size, valid_dp_world_sizes, micro_batch)``.
+    """
+    if chips_per_host % model_parallel_size:
+        raise ElasticityError(
+            f"chips_per_host {chips_per_host} must be divisible by "
+            f"model_parallel_size {model_parallel_size}")
+    dp_per_host = chips_per_host // model_parallel_size
+    min_chips = min_chips or 1
+    max_chips = max_chips or max_acceptable_batch_size // min(micro_batches)
+    current_dp_size = current_num_chips // model_parallel_size
+
+    def pick_micro(batch: int) -> Optional[int]:
+        # per-DP-rank batch (model-parallel ranks share one replica's batch)
+        fitting = [mb for mb in micro_batches
+                   if (batch // max(1, current_dp_size)) % mb == 0]
+        if not fitting:
+            return None
+        return max(fitting) if prefer_larger else min(fitting)
+
+    b, valid_hosts = get_compatible_chips(
+        micro_batches, max_acceptable_batch_size // dp_per_host,
+        max(1, min_chips // chips_per_host),
+        max(1, max_chips // chips_per_host), prefer_larger)
+    final = b * dp_per_host
+    valid_dp = [h * dp_per_host for h in valid_hosts]
+    if current_num_chips // model_parallel_size in valid_dp:
+        return final, valid_dp, pick_micro(final)
+
+    # fall back: fix the current dp size, scale the largest fitting batch
+    current_dp = (current_num_chips // chips_per_host) * dp_per_host
+    cands = [mb * current_dp * (max_acceptable_batch_size // (mb * current_dp))
+             for mb in micro_batches if mb * current_dp <= max_acceptable_batch_size]
+    if not cands:
+        raise ElasticityIncompatibleWorldSize(
+            f"no batch size fits {current_num_chips} chips within "
+            f"max_acceptable_batch_size {max_acceptable_batch_size}")
+    batch = max(cands) if prefer_larger else min(cands)
+    return batch, [current_dp], pick_micro(batch)
+
+
+def elasticity_enabled(ds_config: Dict) -> bool:
+    return bool(ds_config.get(ELASTICITY, {}).get("enabled", False))
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch: bool = False):
+    """Reference ``compute_elastic_config`` (``elasticity.py:287``).
+
+    Returns ``(final_batch_size, valid_chip_counts[, micro_batch])``; when
+    ``world_size`` > 0, validates it and also returns that world size's
+    micro-batch choice the way the reference does.
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError("ds_config must be a dict")
+    cfg = ElasticityConfig(ds_config.get(ELASTICITY, {}))
+    if not cfg.enabled:
+        raise ElasticityError("elasticity is not enabled in the config")
+    is_v2 = cfg.version >= 0.2 - 1e-9
+    if is_v2 and cfg.version <= LATEST_ELASTICITY_VERSION:
+        if world_size <= 0:
+            raise ElasticityConfigError(
+                "elasticity v0.2 needs the current world size")
+        final, valid, micro = get_compatible_chips_with_slices(
+            cfg.micro_batch_sizes, cfg.max_train_batch_size, world_size,
+            cfg.min_gpus, cfg.max_gpus, cfg.prefer_larger_batch,
+            cfg.num_gpus_per_node, cfg.model_parallel_size)
+    elif cfg.version <= 0.1 + 1e-9:
+        final, valid = get_compatible_chips(
+            cfg.micro_batch_sizes, cfg.max_train_batch_size,
+            cfg.min_gpus, cfg.max_gpus, cfg.prefer_larger_batch)
+        micro = None
+    else:
+        raise ElasticityConfigError(
+            f"unsupported elasticity version {cfg.version}; latest is "
+            f"{LATEST_ELASTICITY_VERSION}")
+
+    # v0.2's `valid` is in data-parallel units (chips / model_parallel_size)
+    check = world_size // cfg.model_parallel_size if is_v2 else world_size
+    if world_size > 0 and check not in valid:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} (dp={check}) is not in the compatible "
+            f"set {valid} for elastic batch {final}")
+    if world_size > 0 and micro is None:
+        per = final // world_size
+        fitting = [mb for mb in cfg.micro_batch_sizes if per % mb == 0]
+        micro = (max(fitting) if cfg.prefer_larger_batch else min(fitting)) \
+            if fitting else None
+    logger.info(f"elastic plan: batch={final} valid_chips={valid} micro={micro}")
+    if return_microbatch:
+        return final, valid, micro
+    return final, valid

@@ -1,0 +1,93 @@
+"""Random layerwise token dropping (random-LTD) ops.
+
+Replaces the reference CUDA kernels ``csrc/random_ltd/{token_sort.cu,
+gather_scatter.cu,slice_attn_masks.cu}`` exposed through
+``deepspeed/ops/random_ltd/dropping_utils.py:16-113``. On TPU none of these
+need custom kernels: sampling-without-replacement is a top-k over random
+keys, sort is ``jnp.sort``, gather/scatter are ``take_along_axis`` /
+``.at[].set`` — and JAX differentiates through gathers natively, so the
+reference's hand-written ``GatherTokens``/``ScatterTokens`` autograd
+Functions reduce to plain functions.
+
+Shapes are static per ``reserved_length``: each curriculum step of the LTD
+schedule compiles one new program (coarse schedule steps keep that cheap).
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token_indices(rng, reserved_length: int, seq_length: int,
+                         batch_size: int, layers: int = 1) -> jnp.ndarray:
+    """[layers, batch, reserved_length] sorted indices, sampled uniformly
+    without replacement (reference ``gpt_sample_tokens`` multinomial +
+    ``token_sort_``)."""
+    if reserved_length > seq_length:
+        raise ValueError(
+            f"reserved_length {reserved_length} > seq_length {seq_length}")
+    keys = jax.random.uniform(rng, (layers, batch_size, seq_length))
+    _, idx = jax.lax.top_k(keys, reserved_length)  # w/o replacement
+    return jnp.sort(idx, axis=-1).astype(jnp.int32)
+
+
+def gpt_sample_tokens(rng, reserved_length: int, seq_length: int,
+                      batch_size: int, layers: int = 1,
+                      attn_mask: Optional[jnp.ndarray] = None):
+    """Reference ``gpt_sample_tokens`` (``dropping_utils.py:16``). For the
+    causal (GPT) case the kept tokens stay causally ordered, so the new mask
+    is just the leading square of the old one."""
+    idx = sample_token_indices(rng, reserved_length, seq_length, batch_size,
+                               layers)
+    new_mask = None
+    if attn_mask is not None:
+        new_mask = attn_mask[..., :reserved_length, :reserved_length]
+    return idx, new_mask
+
+
+def bert_sample_tokens(rng, reserved_length: int, seq_length: int,
+                       batch_size: int, layers: int = 1,
+                       attn_mask: Optional[jnp.ndarray] = None):
+    """Reference ``bert_sample_tokens`` (``dropping_utils.py:52``): the
+    bidirectional mask must be sliced at the sampled rows AND columns."""
+    if attn_mask is None:
+        raise ValueError("bert_sample_tokens requires attn_mask")
+    idx = sample_token_indices(rng, reserved_length, seq_length, batch_size,
+                               layers)
+
+    def slice_mask(layer_idx):  # [B, H, S, S] → [B, H, r, r]
+        def per_batch(mask_b, idx_b):
+            return mask_b[:, idx_b][:, :, idx_b]
+        return jax.vmap(per_batch)(attn_mask, layer_idx)
+
+    new_masks = jax.vmap(slice_mask)(idx)  # [layers, B, H, r, r]
+    return idx, new_masks
+
+
+def gather_tokens(activations: jnp.ndarray, sorted_indices: jnp.ndarray,
+                  batch_first: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Keep only the sampled tokens (reference ``GatherTokens``,
+    ``dropping_utils.py:84``). Returns ``(activations, gathered)`` to match
+    the reference's two-output contract."""
+    x = activations if batch_first else activations.swapaxes(0, 1)
+    g = jnp.take_along_axis(x, sorted_indices[..., None], axis=1)
+    if not batch_first:
+        g = g.swapaxes(0, 1)
+    return activations, g
+
+
+def scatter_tokens(all_activations: jnp.ndarray,
+                   layer_activations: jnp.ndarray,
+                   sorted_indices: jnp.ndarray,
+                   batch_first: bool = True) -> jnp.ndarray:
+    """Write processed tokens back into the full sequence (reference
+    ``ScatterTokens``, ``dropping_utils.py:113``); untouched positions keep
+    their pre-layer values."""
+    x = all_activations if batch_first else all_activations.swapaxes(0, 1)
+    y = layer_activations if batch_first else layer_activations.swapaxes(0, 1)
+    B = x.shape[0]
+    out = x.at[jnp.arange(B)[:, None], sorted_indices].set(y)
+    if not batch_first:
+        out = out.swapaxes(0, 1)
+    return out

@@ -1,0 +1,8 @@
+"""Data-efficiency pipeline (reference ``deepspeed/runtime/data_pipeline/``):
+curriculum learning, curriculum-aware sampling, random layerwise token drop.
+"""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler)
+
+__all__ = ["CurriculumScheduler"]

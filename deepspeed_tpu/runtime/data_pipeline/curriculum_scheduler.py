@@ -1,0 +1,110 @@
+"""Curriculum-learning difficulty scheduler.
+
+Capability parity with the reference ``CurriculumScheduler``
+(``runtime/data_pipeline/curriculum_scheduler.py:9``): maps the global step
+to a difficulty value (typically a sequence length) under the schedules
+``fixed_linear`` / ``fixed_root`` / ``fixed_discrete`` / ``custom``. The
+engine truncates or re-bins batches to the current difficulty; on TPU a
+changing seqlen means a new jit specialization, so difficulty steps should
+be coarse (``difficulty_step`` rounds to multiples — default 8 keeps shapes
+MXU-tile friendly).
+"""
+
+from typing import Callable, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict):
+        for key in ("min_difficulty", "max_difficulty", "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum learning requires config {key!r}")
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.schedule_type = config["schedule_type"]
+        self.schedule_config = dict(config.get("schedule_config", {}))
+        self.current_difficulty = self.min_difficulty
+        self.first_step = True
+        self._custom_fn: Optional[Callable[[int], int]] = None
+
+        if self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            for key in ("total_curriculum_step", "difficulty_step"):
+                if key not in self.schedule_config:
+                    raise ValueError(
+                        f"{self.schedule_type} schedule requires "
+                        f"schedule_config {key!r}")
+            if int(self.schedule_config["difficulty_step"]) % 8:
+                logger.warning(
+                    "curriculum difficulty_step not a multiple of 8 — "
+                    "seq lengths will fall off MXU tile boundaries")
+            if self.schedule_type == FIXED_ROOT:
+                self.schedule_config.setdefault("root_degree", 2)
+        elif self.schedule_type == FIXED_DISCRETE:
+            diff = self.schedule_config.get("difficulty")
+            max_step = self.schedule_config.get("max_step")
+            if not diff or max_step is None or len(diff) != len(max_step) + 1:
+                raise ValueError(
+                    "fixed_discrete needs len(difficulty) == len(max_step)+1")
+        elif self.schedule_type == CUSTOM:
+            pass  # user installs a callable via set_custom_get_difficulty
+        else:
+            raise ValueError(f"unknown curriculum schedule {self.schedule_type!r}")
+
+    # ------------------------------------------------------------------
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]):
+        self._custom_fn = fn
+
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    def set_current_difficulty(self, difficulty: int):
+        self.current_difficulty = int(difficulty)
+
+    def _root_schedule(self, global_steps: int, degree: float) -> int:
+        sc = self.schedule_config
+        total = int(sc["total_curriculum_step"])
+        frac = min(1.0, global_steps / total)
+        next_diff = self.min_difficulty + (
+            (self.max_difficulty - self.min_difficulty) * frac ** (1.0 / degree))
+        step = int(sc["difficulty_step"])
+        next_diff = int(next_diff / step) * step
+        return min(max(next_diff, self.min_difficulty), self.max_difficulty)
+
+    def get_difficulty(self, global_steps: int) -> int:
+        if self.schedule_type == FIXED_LINEAR:
+            return self._root_schedule(global_steps, 1.0)
+        if self.schedule_type == FIXED_ROOT:
+            return self._root_schedule(
+                global_steps, float(self.schedule_config["root_degree"]))
+        if self.schedule_type == FIXED_DISCRETE:
+            diff = self.schedule_config["difficulty"]
+            max_step = self.schedule_config["max_step"]
+            for d, s in zip(diff, max_step):
+                if global_steps <= s:
+                    return int(d)
+            return int(diff[-1])
+        if self._custom_fn is None:
+            raise RuntimeError(
+                "custom curriculum schedule requires set_custom_get_difficulty")
+        return int(self._custom_fn(global_steps))
+
+    def update_difficulty(self, global_steps: int) -> int:
+        if self.current_difficulty < self.max_difficulty or self.first_step:
+            self.first_step = False
+            self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty
+
+    # state-dict surface (reference parity for checkpointing)
+    def state_dict(self):
+        return {"current_difficulty": self.current_difficulty,
+                "first_step": self.first_step}
+
+    def load_state_dict(self, sd):
+        self.current_difficulty = int(sd["current_difficulty"])
+        self.first_step = bool(sd.get("first_step", False))

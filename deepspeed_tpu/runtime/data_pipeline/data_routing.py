@@ -1,0 +1,74 @@
+"""Random-LTD data routing (reference
+``runtime/data_pipeline/data_routing/{scheduler.py,basic_layer.py}``).
+
+The reference wraps transformer layers in ``RandomLayerTokenDrop`` modules
+that gather a sampled token subset before the layer and scatter results
+back after. The TPU-native form is functional: :func:`apply_random_ltd`
+performs gather → layer_fn → scatter with ops from
+``deepspeed_tpu.ops.random_ltd``; the scheduler maps global step →
+reserved sequence length.
+"""
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.random_ltd import (gather_tokens, sample_token_indices,
+                                          scatter_tokens)
+
+
+class RandomLTDScheduler:
+    """Reserved-length schedule (reference ``data_routing/scheduler.py``):
+    linearly grows the kept-token count from ``start_value`` to the full
+    sequence over ``total_layer_token_drop_steps``."""
+
+    def __init__(self, config: Dict):
+        ltd = config.get("random_ltd", config)
+        sched = ltd.get("random_ltd_schedule", {})
+        # reference schedule keys: min_value / max_value / schedule_config
+        # {seq_per_step, require_steps} (data_pipeline/constants.py)
+        inner = sched.get("schedule_config", {})
+        self.start_value = int(sched.get("min_value",
+                                         sched.get("start_value", 128)))
+        self.max_value = int(sched.get("max_value", ltd.get("max_value", 2048)))
+        self.total_steps = int(
+            sched.get("total_layer_token_drop_steps",
+                      sched.get("total_steps", inner.get("require_steps", 1))))
+        self.step_size = int(sched.get("seq_per_step",
+                                       inner.get("seq_per_step", 8)))
+        self.current_seq = self.start_value
+        self.global_steps = 0
+
+    def get_current_seq(self) -> int:
+        return self.current_seq
+
+    def update_seq(self, global_steps: int) -> int:
+        self.global_steps = global_steps
+        frac = min(1.0, global_steps / max(1, self.total_steps))
+        seq = self.start_value + (self.max_value - self.start_value) * frac
+        seq = int(seq / self.step_size) * self.step_size
+        self.current_seq = min(max(seq, self.start_value), self.max_value)
+        return self.current_seq
+
+    def state_dict(self):
+        return {"current_seq": self.current_seq,
+                "global_steps": self.global_steps}
+
+    def load_state_dict(self, sd):
+        self.current_seq = int(sd["current_seq"])
+        self.global_steps = int(sd.get("global_steps", 0))
+
+
+def apply_random_ltd(x: jnp.ndarray, rng, reserved_length: int,
+                     layer_fn: Callable[[jnp.ndarray], jnp.ndarray],
+                     batch_first: bool = True) -> jnp.ndarray:
+    """gather → layer → scatter (reference ``RandomLayerTokenDrop.forward``,
+    ``data_routing/basic_layer.py:13``). ``layer_fn`` sees only the sampled
+    ``reserved_length`` tokens; dropped tokens skip the layer entirely."""
+    B, T = (x.shape[0], x.shape[1]) if batch_first else (x.shape[1], x.shape[0])
+    if reserved_length >= T:
+        return layer_fn(x)
+    idx = sample_token_indices(rng, reserved_length, T, B)[0]
+    _, gathered = gather_tokens(x, idx, batch_first)
+    processed = layer_fn(gathered)
+    return scatter_tokens(x, processed, idx, batch_first)

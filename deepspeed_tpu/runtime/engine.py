@@ -207,6 +207,45 @@ class DeepSpeedEngine:
 
         self.monitor = MonitorMaster(self._config.monitor_config)
 
+        # --- data-efficiency / PLD / eigenvalue hooks (reference
+        #     engine.py:319,365,368,375 optional-feature configuration) ---
+        self.progressive_layer_drop = None
+        if self._config.pld_enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import (
+                ProgressiveLayerDrop)
+
+            p = self._config.pld_params or {}
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=p.get("theta", 0.5), gamma=p.get("gamma", 0.001))
+        self.curriculum_scheduler = None
+        if self._config.curriculum_enabled_legacy:
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+                CurriculumScheduler)
+
+            self.curriculum_scheduler = CurriculumScheduler(
+                self._config.curriculum_params_legacy)
+        self.random_ltd_scheduler = None
+        ltd_cfg = (self._config.data_efficiency_config or {}).get(
+            "data_routing", {}).get("random_ltd", {})
+        if ltd_cfg.get("enabled", False):
+            from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+                RandomLTDScheduler)
+
+            self.random_ltd_scheduler = RandomLTDScheduler(ltd_cfg)
+        self.eigenvalue = None
+        if self._config.eigenvalue_enabled:
+            from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+            e = self._config.eigenvalue_params or {}
+            self.eigenvalue = Eigenvalue(
+                verbose=e.get("verbose", False),
+                max_iter=e.get("max_iter", 100),
+                tol=e.get("tol", 1e-2),
+                stability=e.get("stability", 1e-6),
+                gas_boundary_resolution=e.get("gas_boundary_resolution", 1),
+                layer_name=e.get("layer_name", ""),
+                layer_num=e.get("layer_num", 0))
+
         # --- device state (built eagerly if params given, else on first batch) ---
         self.state: Optional[TrainState] = None
         self._state_shardings = None
@@ -445,6 +484,7 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown_:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         self.tput_timer.start()
+        batch = self._apply_curriculum(batch)
         batch = self._shard_batch(batch)
         self._ensure_state(batch)
         self.state, loss = self._jit_micro(self.state, batch)
@@ -454,6 +494,32 @@ class DeepSpeedEngine:
         return loss
 
     __call__ = forward
+
+    def _apply_curriculum(self, batch):
+        """Truncate token batches to the current curriculum seqlen
+        (reference passes ``curriculum_seqlen`` into the model forward,
+        ``engine.py:1807-1813``; here shapes are the contract, so the batch
+        itself is cut — one jit specialization per difficulty value)."""
+        if self.curriculum_scheduler is None or not isinstance(batch, dict):
+            return batch
+        ids = batch.get("input_ids")
+        if ids is None or not hasattr(ids, "ndim") or ids.ndim < 2:
+            return batch
+        seqlen = ids.shape[1]
+        diff = self.curriculum_scheduler.get_current_difficulty()
+        if seqlen <= diff:
+            return batch
+        out = dict(batch)
+        for key in ("input_ids", "labels", "attention_mask", "position_ids"):
+            v = out.get(key)
+            if v is None or not hasattr(v, "ndim"):
+                continue
+            # cut every axis that spans the sequence (handles [B,T],
+            # [B,T,T] pairwise masks, and [B,1,T,T] broadcast masks)
+            idx = tuple(slice(0, diff) if d == seqlen else slice(None)
+                        for d in v.shape)
+            out[key] = v[idx]
+        return out
 
     def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
         """Gradient accounting boundary (grads were produced with the loss in
@@ -481,6 +547,14 @@ class DeepSpeedEngine:
             self.global_samples += self.train_batch_size()
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
+            # schedule-driven features advance at the global-step boundary
+            # (reference _take_model_step, engine.py:2056 region)
+            if self.progressive_layer_drop is not None:
+                self.progressive_layer_drop.update_state(self.global_steps)
+            if self.curriculum_scheduler is not None:
+                self.curriculum_scheduler.update_difficulty(self.global_steps)
+            if self.random_ltd_scheduler is not None:
+                self.random_ltd_scheduler.update_seq(self.global_steps)
             if self.wall_clock_breakdown_:
                 self.timers(STEP_GLOBAL_TIMER).stop()
             self._report_progress()
@@ -570,6 +644,24 @@ class DeepSpeedEngine:
 
     def wall_clock_breakdown(self):
         return self.wall_clock_breakdown_
+
+    def pld_enabled(self):
+        return self.progressive_layer_drop is not None
+
+    def pld_params(self):
+        return self._config.pld_params
+
+    def curriculum_enabled_legacy(self):
+        return self.curriculum_scheduler is not None
+
+    def curriculum_params_legacy(self):
+        return self._config.curriculum_params_legacy
+
+    def random_ltd_enabled(self):
+        return self.random_ltd_scheduler is not None
+
+    def eigenvalue_enabled(self):
+        return self.eigenvalue is not None
 
     def dump_state(self):
         return self._config.dump_state

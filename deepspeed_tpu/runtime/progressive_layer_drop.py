@@ -1,0 +1,42 @@
+"""Progressive layer dropping (PLD).
+
+Capability parity with the reference ``ProgressiveLayerDrop``
+(``runtime/progressive_layer_drop.py:5``): a per-step keep probability
+``theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar`` that the engine
+passes into the model forward; layers apply stochastic depth with keep-prob
+scaled by depth (deeper layers dropped more). The reference's paper recipe
+("Accelerating Training of Transformer-Based Language Models with
+Progressive Layer Dropping") is preserved; on TPU the drop decision is a
+per-layer Bernoulli drawn inside the jitted step from the engine rng —
+shapes stay static (dropped layers multiply by zero), so no recompilation.
+"""
+
+import numpy as np
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int):
+        def _prob(x, gamma, p):
+            return (1.0 - p) * np.exp(-gamma * x) + p
+
+        self.current_theta = float(_prob(global_step, self.gamma, self.theta))
+        return self.current_theta
+
+
+def layer_keep_probs(theta: float, n_layer: int):
+    """Depth-scaled keep probabilities: layer i keeps with prob
+    ``1 - i/n * (1 - theta)`` (paper eq. 6) — the schedule the reference's
+    patched BERT forward implements in model code."""
+    i = np.arange(1, n_layer + 1, dtype=np.float32)
+    return 1.0 - (i / n_layer) * (1.0 - theta)

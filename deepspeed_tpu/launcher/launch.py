@@ -1,0 +1,82 @@
+"""Per-host launcher.
+
+Capability parity with the reference ``launcher/launch.py:129``, which forks
+one process per GPU and sets ``RANK/LOCAL_RANK/WORLD_SIZE/MASTER_*``. On a
+TPU pod each host runs ONE Python process that drives all local chips
+(single-controller-per-host SPMD), so this launcher execs the user script
+once with the JAX coordination env:
+
+- ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``
+  → consumed by ``jax.distributed.initialize()`` (called by
+  ``deepspeed_tpu.init_distributed``).
+- Reference-compatible ``RANK/LOCAL_RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT``
+  are also set so ported user scripts that read them keep working (RANK =
+  host index, WORLD_SIZE = host count).
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.runner import decode_world_info
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", type=str, required=True,
+                        help="base64 {host: [chips]} map")
+    parser.add_argument("--node_rank", type=int, required=True)
+    parser.add_argument("--master_addr", type=str, required=True)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--save_pid", action="store_true",
+                        help="Write a pidfile (reference parity)")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def build_env(args):
+    world = decode_world_info(args.world_info)
+    hosts = list(world)
+    if args.node_rank >= len(hosts):
+        raise ValueError(
+            f"node_rank {args.node_rank} out of range for {len(hosts)} hosts")
+    env = dict(os.environ)
+    env.update({
+        "JAX_COORDINATOR_ADDRESS": f"{args.master_addr}:{args.master_port}",
+        "JAX_NUM_PROCESSES": str(len(hosts)),
+        "JAX_PROCESS_ID": str(args.node_rank),
+        # reference-compatible names (launch.py sets these per fork)
+        "RANK": str(args.node_rank),
+        "LOCAL_RANK": "0",
+        "WORLD_SIZE": str(len(hosts)),
+        "MASTER_ADDR": args.master_addr,
+        "MASTER_PORT": str(args.master_port),
+        "DS_TPU_CHIPS_PER_HOST": str(len(world[hosts[args.node_rank]])),
+    })
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    env = build_env(args)
+    cmd = [sys.executable, "-u", args.user_script, *args.user_args]
+    logger.info(f"host {args.node_rank}: exec {' '.join(cmd)}")
+    proc = subprocess.Popen(cmd, env=env)
+    if args.save_pid:
+        with open(f"/tmp/ds_tpu_{os.getpid()}.pid", "w") as f:
+            f.write(str(proc.pid))
+
+    def forward_signal(sig, _frame):
+        proc.send_signal(sig)
+
+    signal.signal(signal.SIGTERM, forward_signal)
+    signal.signal(signal.SIGINT, forward_signal)
+    sys.exit(proc.wait())
+
+
+if __name__ == "__main__":
+    main()

@@ -232,6 +232,12 @@ class DeepSpeedEngine:
                 RandomLTDScheduler)
 
             self.random_ltd_scheduler = RandomLTDScheduler(ltd_cfg)
+        self.flops_profiler = None
+        self._last_batch = None
+        if self._config.flops_profiler_config.enabled:
+            from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+
+            self.flops_profiler = FlopsProfiler(ds_engine=self)
         self.eigenvalue = None
         if self._config.eigenvalue_enabled:
             from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
@@ -487,6 +493,14 @@ class DeepSpeedEngine:
         batch = self._apply_curriculum(batch)
         batch = self._shard_batch(batch)
         self._ensure_state(batch)
+        self._last_batch = batch
+        if (self.flops_profiler is not None and not self.flops_profiler.started
+                and self.global_steps + 1 == max(
+                    2, self._config.flops_profiler_config.profile_step)):
+            # reference starts profiling in forward at profile_step
+            # (engine.py:1774,1797); floored at step 2 here so the profiled
+            # window never includes XLA compilation of the step programs
+            self.flops_profiler.start_profile()
         self.state, loss = self._jit_micro(self.state, batch)
         self._last_loss = loss
         if self.wall_clock_breakdown_:
@@ -555,6 +569,14 @@ class DeepSpeedEngine:
                 self.curriculum_scheduler.update_difficulty(self.global_steps)
             if self.random_ltd_scheduler is not None:
                 self.random_ltd_scheduler.update_seq(self.global_steps)
+            if self.flops_profiler is not None and self.flops_profiler.started:
+                # prints at the end of the profiled step (reference
+                # engine.py:1845-1851)
+                jax.block_until_ready(self.state.params)
+                self.flops_profiler.stop_profile()
+                self.flops_profiler.print_model_profile(
+                    profile_step=self.global_steps,
+                    output_file=self._config.flops_profiler_config.output_file)
             if self.wall_clock_breakdown_:
                 self.timers(STEP_GLOBAL_TIMER).stop()
             self._report_progress()

@@ -1,0 +1,80 @@
+"""Flops profiler tests (reference
+``tests/unit/profiling/flops_profiler/test_flops_profiler.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler,
+                                                    count_params,
+                                                    flops_to_string,
+                                                    get_model_profile,
+                                                    number_to_string,
+                                                    transformer_flops_per_token)
+
+
+class TestCostAnalysis:
+    def test_matmul_flops_exact(self):
+        # [64,128] @ [128,32]: 2*M*N*K flops, and XLA should agree
+        a = jnp.ones((64, 128), jnp.float32)
+        b = jnp.ones((128, 32), jnp.float32)
+        prof = FlopsProfiler()
+        flops, duration, cost = prof.profile_fn(jnp.matmul, a, b)
+        assert flops == 2 * 64 * 128 * 32
+        assert duration > 0
+        assert prof.get_total_macs() == flops // 2
+
+    def test_get_model_profile_gpt2(self):
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+        cfg = GPT2Config.tiny(dtype=jnp.float32, use_flash=False)
+        flops, macs, params = get_model_profile(
+            GPT2LMHeadModel(cfg), input_shape=(2, 16), as_string=False,
+            print_profile=False)
+        n_params = params
+        # embedding-dominated tiny model; fwd flops must at least cover the
+        # analytic matmul floor for the non-embedding params
+        assert flops > 0 and n_params > cfg.vocab_size * cfg.n_embd
+        s = get_model_profile(GPT2LMHeadModel(cfg), input_shape=(2, 16),
+                              as_string=True, print_profile=False)
+        assert s[0].endswith("FLOPS") and s[1].endswith("MACs")
+
+    def test_count_params(self):
+        tree = {"a": jnp.zeros((3, 4)), "b": {"c": jnp.zeros(5)}}
+        assert count_params(tree) == 17
+
+    def test_strings(self):
+        assert flops_to_string(2.5e12) == "2.50 TFLOPS"
+        assert number_to_string(1500) == "1.50 K"
+
+    def test_analytic_transformer_model(self):
+        out = transformer_flops_per_token(124e6, 12, 768, 1024)
+        assert out["train_flops_per_token"] == pytest.approx(
+            3 * out["fwd_flops_per_token"])
+        assert out["fwd_flops_per_token"] > 2 * 124e6
+
+
+class TestEngineProfile:
+    def test_engine_profiles_at_step(self, tmp_path):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+        from deepspeed_tpu.parallel.topology import reset_topology
+
+        reset_topology()
+        out = tmp_path / "profile.txt"
+        cfg = GPT2Config.tiny(dtype=jnp.float32, use_flash=False)
+        ds = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "flops_profiler": {"enabled": True, "profile_step": 2,
+                                 "output_file": str(out)}}
+        engine, *_ = deepspeed_tpu.initialize(model=GPT2ForTraining(cfg),
+                                              config=ds)
+        batch = {"input_ids": np.ones((8, 16), np.int32)}
+        for _ in range(3):
+            engine.train_batch(batch=batch)
+        assert out.exists()
+        text = out.read_text()
+        assert "Flops Profiler" in text and "params:" in text
+        assert engine.flops_profiler.get_total_flops() > 0
+        reset_topology()

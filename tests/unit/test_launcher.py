@@ -1,0 +1,132 @@
+"""Launcher/CLI tests (reference ``tests/unit/launcher/test_run.py`` —
+hostfile parsing, resource filters, command construction)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher import launch as launch_mod
+from deepspeed_tpu.launcher import runner
+
+
+class TestHostfile:
+    def test_parse(self):
+        pool = runner._parse_hostfile([
+            "# comment", "", "worker-0 slots=4", "worker-1 slots=8"])
+        assert pool == {"worker-0": 4, "worker-1": 8}
+
+    def test_duplicate_rejected(self):
+        with pytest.raises(ValueError, match="multiple"):
+            runner._parse_hostfile(["w slots=4", "w slots=4"])
+
+    def test_bad_entry_rejected(self):
+        with pytest.raises(ValueError, match="bad entry"):
+            runner._parse_hostfile(["worker-0 gpus=4"])
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError, match="empty"):
+            runner._parse_hostfile(["# nothing"])
+
+
+class TestResourceFilter:
+    POOL = {"worker-0": 4, "worker-1": 4}
+
+    def test_include_with_slots(self):
+        active = runner.parse_inclusion_exclusion(
+            self.POOL, "worker-0@worker-1:0,2", "")
+        assert active == {"worker-0": [0, 1, 2, 3], "worker-1": [0, 2]}
+
+    def test_exclude(self):
+        active = runner.parse_inclusion_exclusion(self.POOL, "", "worker-1")
+        assert active == {"worker-0": [0, 1, 2, 3]}
+        active = runner.parse_inclusion_exclusion(self.POOL, "", "worker-0:1,3")
+        assert active["worker-0"] == [0, 2]
+
+    def test_both_rejected(self):
+        with pytest.raises(ValueError):
+            runner.parse_inclusion_exclusion(self.POOL, "worker-0", "worker-1")
+
+    def test_unknown_host_rejected(self):
+        with pytest.raises(ValueError, match="unknown host"):
+            runner.parse_inclusion_exclusion(self.POOL, "worker-9", "")
+
+
+class TestWorldInfo:
+    def test_round_trip(self):
+        info = {"a": [0, 1], "b": [0]}
+        assert runner.decode_world_info(runner.encode_world_info(info)) == info
+
+
+class TestCommands:
+    def test_single_host_local_command(self):
+        args = runner.parse_args(["-H", "/nonexistent", "--launcher", "local",
+                                  "train.py", "--lr", "0.1"])
+        cmds = runner.build_launch_commands(args, {"localhost": [0]})
+        assert len(cmds) == 1
+        cmd = cmds[0]
+        assert cmd[0] == sys.executable
+        assert "deepspeed_tpu.launcher.launch" in cmd
+        assert cmd[-3:] == ["train.py", "--lr", "0.1"]
+
+    def test_multi_host_ssh_commands(self):
+        args = runner.parse_args(["--launcher", "ssh", "--master_port",
+                                  "12345", "train.py"])
+        active = {"worker-0": [0, 1], "worker-1": [0, 1]}
+        cmds = runner.build_launch_commands(args, active)
+        assert len(cmds) == 2
+        assert cmds[0][0] == "ssh" and cmds[0][1] == "worker-0"
+        assert "--node_rank=0" in cmds[0][-1]
+        assert "--node_rank=1" in cmds[1][-1]
+        assert "--master_addr=worker-0" in cmds[1][-1]
+
+
+class TestLaunchEnv:
+    def test_env_carries_jax_coordination(self):
+        info = runner.encode_world_info({"h0": [0, 1, 2, 3], "h1": [0, 1, 2, 3]})
+        args = launch_mod.parse_args([
+            f"--world_info={info}", "--node_rank=1",
+            "--master_addr=h0", "--master_port=777", "t.py"])
+        env = launch_mod.build_env(args)
+        assert env["JAX_COORDINATOR_ADDRESS"] == "h0:777"
+        assert env["JAX_NUM_PROCESSES"] == "2"
+        assert env["JAX_PROCESS_ID"] == "1"
+        assert env["RANK"] == "1" and env["WORLD_SIZE"] == "2"
+        assert env["DS_TPU_CHIPS_PER_HOST"] == "4"
+
+    def test_node_rank_out_of_range(self):
+        info = runner.encode_world_info({"h0": [0]})
+        args = launch_mod.parse_args([
+            f"--world_info={info}", "--node_rank=3",
+            "--master_addr=h0", "t.py"])
+        with pytest.raises(ValueError, match="out of range"):
+            launch_mod.build_env(args)
+
+
+class TestEndToEnd:
+    def test_local_launch_runs_script(self, tmp_path):
+        script = tmp_path / "probe.py"
+        script.write_text(
+            "import os\n"
+            "assert os.environ['WORLD_SIZE'] == '1'\n"
+            "assert 'JAX_COORDINATOR_ADDRESS' in os.environ\n"
+            "print('LAUNCH_OK')\n")
+        out = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+             "-H", "/nonexistent", "--launcher", "local", str(script)],
+            capture_output=True, text=True, cwd="/root/repo", timeout=120)
+        assert "LAUNCH_OK" in out.stdout, out.stderr
+        assert out.returncode == 0
+
+
+class TestEnvReport:
+    def test_report_sections_never_crash(self):
+        from deepspeed_tpu import env_report
+
+        soft = env_report.software_report()
+        assert any(r[0] == "jax" for r in soft)
+        hard = env_report.hardware_report()
+        assert any(r[0] in ("platform", "jax devices") for r in hard)
+        tools = env_report.toolchain_report()
+        assert any(r[0] == "g++" for r in tools)
+        assert env_report.op_report()

@@ -179,8 +179,17 @@ def init_distributed(dist_backend="xla",
 
     n_procs = world_size if world_size > 0 else int(
         os.environ.get("WORLD_SIZE", os.environ.get("JAX_NUM_PROCESSES", 1)))
-    coordinator = init_method or os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("MASTER_ADDR")
-    proc_id = rank if rank >= 0 else int(os.environ.get("RANK", 0))
+    # launcher precedence: explicit init_method > JAX_COORDINATOR_ADDRESS
+    # (set by launcher/launch.py, includes the port) > MASTER_ADDR[:MASTER_PORT]
+    coordinator = (init_method
+                   or os.environ.get("JAX_COORDINATOR_ADDRESS")
+                   or os.environ.get("COORDINATOR_ADDRESS")
+                   or os.environ.get("MASTER_ADDR"))
+    if coordinator and ":" not in coordinator.replace("tcp://", ""):
+        port = os.environ.get("MASTER_PORT", str(distributed_port))
+        coordinator = f"{coordinator}:{port}"
+    proc_id = rank if rank >= 0 else int(
+        os.environ.get("RANK", os.environ.get("JAX_PROCESS_ID", 0)))
     if n_procs > 1:
         if not coordinator:
             raise RuntimeError(

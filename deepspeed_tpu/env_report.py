@@ -34,14 +34,8 @@ def software_report():
     for mod in ("jax", "jaxlib", "flax", "optax", "numpy"):
         v = _version(mod)
         rows.append((mod, v or "not installed", OKAY if v else FAIL))
-    try:
-        import jax
-        rows.append(("python", sys.version.split()[0], OKAY))
-        rows.append(("deepspeed_tpu",
-                     _version("deepspeed_tpu") or "source", OKAY))
-        del jax
-    except ImportError:
-        pass
+    rows.append(("python", sys.version.split()[0], OKAY))
+    rows.append(("deepspeed_tpu", _version("deepspeed_tpu") or "source", OKAY))
     return rows
 
 

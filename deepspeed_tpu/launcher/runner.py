@@ -233,7 +233,17 @@ def main(args=None):
                 f"pass --no_ssh_check")
     cmds = build_launch_commands(args, active)
     logger.info(f"launching on {len(cmds)} host(s): {list(active)}")
-    procs = [subprocess.Popen(cmd) for cmd in cmds]
+    # make an uninstalled checkout importable in children by APPENDING the
+    # repo root to PYTHONPATH (never replacing it — the TPU plugin may be
+    # registered via an existing PYTHONPATH sitecustomize)
+    child_env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    existing = child_env.get("PYTHONPATH", "")
+    if repo_root not in existing.split(os.pathsep):
+        child_env["PYTHONPATH"] = (existing + os.pathsep + repo_root).lstrip(
+            os.pathsep)
+    procs = [subprocess.Popen(cmd, env=child_env) for cmd in cmds]
     # first failure tears down the surviving hosts (reference runner kills
     # peers via its sigkill handler, runner.py:541) — otherwise the others
     # hang forever inside the jax.distributed rendezvous

@@ -1,0 +1,85 @@
+"""Async tensor file I/O (NVMe offload tier).
+
+Capability parity with the reference ``aio_handle``
+(``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp`` via ``op_builder/async_io.py``):
+submit overlapped reads/writes of host arrays against files, then wait.
+Backed by ``csrc/aio/ds_aio.cpp`` (thread pool + O_DIRECT when aligned).
+"""
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+
+
+class AsyncIOHandle:
+    """Reference ``aio_handle(block_size, queue_depth, single_submit,
+    overlap_events, num_threads)`` — queue_depth/submit knobs collapse into
+    the worker-pool size here."""
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
+                 single_submit: bool = False, overlap_events: bool = True,
+                 num_threads: int = 4):
+        self._lib = AsyncIOBuilder().load()
+        self._handle = self._lib.ds_aio_create(num_threads, block_size)
+        if self._handle < 0:
+            raise RuntimeError("failed to create aio engine")
+        self.block_size = block_size
+        self.num_threads = num_threads
+
+    def _buf(self, arr: np.ndarray):
+        if not arr.flags["C_CONTIGUOUS"]:
+            raise ValueError("aio buffers must be contiguous")
+        return ctypes.c_void_p(arr.ctypes.data)
+
+    # -- reference surface: sync_pread/sync_pwrite/async_pread/async_pwrite
+    def sync_pread(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        rc = self._lib.ds_aio_pread(self._handle, filename.encode(),
+                                    self._buf(buffer), buffer.nbytes, offset, 0)
+        if rc != 0:
+            raise IOError(f"pread failed: {filename}")
+        return buffer.nbytes
+
+    def sync_pwrite(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        rc = self._lib.ds_aio_pwrite(self._handle, filename.encode(),
+                                     self._buf(buffer), buffer.nbytes, offset, 0)
+        if rc != 0:
+            raise IOError(f"pwrite failed: {filename}")
+        return buffer.nbytes
+
+    def async_pread(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        rc = self._lib.ds_aio_pread(self._handle, filename.encode(),
+                                    self._buf(buffer), buffer.nbytes, offset, 1)
+        if rc != 0:
+            raise IOError(f"async pread submit failed: {filename}")
+
+    def async_pwrite(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        rc = self._lib.ds_aio_pwrite(self._handle, filename.encode(),
+                                     self._buf(buffer), buffer.nbytes, offset, 1)
+        if rc != 0:
+            raise IOError(f"async pwrite submit failed: {filename}")
+
+    def wait(self) -> int:
+        """Block until all submitted ops complete; returns completed count."""
+        done = self._lib.ds_aio_wait(self._handle)
+        if done < 0:
+            raise IOError(f"{-done} async io operation(s) failed")
+        return int(done)
+
+    @staticmethod
+    def aligned_array(num_bytes: int, dtype=np.uint8) -> np.ndarray:
+        """4KiB-aligned host buffer eligible for O_DIRECT (reference pinned
+        staging buffers). Over-allocates and slices; the view keeps the
+        backing allocation alive via ``.base``."""
+        align = 4096
+        raw = np.empty(num_bytes + align, np.uint8)
+        offset = (-raw.ctypes.data) % align
+        return raw[offset:offset + num_bytes].view(dtype)
+
+    def __del__(self):
+        try:
+            self._lib.ds_aio_destroy(self._handle)
+        except Exception:
+            pass

@@ -1,0 +1,114 @@
+"""Host-offload Adam optimizer.
+
+Capability parity with the reference ``DeepSpeedCPUAdam``
+(``deepspeed/ops/adam/cpu_adam.py:12`` over ``csrc/adam/cpu_adam.cpp``): the
+fp32 master weights and moments live in host RAM; each step fuses
+grad-read (fp32 or bf16 wire format), moment update, and param write in a
+multithreaded vectorized C++ loop. Used by the optimizer-offload tier where
+the chip holds only bf16 working params.
+"""
+
+import itertools
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import CpuAdamBuilder
+
+_ids = itertools.count()
+
+
+class DeepSpeedCPUAdam:
+    def __init__(self, params=None, lr: float = 1e-3, betas=(0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adamw_mode: bool = True, fp32_optimizer_states: bool = True):
+        self.opt_id = next(_ids)
+        self.lr = float(lr)
+        self.betas = betas
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+        self.adamw_mode = adamw_mode
+        self._lib = CpuAdamBuilder().load()
+        self._lib.ds_adam_create(self.opt_id, self.lr, betas[0], betas[1],
+                                 self.eps, self.weight_decay,
+                                 1 if adamw_mode else 0)
+        self.step_count = 0
+        # flat master state per registered param name
+        self._state: Dict[str, Dict[str, np.ndarray]] = {}
+        if params is not None:
+            for name, p in params.items():
+                self.register_param(name, p)
+
+    # ------------------------------------------------------------------
+    def register_param(self, name: str, value: np.ndarray):
+        value = np.ascontiguousarray(np.asarray(value, np.float32))
+        self._state[name] = {
+            "param": value,
+            "exp_avg": np.zeros_like(value),
+            "exp_avg_sq": np.zeros_like(value),
+        }
+
+    def get_param(self, name: str) -> np.ndarray:
+        return self._state[name]["param"]
+
+    def set_lr(self, lr: float):
+        self.lr = float(lr)
+        self._lib.ds_adam_update_lr(self.opt_id, self.lr)
+
+    def _ptr(self, arr: np.ndarray):
+        import ctypes
+
+        return arr.ctypes.data_as(ctypes.POINTER(
+            ctypes.c_uint16 if arr.dtype == np.uint16 else ctypes.c_float))
+
+    def step(self, grads: Dict[str, np.ndarray], lr: Optional[float] = None):
+        """Apply one Adam step to every registered param.
+
+        ``grads[name]`` may be fp32, or uint16 (bf16 bit pattern — the raw
+        device-to-host wire format, fused without a separate upcast pass).
+        """
+        if lr is not None and lr != self.lr:
+            self.set_lr(lr)
+        self.step_count += 1
+        for name, g in grads.items():
+            st = self._state[name]
+            p = st["param"]
+            n = p.size
+            g = np.ascontiguousarray(g).reshape(-1)
+            if g.dtype == np.uint16:
+                rc = self._lib.ds_adam_step_bf16grad(
+                    self.opt_id, self.step_count, n, self._ptr(p.reshape(-1)),
+                    self._ptr(g), self._ptr(st["exp_avg"].reshape(-1)),
+                    self._ptr(st["exp_avg_sq"].reshape(-1)))
+            else:
+                g = g.astype(np.float32, copy=False)
+                rc = self._lib.ds_adam_step(
+                    self.opt_id, self.step_count, n, self._ptr(p.reshape(-1)),
+                    self._ptr(g), self._ptr(st["exp_avg"].reshape(-1)),
+                    self._ptr(st["exp_avg_sq"].reshape(-1)))
+            if rc != 0:
+                raise RuntimeError(f"cpu_adam step failed for {name!r}")
+
+    def params_as_bf16(self) -> Dict[str, np.ndarray]:
+        """Master fp32 → bf16 bit patterns for shipping back to the chip."""
+        out = {}
+        for name, st in self._state.items():
+            p = st["param"].reshape(-1)
+            dst = np.empty(p.size, np.uint16)
+            self._lib.ds_f32_to_bf16(p.size, self._ptr(p), self._ptr(dst))
+            out[name] = dst.reshape(st["param"].shape)
+        return out
+
+    def state_dict(self):
+        return {"step": self.step_count, "lr": self.lr, "state": self._state}
+
+    def load_state_dict(self, sd):
+        self.step_count = int(sd["step"])
+        self.set_lr(float(sd["lr"]))
+        self._state = sd["state"]
+
+    def __del__(self):
+        try:
+            self._lib.ds_adam_destroy(self.opt_id)
+        except Exception:
+            pass

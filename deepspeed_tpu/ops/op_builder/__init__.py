@@ -1,0 +1,8 @@
+"""Native-op build system (reference ``op_builder/``)."""
+
+from deepspeed_tpu.ops.op_builder.builder import (ALL_OPS, AsyncIOBuilder,
+                                                  CpuAdamBuilder, OpBuilder,
+                                                  get_op_builder)
+
+__all__ = ["OpBuilder", "CpuAdamBuilder", "AsyncIOBuilder", "ALL_OPS",
+           "get_op_builder"]

@@ -1,0 +1,37 @@
+"""Op availability registry, consumed by ``ds_report`` (env_report.op_report).
+
+Covers both tiers: XLA/Pallas device ops (import/compile probes) and native
+host ops (build probes via ``op_builder``).
+"""
+
+import importlib
+from typing import Dict
+
+
+def report() -> Dict[str, Dict]:
+    out: Dict[str, Dict] = {}
+    # device ops: importable == available (Pallas kernels fall back to XLA
+    # reference paths at call time if the platform can't run them)
+    for mod in ("flash_attention", "attention", "ring_attention", "quantizer",
+                "optimizer", "random_ltd"):
+        try:
+            importlib.import_module(f"deepspeed_tpu.ops.{mod}")
+            out[mod] = {"available": True, "detail": "importable (XLA/Pallas)"}
+        except Exception as e:
+            out[mod] = {"available": False, "detail": f"import error: {e}"}
+    # host ops: actually build them (cached after first call)
+    try:
+        from deepspeed_tpu.ops.op_builder import ALL_OPS
+
+        for name, cls in ALL_OPS.items():
+            builder = cls()
+            if not builder.enabled():
+                out[name] = {"available": False, "detail": "disabled via env"}
+            elif builder.available():
+                out[name] = {"available": True, "detail": "built (C++ host op)"}
+            else:
+                out[name] = {"available": False,
+                             "detail": f"build failed: {builder.error}"}
+    except Exception as e:
+        out["op_builder"] = {"available": False, "detail": str(e)}
+    return out

@@ -23,6 +23,7 @@ import collections
 import itertools
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import os
 import numpy as np
 
 from deepspeed_tpu.utils.logging import logger
@@ -186,7 +187,15 @@ class MeshTopology:
             for a in CANONICAL_AXIS_ORDER:
                 self.axis_sizes.setdefault(a, 1)
         else:
-            devices = devices if devices is not None else jax.devices()
+            if devices is None:
+                devices = jax.devices()
+                # launcher chip cap: 'slots=N' / --num_chips flows here via
+                # DS_TPU_CHIPS_PER_HOST (single-process only — a multi-host
+                # job must shape its own device list)
+                cap = os.environ.get("DS_TPU_CHIPS_PER_HOST")
+                if cap and jax.process_count() == 1 \
+                        and 0 < int(cap) < len(devices):
+                    devices = devices[:int(cap)]
             axis_sizes = dict(axis_sizes or {})
             axis_sizes.setdefault(AXIS_DATA, -1)
             sizes = _normalize_axis_sizes(axis_sizes, len(devices))

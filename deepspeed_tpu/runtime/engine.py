@@ -149,6 +149,24 @@ class DeepSpeedEngine:
                 self._config.optimizer_params or {})
         self.basic_optimizer = self.optimizer
 
+        # --- ZeRO-Offload optimizer tier (reference stage_1_and_2.py cpu
+        #     offload + swap_tensor optimizer swappers): masters/moments on
+        #     host (or nvme memmap), native cpu_adam does the update ---
+        off = self._config.zero_config.offload_optimizer
+        self._host_offload = off is not None and str(off.device) in ("cpu", "nvme")
+        self._host_optimizer = None
+        if self._host_offload:
+            p = self._config.optimizer_params or {}
+            betas = tuple(p.get("betas", (0.9, 0.999)))
+            from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+
+            self._host_optimizer = HostOffloadOptimizer(
+                lr=p.get("lr", 1e-3), betas=betas, eps=p.get("eps", 1e-8),
+                weight_decay=p.get("weight_decay", 0.0),
+                adamw_mode=(self._config.optimizer_name or "adamw") == "adamw",
+                gradient_clipping=self._config.gradient_clipping,
+                device=str(off.device), nvme_path=off.nvme_path)
+
         # --- lr schedule (reference _configure_lr_scheduler, engine.py:900) ---
         if lr_scheduler is not None:
             self.lr_scheduler = lr_scheduler
@@ -357,12 +375,18 @@ class DeepSpeedEngine:
         stage = self.zero_optimization_stage()
         base_specs = self._tp_base_specs(abstract)
 
-        opt_abstract = jax.eval_shape(self.optimizer.init, abstract)
-        opt_state_shardings = build_opt_state_shardings(
-            opt_abstract, abstract, self.mesh, stage=stage, param_specs=base_specs)
-        with self.mesh:
-            opt_state = jax.jit(self.optimizer.init,
-                                out_shardings=opt_state_shardings)(params)
+        if self._host_offload:
+            # moments/masters live on host (HostOffloadOptimizer); the
+            # device keeps no optimizer state at all
+            opt_state, opt_state_shardings = {}, {}
+            self._host_optimizer.init_from_params(params)
+        else:
+            opt_abstract = jax.eval_shape(self.optimizer.init, abstract)
+            opt_state_shardings = build_opt_state_shardings(
+                opt_abstract, abstract, self.mesh, stage=stage, param_specs=base_specs)
+            with self.mesh:
+                opt_state = jax.jit(self.optimizer.init,
+                                    out_shardings=opt_state_shardings)(params)
         if stage >= 2:
             # grads live reduce-scattered over the data axes (ZeRO-2), on top
             # of any TP sharding
@@ -428,6 +452,24 @@ class DeepSpeedEngine:
 
     def _compile_steps_apply_only(self):
         """Compile the optimizer-apply program (shared with PipelineEngine)."""
+        if self._host_offload:
+            self._jit_apply = None
+            shardings = self._state_shardings
+
+            def zero_grads(state: TrainState, new_params):
+                return state._replace(
+                    params=jax.tree_util.tree_map(
+                        lambda p, n: n.astype(p.dtype), state.params, new_params),
+                    grad_acc=jax.tree_util.tree_map(jnp.zeros_like,
+                                                    state.grad_acc),
+                    global_step=state.global_step + 1)
+
+            self._jit_offload_commit = jax.jit(
+                zero_grads,
+                in_shardings=(shardings, shardings.params),
+                out_shardings=shardings,
+                donate_argnums=(0,))
+            return
         fp16 = self.fp16_enabled_
         clip = self._config.gradient_clipping
         optimizer = self.optimizer
@@ -493,7 +535,10 @@ class DeepSpeedEngine:
         batch = self._apply_curriculum(batch)
         batch = self._shard_batch(batch)
         self._ensure_state(batch)
-        self._last_batch = batch
+        if self.flops_profiler is not None:
+            # only the profiler's stop_profile lowering needs the batch;
+            # don't pin device buffers when profiling is off
+            self._last_batch = batch
         if (self.flops_profiler is not None and not self.flops_profiler.started
                 and self.global_steps + 1 == max(
                     2, self._config.flops_profiler_config.profile_step)):
@@ -555,8 +600,12 @@ class DeepSpeedEngine:
         if self.is_gradient_accumulation_boundary():
             if self.wall_clock_breakdown_:
                 self.timers(STEP_GLOBAL_TIMER).start()
-            self.state, overflow, grad_norm = self._jit_apply(self.state, self._lr_override())
-            self._last_grad_norm = grad_norm
+            if self._host_offload:
+                self._host_apply()
+            else:
+                self.state, overflow, grad_norm = self._jit_apply(
+                    self.state, self._lr_override())
+                self._last_grad_norm = grad_norm
             self.global_steps += 1
             self.global_samples += self.train_batch_size()
             if self.lr_scheduler is not None:
@@ -584,6 +633,38 @@ class DeepSpeedEngine:
         else:
             self.tput_timer.stop(global_step=False)
         self.micro_steps += 1
+
+    def _host_apply(self):
+        """Offload-tier optimizer boundary: grads D2H → native cpu_adam →
+        params H2D (reference ZeRO-Offload step; ``stage_1_and_2.py:1074``)."""
+        fp16 = self.fp16_enabled_
+        scale = float(self.state.loss_scale.loss_scale) if fp16 else 1.0
+        if self._schedule_fn is not None:
+            lr = float(self._schedule_fn(int(self.state.global_step)))
+        else:
+            lr = float(self._lr_override())
+        new_params, overflow, grad_norm = self._host_optimizer.apply(
+            self.state.grad_acc, lr=lr, loss_scale=scale,
+            check_overflow=fp16)
+        self._last_grad_norm = grad_norm
+        # identical dynamic-loss-scale semantics to the compiled apply_step
+        # (growth window, hysteresis, min_scale floor)
+        new_scale = update_scale(self._scaler_config, self.state.loss_scale,
+                                 jnp.asarray(overflow)) if fp16 \
+            else self.state.loss_scale
+        if overflow:
+            self.skipped_steps += 1
+            zero = jax.tree_util.tree_map(jnp.zeros_like, self.state.grad_acc)
+            self.state = self.state._replace(
+                grad_acc=zero, loss_scale=new_scale,
+                skipped_steps=self.state.skipped_steps + 1)
+            return
+        params_tree = jax.tree_util.tree_unflatten(
+            self._host_optimizer._treedef,
+            [new_params[p] for p in self._host_optimizer._paths])
+        self.state = self._jit_offload_commit(self.state, params_tree)
+        if fp16:
+            self.state = self.state._replace(loss_scale=new_scale)
 
     def _lr_override(self):
         """lr fed to the compiled step when no traced schedule_fn exists."""
@@ -744,6 +825,9 @@ class DeepSpeedEngine:
         module_state = {"params": host_state.params}
         optim_state = {
             "opt_state": host_state.opt_state,  # generic: any pytree structure
+            # offload tier: masters/moments live host-side, not in opt_state
+            "host_optimizer": (self._host_optimizer.state_dict()
+                               if self._host_offload else None),
             "loss_scale": host_state.loss_scale.loss_scale,
             "good_steps": host_state.loss_scale.good_steps,
             "hysteresis": host_state.loss_scale.hysteresis,
@@ -841,6 +925,12 @@ class DeepSpeedEngine:
                 skipped_steps=jnp.asarray(flat_opt["skipped_steps"], jnp.int32),
                 rng=jnp.asarray(flat_opt["rng"], jnp.uint32),
             )
+            if self._host_offload:
+                hosted = {k[len("host_optimizer/"):]: v
+                          for k, v in flat_opt.items()
+                          if k.startswith("host_optimizer/")}
+                if hosted:
+                    self._host_optimizer.load_flat_state(hosted)
         engine_state = self.checkpoint_engine.load(os.path.join(ckpt_dir, "engine"))
         self.micro_steps = int(engine_state.get("micro_steps", 0))
         self.global_steps = int(engine_state.get("global_steps", 0))

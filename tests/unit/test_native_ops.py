@@ -1,0 +1,149 @@
+"""Native host-op tests (reference ``tests/unit/ops/adam/test_cpu_adam.py``
+and ``tests/unit/ops/aio/test_aio.py``): C++ kernels vs Python references.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.aio import AsyncIOHandle
+from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.ops.op_builder import (ALL_OPS, AsyncIOBuilder,
+                                          CpuAdamBuilder, get_op_builder)
+
+
+def np_adam_reference(p, g, m, v, step, lr, b1, b2, eps, wd, adamw):
+    """Plain numpy Adam/AdamW for parity checks."""
+    p, g, m, v = (x.astype(np.float64) for x in (p, g, m, v))
+    if not adamw and wd:
+        g = g + wd * p
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mh = m / (1 - b1 ** step)
+    vh = v / (1 - b2 ** step)
+    update = lr * mh / (np.sqrt(vh) + eps)
+    if adamw and wd:
+        update = update + lr * wd * p
+    return (p - update).astype(np.float32), m.astype(np.float32), v.astype(np.float32)
+
+
+class TestBuilder:
+    def test_registry(self):
+        assert set(ALL_OPS) >= {"cpu_adam", "async_io"}
+        assert isinstance(get_op_builder("cpu_adam"), CpuAdamBuilder)
+        with pytest.raises(ValueError):
+            get_op_builder("nope")
+
+    def test_build_and_cache(self):
+        b = CpuAdamBuilder()
+        lib = b.load()
+        assert lib is not None
+        # second load is the cached object
+        assert b.load() is lib
+        assert os.path.isfile(b._cache_path())
+
+    def test_disable_env(self, monkeypatch):
+        monkeypatch.setenv("DS_BUILD_CPU_ADAM", "0")
+        b = CpuAdamBuilder()
+        assert not b.enabled()
+        with pytest.raises(RuntimeError, match="disabled"):
+            b.load()
+
+
+class TestCPUAdam:
+    @pytest.mark.parametrize("adamw", [True, False])
+    @pytest.mark.parametrize("wd", [0.0, 0.01])
+    def test_matches_numpy_reference(self, adamw, wd):
+        rng = np.random.default_rng(0)
+        n = 1025  # off the vector width on purpose
+        p0 = rng.standard_normal(n).astype(np.float32)
+        opt = DeepSpeedCPUAdam({"w": p0.copy()}, lr=1e-2, weight_decay=wd,
+                               adamw_mode=adamw)
+        ref_p, ref_m, ref_v = p0.copy(), np.zeros(n, np.float32), np.zeros(n, np.float32)
+        for step in range(1, 5):
+            g = rng.standard_normal(n).astype(np.float32)
+            opt.step({"w": g})
+            ref_p, ref_m, ref_v = np_adam_reference(
+                ref_p, g, ref_m, ref_v, step, 1e-2, 0.9, 0.999, 1e-8, wd, adamw)
+        np.testing.assert_allclose(opt.get_param("w"), ref_p, rtol=2e-5,
+                                   atol=2e-5)
+
+    def test_bf16_grad_wire_format(self):
+        rng = np.random.default_rng(1)
+        n = 512
+        p0 = rng.standard_normal(n).astype(np.float32)
+        g32 = rng.standard_normal(n).astype(np.float32)
+        # bf16 = top 16 bits of fp32 (truncation is close enough for parity)
+        g_bf16 = (g32.view(np.uint32) >> 16).astype(np.uint16)
+        g_as_f32 = (g_bf16.astype(np.uint32) << 16).view(np.float32)
+
+        a = DeepSpeedCPUAdam({"w": p0.copy()}, lr=1e-2)
+        b = DeepSpeedCPUAdam({"w": p0.copy()}, lr=1e-2)
+        a.step({"w": g_bf16})
+        b.step({"w": g_as_f32})
+        np.testing.assert_allclose(a.get_param("w"), b.get_param("w"),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_round_trip_bf16_export(self):
+        opt = DeepSpeedCPUAdam({"w": np.full(7, 1.5, np.float32)})
+        out = opt.params_as_bf16()["w"]
+        back = (out.astype(np.uint32) << 16).view(np.float32)
+        np.testing.assert_allclose(back, 1.5)
+
+    def test_lr_schedule_applied(self):
+        p0 = np.ones(4, np.float32)
+        opt = DeepSpeedCPUAdam({"w": p0.copy()}, lr=1e-3)
+        opt.step({"w": np.ones(4, np.float32)}, lr=0.1)
+        assert opt.lr == 0.1
+        moved = np.abs(opt.get_param("w") - p0).max()
+        assert moved > 0.01  # lr=0.1 scale step, not 1e-3
+
+
+class TestAsyncIO:
+    def test_sync_round_trip(self, tmp_path):
+        h = AsyncIOHandle(num_threads=2)
+        data = np.arange(10000, dtype=np.float32)
+        f = str(tmp_path / "t.bin")
+        h.sync_pwrite(data, f)
+        out = np.empty_like(data)
+        h.sync_pread(out, f)
+        np.testing.assert_array_equal(out, data)
+
+    def test_async_overlapped_ops(self, tmp_path):
+        h = AsyncIOHandle(num_threads=4)
+        bufs = [np.full(4096, i, np.float32) for i in range(8)]
+        files = [str(tmp_path / f"s{i}.bin") for i in range(8)]
+        for b, f in zip(bufs, files):
+            h.async_pwrite(b, f)
+        assert h.wait() == 8
+        outs = [np.empty(4096, np.float32) for _ in range(8)]
+        for o, f in zip(outs, files):
+            h.async_pread(o, f)
+        assert h.wait() == 8
+        for i, o in enumerate(outs):
+            np.testing.assert_array_equal(o, bufs[i])
+
+    def test_offset_io(self, tmp_path):
+        h = AsyncIOHandle()
+        f = str(tmp_path / "o.bin")
+        h.sync_pwrite(np.zeros(1024, np.uint8), f)
+        h.sync_pwrite(np.full(16, 7, np.uint8), f, offset=100)
+        out = np.empty(1024, np.uint8)
+        h.sync_pread(out, f)
+        assert (out[100:116] == 7).all() and out[99] == 0 and out[116] == 0
+
+    def test_failed_read_raises(self, tmp_path):
+        h = AsyncIOHandle()
+        buf = np.empty(128, np.uint8)
+        with pytest.raises(IOError):
+            h.sync_pread(buf, str(tmp_path / "missing.bin"))
+        h.async_pread(buf, str(tmp_path / "missing2.bin"))
+        with pytest.raises(IOError):
+            h.wait()
+
+    def test_aligned_array(self):
+        arr = AsyncIOHandle.aligned_array(8192, np.float32)
+        assert arr.ctypes.data % 4096 == 0
+        assert arr.nbytes == 8192
+        arr[:] = 3.0  # writable

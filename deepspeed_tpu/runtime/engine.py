@@ -387,12 +387,15 @@ class DeepSpeedEngine:
             with self.mesh:
                 opt_state = jax.jit(self.optimizer.init,
                                     out_shardings=opt_state_shardings)(params)
-        if stage >= 2:
+        if stage >= 2 and not self._host_offload:
             # grads live reduce-scattered over the data axes (ZeRO-2), on top
             # of any TP sharding
             _, grad_shardings = build_zero_shardings(
                 abstract, self.mesh, stage=stage, param_specs=base_specs)
         else:
+            # host offload fetches full grads D2H each boundary, so keep them
+            # in the param layout (stage-2 scatter would make device_get span
+            # non-addressable devices on multi-host)
             grad_shardings = param_shardings
         with self.mesh:
             grad_acc = jax.jit(
@@ -653,10 +656,12 @@ class DeepSpeedEngine:
                                  jnp.asarray(overflow)) if fp16 \
             else self.state.loss_scale
         if overflow:
-            self.skipped_steps += 1
             zero = jax.tree_util.tree_map(jnp.zeros_like, self.state.grad_acc)
+            # mirror the compiled apply_step exactly: global_step advances on
+            # overflow too, so the lr schedule stays aligned with non-offload
             self.state = self.state._replace(
                 grad_acc=zero, loss_scale=new_scale,
+                global_step=self.state.global_step + 1,
                 skipped_steps=self.state.skipped_steps + 1)
             return
         params_tree = jax.tree_util.tree_unflatten(

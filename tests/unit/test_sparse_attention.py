@@ -1,0 +1,171 @@
+"""Sparse attention tests (reference ``tests/unit/ops/sparse_attention/``):
+layout structural properties + attention numerics vs dense reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import attention_reference
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                DenseSparsityConfig,
+                                                FixedSparsityConfig,
+                                                LocalSlidingWindowSparsityConfig,
+                                                SparseAttentionUtils,
+                                                SparseSelfAttention,
+                                                VariableSparsityConfig)
+
+
+class TestLayouts:
+    def test_dense_all_ones(self):
+        layout = DenseSparsityConfig(num_heads=2, block=16).make_layout(64)
+        assert layout.shape == (2, 4, 4)
+        assert (layout == 1).all()
+
+    def test_seq_not_divisible_raises(self):
+        with pytest.raises(ValueError, match="divisible"):
+            DenseSparsityConfig(num_heads=2, block=16).make_layout(65)
+
+    def test_fixed_unidirectional_is_causal(self):
+        cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                                  attention="unidirectional")
+        layout = cfg.make_layout(128)
+        assert (np.triu(layout[0], 1) == 0).all()  # nothing above diagonal
+        assert (np.diagonal(layout[0]) == 1).all()  # self-block always on
+
+    def test_fixed_local_windows_and_globals(self):
+        cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                                  num_global_blocks=1,
+                                  attention="bidirectional")
+        layout = cfg.make_layout(16 * 8)
+        # local: block (1,0) same window → 1; (4,0) different window w/o global
+        assert layout[0, 1, 0] == 1
+        # global column: last block of each window (idx 3, 7) visible to all
+        assert (layout[0, :, 3] == 1).all()
+        assert (layout[0, :, 7] == 1).all()
+        # non-global cross-window block stays 0
+        assert layout[0, 4, 0] == 0
+
+    def test_fixed_different_patterns_per_head(self):
+        cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=4,
+                                  different_layout_per_head=True,
+                                  num_different_global_patterns=4)
+        layout = cfg.make_layout(16 * 8)
+        # heads rotate the global representative: all layouts distinct
+        assert len({layout[h].tobytes() for h in range(4)}) == 4
+
+    def test_bigbird_components(self):
+        cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                    num_sliding_window_blocks=3,
+                                    num_global_blocks=1)
+        layout = cfg.make_layout(16 * 8)
+        nb = 8
+        # global row/col 0
+        assert (layout[0, 0, :] == 1).all() and (layout[0, :, 0] == 1).all()
+        # sliding window around the diagonal
+        for r in range(nb):
+            for c in range(max(0, r - 1), min(nb, r + 2)):
+                assert layout[0, r, c] == 1
+        # each row has at least window+random coverage, but not dense
+        assert layout[0].sum() < nb * nb
+
+    def test_bigbird_too_few_blocks_raises(self):
+        cfg = BigBirdSparsityConfig(num_heads=1, block=16,
+                                    num_sliding_window_blocks=9)
+        with pytest.raises(ValueError, match="sliding window"):
+            cfg.make_layout(16 * 4)
+
+    def test_longformer_globals(self):
+        cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                         num_sliding_window_blocks=3,
+                                         global_block_indices=[0, 5])
+        layout = cfg.make_layout(16 * 8)
+        for g in (0, 5):
+            assert (layout[0, g, :] == 1).all()
+            assert (layout[0, :, g] == 1).all()
+
+    def test_longformer_global_ranges(self):
+        cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                         global_block_indices=[0],
+                                         global_block_end_indices=[2])
+        layout = cfg.make_layout(16 * 8)
+        assert (layout[0, 0:2, :] == 1).all() and (layout[0, :, 0:2] == 1).all()
+
+    def test_variable_windows(self):
+        cfg = VariableSparsityConfig(num_heads=1, block=16,
+                                     local_window_blocks=[1, 2],
+                                     global_block_indices=[0])
+        layout = cfg.make_layout(16 * 8)
+        # window sizes 1, 2, 2, 2, ... → blocks 1 and 2 share a window
+        assert layout[0, 1, 2] == 1 and layout[0, 2, 1] == 1
+        assert layout[0, 1, 0] == 1  # global col 0
+
+    def test_sliding_window_causal(self):
+        cfg = LocalSlidingWindowSparsityConfig(num_heads=2, block=16,
+                                               num_sliding_window_blocks=3)
+        layout = cfg.make_layout(16 * 6)
+        assert (np.triu(layout[0], 1) == 0).all()
+        assert (layout[0] == layout[1]).all()
+
+
+class TestSparseSelfAttention:
+    def _qkv(self, B=2, H=2, S=64, D=16, seed=0):
+        rng = jax.random.PRNGKey(seed)
+        ks = jax.random.split(rng, 3)
+        shape = (B, H, S, D)
+        return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+    def test_dense_layout_matches_reference(self):
+        q, k, v = self._qkv()
+        attn = SparseSelfAttention(DenseSparsityConfig(num_heads=2, block=16))
+        out = attn(q, k, v)
+        ref = attention_reference(q, k, v, causal=False)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_sparse_equals_masked_dense(self):
+        q, k, v = self._qkv()
+        # two identically-seeded configs: layouts are random but reproducible
+        cfg = BigBirdSparsityConfig(num_heads=2, block=16)
+        cfg2 = BigBirdSparsityConfig(num_heads=2, block=16)
+        attn = SparseSelfAttention(cfg)
+        out = attn(q, k, v)
+        mask = jnp.asarray(cfg2.expand_mask(cfg2.make_layout(64), 64))[None]
+        ref = attention_reference(q, k, v, mask=mask, causal=False)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_key_padding_mask(self):
+        q, k, v = self._qkv()
+        attn = SparseSelfAttention(DenseSparsityConfig(num_heads=2, block=16),
+                                   key_padding_mask_mode="mul")
+        kp = jnp.ones((2, 64), jnp.int32).at[:, 48:].set(0)
+        out = attn(q, k, v, key_padding_mask=kp)
+        ref = attention_reference(q, k, v,
+                                  mask=(kp != 0)[:, None, None, :],
+                                  causal=False)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_indivisible_seq_raises(self):
+        q, k, v = self._qkv(S=60)
+        attn = SparseSelfAttention(DenseSparsityConfig(num_heads=2, block=16))
+        with pytest.raises(ValueError, match="divisible"):
+            attn(q, k, v)
+
+
+class TestUtils:
+    def test_pad_and_unpad(self):
+        ids = jnp.ones((2, 60), jnp.int32)
+        mask = jnp.ones((2, 60), jnp.int32)
+        pad_len, ids2, mask2, *_ = SparseAttentionUtils.pad_to_block_size(
+            16, input_ids=ids, attention_mask=mask, pad_token_id=9)
+        assert pad_len == 4 and ids2.shape == (2, 64)
+        assert (ids2[:, -4:] == 9).all() and (mask2[:, -4:] == 0).all()
+        out = SparseAttentionUtils.unpad_sequence_output(
+            pad_len, jnp.ones((2, 64, 8)))
+        assert out.shape == (2, 60, 8)
+
+    def test_extend_position_embedding(self):
+        pe = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+        ext = SparseAttentionUtils.extend_position_embedding(pe, 20)
+        assert ext.shape == (20, 4)
+        np.testing.assert_array_equal(ext[8:16], pe)

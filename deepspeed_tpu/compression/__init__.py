@@ -1,0 +1,9 @@
+"""Compression-aware training (reference ``deepspeed/compression/``)."""
+
+from deepspeed_tpu.compression.compress import (Compressor,
+                                                get_compression_config,
+                                                init_compression,
+                                                redundancy_clean)
+
+__all__ = ["Compressor", "get_compression_config", "init_compression",
+           "redundancy_clean"]

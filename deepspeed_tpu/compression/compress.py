@@ -1,0 +1,250 @@
+"""Compression-aware training.
+
+Capability parity with the reference ``deepspeed/compression/compress.py``
+(``init_compression:97``, ``redundancy_clean:127``) and the technique zoo in
+``basic_layer.py`` (``LinearLayer_Compress:134``: QAT weight quantization,
+sparse/row/head/channel pruning with learned or magnitude masks).
+
+TPU-native design: the reference swaps ``nn.Linear`` modules for stateful
+compress layers; here compression is a **pure function over the param
+pytree** applied inside the jitted train step — fake-quant with a
+straight-through estimator (``ops/quantizer.fake_quantize``) and
+stop-gradient magnitude masks, gated on the traced global step against each
+group's ``schedule_offset``. ``redundancy_clean`` then materializes the
+pruning physically (smaller arrays) for deployment.
+
+Config surface is the reference's ``compression_training`` JSON block:
+technique → ``shared_parameters`` + ``different_groups`` where each group
+lists ``modules`` glob patterns and ``related_modules`` (scope patterns
+match parameter path segments here instead of module class names).
+"""
+
+import fnmatch
+import re
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression import constants as C
+from deepspeed_tpu.ops.quantizer import fake_quantize
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.pytree import flatten_with_path_strings
+
+
+def _match(path: str, patterns: List[str]) -> bool:
+    segments = path.split("/")
+    for pat in patterns:
+        if pat == "*" or fnmatch.fnmatch(path, pat):
+            return True
+        if any(fnmatch.fnmatch(seg, pat) for seg in segments):
+            return True
+    return False
+
+
+# ----------------------------------------------------------------------
+# technique transforms (reference basic_layer.py methods, functional form)
+
+def quantize_weight(w, bits: int, groups: int = 1, symmetric: bool = True):
+    """QAT fake quantization with STE (reference ``weight_quantization``)."""
+    if w.ndim < 2:
+        return w
+    return fake_quantize(w, num_groups=groups, num_bits=bits,
+                         symmetric=symmetric)
+
+
+def sparse_prune(w, ratio: float):
+    """Unstructured magnitude pruning (reference ``sparse_pruning``):
+    zero the smallest ``ratio`` fraction by |w|; mask is stop-gradient."""
+    if w.ndim < 2 or ratio <= 0:
+        return w
+    k = int(w.size * (1.0 - ratio))
+    if k <= 0:
+        return jnp.zeros_like(w)
+    flat = jnp.abs(w.reshape(-1))
+    thresh = jax.lax.stop_gradient(jnp.sort(flat)[w.size - k])
+    return w * (jnp.abs(w) >= thresh)
+
+
+def row_prune(w, ratio: float):
+    """Structured output-row pruning by row L1 norm (reference
+    ``row_pruning``); rows = output dim (last axis of a flax kernel)."""
+    if w.ndim < 2 or ratio <= 0:
+        return w
+    out_dim = w.shape[-1]
+    keep = out_dim - int(out_dim * ratio)
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    thresh = jax.lax.stop_gradient(jnp.sort(norms)[out_dim - keep])
+    mask = (norms >= thresh).astype(w.dtype)
+    return w * mask
+
+
+def head_prune(w, ratio: float, num_heads: int):
+    """Attention head pruning (reference ``head_pruning``): rank heads by
+    the L1 norm of their slice of the output-projection input dim."""
+    if w.ndim != 2 or ratio <= 0:
+        return w
+    in_dim = w.shape[0]
+    if in_dim % num_heads:
+        return w
+    head_dim = in_dim // num_heads
+    per_head = jnp.sum(jnp.abs(w.reshape(num_heads, head_dim, -1)),
+                       axis=(1, 2))
+    keep = num_heads - int(num_heads * ratio)
+    thresh = jax.lax.stop_gradient(jnp.sort(per_head)[num_heads - keep])
+    mask = jnp.repeat((per_head >= thresh).astype(w.dtype), head_dim)
+    return w * mask[:, None]
+
+
+def channel_prune(w, ratio: float):
+    """Input-channel pruning (reference ``channel_pruning``)."""
+    if w.ndim < 2 or ratio <= 0:
+        return w
+    in_dim = w.shape[0]
+    keep = in_dim - int(in_dim * ratio)
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(1, w.ndim)))
+    thresh = jax.lax.stop_gradient(jnp.sort(norms)[in_dim - keep])
+    mask = (norms >= thresh).astype(w.dtype)
+    return w * mask.reshape((-1,) + (1,) * (w.ndim - 1))
+
+
+_TECH_FNS = {
+    C.WEIGHT_QUANTIZATION: lambda w, p: quantize_weight(
+        w, p.get("bits", 8), p.get("groups", 1),
+        p.get("symmetric", True)),
+    C.SPARSE_PRUNING: lambda w, p: sparse_prune(w, p.get("ratio", 0.5)),
+    C.ROW_PRUNING: lambda w, p: row_prune(w, p.get("ratio", 0.5)),
+    C.HEAD_PRUNING: lambda w, p: head_prune(w, p.get("ratio", 0.5),
+                                            p.get("num_heads", 12)),
+    C.CHANNEL_PRUNING: lambda w, p: channel_prune(w, p.get("ratio", 0.5)),
+}
+
+
+class Compressor:
+    """Per-parameter technique plan + jit-safe transform."""
+
+    def __init__(self, plans: Dict[str, List[Dict]]):
+        # plans: param path → list of {technique, params, schedule_offset}
+        self.plans = plans
+
+    def transform(self, params: Any, global_step) -> Any:
+        """Apply scheduled techniques; pure & traceable (``global_step`` may
+        be a traced scalar — gating uses ``jnp.where``)."""
+        if not self.plans:
+            return params
+        flat, treedef = flatten_with_path_strings(params)
+        out = []
+        for path, leaf in flat:
+            for plan in self.plans.get(path, ()):
+                fn = _TECH_FNS[plan["technique"]]
+                compressed = fn(leaf, plan["params"])
+                on = global_step >= plan["schedule_offset"]
+                leaf = jnp.where(on, compressed, leaf)
+            out.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, [l for l in out])
+
+    def any_active(self) -> bool:
+        return bool(self.plans)
+
+
+def get_compression_config(param_dict: Dict) -> Dict:
+    """Normalize the ``compression_training`` block (reference
+    ``compression/config.py:get_compression_config``)."""
+    block = dict(param_dict.get(C.COMPRESSION_TRAINING, {}))
+    out = {}
+    for tech in C.TECHNIQUES:
+        t = dict(block.get(tech, {}))
+        shared = dict(t.get(C.SHARED_PARAMETERS, {}))
+        shared.setdefault("enabled", False)
+        shared.setdefault("schedule_offset", 0)
+        groups = {}
+        for gname, g in dict(t.get(C.DIFFERENT_GROUPS, {})).items():
+            g = dict(g)
+            g.setdefault("params", {})
+            g.setdefault("modules", ["*"])
+            groups[gname] = g
+        out[tech] = {C.SHARED_PARAMETERS: shared, C.DIFFERENT_GROUPS: groups}
+    out[C.LAYER_REDUCTION] = dict(block.get(C.LAYER_REDUCTION,
+                                            {"enabled": False}))
+    return out
+
+
+def init_compression(params_abstract: Any, deepspeed_config: Dict,
+                     teacher_model=None, mpu=None) -> Compressor:
+    """Build the per-param technique plan (reference ``init_compression``).
+
+    ``params_abstract``: the param pytree (or its eval_shape) — paths are
+    matched against each group's ``modules`` patterns.
+    """
+    cfg = get_compression_config(
+        deepspeed_config if isinstance(deepspeed_config, dict) else {})
+    flat, _ = flatten_with_path_strings(params_abstract)
+    paths = [p for p, leaf in flat
+             if getattr(leaf, "ndim", 0) >= 2]  # matmul weights only
+    plans: Dict[str, List[Dict]] = {}
+    for tech in C.TECHNIQUES:
+        if tech == C.ACTIVATION_QUANTIZATION:
+            continue  # activations are handled by model dtype policy on TPU
+        shared = cfg[tech][C.SHARED_PARAMETERS]
+        if not shared.get("enabled", False):
+            continue
+        for gname, group in cfg[tech][C.DIFFERENT_GROUPS].items():
+            gp = dict(group["params"])
+            # normalize reference key spellings
+            params_norm = {
+                "bits": gp.get("wq1", {}).get("target_bits") if "wq1" in gp
+                else gp.get("target_bits", gp.get("bits", 8)),
+                "groups": gp.get("quantization_groups", gp.get("groups", 1)),
+                "symmetric": "symmetric" in str(
+                    gp.get("quantization_type", "symmetric")),
+                "ratio": gp.get("dense_ratio", gp.get("ratio", 0.5)),
+                "num_heads": gp.get("num_heads", 12),
+            }
+            if tech in (C.SPARSE_PRUNING, C.ROW_PRUNING, C.CHANNEL_PRUNING,
+                        C.HEAD_PRUNING) and "dense_ratio" in gp:
+                params_norm["ratio"] = 1.0 - float(gp["dense_ratio"])
+            offset = int(group.get("schedule_offset",
+                                   shared.get("schedule_offset", 0)))
+            for path in paths:
+                if _match(path, group["modules"]):
+                    plans.setdefault(path, []).append({
+                        "technique": tech, "params": params_norm,
+                        "schedule_offset": offset})
+    n = sum(len(v) for v in plans.values())
+    if n:
+        log_dist(f"[compression] {n} technique applications over "
+                 f"{len(plans)} params", ranks=[0])
+    return Compressor(plans)
+
+
+def redundancy_clean(params: Any, deepspeed_config: Dict) -> Any:
+    """Physically shrink pruned structures (reference ``redundancy_clean``):
+    rows/channels whose masks are zero are removed from the arrays. Only
+    exact-zero rows/channels produced by the pruning masks are dropped."""
+    import numpy as np
+
+    cfg = get_compression_config(
+        deepspeed_config if isinstance(deepspeed_config, dict) else {})
+    row_on = cfg[C.ROW_PRUNING][C.SHARED_PARAMETERS].get("enabled", False)
+    ch_on = cfg[C.CHANNEL_PRUNING][C.SHARED_PARAMETERS].get("enabled", False)
+    if not (row_on or ch_on):
+        return params
+
+    flat, treedef = flatten_with_path_strings(params)
+    out = []
+    for path, leaf in flat:
+        w = np.asarray(leaf)
+        if w.ndim >= 2:
+            if row_on:
+                keep = np.abs(w).sum(axis=tuple(range(w.ndim - 1))) != 0
+                if not keep.all():
+                    w = w[..., keep]
+            if ch_on:
+                keep = np.abs(w).sum(axis=tuple(range(1, w.ndim))) != 0
+                if not keep.all():
+                    w = w[keep]
+        out.append(w)
+    logger.warning(
+        "redundancy_clean returns physically smaller arrays; dependent "
+        "dims (biases, next layer inputs) must be resized by the caller")
+    return jax.tree_util.tree_unflatten(treedef, out)

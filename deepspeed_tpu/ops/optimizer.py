@@ -203,4 +203,13 @@ def build_basic_optimizer(name: str, params: dict):
         return FusedLamb(**params)
     if name == SGD_OPTIMIZER:
         return FusedSGD(**params)
+    if name in ("onebitadam", "onebitlamb", "zerooneadam"):
+        # 1-bit family: local-grad optimizers with the collective inside
+        # (engine compiles the fused shard_map step for these)
+        from deepspeed_tpu.runtime.fp16.onebit import (OnebitAdam, OnebitLamb,
+                                                       ZeroOneAdam)
+
+        cls = {"onebitadam": OnebitAdam, "onebitlamb": OnebitLamb,
+               "zerooneadam": ZeroOneAdam}[name]
+        return cls(**params)
     raise ValueError(f"Unknown optimizer {name!r}")

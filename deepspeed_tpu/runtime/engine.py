@@ -38,7 +38,7 @@ from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
     ArrayCheckpointEngine,
     OrbaxCheckpointEngine,
 )
-from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
 from deepspeed_tpu.runtime.fp16.loss_scaler import (
     LossScaleState,
@@ -148,6 +148,9 @@ class DeepSpeedEngine:
                 self._config.optimizer_name or "adam",
                 self._config.optimizer_params or {})
         self.basic_optimizer = self.optimizer
+        # 1-bit family: the collective lives inside the optimizer
+        # (update_local under shard_map) — engine compiles a fused step
+        self._onebit = hasattr(self.optimizer, "update_local")
 
         # --- ZeRO-Offload optimizer tier (reference stage_1_and_2.py cpu
         #     offload + swap_tensor optimizer swappers): masters/moments on
@@ -250,6 +253,12 @@ class DeepSpeedEngine:
                 RandomLTDScheduler)
 
             self.random_ltd_scheduler = RandomLTDScheduler(ltd_cfg)
+        # compression-aware training (reference engine hooks compression via
+        # init_compression before initialize(); here it's config-driven)
+        self._compressor = None
+        self._compression_dict = self._config._param_dict.get(
+            "compression_training")
+
         self.flops_profiler = None
         self._last_batch = None
         if self._config.flops_profiler_config.enabled:
@@ -375,6 +384,19 @@ class DeepSpeedEngine:
         stage = self.zero_optimization_stage()
         base_specs = self._tp_base_specs(abstract)
 
+        if self._compression_dict is not None:
+            from deepspeed_tpu.compression import init_compression
+
+            self._compressor = init_compression(
+                abstract, {"compression_training": self._compression_dict})
+        if self._onebit:
+            if stage > 0 or self.topology.get_model_parallel_world_size() > 1 \
+                    or self.gradient_accumulation_steps() > 1:
+                raise DeepSpeedConfigError(
+                    "1-bit optimizers require zero stage 0, no model "
+                    "parallelism, and gradient_accumulation_steps=1 "
+                    "(reference OnebitAdam has the same constraints)")
+            return self._build_state_onebit(params, param_shardings, rep)
         if self._host_offload:
             # moments/masters live on host (HostOffloadOptimizer); the
             # device keeps no optimizer state at all
@@ -424,17 +446,117 @@ class DeepSpeedEngine:
         self._compile_steps()
 
     # ------------------------------------------------------------------
+    # 1-bit optimizer path: fused shard_map step, collective inside
+    def _build_state_onebit(self, params, param_shardings, rep):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        dp = self.topology.get_data_parallel_world_size()
+        with self.mesh:
+            opt_state = jax.jit(self.optimizer.init)(params)
+        # per-replica error feedback: stacked [dp, ...] sharded on the data
+        # axis (each replica owns its slice inside shard_map)
+        err_sh = NamedSharding(self.mesh, P(AXIS_DATA))
+        stacked_err = jax.tree_util.tree_map(
+            lambda e: jax.device_put(
+                jnp.zeros((dp,) + e.shape, e.dtype), err_sh),
+            opt_state.error)
+        opt_state = opt_state._replace(error=stacked_err)
+        opt_shardings = jax.tree_util.tree_map(lambda _: rep, opt_state)
+        opt_shardings = opt_shardings._replace(
+            error=jax.tree_util.tree_map(lambda _: err_sh, stacked_err))
+
+        self.state = TrainState(
+            params=params, opt_state=opt_state, grad_acc={},
+            loss_scale=jax.device_put(
+                self._initial_loss_scaler,
+                jax.tree_util.tree_map(lambda _: rep, self._initial_loss_scaler)),
+            global_step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+            skipped_steps=jax.device_put(jnp.zeros((), jnp.int32), rep),
+            rng=jax.device_put(jax.random.PRNGKey(0), rep),
+        )
+        self._state_shardings = TrainState(
+            params=param_shardings, opt_state=opt_shardings, grad_acc={},
+            loss_scale=jax.tree_util.tree_map(
+                lambda _: rep, self._initial_loss_scaler),
+            global_step=rep, skipped_steps=rep, rng=rep,
+        )
+        self._jit_onebit = {}
+        self._jit_micro = None
+        self._jit_apply = None
+
+    def _onebit_flag(self):
+        """(kwarg_name, value) for the optimizer's static stage flag."""
+        if hasattr(self.optimizer, "var_sync_interval"):  # 0/1 Adam
+            iv = self.optimizer.var_sync_interval
+            return "sync", (self.global_steps % iv) == 0
+        return "compressed", self.global_steps >= getattr(
+            self.optimizer, "freeze_step", 0)
+
+    def _get_onebit_fn(self, flag_name: str, flag: bool):
+        key = (flag_name, bool(flag))
+        if key in self._jit_onebit:
+            return self._jit_onebit[key]
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        loss_fn = self._loss_fn
+        optimizer = self.optimizer
+        shardings = self._state_shardings
+        opt_specs = jax.tree_util.tree_map(
+            lambda s: s.spec, shardings.opt_state)
+
+        def local(params, opt_state, batch, lr, rngkey):
+            my_err = jax.tree_util.tree_map(lambda e: e[0], opt_state.error)
+            st = opt_state._replace(error=my_err)
+            idx = jax.lax.axis_index(AXIS_DATA)
+            rngs = {"dropout": jax.random.fold_in(rngkey, idx),
+                    "gating": jax.random.fold_in(rngkey, idx + 1_000_000)}
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(p, batch, rngs=rngs))(params)
+            new_p, new_st = optimizer.update_local(
+                grads, st, params, lr=lr, **{flag_name: bool(flag)})
+            new_st = new_st._replace(error=jax.tree_util.tree_map(
+                lambda e: e[None], new_st.error))
+            n = jax.lax.psum(1, AXIS_DATA)
+            return jax.lax.psum(loss, AXIS_DATA) / n, new_p, new_st
+
+        def fused(state: TrainState, batch, lr):
+            rng, sub = jax.random.split(state.rng)
+            loss, new_p, new_opt = shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(), opt_specs, P(AXIS_DATA), P(), P()),
+                out_specs=(P(), P(), opt_specs),
+                check_rep=False,
+            )(state.params, state.opt_state, batch, lr, sub)
+            return state._replace(params=new_p, opt_state=new_opt, rng=rng,
+                                  global_step=state.global_step + 1), loss
+
+        fn = jax.jit(fused,
+                     in_shardings=(shardings, None, replicated(self.mesh)),
+                     out_shardings=(shardings, replicated(self.mesh)),
+                     donate_argnums=(0,))
+        self._jit_onebit[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
     # jitted hot paths
     def _compile_steps(self):
+        if self._onebit:
+            return  # fused step compiled lazily per stage flag
         gas = self.gradient_accumulation_steps()
         loss_fn = self._loss_fn
         fp16 = self.fp16_enabled_
         grad_shardings = self._state_shardings.grad_acc
 
+        compressor = self._compressor
+
         def micro_step(state: TrainState, batch):
             rng, sub, sub2 = jax.random.split(state.rng, 3)
 
             def scaled_loss(p):
+                if compressor is not None and compressor.any_active():
+                    # QAT/pruning transforms with STE, gated on global step
+                    p = compressor.transform(p, state.global_step)
                 loss = loss_fn(p, batch, rngs={"dropout": sub, "gating": sub2})
                 return loss * (state.loss_scale.loss_scale if fp16 else 1.0) / gas
 

@@ -1,0 +1,7 @@
+"""1-bit optimizers (reference ``deepspeed/runtime/fp16/onebit/``)."""
+
+from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam, OnebitAdamState
+from deepspeed_tpu.runtime.fp16.onebit.lamb import OnebitLamb
+from deepspeed_tpu.runtime.fp16.onebit.zoadam import ZeroOneAdam
+
+__all__ = ["OnebitAdam", "OnebitAdamState", "OnebitLamb", "ZeroOneAdam"]

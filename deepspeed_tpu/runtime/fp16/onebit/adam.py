@@ -1,0 +1,101 @@
+"""1-bit Adam.
+
+Capability parity with the reference ``OnebitAdam``
+(``runtime/fp16/onebit/adam.py:10``; https://arxiv.org/abs/2102.02888):
+
+- **Warmup stage** (step < ``freeze_step``): exact Adam with full-precision
+  gradient averaging.
+- **Compressed stage**: the second moment ``v`` is frozen at its warmup
+  value; each replica updates its *local* momentum with its *local* grads,
+  the momentum is 1-bit-compressed with per-replica error feedback and
+  mean-allreduced (sign × scale over the wire), and the averaged momentum
+  drives the update against the frozen ``v``.
+
+TPU-native packaging: a pure ``init/update_local`` pair. ``update_local``
+runs inside ``shard_map`` over the data axis (local grads in, collective
+inside). The stage is a **static** Python flag — the caller (engine)
+recompiles once when crossing ``freeze_step`` so the compiled graph carries
+exactly one collective: a full psum during warmup, a 1-bit psum after.
+The reference's NCCL/MPI gather-scatter choreography is that one psum.
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+
+
+class OnebitAdamState(NamedTuple):
+    m: Any            # momentum (local in compressed stage)
+    v: Any            # second moment (frozen after freeze_step)
+    error: Any        # per-replica compression error feedback
+    step: jnp.ndarray
+
+
+def _map2(fn, treedef, *trees):
+    flats = [treedef.flatten_up_to(t) for t in trees]
+    outs = [fn(*leaves) for leaves in zip(*flats)]
+    n_out = len(outs[0])
+    return tuple(jax.tree_util.tree_unflatten(treedef, [o[i] for o in outs])
+                 for i in range(n_out))
+
+
+class OnebitAdam:
+    """Engine-compatible optimizer (``init``/``update_local`` surface)."""
+
+    name = "onebitadam"
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, freeze_step=100000, data_axis="data",
+                 **_unused):
+        self.lr = float(lr)
+        self.b1, self.b2 = betas
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+        self.freeze_step = int(freeze_step)
+        self.data_axis = data_axis
+
+    def init(self, params) -> OnebitAdamState:
+        zeros = lambda: jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return OnebitAdamState(m=zeros(), v=zeros(), error=zeros(),
+                               step=jnp.zeros((), jnp.int32))
+
+    def update_local(self, local_grads, state: OnebitAdamState, params,
+                     lr=None, compressed: bool = False
+                     ) -> Tuple[Any, OnebitAdamState]:
+        """One step from per-replica grads; call inside shard_map with the
+        data axis bound. ``compressed`` is static: False → warmup Adam
+        (full-precision psum), True → 1-bit stage."""
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        b1, b2 = self.b1, self.b2
+        bias1 = 1 - b1 ** step.astype(jnp.float32)
+        bias2 = 1 - b2 ** step.astype(jnp.float32)
+
+        def leaf(g, m, v, e, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if compressed:
+                m_local = b1 * m + (1 - b1) * g
+                m_new, e_new = compressed_allreduce(m_local, e,
+                                                    self.data_axis)
+                v_new = v  # frozen
+            else:
+                n = jax.lax.psum(1, self.data_axis)
+                g_avg = jax.lax.psum(g, self.data_axis) / n
+                m_new = b1 * m + (1 - b1) * g_avg
+                v_new = b2 * v + (1 - b2) * g_avg * g_avg
+                e_new = e
+            upd = (m_new / bias1) / (jnp.sqrt(v_new / bias2) + self.eps)
+            if self.weight_decay:
+                upd = upd + self.weight_decay * p32
+            return (p32 - lr * upd).astype(p.dtype), m_new, v_new, e_new
+
+        _, treedef = jax.tree_util.tree_flatten(local_grads)
+        new_p, new_m, new_v, new_e = _map2(
+            leaf, treedef, local_grads, state.m, state.v, state.error, params)
+        return new_p, OnebitAdamState(m=new_m, v=new_v, error=new_e,
+                                      step=step)

@@ -1,0 +1,95 @@
+"""1-bit LAMB (reference ``runtime/fp16/onebit/lamb.py``;
+https://arxiv.org/abs/2104.06069): 1-bit Adam's compressed-momentum scheme
+plus LAMB's per-layer trust ratio. During the compressed stage the trust
+ratio is frozen at its last warmup value (the reference freezes its fused
+lamb coefficients), so no extra full-precision collectives are needed.
+``compressed`` is a static flag — one collective per compiled graph.
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+from deepspeed_tpu.runtime.fp16.onebit.adam import _map2
+
+
+class OnebitLambState(NamedTuple):
+    m: Any
+    v: Any
+    error: Any
+    frozen_ratio: Any   # per-leaf trust ratio recorded during warmup
+    step: jnp.ndarray
+
+
+class OnebitLamb:
+    name = "onebitlamb"
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, freeze_step=100000, data_axis="data",
+                 max_coeff=10.0, min_coeff=0.01, **_unused):
+        self.lr = float(lr)
+        self.b1, self.b2 = betas
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+        self.freeze_step = int(freeze_step)
+        self.data_axis = data_axis
+        self.max_coeff = float(max_coeff)
+        self.min_coeff = float(min_coeff)
+
+    def init(self, params) -> OnebitLambState:
+        zeros = lambda: jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        ones = jax.tree_util.tree_map(lambda p: jnp.ones((), jnp.float32),
+                                      params)
+        return OnebitLambState(m=zeros(), v=zeros(), error=zeros(),
+                               frozen_ratio=ones,
+                               step=jnp.zeros((), jnp.int32))
+
+    def update_local(self, local_grads, state: OnebitLambState, params,
+                     lr=None, compressed: bool = False
+                     ) -> Tuple[Any, OnebitLambState]:
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        b1, b2 = self.b1, self.b2
+        bias1 = 1 - b1 ** step.astype(jnp.float32)
+        bias2 = 1 - b2 ** step.astype(jnp.float32)
+
+        def leaf(g, m, v, e, fr, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if compressed:
+                m_local = b1 * m + (1 - b1) * g
+                m_new, e_new = compressed_allreduce(m_local, e,
+                                                    self.data_axis)
+                v_new = v
+            else:
+                n = jax.lax.psum(1, self.data_axis)
+                g_avg = jax.lax.psum(g, self.data_axis) / n
+                m_new = b1 * m + (1 - b1) * g_avg
+                v_new = b2 * v + (1 - b2) * g_avg * g_avg
+                e_new = e
+            upd = (m_new / bias1) / (jnp.sqrt(v_new / bias2) + self.eps)
+            if self.weight_decay:
+                upd = upd + self.weight_decay * p32
+            if compressed:
+                ratio = fr
+                fr_new = fr
+            else:
+                w_norm = jnp.linalg.norm(p32.reshape(-1))
+                u_norm = jnp.linalg.norm(upd.reshape(-1))
+                ratio = jnp.where(
+                    (w_norm > 0) & (u_norm > 0),
+                    jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+                    1.0)
+                fr_new = ratio
+            return ((p32 - lr * ratio * upd).astype(p.dtype),
+                    m_new, v_new, e_new, fr_new)
+
+        _, treedef = jax.tree_util.tree_flatten(local_grads)
+        new_p, new_m, new_v, new_e, new_fr = _map2(
+            leaf, treedef, local_grads, state.m, state.v, state.error,
+            state.frozen_ratio, params)
+        return new_p, OnebitLambState(m=new_m, v=new_v, error=new_e,
+                                      frozen_ratio=new_fr, step=step)

@@ -1,0 +1,143 @@
+"""Compression tests (reference ``tests/unit/compression/test_compression.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression import (get_compression_config,
+                                       init_compression, redundancy_clean)
+from deepspeed_tpu.compression.compress import (channel_prune, head_prune,
+                                                quantize_weight, row_prune,
+                                                sparse_prune)
+
+
+def _cfg(**techniques):
+    return {"compression_training": techniques}
+
+
+class TestTechniques:
+    def test_quantize_weight_ste(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (16, 16))
+        q = quantize_weight(w, bits=8, groups=2)
+        assert q.shape == w.shape
+        # quantized values are close but not identical
+        assert 0 < np.abs(np.asarray(q - w)).max() < 0.1
+        # straight-through estimator: gradient passes unchanged
+        g = jax.grad(lambda w: quantize_weight(w, 8).sum())(w)
+        np.testing.assert_allclose(g, np.ones_like(w), rtol=1e-6)
+
+    def test_sparse_prune_ratio(self):
+        w = jax.random.normal(jax.random.PRNGKey(1), (32, 32))
+        p = sparse_prune(w, 0.75)
+        sparsity = float((np.asarray(p) == 0).mean())
+        assert 0.70 <= sparsity <= 0.80
+        # surviving weights untouched
+        nz = np.asarray(p) != 0
+        np.testing.assert_array_equal(np.asarray(p)[nz], np.asarray(w)[nz])
+
+    def test_row_prune_zeroes_output_columns(self):
+        w = jax.random.normal(jax.random.PRNGKey(2), (16, 8))
+        p = np.asarray(row_prune(w, 0.5))
+        zero_cols = (np.abs(p).sum(axis=0) == 0).sum()
+        assert zero_cols == 4
+
+    def test_head_prune(self):
+        w = jax.random.normal(jax.random.PRNGKey(3), (64, 32))
+        p = np.asarray(head_prune(w, 0.5, num_heads=4))
+        head_norms = np.abs(p.reshape(4, 16, 32)).sum(axis=(1, 2))
+        assert (head_norms == 0).sum() == 2
+
+    def test_channel_prune(self):
+        w = jax.random.normal(jax.random.PRNGKey(4), (16, 8))
+        p = np.asarray(channel_prune(w, 0.25))
+        assert (np.abs(p).sum(axis=1) == 0).sum() == 4
+
+
+class TestPlanBuilding:
+    PARAMS = {"attn": {"c_attn": {"kernel": jnp.zeros((8, 24)),
+                                  "bias": jnp.zeros(24)},
+                       "c_proj": {"kernel": jnp.zeros((8, 8))}},
+              "mlp": {"c_fc": {"kernel": jnp.zeros((8, 32))}}}
+
+    def test_group_module_matching(self):
+        comp = init_compression(self.PARAMS, _cfg(weight_quantization={
+            "shared_parameters": {"enabled": True, "schedule_offset": 5},
+            "different_groups": {
+                "wq1": {"params": {"target_bits": 8},
+                        "modules": ["c_attn", "c_fc"]}}}))
+        assert set(comp.plans) == {"attn/c_attn/kernel", "mlp/c_fc/kernel"}
+        assert comp.plans["attn/c_attn/kernel"][0]["schedule_offset"] == 5
+
+    def test_wildcard_matches_all_matrices(self):
+        comp = init_compression(self.PARAMS, _cfg(sparse_pruning={
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"sp1": {"params": {"dense_ratio": 0.75},
+                                         "modules": ["*"]}}}))
+        assert len(comp.plans) == 3  # kernels only, bias excluded
+        assert comp.plans["attn/c_proj/kernel"][0]["params"]["ratio"] == 0.25
+
+    def test_schedule_gating_in_transform(self):
+        comp = init_compression(self.PARAMS, _cfg(sparse_pruning={
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"sp1": {
+                "params": {"dense_ratio": 0.5},
+                "modules": ["c_fc"], "schedule_offset": 10}}}))
+        params = jax.tree_util.tree_map(
+            lambda x: jax.random.normal(jax.random.PRNGKey(0), x.shape),
+            self.PARAMS)
+        before = comp.transform(params, jnp.asarray(3))
+        np.testing.assert_array_equal(before["mlp"]["c_fc"]["kernel"],
+                                      params["mlp"]["c_fc"]["kernel"])
+        after = comp.transform(params, jnp.asarray(10))
+        assert (np.asarray(after["mlp"]["c_fc"]["kernel"]) == 0).any()
+
+    def test_config_defaults(self):
+        cfg = get_compression_config({})
+        assert not cfg["weight_quantization"]["shared_parameters"]["enabled"]
+        assert not cfg["layer_reduction"]["enabled"]
+
+
+class TestEngineIntegration:
+    def test_qat_training_and_redundancy_clean(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+        from deepspeed_tpu.parallel.topology import reset_topology
+
+        reset_topology()
+        cfg = GPT2Config.tiny(dtype=jnp.float32, use_flash=False)
+        ds = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "compression_training": {
+                  "weight_quantization": {
+                      "shared_parameters": {"enabled": True,
+                                            "schedule_offset": 0},
+                      "different_groups": {"wq1": {
+                          "params": {"target_bits": 8,
+                                     "quantization_groups": 4},
+                          "modules": ["c_fc", "c_proj"]}}},
+                  "row_pruning": {
+                      "shared_parameters": {"enabled": True,
+                                            "schedule_offset": 2},
+                      "different_groups": {"rp1": {
+                          "params": {"dense_ratio": 0.75},
+                          "modules": ["c_fc"]}}}}}
+        engine, *_ = deepspeed_tpu.initialize(model=GPT2ForTraining(cfg),
+                                              config=ds)
+        data = (np.arange(8 * 16).reshape(8, 16) % 19).astype(np.int32)
+        losses = [engine.train_batch(batch={"input_ids": data})
+                  for _ in range(5)]
+        assert engine._compressor is not None and engine._compressor.any_active()
+        assert losses[-1] < losses[0]
+        # after the schedule offset, the pruned-through weights train with
+        # 25% of c_fc rows masked — apply transform and clean physically
+        params = jax.device_get(engine.state.params)
+        compressed = jax.device_get(engine._compressor.transform(
+            jax.tree_util.tree_map(jnp.asarray, params), jnp.asarray(100)))
+        cleaned = redundancy_clean(compressed, ds)
+        flat_c, _ = jax.tree_util.tree_flatten_with_path(cleaned)
+        flat_o, _ = jax.tree_util.tree_flatten_with_path(params)
+        shrunk = [1 for (pc, lc), (po, lo) in zip(flat_c, flat_o)
+                  if np.asarray(lc).shape != np.asarray(lo).shape]
+        assert shrunk, "row pruning should physically shrink some arrays"
+        reset_topology()

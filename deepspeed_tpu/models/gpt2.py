@@ -33,6 +33,11 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16  # compute/activation dtype (params kept fp32)
     scan_layers: bool = True
     remat: bool = False  # activation checkpointing over blocks
+    # remat granularity: "full" recomputes the whole block in backward;
+    # "dots" saves matmul outputs and recomputes only elementwise chains
+    # (LN/gelu/residual) — the usual best trade on TPU where HBM, not the
+    # MXU, is the scarce resource
+    remat_policy: str = "full"
     use_flash: Optional[bool] = None
     # decode mode: attention reads/writes a KV cache (mutable "cache"
     # collection) — the TPU-native form of the reference's inference
@@ -64,6 +69,22 @@ def _dense_init(scale=0.02):
     return nn.initializers.normal(stddev=scale)
 
 
+def _remat_block(cfg):
+    """Block wrapped per the config's activation-checkpointing policy."""
+    if not cfg.remat:
+        return Block
+    policy = None
+    if cfg.remat_policy == "dots":
+        # save matmul outputs AND the flash-attention residuals (named in
+        # ops/flash_attention.py) — backward recomputes only the cheap
+        # elementwise chains (LN / gelu / residual adds)
+        policy = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.checkpoint_dots,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_q", "flash_k", "flash_v", "flash_o", "flash_lse"))
+    return nn.remat(Block, prevent_cse=False, policy=policy)
+
+
 class CausalSelfAttention(nn.Module):
     config: GPT2Config
 
@@ -76,7 +97,7 @@ class CausalSelfAttention(nn.Module):
         qkv = nn.Dense(3 * cfg.n_embd, dtype=cfg.dtype, kernel_init=_dense_init(),
                        name="c_attn")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
+        q4 = q.reshape(B, T, cfg.n_head, head_dim)  # [B, T, H, D]
         cached_attn = False
         if cfg.decode:
             # KV cache: [B, n_positions, H, D] append buffer (the TPU-native
@@ -100,19 +121,33 @@ class CausalSelfAttention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(cv.value, v4, (0, idx, 0, 0))
             cidx.value = idx + T
             if not is_prefill:
-                kc = ck.value.transpose(0, 2, 1, 3)
-                vc = cv.value.transpose(0, 2, 1, 3)
-                # query at global position idx+t sees keys at positions <= idx+t
-                key_pos = jnp.arange(cfg.n_positions)
-                q_pos = idx + jnp.arange(T)
-                mask = key_pos[None, :] <= q_pos[:, None]
-                y = attention(q, kc, vc, mask=mask[None, None], causal=False,
-                              use_flash=False)
+                from deepspeed_tpu.ops.attention import use_decode_kernel
+
+                if use_decode_kernel():
+                    # Pallas decode kernel: reads the cache in its native
+                    # [B, S, H, D] layout (no per-token cache transpose) and
+                    # only the valid [0, idx+T) prefix does compute
+                    from deepspeed_tpu.ops.decode_attention import (
+                        decode_attention)
+
+                    y4 = decode_attention(q4, ck.value, cv.value, idx)
+                    y = y4.transpose(0, 2, 1, 3)
+                else:
+                    kc = ck.value.transpose(0, 2, 1, 3)
+                    vc = cv.value.transpose(0, 2, 1, 3)
+                    # query at position idx+t sees keys at positions <= idx+t
+                    key_pos = jnp.arange(cfg.n_positions)
+                    q_pos = idx + jnp.arange(T)
+                    mask = key_pos[None, :] <= q_pos[:, None]
+                    y = attention(q4.transpose(0, 2, 1, 3), kc, vc,
+                                  mask=mask[None, None],
+                                  causal=False, use_flash=False)
                 cached_attn = True
         if not cached_attn:  # training forward, or decode-mode prefill
             k = k.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
             v = v.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
-            y = attention(q, k, v, causal=True, use_flash=cfg.use_flash)
+            y = attention(q4.transpose(0, 2, 1, 3), k, v, causal=True,
+                          use_flash=cfg.use_flash)
         y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
         y = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
                      kernel_init=_dense_init(0.02 / (2 * cfg.n_layer) ** 0.5),
@@ -160,8 +195,7 @@ class _ScanBody(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic):
         cfg = self.config
-        block_cls = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
-        x = block_cls(cfg, name="block")(x, deterministic=deterministic)
+        x = _remat_block(cfg)(cfg, name="block")(x, deterministic=deterministic)
         return x, None
 
 
@@ -193,7 +227,7 @@ class LoopBlocks(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic=True):
         cfg = self.config
-        block_cls = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
+        block_cls = _remat_block(cfg)
         for i in range(cfg.n_layer):
             x = block_cls(cfg, name=f"h_{i}")(x, deterministic=deterministic)
         return x
@@ -394,12 +428,23 @@ def gpt2_loss_fn(model: GPT2LMHeadModel):
         hidden, wte = model.apply({"params": params}, input_ids,
                                   deterministic=rngs is None, rngs=rngs,
                                   return_hidden=True)
-        # shift for next-token prediction by padding the label stream (keeps
-        # T divisible for the chunked head, which avoids the full [B, T, V]
-        # fp32 logits tensor)
+        # shift for next-token prediction by padding the label stream
         shifted = jnp.concatenate(
             [labels[:, 1:], jnp.full((labels.shape[0], 1), -100, labels.dtype)],
             axis=1)
-        return chunked_softmax_xent(hidden, wte, shifted)
+        B, T, _ = hidden.shape
+        V = model.config.vocab_size
+        # without remat the saved block activations already crowd HBM — only
+        # afford the dense head a smaller logits budget there
+        dense_budget = 3_500_000_000 if model.config.remat else 1_000_000_000
+        if B * T * V * 4 <= dense_budget:
+            # dense head: materializing [B, T, V] fp32 logits fits in HBM and
+            # beats the chunked scan (no recompute, one fused program)
+            logits = jnp.einsum("btc,vc->btv", hidden,
+                                wte.astype(hidden.dtype),
+                                preferred_element_type=jnp.float32)
+            return cross_entropy_loss(logits, shifted)
+        # chunked head: avoids the full [B, T, V] fp32 logits tensor
+        return chunked_softmax_xent(hidden, wte, shifted, chunk=512)
 
     return loss_fn

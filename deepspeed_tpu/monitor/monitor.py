@@ -35,13 +35,15 @@ class TensorBoardMonitor(Monitor):
         self.summary_writer = None
         if self.enabled:
             try:
-                from torch.utils.tensorboard import SummaryWriter
+                # torch-free writer (this framework must run without torch)
+                from tensorboardX import SummaryWriter
 
                 log_dir = os.path.join(tensorboard_config.output_path or "./runs",
                                        tensorboard_config.job_name)
                 self.summary_writer = SummaryWriter(log_dir=log_dir)
             except ImportError:
-                logger.warning("tensorboard not available; disabling TensorBoardMonitor")
+                logger.warning("tensorboardX not available; disabling "
+                               "TensorBoardMonitor")
                 self.enabled = False
 
     def write_events(self, event_list, flush=True):

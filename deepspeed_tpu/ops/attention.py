@@ -20,6 +20,15 @@ def _on_tpu() -> bool:
         return False
 
 
+_FORCE_DECODE_KERNEL = False  # tests flip this to exercise the Pallas path
+
+
+def use_decode_kernel() -> bool:
+    """Whether the Pallas decode-attention kernel should serve KV-cache
+    attention (TPU, or forced for interpret-mode testing)."""
+    return _FORCE_DECODE_KERNEL or _on_tpu()
+
+
 def attention_reference(q, k, v, mask=None, causal=True, softmax_scale=None,
                         dropout_rate=0.0, dropout_rng=None):
     """Plain XLA attention: q,k,v [batch, heads, seq, head_dim].
@@ -72,10 +81,27 @@ def attention(q, k, v, mask=None, causal=True, softmax_scale=None,
             from deepspeed_tpu.ops.flash_attention import flash_attention
 
             return flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
-        except (ImportError, NotImplementedError, ValueError):
-            # ValueError: shapes the kernel can't tile (e.g. seq not divisible
-            # by the block size) — fall back to the XLA path
-            pass
+        except (ImportError, NotImplementedError, ValueError) as e:
+            # e.g. seq not divisible by the kernel block size — fall back to
+            # the XLA path, but SAY so: silently losing the kernel is a perf
+            # cliff the user should see (once per offending shape)
+            _warn_fallback(q.shape, k.shape, repr(e))
     return attention_reference(q, k, v, mask=mask, causal=causal,
                                softmax_scale=softmax_scale,
                                dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+
+
+_warned_shapes = set()
+
+
+def _warn_fallback(q_shape, k_shape, reason: str):
+    key = (tuple(q_shape), tuple(k_shape))
+    if key in _warned_shapes:
+        return
+    _warned_shapes.add(key)
+    from deepspeed_tpu.utils.logging import logger
+
+    logger.warning(
+        f"flash_attention unavailable for q{tuple(q_shape)} k{tuple(k_shape)} "
+        f"({reason}); falling back to dense XLA attention — pad the sequence "
+        f"to a multiple of the kernel block (512) to regain the fused kernel")

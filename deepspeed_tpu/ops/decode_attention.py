@@ -1,0 +1,125 @@
+"""Decode attention — Pallas TPU kernel for KV-cache generation.
+
+Replaces the reference's ``softmax_context`` CUDA path
+(``csrc/transformer/inference/csrc/softmax.cu:488``,
+``pt_binding.cpp:1701-1775``): attention of a small query step against the
+valid ``[0, cache_index + T_q)`` prefix of an append-style KV cache.
+
+TPU-native design points:
+
+- Operates directly on the cache's native ``[B, S, H, D]`` layout with
+  strided block DMA — no per-token transpose of the whole cache (the dense
+  XLA fallback pays two ``[B, S, H, D] -> [B, H, S, D]`` copies per decoded
+  token).
+- ``cache_index`` is a *scalar-prefetch* operand: the grid is static over
+  the full window, but blocks past the valid prefix skip both compute and
+  the online-softmax update (``pl.when``), and the boundary block is
+  iota-masked. fp32 accumulation throughout.
+- All heads are processed per grid step (grid = batch x kv-blocks): decode
+  tiles are tiny, so per-step grid overhead, not FLOPs, dominates.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+DEFAULT_BLOCK_K = 256
+
+
+def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+            *, scale, bk, tq, heads, d, num_kb):
+    ki = pl.program_id(1)
+    idx = idx_ref[0]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # keys at positions < idx + tq are (potentially) visible
+    @pl.when(ki * bk < idx + tq)
+    def _body():
+        q = q_ref[...].reshape(tq, heads, d).transpose(1, 0, 2)   # [H,tq,d]
+        k = k_ref[...].reshape(bk, heads, d).transpose(1, 0, 2)   # [H,bk,d]
+        v = v_ref[...].reshape(bk, heads, d).transpose(1, 0, 2)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale            # [H,tq,bk]
+        # query row r sits at absolute position idx + r; it sees keys <= that
+        rows = jax.lax.broadcasted_iota(jnp.int32, (heads, tq, bk), 1)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (heads, tq, bk), 2) + ki * bk
+        s = jnp.where(cols <= idx + rows, s, NEG_INF)
+        m_prev = m_scr[:, :, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        # every row sees at least its own key, so no fully-masked rows and
+        # exp(NEG_INF - finite) underflows to exactly 0 — no select needed
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:, :, 0:1] + jnp.sum(p, axis=2, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)                    # [H,tq,d]
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_kb - 1)
+    def _finish():
+        l = l_scr[:, :, 0:1]
+        out = acc_scr[:] / jnp.where(l == 0.0, 1.0, l)             # [H,tq,d]
+        o_ref[...] = out.transpose(1, 0, 2).reshape(1, tq, heads, d) \
+            .astype(o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, cache_index, softmax_scale=None,
+                     block_k=DEFAULT_BLOCK_K):
+    """Attend a decode step against the valid prefix of an append KV cache.
+
+    Args:
+      q: ``[B, T_q, H, D]`` query step (``T_q`` small: 1 for plain decode).
+      k_cache / v_cache: ``[B, S, H, D]`` append buffers whose rows
+        ``[0, cache_index + T_q)`` are valid — this step's keys must already
+        be written at ``[cache_index, cache_index + T_q)``.
+      cache_index: scalar int32 — number of cache rows valid *before* this
+        step.
+
+    Returns ``[B, T_q, H, D]`` in the query's dtype.
+    """
+    b, tq, heads, d = q.shape
+    s_len = k_cache.shape[1]
+    bk = min(block_k, s_len)
+    if s_len % bk:
+        raise ValueError(f"cache length {s_len} not divisible by block {bk}")
+    num_kb = s_len // bk
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, tq, heads, d), lambda bi, ki, idx: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, bk, heads, d), lambda bi, ki, idx: (bi, ki, 0, 0)),
+            pl.BlockSpec((1, bk, heads, d), lambda bi, ki, idx: (bi, ki, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tq, heads, d),
+                               lambda bi, ki, idx: (bi, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((heads, tq, 128), jnp.float32),   # m
+            pltpu.VMEM((heads, tq, 128), jnp.float32),   # l
+            pltpu.VMEM((heads, tq, d), jnp.float32),     # acc
+        ],
+    )
+    kernel = functools.partial(_kernel, scale=scale, bk=bk, tq=tq,
+                               heads=heads, d=d, num_kb=num_kb)
+    idx = jnp.asarray(cache_index, jnp.int32).reshape(1)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, tq, heads, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(idx, q, k_cache, v_cache)

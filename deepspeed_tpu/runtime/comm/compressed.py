@@ -50,17 +50,33 @@ def compressed_allreduce(x: jnp.ndarray, error: jnp.ndarray, axis_name: str
     return avg, new_error
 
 
+def init_error_tree(params, dp: int):
+    """Zero error-feedback state: one slice per data-parallel replica.
+
+    Leaves are stacked ``[dp, *leaf.shape]`` so each replica owns row
+    ``axis_index`` under ``shard_map`` — errors legitimately differ per
+    replica and must not be treated as replicated.
+    """
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros((dp,) + p.shape, p.dtype), params)
+
+
 def make_compressed_grad_fn(loss_fn, mesh, data_axis: str = "data"):
     """Wrap a loss fn so grads are averaged with 1-bit compression.
 
     Returns ``fn(params, batch, error_tree) -> (loss, grads, new_error_tree)``
     jit-compatible over ``mesh``; params replicated, batch sharded over the
-    data axis. This is the plumbing 1-bit optimizers use post-warmup.
+    data axis, and the error tree stacked per replica (see
+    :func:`init_error_tree`) and sharded over the data axis — error feedback
+    is per-replica state. This is the plumbing 1-bit optimizers use
+    post-warmup.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     def local_step(params, batch, errors):
+        # errors arrive as this replica's [1, ...] slice of the stack
+        errors = jax.tree_util.tree_map(lambda e: e[0], errors)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         flat_g, treedef = jax.tree_util.tree_flatten(grads)
         flat_e = treedef.flatten_up_to(errors)
@@ -68,7 +84,7 @@ def make_compressed_grad_fn(loss_fn, mesh, data_axis: str = "data"):
         for g, e in zip(flat_g, flat_e):
             avg, ne = compressed_allreduce(g, e, data_axis)
             out_g.append(avg)
-            out_e.append(ne)
+            out_e.append(ne[None])  # restack the per-replica row
         n = jax.lax.psum(1, data_axis)
         loss = jax.lax.psum(loss, data_axis) / n
         return (loss,
@@ -76,10 +92,11 @@ def make_compressed_grad_fn(loss_fn, mesh, data_axis: str = "data"):
                 jax.tree_util.tree_unflatten(treedef, out_e))
 
     def wrapped(params, batch, errors):
+        err_specs = jax.tree_util.tree_map(lambda _: P(data_axis), errors)
         return shard_map(
             local_step, mesh=mesh,
-            in_specs=(P(), P(data_axis), P()),  # prefix specs broadcast
-            out_specs=P(),
+            in_specs=(P(), P(data_axis), err_specs),
+            out_specs=(P(), P(), err_specs),
             check_rep=False)(params, batch, errors)
 
     return wrapped

@@ -253,6 +253,11 @@ class DeepSpeedConfig:
         self.wall_clock_breakdown = d.get(C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
         self.memory_breakdown = d.get(C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
         self.dataloader_drop_last = d.get(C.DATALOADER_DROP_LAST, C.DATALOADER_DROP_LAST_DEFAULT)
+        # fuse forward+backward+optimizer into ONE compiled program when
+        # gradient_accumulation_steps == 1 (no grad-accumulation buffer
+        # round-trip, one dispatch per step). Requires the canonical
+        # forward→backward→step call order per batch — hence opt-in.
+        self.fused_step = d.get("fused_step", False)
 
         self.pld_enabled = d.get(C.PLD, {}).get(C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT)
         self.pld_params = d.get(C.PLD, {}) if self.pld_enabled else False

@@ -33,7 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu import comm as dist
 from deepspeed_tpu.ops.optimizer import build_basic_optimizer
 from deepspeed_tpu.parallel import topology as topo_mod
-from deepspeed_tpu.parallel.topology import MeshTopology
+from deepspeed_tpu.parallel.topology import AXIS_DATA, MeshTopology
 from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
     ArrayCheckpointEngine,
     OrbaxCheckpointEngine,
@@ -152,6 +152,15 @@ class DeepSpeedEngine:
         # (update_local under shard_map) — engine compiles a fused step
         self._onebit = hasattr(self.optimizer, "update_local")
 
+        # fused_step: one compiled program for fwd+bwd+apply (gas=1 only)
+        self._fused_step = bool(self._config.fused_step)
+        if self._fused_step and (self._config.gradient_accumulation_steps != 1
+                                 or self._onebit):
+            logger.warning("fused_step requires gradient_accumulation_steps=1 "
+                           "and a standard optimizer; disabling")
+            self._fused_step = False
+        self._fused_meta = None  # (overflow, grad_norm) of the last fused step
+
         # --- ZeRO-Offload optimizer tier (reference stage_1_and_2.py cpu
         #     offload + swap_tensor optimizer swappers): masters/moments on
         #     host (or nvme memmap), native cpu_adam does the update ---
@@ -159,6 +168,15 @@ class DeepSpeedEngine:
         self._host_offload = off is not None and str(off.device) in ("cpu", "nvme")
         self._host_optimizer = None
         if self._host_offload:
+            opt_name = (self._config.optimizer_name or "adamw").lower()
+            if opt_name not in ("adam", "adamw"):
+                # the host tier runs the native cpu_adam kernel — silently
+                # substituting Adam semantics for e.g. LAMB would corrupt
+                # training (the reference restricts cpu offload to
+                # DeepSpeedCPUAdam the same way)
+                raise DeepSpeedConfigError(
+                    f"offload_optimizer requires an Adam-family optimizer; "
+                    f"got {opt_name!r}")
             p = self._config.optimizer_params or {}
             betas = tuple(p.get("betas", (0.9, 0.999)))
             from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
@@ -169,6 +187,10 @@ class DeepSpeedEngine:
                 adamw_mode=(self._config.optimizer_name or "adamw") == "adamw",
                 gradient_clipping=self._config.gradient_clipping,
                 device=str(off.device), nvme_path=off.nvme_path)
+        if self._fused_step and self._host_offload:
+            logger.warning("fused_step is incompatible with optimizer "
+                           "offload; disabling")
+            self._fused_step = False
 
         # --- lr schedule (reference _configure_lr_scheduler, engine.py:900) ---
         if lr_scheduler is not None:
@@ -496,7 +518,7 @@ class DeepSpeedEngine:
         key = (flag_name, bool(flag))
         if key in self._jit_onebit:
             return self._jit_onebit[key]
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         loss_fn = self._loss_fn
@@ -549,6 +571,38 @@ class DeepSpeedEngine:
         grad_shardings = self._state_shardings.grad_acc
 
         compressor = self._compressor
+        shardings = self._state_shardings
+        rep = replicated(self.mesh)
+        self._compile_steps_apply_only()  # defines self._apply_math
+
+        if self._fused_step:
+            apply_math = self._apply_math
+
+            def fused_step(state: TrainState, batch, lr_override):
+                rng, sub, sub2 = jax.random.split(state.rng, 3)
+
+                def scaled_loss(p):
+                    if compressor is not None and compressor.any_active():
+                        p = compressor.transform(p, state.global_step)
+                    loss = loss_fn(p, batch, rngs={"dropout": sub, "gating": sub2})
+                    return loss * (state.loss_scale.loss_scale if fp16 else 1.0)
+
+                loss_scaled, grads = jax.value_and_grad(scaled_loss)(state.params)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads)
+                grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+                new_state, overflow, grad_norm = apply_math(
+                    state._replace(rng=rng), grads, lr_override)
+                loss = loss_scaled / (state.loss_scale.loss_scale if fp16 else 1.0)
+                return new_state, loss, overflow, grad_norm
+
+            self._jit_micro = None
+            self._jit_fused = jax.jit(
+                fused_step,
+                in_shardings=(shardings, None, rep),
+                out_shardings=(shardings, rep, rep, rep),
+                donate_argnums=(0,))
+            return
 
         def micro_step(state: TrainState, batch):
             rng, sub, sub2 = jax.random.split(state.rng, 3)
@@ -567,13 +621,11 @@ class DeepSpeedEngine:
             loss = loss_scaled * gas / (state.loss_scale.loss_scale if fp16 else 1.0)
             return state._replace(grad_acc=grad_acc, rng=rng), loss
 
-        shardings = self._state_shardings
         self._jit_micro = jax.jit(
             micro_step,
             in_shardings=(shardings, None),
             out_shardings=(shardings, replicated(self.mesh)),
             donate_argnums=(0,))
-        self._compile_steps_apply_only()
 
     def _compile_steps_apply_only(self):
         """Compile the optimizer-apply program (shared with PipelineEngine)."""
@@ -601,9 +653,11 @@ class DeepSpeedEngine:
         schedule_fn = self._schedule_fn
         scaler_config = self._scaler_config
 
-        def apply_step(state: TrainState, lr_override):
+        def apply_math(state: TrainState, scaled_grads, lr_override):
+            """Unscale → overflow check → clip → update → loss-scale update.
+            ``scaled_grads``: loss-scaled fp32 grads summed over micro-steps."""
             inv_scale = (1.0 / state.loss_scale.loss_scale) if fp16 else 1.0
-            grads = jax.tree_util.tree_map(lambda g: g * inv_scale, state.grad_acc)
+            grads = jax.tree_util.tree_map(lambda g: g * inv_scale, scaled_grads)
             overflow = has_inf_or_nan(grads) if fp16 else jnp.asarray(False)
             grad_norm = _global_norm(grads)
             if clip and clip > 0:
@@ -618,18 +672,27 @@ class DeepSpeedEngine:
             new_params = keep(new_params, state.params)
             new_opt = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(overflow, o, n), new_opt, state.opt_state)
-            zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
             new_scale = update_scale(scaler_config, state.loss_scale, overflow)
             return state._replace(
                 params=new_params,
                 opt_state=new_opt,
-                grad_acc=zero_acc,
                 loss_scale=new_scale,
                 global_step=state.global_step + 1,
                 skipped_steps=state.skipped_steps + overflow.astype(jnp.int32),
             ), overflow, grad_norm
 
+        self._apply_math = apply_math
         shardings = self._state_shardings
+        if self._fused_step:
+            self._jit_apply = None
+            return
+
+        def apply_step(state: TrainState, lr_override):
+            new_state, overflow, grad_norm = apply_math(
+                state, state.grad_acc, lr_override)
+            zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
+            return new_state._replace(grad_acc=zero_acc), overflow, grad_norm
+
         self._jit_apply = jax.jit(
             apply_step,
             in_shardings=(shardings, replicated(self.mesh)),
@@ -671,7 +734,17 @@ class DeepSpeedEngine:
             # (engine.py:1774,1797); floored at step 2 here so the profiled
             # window never includes XLA compilation of the step programs
             self.flops_profiler.start_profile()
-        self.state, loss = self._jit_micro(self.state, batch)
+        if self._onebit:
+            # fused fwd+bwd+compressed-update program, staged on the
+            # optimizer's warmup/compression flag
+            fn = self._get_onebit_fn(*self._onebit_flag())
+            self.state, loss = fn(self.state, batch, self._lr_override())
+        elif self._fused_step:
+            self.state, loss, overflow, grad_norm = self._jit_fused(
+                self.state, batch, self._lr_override())
+            self._fused_meta = (overflow, grad_norm)
+        else:
+            self.state, loss = self._jit_micro(self.state, batch)
         self._last_loss = loss
         if self.wall_clock_breakdown_:
             self.timers(FORWARD_GLOBAL_TIMER).stop()
@@ -698,10 +771,12 @@ class DeepSpeedEngine:
             v = out.get(key)
             if v is None or not hasattr(v, "ndim"):
                 continue
-            # cut every axis that spans the sequence (handles [B,T],
-            # [B,T,T] pairwise masks, and [B,1,T,T] broadcast masks)
-            idx = tuple(slice(0, diff) if d == seqlen else slice(None)
-                        for d in v.shape)
+            # cut every non-batch axis that spans the sequence (handles
+            # [B,T], [B,T,T] pairwise masks, and [B,1,T,T] broadcast masks);
+            # axis 0 is always the batch axis — never truncated, even when
+            # batch size happens to equal the sequence length
+            idx = tuple(slice(0, diff) if i > 0 and d == seqlen else slice(None)
+                        for i, d in enumerate(v.shape))
             out[key] = v[idx]
         return out
 
@@ -727,6 +802,12 @@ class DeepSpeedEngine:
                 self.timers(STEP_GLOBAL_TIMER).start()
             if self._host_offload:
                 self._host_apply()
+            elif self._onebit:
+                pass  # update applied inside the forward program
+            elif self._fused_step:
+                # optimizer already applied inside the fused forward program
+                if self._fused_meta is not None:
+                    self._last_grad_norm = self._fused_meta[1]
             else:
                 self.state, overflow, grad_norm = self._jit_apply(
                     self.state, self._lr_override())
@@ -794,12 +875,19 @@ class DeepSpeedEngine:
             self.state = self.state._replace(loss_scale=new_scale)
 
     def _lr_override(self):
-        """lr fed to the compiled step when no traced schedule_fn exists."""
+        """lr fed to the compiled step when no traced schedule_fn exists.
+        The device scalar is cached per value — a fresh host→device transfer
+        every step would serialize against the async dispatch queue."""
         if self._schedule_fn is not None:
-            return jnp.asarray(0.0, jnp.float32)  # unused branch
-        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "get_lr"):
-            return jnp.asarray(self.lr_scheduler.get_lr()[0], jnp.float32)
-        return jnp.asarray(getattr(self.optimizer, "lr", 0.0), jnp.float32)
+            lr = 0.0  # unused branch
+        elif self.lr_scheduler is not None and hasattr(self.lr_scheduler, "get_lr"):
+            lr = float(self.lr_scheduler.get_lr()[0])
+        else:
+            lr = float(getattr(self.optimizer, "lr", 0.0))
+        cached = getattr(self, "_lr_cache", None)
+        if cached is None or cached[0] != lr:
+            self._lr_cache = (lr, jnp.asarray(lr, jnp.float32))
+        return self._lr_cache[1]
 
     def train_batch(self, data_iter=None, batch=None):
         """Convenience fused path: run ``gas`` micro-steps + apply.
@@ -904,7 +992,10 @@ class DeepSpeedEngine:
         return [getattr(self.optimizer, "lr", 0.0)]
 
     def get_global_grad_norm(self):
-        return None  # filled by step() return in future
+        """Global (pre-clip) gradient norm of the last optimizer step
+        (reference ``engine.get_global_grad_norm``)."""
+        norm = getattr(self, "_last_grad_norm", None)
+        return float(norm) if norm is not None else None
 
     @property
     def loss_scale(self):

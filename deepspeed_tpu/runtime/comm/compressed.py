@@ -97,6 +97,6 @@ def make_compressed_grad_fn(loss_fn, mesh, data_axis: str = "data"):
             local_step, mesh=mesh,
             in_specs=(P(), P(data_axis), err_specs),
             out_specs=(P(), P(), err_specs),
-            check_rep=False)(params, batch, errors)
+            check_vma=False)(params, batch, errors)
 
     return wrapped

@@ -548,7 +548,7 @@ class DeepSpeedEngine:
                 local, mesh=self.mesh,
                 in_specs=(P(), opt_specs, P(AXIS_DATA), P(), P()),
                 out_specs=(P(), P(), opt_specs),
-                check_rep=False,
+                check_vma=False,
             )(state.params, state.opt_state, batch, lr, sub)
             return state._replace(params=new_p, opt_state=new_opt, rng=rng,
                                   global_step=state.global_step + 1), loss

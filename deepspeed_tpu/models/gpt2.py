@@ -43,6 +43,16 @@ class GPT2Config:
     # collection) — the TPU-native form of the reference's inference
     # workspace (csrc/transformer/inference/includes/inference_context.h)
     decode: bool = False
+    # progressive layer drop (reference runtime/progressive_layer_drop.py:5):
+    # when on, the forward accepts a traced ``pld_theta`` scalar and each
+    # block's residual is stochastically ZEROED with depth-scaled keep
+    # probability 1 - i/L * (1 - theta) (paper eq. 6), with inverted-residual
+    # scaling so eval uses all layers unchanged. Note: under jit/scan the
+    # dropped block's compute still executes (static shapes — the gain here
+    # is the regularization/convergence effect, not per-step FLOPs; the
+    # reference's eager gating skips compute, a dynamic-control-flow shape
+    # XLA cannot express inside one compiled step)
+    pld: bool = False
 
     def for_decode(self):
         return dataclasses.replace(self, decode=True, dropout=0.0)
@@ -82,7 +92,11 @@ def _remat_block(cfg):
             jax.checkpoint_policies.checkpoint_dots,
             jax.checkpoint_policies.save_only_these_names(
                 "flash_q", "flash_k", "flash_v", "flash_o", "flash_lse"))
-    return nn.remat(Block, prevent_cse=False, policy=policy)
+    # deterministic (arg index 2; 0 is self) is branched on in Python —
+    # it must stay static under jax.checkpoint, and therefore must be
+    # passed POSITIONALLY at every call site of the wrapped block
+    return nn.remat(Block, prevent_cse=False, policy=policy,
+                    static_argnums=(2,))
 
 
 class CausalSelfAttention(nn.Module):
@@ -178,14 +192,32 @@ class Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, pld_theta=None, layer_frac=0.0):
         cfg = self.config
-        x = x + CausalSelfAttention(cfg, name="attn")(
+        pld_on = cfg.pld and pld_theta is not None and not deterministic
+        if pld_on:
+            # progressive layer drop (reference progressive_layer_drop.py:5 +
+            # engine.py:1800-1802 threading): depth-scaled keep probability,
+            # inverted-residual scaling so eval runs all layers unchanged.
+            # The residual is zeroed, not skipped — see GPT2Config.pld
+            keep = jnp.asarray(1.0 - layer_frac * (1.0 - pld_theta), jnp.float32)
+
+            def _gate(residual):
+                g = jax.random.bernoulli(self.make_rng("pld"), keep)
+                return jnp.where(g, residual / keep.astype(residual.dtype),
+                                 jnp.zeros_like(residual))
+        attn_out = CausalSelfAttention(cfg, name="attn")(
             nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_1")(x),
             deterministic=deterministic)
-        x = x + MLP(cfg, name="mlp")(
+        if pld_on:
+            attn_out = _gate(attn_out)
+        x = x + attn_out
+        mlp_out = MLP(cfg, name="mlp")(
             nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_2")(x),
             deterministic=deterministic)
+        if pld_on:
+            mlp_out = _gate(mlp_out)
+        x = x + mlp_out
         return x
 
 
@@ -193,9 +225,10 @@ class _ScanBody(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic):
+    def __call__(self, x, deterministic, pld_theta, layer_frac):
         cfg = self.config
-        x = _remat_block(cfg)(cfg, name="block")(x, deterministic=deterministic)
+        x = _remat_block(cfg)(cfg, name="block")(
+            x, deterministic, pld_theta, layer_frac)
         return x, None
 
 
@@ -207,17 +240,21 @@ class ScanBlocks(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, pld_theta=None):
         cfg = self.config
         ScannedBlock = nn.scan(
             _ScanBody,
             variable_axes={"params": 0, "cache": 0},
-            split_rngs={"params": True, "dropout": True},
-            in_axes=nn.broadcast,
+            split_rngs={"params": True, "dropout": True, "pld": True},
+            in_axes=(nn.broadcast, nn.broadcast, 0),
             length=cfg.n_layer,
             metadata_params={nn.meta.PARTITION_NAME: "layers"},
         )
-        x, _ = ScannedBlock(cfg, name="h")(x, deterministic)
+        # 1-indexed depth fractions (paper eq. 6 / layer_keep_probs): layer i
+        # of L keeps with prob 1 - i/L*(1-theta), i = 1..L
+        fracs = (jnp.arange(cfg.n_layer, dtype=jnp.float32) + 1.0) / max(
+            1, cfg.n_layer)
+        x, _ = ScannedBlock(cfg, name="h")(x, deterministic, pld_theta, fracs)
         return x
 
 
@@ -225,11 +262,12 @@ class LoopBlocks(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, pld_theta=None):
         cfg = self.config
         block_cls = _remat_block(cfg)
         for i in range(cfg.n_layer):
-            x = block_cls(cfg, name=f"h_{i}")(x, deterministic=deterministic)
+            x = block_cls(cfg, name=f"h_{i}")(
+                x, deterministic, pld_theta, (i + 1) / max(1, cfg.n_layer))
         return x
 
 
@@ -243,7 +281,8 @@ class GPT2LMHeadModel(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, deterministic=True, return_hidden=False):
+    def __call__(self, input_ids, deterministic=True, return_hidden=False,
+                 pld_theta=None):
         cfg = self.config
         B, T = input_ids.shape
         wte = self.param("wte", _dense_init(), (cfg.vocab_size, cfg.n_embd), jnp.float32)
@@ -261,7 +300,8 @@ class GPT2LMHeadModel(nn.Module):
         if cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
         blocks = ScanBlocks if cfg.scan_layers else LoopBlocks
-        x = blocks(cfg, name="transformer")(x, deterministic=deterministic)
+        x = blocks(cfg, name="transformer")(x, deterministic=deterministic,
+                                            pld_theta=pld_theta)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
             return x, wte
@@ -347,6 +387,22 @@ class GPT2ForTraining:
     def apply(self, variables, batch, rngs=None):
         return self.model.apply(variables, self._input_ids(batch), rngs=rngs)
 
+    def with_activation_checkpointing(self, enabled: bool, policy: str = "full"):
+        """Engine hook: the ds-config ``activation_checkpointing`` section
+        overrides the model's remat setting (reference ``configure``,
+        runtime/activation_checkpointing/checkpointing.py:830 — there the
+        config drives CheckpointFunction; here it drives jax.checkpoint)."""
+        if policy == "none":
+            enabled, policy = False, "full"
+        cfg = dataclasses.replace(self.config, remat=enabled,
+                                  remat_policy=policy)
+        return GPT2ForTraining(cfg)
+
+    def with_progressive_layer_drop(self, enabled: bool = True):
+        """Engine hook: PLD config turns on the drop-capable block stack
+        (reference threads pld into forward, engine.py:1800-1802)."""
+        return GPT2ForTraining(dataclasses.replace(self.config, pld=enabled))
+
 
 class GPT2Embed(nn.Module):
     """Input embedding layer for the pipeline layout (stage-0 work). Its
@@ -418,7 +474,7 @@ def gpt2_loss_fn(model: GPT2LMHeadModel):
     next-token objective (labels shifted internally).
     """
 
-    def loss_fn(params, batch, rngs=None):
+    def loss_fn(params, batch, rngs=None, pld_theta=None):
         if isinstance(batch, dict):
             input_ids, labels = batch["input_ids"], batch.get("labels")
         else:
@@ -427,7 +483,7 @@ def gpt2_loss_fn(model: GPT2LMHeadModel):
             labels = input_ids
         hidden, wte = model.apply({"params": params}, input_ids,
                                   deterministic=rngs is None, rngs=rngs,
-                                  return_hidden=True)
+                                  return_hidden=True, pld_theta=pld_theta)
         # shift for next-token prediction by padding the label stream
         shifted = jnp.concatenate(
             [labels[:, 1:], jnp.full((labels.shape[0], 1), -100, labels.dtype)],

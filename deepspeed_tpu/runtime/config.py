@@ -45,6 +45,12 @@ class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     selects a ``jax.checkpoint`` (remat) policy; partition_activations maps to
     sharding the saved residuals over the model axis."""
 
+    # TPU-native extensions: presence of the config section enables remat
+    # (set ``enabled: false`` to override); ``policy`` picks the
+    # jax.checkpoint granularity — "full" recomputes whole blocks, "dots"
+    # saves matmul outputs and recomputes only elementwise chains
+    enabled: bool = True
+    policy: str = "full"
     partition_activations: bool = False
     contiguous_memory_optimization: bool = False
     cpu_checkpointing: bool = False
@@ -125,6 +131,11 @@ class CheckpointConfig(DeepSpeedConfigModel):
     use_node_local_storage: bool = False
     parallel_write: dict = Field(default_factory=dict)
     async_save: bool = False  # TPU-native: orbax-style async checkpointing
+    # sharded: each host writes only its addressable shards (orbax/tensorstore
+    # parallel write) — no consolidation, and restore can re-shard onto a
+    # different mesh (the universal-checkpoint capability, reference
+    # checkpoint/universal_checkpoint.py:13). False = consolidated npz.
+    sharded: bool = False
 
 
 class DataTypesConfig(DeepSpeedConfigModel):
@@ -210,6 +221,13 @@ class DeepSpeedConfig:
         self.mesh = MeshConfig(**d.get(C.MESH, {}))
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **d.get("activation_checkpointing", {}))
+        # only an explicit enabled/policy key drives model reconfiguration in
+        # the engine; parity-boilerplate sections carrying only the
+        # reference's fields (partition_activations etc.) stay parse-only, so
+        # existing configs don't silently flip remat on
+        _ac = d.get("activation_checkpointing", {})
+        self.activation_checkpointing_explicit = (
+            "enabled" in _ac or "policy" in _ac)
         self.comms_config = CommsLoggerConfig(**d.get("comms_logger", {}))
         self.flops_profiler_config = FlopsProfilerConfig(**d.get("flops_profiler", {}))
         self.monitor_config = MonitorConfig(

@@ -26,11 +26,23 @@ class ProgressiveLayerDrop:
     def get_theta(self) -> float:
         return self.current_theta
 
-    def update_state(self, global_step: int):
-        def _prob(x, gamma, p):
-            return (1.0 - p) * np.exp(-gamma * x) + p
+    def theta_at(self, global_step):
+        """theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar.
 
-        self.current_theta = float(_prob(global_step, self.gamma, self.theta))
+        Host ints stay in numpy (no device round-trip in the step loop);
+        traced scalars (the engine's compiled step) go through jnp — one
+        formula, two execution paths.
+        """
+        if isinstance(global_step, (int, float, np.integer, np.floating)):
+            return (1.0 - self.theta) * np.exp(
+                -self.gamma * float(global_step)) + self.theta
+        import jax.numpy as jnp
+
+        t = jnp.asarray(global_step, jnp.float32)
+        return (1.0 - self.theta) * jnp.exp(-self.gamma * t) + self.theta
+
+    def update_state(self, global_step: int):
+        self.current_theta = float(self.theta_at(int(global_step)))
         return self.current_theta
 
 

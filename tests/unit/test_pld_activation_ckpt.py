@@ -1,0 +1,144 @@
+"""Config sections must change compiled behavior, not just parse:
+progressive layer drop (reference ``runtime/progressive_layer_drop.py:5`` +
+``engine.py:1800-1802``) and activation checkpointing (reference
+``runtime/activation_checkpointing/checkpointing.py:498,830``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+from deepspeed_tpu.parallel.topology import reset_topology
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    reset_topology()
+    yield
+    reset_topology()
+
+
+def _cfg(**over):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 10_000,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _train(model, ds_config, n_steps=4, seed=0):
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=ds_config)
+    ids = np.random.default_rng(seed).integers(0, 256, (8, 32)).astype(np.int32)
+    losses = []
+    for _ in range(n_steps):
+        loss = engine({"input_ids": ids})
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return engine, losses
+
+
+class TestProgressiveLayerDrop:
+    def test_engine_reconfigures_model(self):
+        model = GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32))
+        engine, _ = _train(model, _cfg(progressive_layer_drop={
+            "enabled": True, "theta": 0.5, "gamma": 0.1}), n_steps=1)
+        assert engine.pld_enabled
+        assert engine.module.config.pld is True
+        assert model.config.pld is False  # original untouched
+
+    def test_pld_changes_trajectory(self):
+        """theta(0)=1 keeps every layer (first step identical); as theta
+        decays the gates fire and the trajectories diverge."""
+        model = GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32,
+                                                n_layer=4))
+        reset_topology()
+        _, base = _train(model, _cfg(), n_steps=4)
+        reset_topology()
+        _, pld = _train(model, _cfg(progressive_layer_drop={
+            "enabled": True, "theta": 0.3, "gamma": 2.0}), n_steps=4)
+        # step 0: theta = (1-0.3)*exp(0)+0.3 = 1.0 -> keep-prob 1, no drops
+        assert pld[0] == pytest.approx(base[0], rel=1e-5)
+        # by step 2, theta ~ 0.3: deeper layers dropped w.p. ~0.5
+        assert not np.allclose(pld[2:], base[2:], rtol=1e-4)
+
+    @pytest.mark.parametrize("scan", [True, False])
+    @pytest.mark.parametrize("policy", ["full", "dots"])
+    def test_pld_composes_with_remat(self, scan, policy):
+        """Regression: deterministic is branched on in Python inside Block,
+        so it must stay static under jax.checkpoint (PLD+remat crashed with
+        TracerBoolConversionError before static_argnums)."""
+        model = GPT2ForTraining(GPT2Config.tiny(
+            dtype=jnp.float32, n_layer=2, scan_layers=scan))
+        engine, losses = _train(model, _cfg(
+            progressive_layer_drop={"enabled": True, "theta": 0.5,
+                                    "gamma": 0.5},
+            activation_checkpointing={"enabled": True, "policy": policy}),
+            n_steps=2)
+        assert engine.module.config.pld and engine.module.config.remat
+        assert all(np.isfinite(losses))
+
+    def test_theta_host_accessor_tracks(self):
+        model = GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32))
+        engine, _ = _train(model, _cfg(progressive_layer_drop={
+            "enabled": True, "theta": 0.5, "gamma": 0.5}), n_steps=3)
+        theta = engine.progressive_layer_drop.get_theta()
+        assert theta == pytest.approx(0.5 * np.exp(-0.5 * 3) + 0.5, rel=1e-6)
+
+
+class TestActivationCheckpointingConfig:
+    def test_config_enables_remat(self):
+        model = GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32))
+        assert model.config.remat is False
+        engine, losses = _train(model, _cfg(activation_checkpointing={
+            "enabled": True, "policy": "dots"}), n_steps=2)
+        assert engine.module.config.remat is True
+        assert engine.module.config.remat_policy == "dots"
+        assert all(np.isfinite(losses))
+
+    def test_parity_boilerplate_section_stays_parse_only(self):
+        """A section carrying only the reference's fields (no enabled/policy)
+        must not silently flip remat on for existing configs."""
+        model = GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32))
+        engine, _ = _train(model, _cfg(activation_checkpointing={
+            "partition_activations": False}), n_steps=1)
+        assert engine.module.config.remat is False
+        assert engine.module is model  # not reconfigured
+
+    def test_config_disable_wins(self):
+        model = GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32,
+                                                remat=True))
+        engine, _ = _train(model, _cfg(activation_checkpointing={
+            "enabled": False}), n_steps=1)
+        assert engine.module.config.remat is False
+
+    def test_remat_preserves_math(self):
+        """Remat changes the compiled program (recompute in backward), not
+        the trajectory."""
+        model = GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32))
+        reset_topology()
+        _, base = _train(model, _cfg(), n_steps=3)
+        reset_topology()
+        _, remat = _train(model, _cfg(activation_checkpointing={
+            "enabled": True, "policy": "full"}), n_steps=3)
+        np.testing.assert_allclose(remat, base, rtol=2e-4)
+
+    def test_remat_primitive_in_graph(self):
+        """The config-selected policy actually lands in the lowered program:
+        the backward of a remat'd model contains a checkpoint/remat eqn."""
+        import jax
+
+        model = GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32))
+        remat_model = model.with_activation_checkpointing(True, "full")
+        ids = jnp.zeros((2, 16), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+
+        def grad_of(m):
+            return jax.make_jaxpr(
+                jax.grad(lambda p: m.loss_fn(p, {"input_ids": ids})))(params)
+
+        assert "remat" in str(grad_of(remat_model))
+        assert "remat" not in str(grad_of(model))

@@ -7,29 +7,54 @@ MFU"); >1.0 beats the target.
 """
 
 import json
+import os
 import time
 
 import numpy as np
 
 
+def load_autotuned():
+    """Best config from ``python -m deepspeed_tpu.autotuning``, if tuned
+    FOR THIS bench model (gpt2-125m @ seq 1024) — a config tuned for a
+    different model/seq is ignored with a note, not silently applied.
+
+    The autotuner writes autotuning_results/best_config.json; the bench
+    honors its micro-batch / zero-stage / remat / fused-step choices so the
+    tuned result is what gets reported (VERDICT r1 #7: "the bench uses it").
+    """
+    for base in (os.path.dirname(os.path.abspath(__file__)), os.getcwd()):
+        path = os.path.join(base, "autotuning_results", "best_config.json")
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            tuned = json.load(f)
+        import jax
+        import sys
+
+        mc = (tuned.get("model_spec") or {}).get("config", {})
+        if (tuned.get("seq_len") == 1024 and mc.get("n_layer") == 12
+                and mc.get("n_embd") == 768
+                and mc.get("vocab_size") == 50257
+                and tuned.get("dp", 1) == jax.device_count()):
+            return tuned
+        print(f"bench: ignoring {path} "
+              "(tuned for a different model/seq/chip-count)",
+              file=sys.stderr)
+    return None
+
+
 def peak_flops_per_chip() -> float:
-    """bf16 peak FLOP/s for the local accelerator."""
+    """bf16 peak FLOP/s for the local accelerator (single source:
+    autotuning.cost_model.ChipSpec — the bench MFU denominator and the
+    autotuner's roofline must agree)."""
     import jax
 
+    from deepspeed_tpu.autotuning.cost_model import ChipSpec
+
     d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "").lower()
-    table = {
-        "tpu v5 lite": 197e12,   # v5e bf16 (394 TOPS is the int8 figure)
-        "tpu v5e": 197e12,
-        "tpu v5": 459e12,        # v5p
-        "tpu v5p": 459e12,
-        "tpu v4": 275e12,
-        "tpu v6 lite": 918e12,   # v6e
-    }
-    for k, v in table.items():
-        if k in kind:
-            return v
-    return 197e12 if d.platform == "tpu" else 1e12  # conservative default
+    if d.platform != "tpu":
+        return 1e12  # CPU smoke: nominal denominator
+    return ChipSpec.from_kind(getattr(d, "device_kind", "")).peak_flops
 
 
 def main():
@@ -40,33 +65,48 @@ def main():
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
 
     on_tpu = jax.devices()[0].platform == "tpu"
+    tuned = load_autotuned() if on_tpu else None
     if on_tpu:
         # tuned: selective ("dots") remat keeps matmul + flash-attention
         # outputs and recomputes only elementwise chains; fused_step compiles
         # fwd+bwd+optimizer into one program (no grad-acc round trip)
+        remat, remat_policy, zero_stage, fused = True, "dots", 0, True
+        batch, seq, steps = 16, 1024, 10
+        if tuned:
+            c = tuned["candidate"]
+            batch = int(c["micro_batch"])
+            zero_stage = int(c["zero_stage"])
+            fused = bool(c.get("fused_step", True))
+            remat = c["remat_policy"] != "none"
+            remat_policy = c["remat_policy"] if remat else "full"
         cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=768,
                          n_layer=12, n_head=12, dtype=jnp.bfloat16,
-                         scan_layers=True, remat=True, remat_policy="dots")
-        batch, seq, steps = 16, 1024, 10
+                         scan_layers=True, remat=remat,
+                         remat_policy=remat_policy)
     else:  # local CPU smoke: tiny proxy so the script stays runnable anywhere
         cfg = GPT2Config.tiny(dtype=jnp.float32)
         batch, seq, steps = 8, 64, 3
 
+    # `batch` is per-chip (matching the trial semantics of the autotuner:
+    # train_micro_batch_size_per_gpu); global rows = batch x local chips
+    n_dev = jax.device_count()
+    rows = batch * n_dev
     model = GPT2ForTraining(cfg)
     engine, *_ = deepspeed_tpu.initialize(
         model=model,
         config={
-            "train_batch_size": batch,
+            "train_micro_batch_size_per_gpu": batch,
+            "gradient_accumulation_steps": 1,
             "optimizer": {"type": "AdamW",
                           "params": {"lr": 6e-4, "weight_decay": 0.1}},
             "gradient_clipping": 1.0,
             "bf16": {"enabled": on_tpu},
-            "fused_step": True,
-            "zero_optimization": {"stage": 0},
+            "fused_step": fused if on_tpu else True,
+            "zero_optimization": {"stage": zero_stage if on_tpu else 0},
             "steps_per_print": 10_000,
         })
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    ids = rng.integers(0, cfg.vocab_size, (rows, seq)).astype(np.int32)
 
     def _force_sync():
         # device_get does a real transfer — reliable fence even on platforms
@@ -88,13 +128,16 @@ def main():
     _force_sync()
     dt = time.perf_counter() - t0
 
-    tokens_per_sec = steps * batch * seq / dt
+    tokens_per_sec = steps * rows * seq / dt / n_dev  # per chip
     n_params = sum(int(np.prod(p.shape)) for p in
                    jax.tree_util.tree_leaves(engine.state.params))
-    # 6N matmul flops (fwd+bwd) + causal attention: 12*L*T*C per token full,
-    # halved by causal masking (PaLM appendix B accounting)
-    model_flops_per_token = (6 * n_params
-                             + 6 * cfg.n_layer * seq * cfg.n_embd)
+    # 6N matmul flops (fwd+bwd) + causal attention (PaLM appendix B);
+    # single source shared with the autotuner's cost model
+    from deepspeed_tpu.autotuning.space import ModelProfile
+
+    model_flops_per_token = ModelProfile(
+        n_params=n_params, n_layer=cfg.n_layer, n_embd=cfg.n_embd,
+        vocab_size=cfg.vocab_size, seq_len=seq).flops_per_token
     mfu = tokens_per_sec * model_flops_per_token / peak_flops_per_chip()
     print(json.dumps({
         "metric": "gpt2_125m_train_tokens_per_sec_per_chip",

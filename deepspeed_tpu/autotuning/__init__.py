@@ -1,0 +1,33 @@
+"""Autotuning: search {micro-batch, ZeRO stage, remat policy} for the best
+measured throughput on the local chip.
+
+Reference subsystem: deepspeed/autotuning (autotuner.py:31, scheduler.py:30,
+tuner/cost_model.py:14 — 2.8k LoC). Usage::
+
+    from deepspeed_tpu.autotuning import Autotuner, AutotuningConfig
+
+    best = Autotuner(model_spec={"preset": "gpt2",
+                                 "config": {"n_layer": 12, "n_embd": 768}},
+                     base_ds_config={"optimizer": {...}},
+                     config=AutotuningConfig(max_trials=8)).tune()
+
+or ``python -m deepspeed_tpu.autotuning`` for the bench model (the tuned
+config feeds ``bench.py``).
+"""
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner, profile_model
+from deepspeed_tpu.autotuning.config import AutotuningConfig
+from deepspeed_tpu.autotuning.cost_model import (ChipSpec, predict_step_time,
+                                                 predict_throughput,
+                                                 xla_cost_analysis)
+from deepspeed_tpu.autotuning.space import (Candidate, ModelProfile,
+                                            build_space, estimate_hbm_bytes)
+from deepspeed_tpu.autotuning.tuner import (GridSearchTuner, ModelBasedTuner,
+                                            RandomTuner, get_tuner)
+
+__all__ = [
+    "Autotuner", "AutotuningConfig", "Candidate", "ChipSpec",
+    "GridSearchTuner", "ModelBasedTuner", "ModelProfile", "RandomTuner",
+    "build_space", "estimate_hbm_bytes", "get_tuner", "predict_step_time",
+    "predict_throughput", "profile_model", "xla_cost_analysis",
+]

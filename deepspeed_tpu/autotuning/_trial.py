@@ -1,0 +1,122 @@
+"""One autotuning trial: build the model, train a few steps, report.
+
+Runs in its own process (reference: each autotuning experiment is a
+separate ``deepspeed`` launch, deepspeed/autotuning/scheduler.py:62 — an
+OOM-ing candidate must not kill the search). Protocol: argv[1] is a JSON
+spec file; the last stdout line is a JSON result
+``{"ok", "tokens_per_sec", "step_ms", "error"}``.
+
+Spec keys:
+  model:  {"preset": "gpt2", "config": {...GPT2Config kwargs}} |
+          {"import": "pkg.mod:factory"}  (factory(micro_batch, seq_len) ->
+          (model, batch))
+  ds_config: full engine config (already includes the candidate overrides)
+  seq_len, warmup_steps, steps
+  platform: force "cpu" (tests); host_device_count: virtual CPU devices
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def _build_model(spec, rows=None):
+    """Build (model, batch). ``rows`` is the global batch row count
+    (micro-batch × data-parallel degree); defaults to the per-chip
+    micro-batch for host-side profiling."""
+    model_spec = spec["model"]
+    if rows is None:
+        rows = int(spec["ds_config"].get("train_micro_batch_size_per_gpu")
+                   or spec["ds_config"].get("train_batch_size"))
+    seq = int(spec.get("seq_len", 128))
+    if "import" in model_spec:
+        import importlib
+
+        mod_name, fn_name = model_spec["import"].split(":")
+        factory = getattr(importlib.import_module(mod_name), fn_name)
+        return factory(rows, seq)
+    if model_spec.get("preset", "gpt2") == "gpt2":
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+
+        kw = dict(model_spec.get("config", {}))
+        dtype = kw.pop("dtype", "bfloat16")
+        kw["dtype"] = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+        cfg = GPT2Config(**kw)
+        ids = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (rows, seq)).astype(np.int32)
+        return GPT2ForTraining(cfg), {"input_ids": ids}
+    raise ValueError(f"unknown model spec {model_spec!r}")
+
+
+def run_trial(spec):
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.parallel.topology import reset_topology
+
+    reset_topology()
+    # trials don't carve model/pipe axes: every local device is data-parallel
+    mb = int(spec["ds_config"].get("train_micro_batch_size_per_gpu")
+             or spec["ds_config"].get("train_batch_size"))
+    model, batch = _build_model(spec, rows=mb * jax.device_count())
+    engine, *_ = deepspeed_tpu.initialize(model=model,
+                                          config=dict(spec["ds_config"]))
+
+    def _sync():
+        np.asarray(jax.device_get(
+            jax.tree_util.tree_leaves(engine.state.params)[0]))
+
+    def _step():
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    for _ in range(max(1, int(spec.get("warmup_steps", 1)))):
+        loss = _step()
+    _sync()
+    steps = max(1, int(spec.get("steps", 5)))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = _step()
+    float(loss)
+    _sync()
+    dt = time.perf_counter() - t0
+
+    rows = engine.train_batch_size()  # global rows/step (gas=1 in trials)
+    seq = int(spec.get("seq_len", 128))
+    return {
+        "ok": True,
+        "tokens_per_sec": steps * rows * seq / dt,
+        "step_ms": 1e3 * dt / steps,
+        "loss": float(loss),
+    }
+
+
+def main():
+    with open(sys.argv[1]) as f:
+        spec = json.load(f)
+    if spec.get("host_device_count"):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count="
+              f"{spec['host_device_count']}")
+    if spec.get("platform"):
+        import jax
+
+        jax.config.update("jax_platforms", spec["platform"])
+    try:
+        out = run_trial(spec)
+    except Exception as e:  # noqa: BLE001 — the whole point is isolation
+        out = {"ok": False, "error": repr(e)[:4000]}
+    sys.stdout.flush()
+    print("\n" + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

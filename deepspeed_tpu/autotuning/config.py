@@ -1,0 +1,41 @@
+"""Typed autotuning config (reference: deepspeed/autotuning/config.py:15
+``DeepSpeedAutotuningConfig``)."""
+
+from typing import List, Optional
+
+from deepspeed_tpu.autotuning import constants as C
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled: bool = C.AUTOTUNING_ENABLED_DEFAULT
+    metric: str = C.AUTOTUNING_METRIC_DEFAULT
+    tuner_type: str = C.AUTOTUNING_TUNER_TYPE_DEFAULT
+    max_trials: int = C.AUTOTUNING_MAX_TRIALS_DEFAULT
+    trial_steps: int = C.AUTOTUNING_TRIAL_STEPS_DEFAULT
+    trial_warmup_steps: int = C.AUTOTUNING_TRIAL_WARMUP_STEPS_DEFAULT
+    tuner_early_stopping: int = C.AUTOTUNING_EARLY_STOP_DEFAULT
+    # Candidate axes. ``None`` means "derive": micro-batches are powers of
+    # two up to the memory bound; stages default to [0, 1, 2, 3].
+    micro_batch_sizes: Optional[List[int]] = None
+    zero_stages: Optional[List[int]] = None
+    remat_policies: List[str] = C.AUTOTUNING_REMAT_POLICIES_DEFAULT
+    # fused-step axis; default only measures the fused program (gas=1).
+    # Pass [True, False] to also try the split fwd/bwd/apply path.
+    fused_steps: Optional[List[bool]] = None
+    results_dir: str = C.AUTOTUNING_RESULTS_DIR_DEFAULT
+    overwrite: bool = C.AUTOTUNING_OVERWRITE_DEFAULT
+    trial_timeout_s: int = C.AUTOTUNING_TRIAL_TIMEOUT_S_DEFAULT
+    memory_headroom: float = C.AUTOTUNING_MEM_HEADROOM_DEFAULT
+    # Explicit HBM budget per chip in GiB; None = read the live device's
+    # limit (falling back to 16 GiB when the platform can't report one).
+    hbm_gib: Optional[float] = None
+    # run trials in-process instead of one subprocess each (fast, but an
+    # OOM-ing candidate kills the whole search — subprocess is the default,
+    # mirroring the reference's experiment scheduler isolation,
+    # deepspeed/autotuning/scheduler.py:62)
+    in_process: bool = False
+    # force a platform / virtual-device count in trial subprocesses (tests
+    # tune on the 8-device CPU mesh without touching the chip)
+    trial_platform: Optional[str] = None
+    trial_host_device_count: Optional[int] = None

@@ -1,0 +1,62 @@
+"""Autotuning defaults and key names.
+
+Capability parity with the reference ``deepspeed/autotuning/constants.py``
+(reference: /root/reference/deepspeed/autotuning/constants.py:1) — the key
+surface is kept recognizable (metric names, tuner types, exit modes) while
+the tunable dimensions are the TPU-native ones: micro-batch size, ZeRO
+stage, rematerialization policy, and fused-step mode (instead of the
+reference's CUDA-centric offload/bucket knobs).
+"""
+
+AUTOTUNING = "autotuning"
+
+AUTOTUNING_ENABLED = "enabled"
+AUTOTUNING_ENABLED_DEFAULT = False
+
+# What the tuner optimizes. The reference supports latency/throughput/flops
+# (autotuning/constants.py: AUTOTUNING_METRIC_*); tokens/s is the native
+# throughput unit here.
+AUTOTUNING_METRIC = "metric"
+AUTOTUNING_METRIC_THROUGHPUT = "throughput"   # tokens/s (maximize)
+AUTOTUNING_METRIC_LATENCY = "latency"         # step ms (minimize)
+AUTOTUNING_METRIC_DEFAULT = AUTOTUNING_METRIC_THROUGHPUT
+
+AUTOTUNING_TUNER_TYPE = "tuner_type"
+AUTOTUNING_TUNER_GRIDSEARCH = "gridsearch"
+AUTOTUNING_TUNER_RANDOM = "random"
+AUTOTUNING_TUNER_MODELBASED = "model_based"
+AUTOTUNING_TUNER_TYPE_DEFAULT = AUTOTUNING_TUNER_MODELBASED
+
+AUTOTUNING_MAX_TRIALS = "max_trials"
+AUTOTUNING_MAX_TRIALS_DEFAULT = 16
+
+AUTOTUNING_TRIAL_STEPS = "trial_steps"
+AUTOTUNING_TRIAL_STEPS_DEFAULT = 5
+
+AUTOTUNING_TRIAL_WARMUP_STEPS = "trial_warmup_steps"
+AUTOTUNING_TRIAL_WARMUP_STEPS_DEFAULT = 1
+
+AUTOTUNING_EARLY_STOP = "tuner_early_stopping"
+AUTOTUNING_EARLY_STOP_DEFAULT = 4  # stop after N trials with no improvement
+
+AUTOTUNING_MICRO_BATCH_SIZES = "micro_batch_sizes"
+AUTOTUNING_ZERO_STAGES = "zero_stages"
+AUTOTUNING_REMAT_POLICIES = "remat_policies"
+AUTOTUNING_REMAT_POLICIES_DEFAULT = ["none", "dots", "full"]
+
+AUTOTUNING_RESULTS_DIR = "results_dir"
+AUTOTUNING_RESULTS_DIR_DEFAULT = "autotuning_results"
+
+AUTOTUNING_OVERWRITE = "overwrite"
+AUTOTUNING_OVERWRITE_DEFAULT = True
+
+AUTOTUNING_TRIAL_TIMEOUT_S = "trial_timeout_s"
+AUTOTUNING_TRIAL_TIMEOUT_S_DEFAULT = 600
+
+# Fraction of HBM the memory model is allowed to plan into; the rest covers
+# XLA scratch/fragmentation that the closed-form estimate cannot see.
+AUTOTUNING_MEM_HEADROOM = "memory_headroom"
+AUTOTUNING_MEM_HEADROOM_DEFAULT = 0.90
+
+BEST_CONFIG_FILE = "best_config.json"
+SUMMARY_FILE = "summary.json"

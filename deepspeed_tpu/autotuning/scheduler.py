@@ -1,0 +1,102 @@
+"""Trial execution: isolated subprocesses, result collection.
+
+Reference: ``ResourceManager``/experiment scheduler
+(deepspeed/autotuning/scheduler.py:30,62) launches each experiment as a
+separate deepspeed run and reaps results from files. Single-host TPU
+tuning needs the same isolation (an OOM-ing micro-batch must not kill the
+search) but none of the ssh machinery: one subprocess per trial, JSON in,
+JSON out.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclasses.dataclass
+class TrialResult:
+    name: str
+    ok: bool
+    tokens_per_sec: float = 0.0
+    step_ms: float = 0.0
+    error: Optional[str] = None
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class TrialScheduler:
+    def __init__(self, results_dir: str, timeout_s: int = 600,
+                 in_process: bool = False):
+        self.results_dir = results_dir
+        self.timeout_s = timeout_s
+        self.in_process = in_process
+        os.makedirs(results_dir, exist_ok=True)
+
+    def run(self, name: str, spec: Dict) -> TrialResult:
+        spec_path = os.path.join(self.results_dir, f"{name}.spec.json")
+        with open(spec_path, "w") as f:
+            json.dump(spec, f, indent=2, default=str)
+        raw = (self._run_in_process(spec) if self.in_process
+               else self._run_subprocess(name, spec_path))
+        result = TrialResult(
+            name=name,
+            ok=bool(raw.get("ok")),
+            tokens_per_sec=float(raw.get("tokens_per_sec", 0.0)),
+            step_ms=float(raw.get("step_ms", 0.0)),
+            error=raw.get("error"))
+        with open(os.path.join(self.results_dir, f"{name}.result.json"),
+                  "w") as f:
+            json.dump(result.to_json(), f, indent=2)
+        return result
+
+    def _run_in_process(self, spec) -> Dict:
+        from deepspeed_tpu.autotuning._trial import run_trial
+
+        want = spec.get("platform")
+        if want:
+            import jax
+
+            have = jax.devices()[0].platform
+            if have != want:
+                # the backend is already initialized; platform can only be
+                # forced in a fresh process (the subprocess path)
+                logger.warning(
+                    f"in_process trial wants platform={want!r} but the live "
+                    f"backend is {have!r}; measuring on {have!r} — use "
+                    "in_process=False for platform isolation")
+        try:
+            return run_trial(spec)
+        except Exception as e:  # noqa: BLE001 — record, keep searching
+            return {"ok": False, "error": repr(e)[:4000]}
+
+    def _run_subprocess(self, name: str, spec_path: str) -> Dict:
+        env = dict(os.environ)
+        # the trial must import this very package, wherever it lives
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "deepspeed_tpu.autotuning._trial",
+                 spec_path],
+                capture_output=True, text=True, timeout=self.timeout_s,
+                env=env)
+        except subprocess.TimeoutExpired:
+            return {"ok": False, "error": f"timeout after {self.timeout_s}s"}
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        logger.warning(f"trial {name}: no JSON result "
+                       f"(rc={proc.returncode}): {proc.stderr[-500:]}")
+        return {"ok": False,
+                "error": f"rc={proc.returncode}: {proc.stderr[-2000:]}"}

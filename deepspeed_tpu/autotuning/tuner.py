@@ -1,0 +1,83 @@
+"""Tuner strategies: the order in which candidates are measured.
+
+Reference: deepspeed/autotuning/tuner/{base_tuner.py:15,
+index_based_tuner.py:10, model_based_tuner.py:23}. GridSearch and Random
+match the reference's index-based tuners; ModelBased replaces the XGBoost
+cost model with the analytic TPU roofline (cost_model.py), recalibrated
+against each measured trial.
+"""
+
+import random
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.autotuning.cost_model import ChipSpec, predict_throughput
+from deepspeed_tpu.autotuning.space import Candidate, ModelProfile
+
+
+class BaseTuner:
+    def __init__(self, space: List[Candidate], profile: ModelProfile,
+                 chip: Optional[ChipSpec] = None):
+        self.space = list(space)
+        self.profile = profile
+        self.chip = chip or ChipSpec.detect()
+        self.results: Dict[Candidate, float] = {}
+
+    def order(self) -> List[Candidate]:
+        raise NotImplementedError
+
+    def record(self, cand: Candidate, throughput: Optional[float]):
+        """Feed back a measurement (None = infeasible/OOM)."""
+        self.results[cand] = throughput
+
+
+class GridSearchTuner(BaseTuner):
+    """Exhaustive, deterministic order: small micro-batches first (they
+    compile fastest and establish a floor)."""
+
+    def order(self):
+        return sorted(self.space, key=lambda c: (
+            c.micro_batch, c.zero_stage, c.remat_policy))
+
+
+class RandomTuner(BaseTuner):
+    def __init__(self, space, profile, chip=None, seed: int = 0):
+        super().__init__(space, profile, chip)
+        self.seed = seed
+
+    def order(self):
+        rng = random.Random(self.seed)
+        out = list(self.space)
+        rng.shuffle(out)
+        return out
+
+
+class ModelBasedTuner(BaseTuner):
+    """Measure in descending predicted-throughput order.
+
+    ``calibration()`` tracks mean(measured/predicted) over completed trials;
+    it does not change the ordering mid-run (the roofline's *relative*
+    ranking is what matters) but is reported so the user can judge how much
+    to trust the model's untried tail.
+    """
+
+    def order(self):
+        return sorted(
+            self.space,
+            key=lambda c: -predict_throughput(self.profile, c, self.chip))
+
+    def calibration(self) -> Optional[float]:
+        ratios = [
+            measured / predict_throughput(self.profile, c, self.chip)
+            for c, measured in self.results.items() if measured
+        ]
+        return sum(ratios) / len(ratios) if ratios else None
+
+
+def get_tuner(kind: str, space, profile, chip=None) -> BaseTuner:
+    from deepspeed_tpu.autotuning import constants as C
+
+    if kind == C.AUTOTUNING_TUNER_GRIDSEARCH:
+        return GridSearchTuner(space, profile, chip)
+    if kind == C.AUTOTUNING_TUNER_RANDOM:
+        return RandomTuner(space, profile, chip)
+    return ModelBasedTuner(space, profile, chip)

@@ -1,0 +1,165 @@
+"""HF checkpoint ingestion (reference ``runtime/state_dict_factory.py``:
+``SDLoaderFactory``:20, ``MegatronSDLoader`` QKV merge/split:214,282,328;
+per-arch maps mirror ``module_inject/replace_policy.py``:174-712)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.state_dict_factory import (
+    BloomWeightMap, GPT2WeightMap, OPTWeightMap, SDLoaderFactory,
+    deinterleave_bloom_qkv, detect_arch, load_hf_gpt2, merge_qkv,
+    merge_qkv_tp_shards, shard_qkv_for_tp, split_qkv)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _tiny_hf_gpt2():
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=32, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    return transformers.GPT2LMHeadModel(cfg).eval(), cfg
+
+
+class TestQKVUtils:
+    def test_merge_split_roundtrip(self):
+        rng = np.random.default_rng(0)
+        q, k, v = (rng.normal(size=(8, 8)).astype(np.float32)
+                   for _ in range(3))
+        fused = merge_qkv(q, k, v)
+        assert fused.shape == (8, 24)
+        q2, k2, v2 = split_qkv(fused)
+        np.testing.assert_array_equal(q, q2)
+        np.testing.assert_array_equal(v, v2)
+
+    def test_tp_shard_roundtrip(self):
+        rng = np.random.default_rng(0)
+        fused = rng.normal(size=(16, 48)).astype(np.float32)
+        shards = [shard_qkv_for_tp(fused, 4, r) for r in range(4)]
+        assert all(s.shape == (16, 12) for s in shards)
+        np.testing.assert_array_equal(merge_qkv_tp_shards(shards), fused)
+
+    def test_tp_shard_keeps_qkv_alignment(self):
+        """Each rank's shard must contain its heads of q AND k AND v — a
+        naive split of the raw concat would give rank 0 only q columns."""
+        c, tp = 8, 2
+        q = np.full((4, c), 1.0)
+        k = np.full((4, c), 2.0)
+        v = np.full((4, c), 3.0)
+        shard0 = shard_qkv_for_tp(merge_qkv(q, k, v), tp, 0)
+        # [q_half, k_half, v_half]
+        np.testing.assert_array_equal(
+            shard0, np.concatenate([np.full((4, 4), x) for x in (1., 2., 3.)],
+                                   axis=-1))
+
+    def test_bloom_deinterleave(self):
+        n_head, hd = 2, 3
+        c = n_head * hd
+        # out dim interleaved per head: h0q h0k h0v h1q h1k h1v
+        cols = []
+        for h in range(n_head):
+            for which in range(3):
+                cols.append(np.full((4, hd), 10 * which + h, np.float32))
+        w = np.concatenate(cols, axis=-1)  # [4, 3C]
+        out = deinterleave_bloom_qkv(w, n_head)
+        expect = np.concatenate(
+            [np.full((4, hd), 10 * which + h, np.float32)
+             for which in range(3) for h in range(n_head)], axis=-1)
+        np.testing.assert_array_equal(out, expect)
+
+
+class TestLoaders:
+    def test_load_from_torch_state_dict(self):
+        model, _ = _tiny_hf_gpt2()
+        sd = SDLoaderFactory.load(model.state_dict())
+        assert isinstance(sd["transformer.wte.weight"], np.ndarray)
+        assert detect_arch(sd) == "gpt2"
+
+    def test_load_npz_roundtrip(self, tmp_path):
+        arrs = {"a.b": np.arange(6.0).reshape(2, 3)}
+        np.savez(tmp_path / "weights.npz", **arrs)
+        sd = SDLoaderFactory.load(str(tmp_path / "weights.npz"))
+        np.testing.assert_array_equal(sd["a.b"], arrs["a.b"])
+
+    def test_opt_map_merges_qkv(self):
+        c = 8
+        rng = np.random.default_rng(0)
+        sd = {}
+        for n in "qkv":
+            sd[f"model.decoder.layers.0.self_attn.{n}_proj.weight"] = (
+                rng.normal(size=(c, c)).astype(np.float32))
+            sd[f"model.decoder.layers.0.self_attn.{n}_proj.bias"] = (
+                rng.normal(size=(c,)).astype(np.float32))
+        lw = OPTWeightMap().layer_weights(sd, 0)
+        assert lw["c_attn.kernel"].shape == (c, 3 * c)
+        np.testing.assert_allclose(
+            lw["c_attn.kernel"][:, :c],
+            sd["model.decoder.layers.0.self_attn.q_proj.weight"].T)
+        assert detect_arch(sd) == "opt"
+
+    def test_bloom_map_deinterleaves(self):
+        n_head, hd = 2, 4
+        c = n_head * hd
+        rng = np.random.default_rng(0)
+        sd = {"transformer.h.0.self_attention.query_key_value.weight":
+              rng.normal(size=(3 * c, c)).astype(np.float32)}
+        lw = BloomWeightMap(n_head=n_head).layer_weights(sd, 0)
+        assert lw["c_attn.kernel"].shape == (c, 3 * c)
+        assert detect_arch(sd) == "bloom"
+
+
+class TestHFGPT2EndToEnd:
+    def test_logits_match_hf(self):
+        """The VERDICT r1 #8 acceptance: our model on converted HF weights
+        reproduces HF logits (fp32, CPU)."""
+        import jax
+
+        hf, cfg = _tiny_hf_gpt2()
+        config, params = load_hf_gpt2(hf.state_dict(), scan_layers=True,
+                                      n_head=cfg.n_head)
+        assert config.n_layer == 2 and config.n_head == 4
+
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+
+        model = GPT2LMHeadModel(config)
+        ids = np.array([[3, 17, 42, 99, 7, 23, 56, 1]], np.int32)
+        ours = np.asarray(model.apply({"params": params}, ids))
+        with torch.no_grad():
+            theirs = hf(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-4)
+
+    @pytest.mark.parametrize("scan_layers", [True, False])
+    def test_loop_and_scan_layouts_agree(self, scan_layers):
+        hf, cfg = _tiny_hf_gpt2()
+        config, params = load_hf_gpt2(hf.state_dict(),
+                                      scan_layers=scan_layers,
+                                      n_head=cfg.n_head)
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+
+        model = GPT2LMHeadModel(config)
+        ids = np.array([[1, 2, 3, 4]], np.int32)
+        out = np.asarray(model.apply({"params": params}, ids))
+        assert np.isfinite(out).all()
+
+    def test_init_inference_on_hf_weights(self):
+        """HF weights flow through init_inference + generate."""
+        import deepspeed_tpu
+        from deepspeed_tpu.parallel.topology import reset_topology
+
+        reset_topology()
+        try:
+            hf, cfg = _tiny_hf_gpt2()
+            config, params = load_hf_gpt2(hf.state_dict(),
+                                          n_head=cfg.n_head)
+            import jax.numpy as jnp
+
+            engine = deepspeed_tpu.init_inference(
+                __import__("deepspeed_tpu.models.gpt2",
+                           fromlist=["GPT2LMHeadModel"]).GPT2LMHeadModel(config),
+                params=params, dtype=jnp.float32, tensor_parallel={"tp_size": 1})
+            ids = np.array([[5, 9, 2]], np.int32)
+            out = engine.generate(ids, max_new_tokens=4, do_sample=False)
+            assert out.shape == (1, 7)
+        finally:
+            reset_topology()

@@ -53,6 +53,17 @@ class SparseSelfAttention:
                 cfg.expand_mask(layout, seq_len))  # [H, S, S] bool
         return self._mask_cache[seq_len]
 
+    def _use_kernel(self, rpe, key_padding_mask, attn_mask) -> bool:
+        """The Pallas block-sparse kernel serves the pure-layout case (the
+        reference Triton path's domain); rpe / runtime masks fall back to
+        the dense-masked reference."""
+        if rpe is not None or key_padding_mask is not None \
+                or attn_mask is not None:
+            return False
+        from deepspeed_tpu.ops.attention import _on_tpu
+
+        return _on_tpu() and self.sparsity_config.block >= 128
+
     def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
                  attn_mask=None):
         B, H, S, D = query.shape
@@ -64,6 +75,12 @@ class SparseSelfAttention:
                 f"seq len {S} must be divisible by block "
                 f"{self.sparsity_config.block} (use "
                 f"SparseAttentionUtils.pad_to_block_size)")
+        if self._use_kernel(rpe, key_padding_mask, attn_mask):
+            from deepspeed_tpu.ops.sparse_attention.block_sparse_kernel import (
+                block_sparse_attention)
+
+            layout = self.sparsity_config.make_layout(S)
+            return block_sparse_attention(query, key, value, layout)
         mask = self._layout_mask(S)[None]  # [1, H, S, S]
         if attn_mask is not None:
             am = jnp.asarray(attn_mask)

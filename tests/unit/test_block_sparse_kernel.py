@@ -1,0 +1,151 @@
+"""Block-sparse Pallas kernel vs the dense-masked reference (reference
+Triton kernels: ops/sparse_attention/matmul.py:212, softmax.py:142).
+Interpret mode on the CPU mesh, like the flash-attention tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.ops.attention import attention_reference
+from deepspeed_tpu.ops.sparse_attention.block_sparse_kernel import (
+    block_sparse_attention, flatten_layout)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig, FixedSparsityConfig)
+
+B, H, S, D = 1, 2, 256, 32
+BLOCK = 64
+NB = S // BLOCK
+
+
+def _qkv(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (B, H, S, D), jnp.float32) * 0.3
+                 for k in ks)
+
+
+def _expand(layout):
+    """Block layout → token mask [H, S, S]."""
+    return np.repeat(np.repeat(layout, BLOCK, axis=1), BLOCK, axis=2)
+
+
+def _rand_layout(seed=0, density=0.4):
+    rng = np.random.default_rng(seed)
+    layout = rng.random((H, NB, NB)) < density
+    for i in range(NB):
+        layout[:, i, i] = True  # diagonal always on
+    return layout
+
+
+class TestFlattening:
+    def test_entries_cover_layout(self):
+        layout = _rand_layout()
+        qrow, kcol, cnt = flatten_layout(layout)
+        for h in range(H):
+            entries = set(zip(qrow[h, :cnt[h]], kcol[h, :cnt[h]]))
+            expect = set(zip(*np.nonzero(layout[h])))
+            assert entries == expect
+
+    def test_padding_repeats_last_entry(self):
+        layout = np.zeros((2, 2, 2), bool)
+        layout[0] = True              # head 0: 4 entries
+        layout[1, 0, 1] = True        # head 1: 2 entries (one per row)
+        layout[1, 1, 0] = True
+        qrow, kcol, cnt = flatten_layout(layout)
+        assert cnt.tolist() == [4, 2]
+        assert qrow.shape == (2, 4)
+        # head 1 tail repeats its last real entry
+        assert (qrow[1, 2:] == qrow[1, 1]).all()
+        assert (kcol[1, 2:] == kcol[1, 1]).all()
+
+    def test_empty_row_rejected(self):
+        layout = np.zeros((1, 2, 2), bool)
+        layout[0, 1, 0] = True
+        q = jnp.zeros((1, 1, 128, 32), jnp.float32)
+        with pytest.raises(ValueError, match="at least one active block"):
+            block_sparse_attention(q, q, q, layout)
+
+
+class TestForwardParity:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_matches_dense_masked(self, seed):
+        q, k, v = _qkv(seed)
+        layout = _rand_layout(seed)
+        with pltpu.force_tpu_interpret_mode():
+            o = block_sparse_attention(q, k, v, layout)
+        mask = jnp.asarray(_expand(layout))[None]  # [1, H, S, S]
+        ref = attention_reference(q, k, v, mask=mask, causal=False)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_bigbird_layout(self):
+        q, k, v = _qkv(2)
+        cfg = BigBirdSparsityConfig(num_heads=H, block=BLOCK,
+                                    num_random_blocks=1,
+                                    num_sliding_window_blocks=3,
+                                    num_global_blocks=1)
+        layout = np.asarray(cfg.make_layout(S), bool)
+        with pltpu.force_tpu_interpret_mode():
+            o = block_sparse_attention(q, k, v, layout)
+        mask = jnp.asarray(_expand(layout))[None]
+        ref = attention_reference(q, k, v, mask=mask, causal=False)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_fixed_layout_per_head(self):
+        q, k, v = _qkv(3)
+        cfg = FixedSparsityConfig(num_heads=H, block=BLOCK,
+                                  num_local_blocks=2, num_global_blocks=1,
+                                  different_layout_per_head=True,
+                                  num_different_global_patterns=2)
+        layout = np.asarray(cfg.make_layout(S), bool)
+        with pltpu.force_tpu_interpret_mode():
+            o = block_sparse_attention(q, k, v, layout)
+        mask = jnp.asarray(_expand(layout))[None]
+        ref = attention_reference(q, k, v, mask=mask, causal=False)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+class TestBackwardParity:
+    def test_grads_match_dense_masked(self):
+        q, k, v = _qkv(4)
+        layout = _rand_layout(4, density=0.5)
+        mask = jnp.asarray(_expand(layout))[None]
+
+        def loss_sparse(q, k, v):
+            return jnp.sum(block_sparse_attention(q, k, v, layout) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                attention_reference(q, k, v, mask=mask, causal=False) ** 2)
+
+        with pltpu.force_tpu_interpret_mode():
+            gs = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gs, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=5e-5,
+                                       err_msg=f"d{name}")
+
+
+class TestValidation:
+    def test_rejects_bad_layout_shape(self):
+        q, k, v = _qkv()
+        with pytest.raises(ValueError, match="layout"):
+            block_sparse_attention(q, k, v, np.ones((H + 1, NB, NB), bool))
+
+    def test_rejects_non_divisible(self):
+        q, k, v = _qkv()
+        with pytest.raises(ValueError, match="incompatible"):
+            block_sparse_attention(q, k, v, np.ones((H, 3, 3), bool))
+
+    def test_rejects_empty_column(self):
+        """An unattended k-block would leave its dk/dv blocks unwritten
+        (garbage, not zeros) — must be rejected up front."""
+        q, k, v = _qkv()
+        layout = np.zeros((H, NB, NB), bool)
+        layout[:, :, 0] = True  # every row attends block 0; cols 1.. empty
+        with pytest.raises(ValueError, match="k_block"):
+            block_sparse_attention(q, k, v, layout)

@@ -1,0 +1,13 @@
+"""Data-efficiency data layer (reference
+``runtime/data_pipeline/data_sampling/``): mmap indexed datasets,
+curriculum-aware sampling, offline metric analysis."""
+
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+    DataAnalyzer)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_sampler import (
+    DeepSpeedDataSampler)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder)
+
+__all__ = ["DataAnalyzer", "DeepSpeedDataSampler", "MMapIndexedDataset",
+           "MMapIndexedDatasetBuilder"]

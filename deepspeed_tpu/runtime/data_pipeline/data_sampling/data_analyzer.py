@@ -1,0 +1,89 @@
+"""Offline per-sample metric analysis (reference ``DataAnalyzer``,
+``runtime/data_pipeline/data_sampling/data_analyzer.py:1`` — 527 LoC).
+
+Computes per-sample difficulty metrics over an indexable dataset (e.g. an
+:class:`MMapIndexedDataset`) and saves them as plain ``.npy`` maps that
+:class:`DeepSpeedDataSampler` consumes for curriculum eligibility. The
+reference shards this over ranks and writes cluster files; one TPU host
+analyzing with vectorized numpy covers the same corpora without the
+machinery — metrics are one int/float per sample.
+
+Built-in metrics: ``seqlen`` (token count) and ``vocab_rarity``
+(mean -log frequency of the sample's tokens, reference data-efficiency
+paper's metric). Custom metrics are ``name -> fn(sample) -> scalar``.
+"""
+
+import os
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def metric_seqlen(sample) -> float:
+    return float(len(sample))
+
+
+class DataAnalyzer:
+    def __init__(self, dataset,
+                 metric_names=("seqlen",),
+                 custom_metrics: Optional[Dict[str, Callable]] = None,
+                 save_path: Optional[str] = None):
+        self.dataset = dataset
+        self.save_path = save_path
+        self.metrics: Dict[str, Callable] = {}
+        custom = custom_metrics or {}
+        for name in metric_names:
+            if name == "seqlen":
+                self.metrics[name] = metric_seqlen
+            elif name == "vocab_rarity":
+                self.metrics[name] = None  # two-pass, handled in run()
+            elif name in custom:
+                self.metrics[name] = custom[name]
+            else:
+                raise ValueError(f"unknown metric {name!r}")
+        for name, fn in custom.items():
+            self.metrics.setdefault(name, fn)
+
+    def run(self) -> Dict[str, np.ndarray]:
+        n = len(self.dataset)
+        out: Dict[str, np.ndarray] = {}
+        needs_rarity = any(fn is None for fn in self.metrics.values())
+        counts = None
+        if needs_rarity:
+            counts = {}
+            for i in range(n):
+                tok, c = np.unique(np.asarray(self.dataset[i]),
+                                   return_counts=True)
+                for t, cc in zip(tok.tolist(), c.tolist()):
+                    counts[t] = counts.get(t, 0) + cc
+            total = max(1, sum(counts.values()))
+            logf = {t: -np.log(c / total) for t, c in counts.items()}
+        for name, fn in self.metrics.items():
+            vals = np.zeros(n, np.float64)
+            for i in range(n):
+                sample = np.asarray(self.dataset[i])
+                if fn is None:  # vocab_rarity
+                    vals[i] = float(np.mean([logf[int(t)] for t in sample]))
+                else:
+                    vals[i] = float(fn(sample))
+            out[name] = vals
+        if self.save_path:
+            os.makedirs(self.save_path, exist_ok=True)
+            for name, vals in out.items():
+                np.save(os.path.join(self.save_path,
+                                     f"index_to_metric_{name}.npy"), vals)
+            logger.info(f"DataAnalyzer: wrote {len(out)} metric map(s) "
+                        f"to {self.save_path}")
+        return out
+
+    @staticmethod
+    def load(save_path: str) -> Dict[str, np.ndarray]:
+        out = {}
+        prefix = "index_to_metric_"
+        for fname in sorted(os.listdir(save_path)):
+            if fname.startswith(prefix) and fname.endswith(".npy"):
+                out[fname[len(prefix):-4]] = np.load(
+                    os.path.join(save_path, fname))
+        return out

@@ -1,0 +1,126 @@
+"""Curriculum-aware data sampler (reference ``DeepSpeedDataSampler``,
+``runtime/data_pipeline/data_sampling/data_sampler.py:32``).
+
+Yields per-step *global-batch* index lists (micro_batch × dp_world × gas
+samples — one engine step's worth; single-controller TPU needs no per-rank
+sub-sampling). With curriculum learning enabled, each metric's
+``CurriculumScheduler`` gates which samples are eligible: a sample is drawn
+only when every metric's difficulty value is within the current threshold
+(the reference's cluster-file machinery collapses to in-memory boolean
+eligibility over the DataAnalyzer's ``index_to_metric`` maps).
+
+``state_dict``/``load_state_dict`` resume mid-epoch, like the reference.
+"""
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler)
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedDataSampler:
+    def __init__(self,
+                 data_efficiency_config: Dict,
+                 one_epoch_total_samples: int,
+                 micro_batch_size: int,
+                 data_parallel_size: int,
+                 gradient_accumulation_steps: int = 1,
+                 metric_values: Optional[Dict[str, np.ndarray]] = None,
+                 drop_last: bool = True):
+        ds_cfg = (data_efficiency_config or {}).get("data_sampling", {})
+        self.num_epochs = int(ds_cfg.get("num_epochs", 1))
+        self.seed = int((data_efficiency_config or {}).get("seed", 1234))
+        self.one_epoch_total_samples = int(one_epoch_total_samples)
+        self.total_samples = self.num_epochs * self.one_epoch_total_samples
+        self.global_batch_size = (micro_batch_size * data_parallel_size
+                                  * gradient_accumulation_steps)
+        self.drop_last = drop_last
+        self.np_rng = np.random.default_rng(self.seed)
+        self.consumed_samples = 0
+
+        # --- curriculum metrics ---
+        self.curriculum_schedulers: Dict[str, CurriculumScheduler] = {}
+        self.metric_values: Dict[str, np.ndarray] = dict(metric_values or {})
+        cl_cfg = ds_cfg.get("curriculum_learning", {})
+        self.curriculum_enabled = bool(cl_cfg.get("enabled", False))
+        if self.curriculum_enabled:
+            metrics = cl_cfg.get("curriculum_metrics", {})
+            if not metrics:
+                raise ValueError(
+                    "curriculum_learning.enabled needs curriculum_metrics")
+            for name, mcfg in metrics.items():
+                if name not in self.metric_values:
+                    raise ValueError(
+                        f"curriculum metric {name!r} has no metric_values "
+                        "array (run the DataAnalyzer first)")
+                if len(self.metric_values[name]) != one_epoch_total_samples:
+                    raise ValueError(
+                        f"metric {name!r} covers "
+                        f"{len(self.metric_values[name])} samples, dataset "
+                        f"has {one_epoch_total_samples}")
+                self.curriculum_schedulers[name] = CurriculumScheduler(mcfg)
+        self.curriculum_step = 0
+
+    # ------------------------------------------------------------------
+    def _eligible_indices(self) -> np.ndarray:
+        ok = np.ones(self.one_epoch_total_samples, bool)
+        for name, sched in self.curriculum_schedulers.items():
+            vals = self.metric_values[name]
+            # clamp the threshold to each metric's easiest sample so a
+            # too-low starting difficulty never empties the pool
+            thr = max(sched.get_current_difficulty(), float(vals.min()))
+            ok &= vals <= thr
+        if not ok.any():
+            logger.warning("curriculum eligibility empty (conflicting "
+                           "metrics); admitting all samples this step")
+            ok[:] = True
+        return np.nonzero(ok)[0]
+
+    def get_next_batch(self) -> np.ndarray:
+        """Indices for one engine step (global batch)."""
+        if self.curriculum_enabled:
+            self.curriculum_step += 1
+            for sched in self.curriculum_schedulers.values():
+                sched.update_difficulty(self.curriculum_step)
+            pool = self._eligible_indices()
+        else:
+            pool = None
+        if pool is None:
+            batch = self.np_rng.integers(
+                0, self.one_epoch_total_samples,
+                self.global_batch_size).astype(np.int64)
+        else:
+            batch = self.np_rng.choice(
+                pool, size=self.global_batch_size,
+                replace=len(pool) < self.global_batch_size)
+        self.consumed_samples += self.global_batch_size
+        return batch
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while self.consumed_samples < self.total_samples:
+            yield self.get_next_batch()
+
+    def __len__(self) -> int:
+        return self.total_samples // self.global_batch_size
+
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict:
+        return {
+            "consumed_samples": self.consumed_samples,
+            "curriculum_step": self.curriculum_step,
+            "rng_state": self.np_rng.bit_generator.state,
+        }
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self.consumed_samples = int(sd["consumed_samples"])
+        self.curriculum_step = int(sd["curriculum_step"])
+        self.np_rng.bit_generator.state = sd["rng_state"]
+        for sched in self.curriculum_schedulers.values():
+            sched.update_difficulty(self.curriculum_step)
+
+    def current_difficulties(self) -> Dict[str, int]:
+        return {n: s.get_current_difficulty()
+                for n, s in self.curriculum_schedulers.items()}

@@ -1,0 +1,141 @@
+"""Memory-mapped indexed token dataset (reference
+``runtime/data_pipeline/data_sampling/indexed_dataset.py`` — 645 LoC,
+Megatron ``MMapIndexedDataset``).
+
+On-disk format is the standard Megatron "MMIDIDX" layout so corpora
+prepared by Megatron/DeepSpeed preprocessing tools load directly:
+
+``<path>.idx``: magic ``MMIDIDX\\x00`` | u64 version=1 | u8 dtype-code |
+u64 n_sequences | u64 n_docs | i32 sizes[n] | i64 pointers[n] |
+i64 doc_idx[n_docs]
+``<path>.bin``: the token arrays, concatenated.
+
+Reads are ``np.memmap`` views — no copies, no RAM proportional to corpus
+size. One process feeds the whole TPU mesh (single-controller), so there
+is no per-rank file sharding here; the sampler (data_sampler.py) hands out
+global batches.
+"""
+
+import os
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+_MAGIC = b"MMIDIDX\x00"
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer (reference ``MMapIndexedDatasetBuilder``)."""
+
+    def __init__(self, out_prefix: str, dtype=np.int32):
+        self._prefix = out_prefix
+        self._dtype = np.dtype(dtype)
+        if self._dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        self._bin = open(data_file_path(out_prefix), "wb")
+        self._sizes: List[int] = []
+        self._doc_idx: List[int] = [0]
+
+    def add_item(self, tokens) -> None:
+        arr = np.asarray(tokens, dtype=self._dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def end_document(self) -> None:
+        self._doc_idx.append(len(self._sizes))
+
+    def finalize(self) -> None:
+        self._bin.close()
+        if self._doc_idx[-1] != len(self._sizes):
+            self._doc_idx.append(len(self._sizes))
+        sizes = np.asarray(self._sizes, np.int32)
+        itemsize = self._dtype.itemsize
+        pointers = np.zeros(len(sizes), np.int64)
+        np.cumsum(sizes[:-1] * itemsize, out=pointers[1:])
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", _DTYPE_CODES[self._dtype]))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(self._doc_idx)))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(np.asarray(self._doc_idx, np.int64).tobytes(order="C"))
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader. ``ds[i]`` → 1-D numpy view of sequence i."""
+
+    def __init__(self, prefix: str):
+        idx_path = index_file_path(prefix)
+        with open(idx_path, "rb") as f:
+            if f.read(8) != _MAGIC:
+                raise ValueError(f"{idx_path}: not an MMIDIDX index")
+            (version,) = struct.unpack("<Q", f.read(8))
+            if version != 1:
+                raise ValueError(f"{idx_path}: unsupported version {version}")
+            (code,) = struct.unpack("<B", f.read(1))
+            self._dtype = np.dtype(_DTYPES[code])
+            (n,) = struct.unpack("<Q", f.read(8))
+            (n_docs,) = struct.unpack("<Q", f.read(8))
+            offset = f.tell()
+        idx_buf = np.memmap(idx_path, mode="r", order="C")
+        self._sizes = np.frombuffer(idx_buf, np.int32, count=n,
+                                    offset=offset)
+        offset += n * 4
+        self._pointers = np.frombuffer(idx_buf, np.int64, count=n,
+                                       offset=offset)
+        offset += n * 8
+        self._doc_idx = np.frombuffer(idx_buf, np.int64, count=n_docs,
+                                      offset=offset)
+        self._bin = np.memmap(data_file_path(prefix), mode="r", order="C")
+        self._prefix = prefix
+
+    def __len__(self) -> int:
+        return len(self._sizes)
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return self._sizes
+
+    @property
+    def doc_idx(self) -> np.ndarray:
+        return self._doc_idx
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def __getitem__(self, i):
+        if isinstance(i, (slice, list, np.ndarray)):
+            idxs = (range(*i.indices(len(self))) if isinstance(i, slice)
+                    else i)
+            return [self[int(j)] for j in idxs]
+        if i < 0:
+            i += len(self)
+        ptr, size = int(self._pointers[i]), int(self._sizes[i])
+        return np.frombuffer(self._bin, self._dtype, count=size, offset=ptr)
+
+    def get(self, i: int, offset: int = 0, length: Optional[int] = None):
+        """Partial read of sequence i (reference ``get``)."""
+        seq = self[i]
+        length = length if length is not None else len(seq) - offset
+        return seq[offset:offset + length]
+
+    @staticmethod
+    def exists(prefix: str) -> bool:
+        return (os.path.exists(index_file_path(prefix))
+                and os.path.exists(data_file_path(prefix)))

@@ -13,6 +13,16 @@ from typing import Any, Callable, Iterable, Optional
 import numpy as np
 
 
+def dataset_len(dataset) -> int:
+    """Sample count of a dataset in any accepted shape: tuple → columns of
+    arrays, dict → column mapping, else ``len`` (samples)."""
+    if isinstance(dataset, tuple):
+        return len(dataset[0])
+    if isinstance(dataset, dict):
+        return len(next(iter(dataset.values())))
+    return len(dataset)
+
+
 class RepeatingLoader:
     """Wrap an iterator to restart on StopIteration (reference ``:16``)."""
 
@@ -63,12 +73,7 @@ class DeepSpeedDataLoader:
         self._len = self._num_batches()
 
     def _dataset_len(self) -> int:
-        # tuple → columns of arrays; list → list of samples (torch-style)
-        if isinstance(self.dataset, tuple):
-            return len(self.dataset[0])
-        if isinstance(self.dataset, dict):
-            return len(next(iter(self.dataset.values())))
-        return len(self.dataset)
+        return dataset_len(self.dataset)
 
     def _num_batches(self) -> int:
         n = self._dataset_len()
@@ -77,6 +82,13 @@ class DeepSpeedDataLoader:
         return (n + self.batch_size - 1) // self.batch_size
 
     def __len__(self):
+        if self.data_sampler is not None and hasattr(self.data_sampler,
+                                                     "global_batch_size"):
+            # sampler drives the schedule: len(sampler) global batches of
+            # global_batch_size samples, rebatched to this loader's size
+            total = len(self.data_sampler) * self.data_sampler.global_batch_size
+            return (total // self.batch_size if self.drop_last
+                    else -(-total // self.batch_size))
         return self._len
 
     def _index(self, idx):
@@ -88,18 +100,56 @@ class DeepSpeedDataLoader:
         return d[idx]
 
     def _samplewise(self) -> bool:
-        """True when the dataset yields one sample per __getitem__ (lists and
-        generic map-style datasets) rather than supporting fancy indexing."""
+        """True when the dataset yields one sample per __getitem__ (lists,
+        MMapIndexedDataset, generic map-style datasets) rather than
+        supporting fancy array indexing. Array-likes have BOTH dtype and
+        shape; an indexed dataset exposes dtype alone."""
         return isinstance(self.dataset, list) or not (
             isinstance(self.dataset, (np.ndarray, tuple, dict))
-            or hasattr(self.dataset, "dtype"))
+            or (hasattr(self.dataset, "dtype")
+                and hasattr(self.dataset, "shape")))
+
+    def _yield_batch(self, idx):
+        if self._samplewise():
+            samples = [self.dataset[int(i)] for i in idx]
+            if self.collate_fn is not None:
+                return self.collate_fn(samples)
+            return _default_collate(samples)
+        return self._index(idx)
 
     def __iter__(self):
+        if self.data_sampler is not None:
+            # sampler drives the index stream; it may yield single indices
+            # or whole index arrays (DeepSpeedDataSampler yields one global
+            # batch per engine step) — rebatch to the loader's batch_size.
+            # NOTE: a stateful sampler (consumed_samples) spans its whole
+            # num_epochs budget across __iter__ calls and is single-pass;
+            # iterating past exhaustion yields nothing, loudly:
+            if (hasattr(self.data_sampler, "consumed_samples")
+                    and hasattr(self.data_sampler, "total_samples")
+                    and self.data_sampler.consumed_samples
+                    >= self.data_sampler.total_samples):
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.warning(
+                    "data sampler exhausted (consumed "
+                    f"{self.data_sampler.consumed_samples}/"
+                    f"{self.data_sampler.total_samples} samples); this "
+                    "iteration yields no batches — raise "
+                    "data_sampling.num_epochs or rebuild the sampler")
+            buf = np.empty((0,), np.int64)
+            for chunk in iter(self.data_sampler):
+                buf = np.concatenate(
+                    [buf, np.atleast_1d(np.asarray(chunk, np.int64))])
+                while len(buf) >= self.batch_size:
+                    idx, buf = buf[:self.batch_size], buf[self.batch_size:]
+                    yield self._yield_batch(idx)
+            if len(buf) and not self.drop_last:
+                yield self._yield_batch(buf)
+            return
         n = self._dataset_len()
         order = np.arange(n)
-        if self.data_sampler is not None:
-            order = np.fromiter(iter(self.data_sampler), dtype=np.int64)
-        elif self.shuffle:
+        if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
             rng.shuffle(order)
         self.epoch += 1
@@ -108,14 +158,7 @@ class DeepSpeedDataLoader:
             idx = order[b * self.batch_size:(b + 1) * self.batch_size]
             if len(idx) < self.batch_size and self.drop_last:
                 return
-            if self._samplewise():
-                samples = [self.dataset[int(i)] for i in idx]
-                if self.collate_fn is not None:
-                    yield self.collate_fn(samples)
-                else:
-                    yield _default_collate(samples)
-            else:
-                yield self._index(idx)
+            yield self._yield_batch(idx)
 
 
 def _default_collate(samples):

@@ -1074,14 +1074,52 @@ class DeepSpeedEngine:
     def deepspeed_io(self, dataset, batch_size=None, route=None, pin_memory=True,
                      data_sampler=None, collate_fn=None, num_local_io_workers=None):
         """Build a loader of *global* micro-batches (reference ``deepspeed_io``,
-        ``engine.py:1670``): micro_batch x dp_world samples per step."""
+        ``engine.py:1670``): micro_batch x dp_world samples per step.
+
+        With ``data_efficiency.data_sampling`` enabled in the config and no
+        explicit sampler, a curriculum-aware :class:`DeepSpeedDataSampler`
+        is built automatically (reference wires the sampler the same way,
+        ``engine.py:1670`` region).
+        """
         bs = batch_size or (self.train_micro_batch_size_per_gpu()
                             * self.topology.get_data_parallel_world_size())
+        if data_sampler is None:
+            data_sampler = self._maybe_build_data_sampler(dataset)
         return DeepSpeedDataLoader(
             dataset, batch_size=bs,
             collate_fn=collate_fn or self.collate_fn,
             data_sampler=data_sampler,
             dataloader_drop_last=self._config.dataloader_drop_last)
+
+    def _maybe_build_data_sampler(self, dataset):
+        de_cfg = self._config.data_efficiency_config or {}
+        ds_cfg = de_cfg.get("data_sampling", {})
+        if not ds_cfg.get("enabled", False):
+            return None
+        import numpy as _np
+
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+            DeepSpeedDataSampler)
+        from deepspeed_tpu.runtime.dataloader import dataset_len
+
+        n = dataset_len(dataset)
+        # metric maps: per-metric "index_to_metric_path" (.npy from the
+        # DataAnalyzer); the builtin "seqlen" metric falls back to the
+        # indexed dataset's own sizes array
+        metric_values = {}
+        cl = ds_cfg.get("curriculum_learning", {})
+        for name, mcfg in (cl.get("curriculum_metrics", {}) or {}).items():
+            path = (mcfg or {}).get("index_to_metric_path")
+            if path:
+                metric_values[name] = _np.load(path)
+            elif name == "seqlen" and hasattr(dataset, "sizes"):
+                metric_values[name] = _np.asarray(dataset.sizes)
+        return DeepSpeedDataSampler(
+            de_cfg, n,
+            micro_batch_size=self.train_micro_batch_size_per_gpu(),
+            data_parallel_size=self.topology.get_data_parallel_world_size(),
+            gradient_accumulation_steps=self.gradient_accumulation_steps(),
+            metric_values=metric_values)
 
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:2706 load / :3061 save)

@@ -1,0 +1,114 @@
+// Host-side Adagrad for the optimizer-offload tier.
+//
+// TPU-native replacement for the reference csrc/adagrad/cpu_adagrad.cpp
+// (AVX-intrinsic Adagrad used by ZeRO-Offload): same capability — update
+// fp32 master params resident in host RAM with the accumulated
+// squared-gradient state — written as portable C++ whose inner loop the
+// compiler vectorizes, parallelized with OpenMP. Mirrors the C ABI shape
+// of cpu_adam.cpp (ctypes-friendly; no pybind11 in this image):
+//
+//   ds_adagrad_create(optimizer_id, alpha, eps, weight_decay)
+//   ds_adagrad_update_lr(optimizer_id, alpha)
+//   ds_adagrad_step(optimizer_id, step, n, params, grads, exp_avg_sq)
+//   ds_adagrad_step_bf16grad(...): grads as uint16 bf16 words (the wire
+//     format coming back from the chip) fused into the update.
+//   ds_adagrad_destroy(optimizer_id)
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+
+namespace {
+
+struct AdagradState {
+  float alpha;
+  float eps;
+  float weight_decay;
+};
+
+std::map<int, AdagradState> g_optimizers;
+std::mutex g_mu;
+
+inline float bf16_to_f32(uint16_t v) {
+  uint32_t bits = static_cast<uint32_t>(v) << 16;
+  float out;
+  std::memcpy(&out, &bits, sizeof(out));
+  return out;
+}
+
+template <typename GradLoader>
+void adagrad_step_impl(const AdagradState& s, int64_t n, float* p,
+                       GradLoader grad_at, float* vsq) {
+  const float alpha = s.alpha, eps = s.eps, wd = s.weight_decay;
+
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grad_at(i);
+    if (wd != 0.0f) g += wd * p[i];  // L2 into grad (reference semantics)
+    float vi = vsq[i] + g * g;
+    vsq[i] = vi;
+    p[i] -= alpha * g / (std::sqrt(vi) + eps);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int ds_adagrad_create(int optimizer_id, float alpha, float eps,
+                      float weight_decay) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_optimizers[optimizer_id] = AdagradState{alpha, eps, weight_decay};
+  return 0;
+}
+
+int ds_adagrad_update_lr(int optimizer_id, float alpha) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  auto it = g_optimizers.find(optimizer_id);
+  if (it == g_optimizers.end()) return -1;
+  it->second.alpha = alpha;
+  return 0;
+}
+
+int ds_adagrad_step(int optimizer_id, int step, int64_t n, float* params,
+                    const float* grads, float* exp_avg_sq) {
+  (void)step;  // Adagrad has no bias correction; kept for ABI symmetry
+  AdagradState s;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto it = g_optimizers.find(optimizer_id);
+    if (it == g_optimizers.end()) return -1;
+    s = it->second;
+  }
+  adagrad_step_impl(s, n, params,
+                    [grads](int64_t i) { return grads[i]; }, exp_avg_sq);
+  return 0;
+}
+
+int ds_adagrad_step_bf16grad(int optimizer_id, int step, int64_t n,
+                             float* params, const uint16_t* grads_bf16,
+                             float* exp_avg_sq) {
+  (void)step;
+  AdagradState s;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto it = g_optimizers.find(optimizer_id);
+    if (it == g_optimizers.end()) return -1;
+    s = it->second;
+  }
+  adagrad_step_impl(
+      s, n, params,
+      [grads_bf16](int64_t i) { return bf16_to_f32(grads_bf16[i]); },
+      exp_avg_sq);
+  return 0;
+}
+
+int ds_adagrad_destroy(int optimizer_id) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_optimizers.erase(optimizer_id);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,94 @@
+"""Host-offload Adagrad optimizer.
+
+Capability parity with the reference ``DeepSpeedCPUAdagrad``
+(``deepspeed/ops/adagrad/cpu_adagrad.py`` over
+``csrc/adagrad/cpu_adagrad.cpp``): fp32 master weights and the accumulated
+squared-gradient state live in host RAM; each step fuses grad-read (fp32 or
+bf16 wire format), accumulator update, and param write in a multithreaded
+vectorized C++ loop. Same wrapper surface as :class:`DeepSpeedCPUAdam`.
+"""
+
+import itertools
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import CpuAdagradBuilder
+
+_ids = itertools.count()
+
+
+class DeepSpeedCPUAdagrad:
+    def __init__(self, params=None, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0):
+        self.opt_id = next(_ids)
+        self.lr = float(lr)
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+        self._lib = CpuAdagradBuilder().load()
+        self._lib.ds_adagrad_create(self.opt_id, self.lr, self.eps,
+                                    self.weight_decay)
+        self.step_count = 0
+        self._state: Dict[str, Dict[str, np.ndarray]] = {}
+        if params is not None:
+            for name, p in params.items():
+                self.register_param(name, p)
+
+    # ------------------------------------------------------------------
+    def register_param(self, name: str, value: np.ndarray):
+        value = np.ascontiguousarray(np.asarray(value, np.float32))
+        self._state[name] = {
+            "param": value,
+            "exp_avg_sq": np.zeros_like(value),
+        }
+
+    def get_param(self, name: str) -> np.ndarray:
+        return self._state[name]["param"]
+
+    def set_lr(self, lr: float):
+        self.lr = float(lr)
+        self._lib.ds_adagrad_update_lr(self.opt_id, self.lr)
+
+    @staticmethod
+    def _ptr(arr: np.ndarray):
+        import ctypes
+
+        return arr.ctypes.data_as(ctypes.POINTER(
+            ctypes.c_uint16 if arr.dtype == np.uint16 else ctypes.c_float))
+
+    def step(self, grads: Dict[str, np.ndarray], lr: Optional[float] = None):
+        """One Adagrad step over every registered param; ``grads[name]``
+        may be fp32 or uint16 bf16 bit patterns (device wire format)."""
+        if lr is not None and lr != self.lr:
+            self.set_lr(lr)
+        self.step_count += 1
+        for name, g in grads.items():
+            st = self._state[name]
+            p = st["param"]
+            n = p.size
+            g = np.ascontiguousarray(g).reshape(-1)
+            if g.dtype == np.uint16:
+                rc = self._lib.ds_adagrad_step_bf16grad(
+                    self.opt_id, self.step_count, n, self._ptr(p.reshape(-1)),
+                    self._ptr(g), self._ptr(st["exp_avg_sq"].reshape(-1)))
+            else:
+                g = g.astype(np.float32, copy=False)
+                rc = self._lib.ds_adagrad_step(
+                    self.opt_id, self.step_count, n, self._ptr(p.reshape(-1)),
+                    self._ptr(g), self._ptr(st["exp_avg_sq"].reshape(-1)))
+            if rc != 0:
+                raise RuntimeError(f"cpu_adagrad step failed for {name!r}")
+
+    def state_dict(self):
+        return {"step": self.step_count, "lr": self.lr, "state": self._state}
+
+    def load_state_dict(self, sd):
+        self.step_count = int(sd["step"])
+        self.set_lr(float(sd["lr"]))
+        self._state = sd["state"]
+
+    def __del__(self):
+        try:
+            self._lib.ds_adagrad_destroy(self.opt_id)
+        except Exception:
+            pass

@@ -1,8 +1,9 @@
 """Native-op build system (reference ``op_builder/``)."""
 
 from deepspeed_tpu.ops.op_builder.builder import (ALL_OPS, AsyncIOBuilder,
+                                                  CpuAdagradBuilder,
                                                   CpuAdamBuilder, OpBuilder,
                                                   get_op_builder)
 
-__all__ = ["OpBuilder", "CpuAdamBuilder", "AsyncIOBuilder", "ALL_OPS",
-           "get_op_builder"]
+__all__ = ["OpBuilder", "CpuAdamBuilder", "CpuAdagradBuilder",
+           "AsyncIOBuilder", "ALL_OPS", "get_op_builder"]

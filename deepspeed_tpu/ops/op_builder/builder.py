@@ -116,6 +116,31 @@ class OpBuilder:
             return False
 
 
+class CpuAdagradBuilder(OpBuilder):
+    """Reference ``op_builder/cpu_adagrad.py`` → ``csrc/adagrad/cpu_adagrad.cpp``."""
+
+    NAME = "cpu_adagrad"
+
+    def sources(self):
+        return [os.path.join(CSRC, "adagrad", "cpu_adagrad.cpp")]
+
+    def extra_flags(self):
+        return ["-fno-math-errno", "-funroll-loops"]
+
+    def _declare(self, lib):
+        i64 = ctypes.c_int64
+        fp = ctypes.POINTER(ctypes.c_float)
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.ds_adagrad_create.argtypes = [ctypes.c_int, ctypes.c_float,
+                                          ctypes.c_float, ctypes.c_float]
+        lib.ds_adagrad_update_lr.argtypes = [ctypes.c_int, ctypes.c_float]
+        lib.ds_adagrad_step.argtypes = [ctypes.c_int, ctypes.c_int, i64, fp,
+                                        fp, fp]
+        lib.ds_adagrad_step_bf16grad.argtypes = [ctypes.c_int, ctypes.c_int,
+                                                 i64, fp, u16p, fp]
+        lib.ds_adagrad_destroy.argtypes = [ctypes.c_int]
+
+
 class CpuAdamBuilder(OpBuilder):
     """Reference ``op_builder/cpu_adam.py`` → ``csrc/adam/cpu_adam.cpp``."""
 
@@ -178,6 +203,7 @@ class AsyncIOBuilder(OpBuilder):
 
 ALL_OPS: Dict[str, type] = {
     CpuAdamBuilder.NAME: CpuAdamBuilder,
+    CpuAdagradBuilder.NAME: CpuAdagradBuilder,
     AsyncIOBuilder.NAME: AsyncIOBuilder,
 }
 

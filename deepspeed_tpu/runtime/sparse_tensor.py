@@ -1,0 +1,120 @@
+"""Row-sparse tensor + sparse gradient allreduce.
+
+Capability parity with the reference ``runtime/sparse_tensor.py:11``
+(``SparseTensor``, an IndexedSlices-style row-compressed wrapper) and the
+engine's sparse embedding-grad allreduce (``engine.py:2459-2541``): an
+embedding gradient touches at most batch×seq rows of a [vocab, d] table, so
+exchanging (row_indices, row_values) instead of the dense table cuts
+traffic by vocab/(B·T).
+
+TPU placement note: inside one compiled step GSPMD already reduces
+embedding grads as part of the sharded program (dense psum over ICI — the
+compiler overlaps it and the rows are needed dense for the optimizer
+update anyway). The row-compressed path pays off at the HOST boundaries —
+the optimizer-offload tier's device→host grad transfer and any DCN-side
+aggregation — which is exactly where this module plugs in.
+"""
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu import comm as dist
+
+
+class SparseTensor:
+    """Row-compressed view of a 2-D (or leading-dim-indexed) array."""
+
+    def __init__(self, dense=None, indices=None, values=None,
+                 dense_size: Optional[Sequence[int]] = None):
+        if dense is not None:
+            dense = np.asarray(dense)
+            nz = np.abs(dense).reshape(dense.shape[0], -1).sum(axis=1)
+            self.indices = np.nonzero(nz)[0].astype(np.int64)
+            self.values = np.ascontiguousarray(dense[self.indices])
+            self.dense_size = tuple(dense.shape)
+        else:
+            self.indices = (np.asarray(indices, np.int64)
+                            if indices is not None else None)
+            self.values = np.asarray(values) if values is not None else None
+            self.dense_size = tuple(dense_size) if dense_size else None
+
+    @staticmethod
+    def type() -> str:
+        return "deepspeed.SparseTensor"  # reference type tag
+
+    @property
+    def nnz_rows(self) -> int:
+        return 0 if self.indices is None else len(self.indices)
+
+    def density(self) -> float:
+        if not self.dense_size:
+            return 1.0
+        return self.nnz_rows / max(1, self.dense_size[0])
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.dense_size, self.values.dtype)
+        # duplicate indices accumulate (coalesce semantics)
+        np.add.at(out, self.indices, self.values)
+        return out
+
+    def coalesce(self) -> "SparseTensor":
+        uniq, inv = np.unique(self.indices, return_inverse=True)
+        vals = np.zeros((len(uniq),) + self.values.shape[1:],
+                        self.values.dtype)
+        np.add.at(vals, inv, self.values)
+        return SparseTensor(indices=uniq, values=vals,
+                            dense_size=self.dense_size)
+
+    def sparse_size(self):
+        """(compressed elements, dense elements) — reference diagnostic."""
+        dense_n = int(np.prod(self.dense_size))
+        comp = self.nnz_rows + (0 if self.values is None else self.values.size)
+        return comp, dense_n
+
+
+def should_use_sparse(dense_grad, threshold: float = 0.5) -> bool:
+    """Worth compressing? (row density below ``threshold``)."""
+    dense_grad = np.asarray(dense_grad)
+    if dense_grad.ndim < 2:
+        return False
+    nz = np.abs(dense_grad).reshape(dense_grad.shape[0], -1).sum(axis=1)
+    return (np.count_nonzero(nz) / dense_grad.shape[0]) < threshold
+
+
+def sparse_all_reduce(st: SparseTensor, average: bool = True) -> SparseTensor:
+    """Allreduce of a row-sparse gradient across processes (host regime).
+
+    Mirrors the reference ``sparse_allreduce`` (``engine.py:2494``):
+    all-gather (indices, values) from every rank, concatenate, coalesce.
+    Single-process (the usual single-controller TPU case) this is a
+    coalesce; multi-host it rides ``comm``'s host-regime collectives.
+    """
+    import jax
+
+    # host-regime exchange: the unit of participation is the PROCESS (each
+    # host holds its local grad), not the device — cf. comm.get_rank docs
+    world = jax.process_count()
+    if world > 1:
+        # ranks hold different nnz: agree on the max, pad with sentinel
+        # rows, fixed-size all-gather, drop sentinels (the reference pads
+        # its sparse allreduce the same way, engine.py:2520)
+        max_nnz = int(np.asarray(dist.all_reduce(
+            np.asarray([st.nnz_rows], np.int64), op=dist.ReduceOp.MAX))[0])
+        pad = max_nnz - st.nnz_rows
+        idx_p = np.pad(st.indices, (0, pad), constant_values=-1)
+        tail = st.values.shape[1:]
+        val_p = np.pad(st.values.reshape(st.nnz_rows, -1),
+                       ((0, pad), (0, 0)))
+        all_idx = np.asarray(dist.all_gather(idx_p)).reshape(-1)
+        all_val = np.asarray(dist.all_gather(val_p)).reshape(
+            world * max_nnz, -1)
+        keep = all_idx >= 0
+        st = SparseTensor(
+            indices=all_idx[keep],
+            values=all_val[keep].reshape((-1,) + tail),
+            dense_size=st.dense_size)
+    out = st.coalesce()
+    if average and world > 1:
+        out.values = out.values / world
+    return out

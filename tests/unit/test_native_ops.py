@@ -100,6 +100,51 @@ class TestCPUAdam:
         assert moved > 0.01  # lr=0.1 scale step, not 1e-3
 
 
+class TestCPUAdagrad:
+    @pytest.mark.parametrize("wd", [0.0, 0.01])
+    def test_matches_numpy_reference(self, wd):
+        from deepspeed_tpu.ops.cpu_adagrad import DeepSpeedCPUAdagrad
+
+        rng = np.random.default_rng(0)
+        n = 1025  # off the vector width on purpose
+        p0 = rng.standard_normal(n).astype(np.float32)
+        opt = DeepSpeedCPUAdagrad({"w": p0.copy()}, lr=1e-2, eps=1e-10,
+                                  weight_decay=wd)
+        ref_p = p0.copy()
+        ref_v = np.zeros(n, np.float32)
+        for _ in range(4):
+            g = rng.standard_normal(n).astype(np.float32)
+            opt.step({"w": g})
+            ge = g + wd * ref_p
+            ref_v = ref_v + ge * ge
+            ref_p = ref_p - 1e-2 * ge / (np.sqrt(ref_v) + 1e-10)
+        np.testing.assert_allclose(opt.get_param("w"), ref_p, rtol=2e-5,
+                                   atol=2e-5)
+
+    def test_bf16_grad_wire_format(self):
+        from deepspeed_tpu.ops.cpu_adagrad import DeepSpeedCPUAdagrad
+
+        rng = np.random.default_rng(1)
+        n = 512
+        p0 = rng.standard_normal(n).astype(np.float32)
+        g = rng.standard_normal(n).astype(np.float32)
+        g_bf16 = ((g.view(np.uint32) + 0x8000) >> 16).astype(np.uint16)
+        opt16 = DeepSpeedCPUAdagrad({"w": p0.copy()}, lr=1e-2)
+        opt16.step({"w": g_bf16})
+        g_rt = (g_bf16.astype(np.uint32) << 16).view(np.float32)
+        opt32 = DeepSpeedCPUAdagrad({"w": p0.copy()}, lr=1e-2)
+        opt32.step({"w": g_rt})
+        np.testing.assert_allclose(opt16.get_param("w"),
+                                   opt32.get_param("w"), rtol=1e-6)
+
+    def test_lr_update(self):
+        from deepspeed_tpu.ops.cpu_adagrad import DeepSpeedCPUAdagrad
+
+        opt = DeepSpeedCPUAdagrad({"w": np.ones(8, np.float32)}, lr=1e-2)
+        opt.step({"w": np.ones(8, np.float32)}, lr=0.5)
+        assert opt.lr == 0.5
+
+
 class TestAsyncIO:
     def test_sync_round_trip(self, tmp_path):
         h = AsyncIOHandle(num_threads=2)

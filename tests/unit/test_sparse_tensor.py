@@ -1,0 +1,52 @@
+"""SparseTensor + sparse gradient allreduce (reference
+``runtime/sparse_tensor.py:11``, ``engine.py:2459-2541``)."""
+
+import numpy as np
+
+from deepspeed_tpu.runtime.sparse_tensor import (SparseTensor,
+                                                 should_use_sparse,
+                                                 sparse_all_reduce)
+
+
+def _rowsparse(vocab=64, d=8, rows=(3, 10, 10, 50), seed=0):
+    rng = np.random.default_rng(seed)
+    g = np.zeros((vocab, d), np.float32)
+    for r in rows:
+        g[r] += rng.standard_normal(d).astype(np.float32)
+    return g
+
+
+class TestSparseTensor:
+    def test_from_dense_roundtrip(self):
+        g = _rowsparse()
+        st = SparseTensor(g)
+        assert st.nnz_rows == 3  # row 10 touched twice but stored once
+        np.testing.assert_allclose(st.to_dense(), g)
+        assert st.density() == 3 / 64
+
+    def test_coalesce_accumulates_duplicates(self):
+        vals = np.ones((3, 4), np.float32)
+        st = SparseTensor(indices=[5, 2, 5], values=vals, dense_size=(8, 4))
+        c = st.coalesce()
+        assert c.nnz_rows == 2
+        dense = c.to_dense()
+        np.testing.assert_allclose(dense[5], 2.0)
+        np.testing.assert_allclose(dense[2], 1.0)
+
+    def test_sparse_size_reports_compression(self):
+        st = SparseTensor(_rowsparse())
+        comp, dense_n = st.sparse_size()
+        assert comp < dense_n
+
+    def test_should_use_sparse_threshold(self):
+        assert should_use_sparse(_rowsparse())          # 3/64 rows
+        assert not should_use_sparse(np.ones((4, 4)))   # fully dense
+        assert not should_use_sparse(np.ones(16))       # 1-D: never
+
+    def test_allreduce_single_process_coalesces(self):
+        st = SparseTensor(indices=[1, 1, 3],
+                          values=np.ones((3, 2), np.float32),
+                          dense_size=(8, 2))
+        out = sparse_all_reduce(st)
+        assert out.nnz_rows == 2
+        np.testing.assert_allclose(out.to_dense()[1], 2.0)

@@ -1,0 +1,1 @@
+"""Collective benchmark suite (reference ``benchmarks/communication/``)."""

@@ -1,0 +1,135 @@
+"""Collective micro-benchmarks over the device mesh (reference
+``benchmarks/communication/{all_reduce,all_gather,all_to_all,broadcast,
+pt2pt}.py``): sweep message sizes, print algbw/busbw per size.
+
+Each op runs as a ``shard_map`` program over one mesh axis so the measured
+path is the real ICI/DCN collective XLA emits, not a host loop.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from benchmarks.communication.utils import (DEFAULT_SIZES_BYTES, bw_report,
+                                            chained_time_s, fmt_size,
+                                            get_mesh, print_header)
+
+
+def _sharded_input(mesh, axis, n_elems):
+    """bf16 operand sharded over the axis (each device holds its slice)."""
+    world = mesh.shape[axis]
+    n = max(world * 128, n_elems // 2 * 2)
+    n -= n % (world * 128)
+    x = jnp.ones((n,), jnp.bfloat16)
+    return jax.device_put(x, NamedSharding(mesh, P(axis))), n
+
+
+def _run_sweep(op_name, make_fn, axis, sizes, iters, trials):
+    topo = get_mesh(axis)
+    mesh = topo.mesh
+    world = int(mesh.shape[axis])
+    print_header(op_name, world)
+    rows = []
+    for size in sizes:
+        x, n = _sharded_input(mesh, axis, size // 2)  # bf16: 2 bytes
+        fn = make_fn(mesh, axis)
+        t = chained_time_s(fn, x, iters=iters, trials=trials)
+        algbw, busbw = bw_report(op_name, n * 2, t, world)
+        rows.append((n * 2, t, algbw, busbw))
+        print(f"{fmt_size(n * 2):>12} {1e3 * t:>10.3f} {algbw:>12.2f} "
+              f"{busbw:>12.2f}")
+    return rows
+
+
+def all_reduce(mesh, axis):
+    def fn(x):
+        return shard_map(lambda s: jax.lax.psum(s, axis), mesh=mesh,
+                         in_specs=P(axis), out_specs=P(axis))(x)
+
+    return fn
+
+
+def all_gather(mesh, axis):
+    # out_specs P(): the gathered value is replicated (vma can't infer it)
+    def fn(x):
+        return shard_map(
+            lambda s: jax.lax.all_gather(s, axis, tiled=True),
+            mesh=mesh, in_specs=P(axis), out_specs=P(),
+            check_vma=False)(x)
+
+    return fn
+
+
+def reduce_scatter(mesh, axis):
+    def fn(x):
+        return shard_map(
+            lambda s: jax.lax.psum_scatter(s, axis, tiled=True),
+            mesh=mesh, in_specs=P(axis), out_specs=P(axis))(x)
+
+    return fn
+
+
+def all_to_all(mesh, axis):
+    n = mesh.shape[axis]
+
+    def fn(x):
+        def local(s):
+            blk = s.reshape(n, -1)
+            return jax.lax.all_to_all(blk, axis, 0, 0, tiled=False).reshape(
+                s.shape)
+
+        return shard_map(local, mesh=mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+    return fn
+
+
+def broadcast(mesh, axis):
+    # broadcast = every rank reads rank-0's shard (XLA lowers to a ring
+    # bcast; collective-permute based)
+    def fn(x):
+        def local(s):
+            full = jax.lax.all_gather(s, axis, tiled=True)
+            return jax.lax.dynamic_slice_in_dim(full, 0, s.shape[0])
+
+        return shard_map(local, mesh=mesh, in_specs=P(axis),
+                         out_specs=P(axis), check_vma=False)(x)
+
+    return fn
+
+
+def pt2pt(mesh, axis):
+    n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def fn(x):
+        return shard_map(lambda s: jax.lax.ppermute(s, axis, perm),
+                         mesh=mesh, in_specs=P(axis), out_specs=P(axis))(x)
+
+    return fn
+
+
+OPS = {
+    "all_reduce": all_reduce,
+    "all_gather": all_gather,
+    "reduce_scatter": reduce_scatter,
+    "all_to_all": all_to_all,
+    "broadcast": broadcast,
+    "pt2pt": pt2pt,
+}
+
+
+def run(op: str = "all_reduce", axis: str = "data", sizes=None,
+        iters: int = 8, trials: int = 5):
+    sizes = sizes or DEFAULT_SIZES_BYTES
+    return _run_sweep(op, OPS[op], axis, sizes, iters, trials)
+
+
+def run_all(axis: str = "data", sizes=None, iters: int = 8,
+            trials: int = 5):
+    """Reference ``benchmarks/communication/run_all.py``."""
+    return {op: run(op, axis, sizes, iters, trials) for op in OPS}

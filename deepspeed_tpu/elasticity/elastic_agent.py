@@ -1,0 +1,114 @@
+"""Runtime elasticity: checkpoint-on-preemption + restore-at-new-mesh.
+
+Capability parity with the reference ``DSElasticAgent``
+(``elasticity/elastic_agent.py:23``): there, a torch-elastic agent
+supervises worker processes and a rendezvous re-forms the job at a new
+world size after failures. TPU preemption works differently — the
+scheduler delivers SIGTERM to the host before reclaiming chips — so the
+TPU-native agent is: (1) a signal-armed step-boundary hook that saves a
+tagged checkpoint the moment preemption is signaled, and (2) a restore
+path that loads that checkpoint onto WHATEVER mesh the restarted job got
+(the sharded checkpoint engine reshards at read; the elasticity planner
+re-picks a compatible batch size for the new chip count).
+"""
+
+import os
+import signal
+from typing import Callable, Optional
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+PREEMPT_TAG = "preempt"
+
+
+class DSElasticAgent:
+    """Wraps an engine's training loop with preemption safety.
+
+    Usage::
+
+        agent = DSElasticAgent(engine, save_dir="/ckpts")
+        agent.restore_if_any()          # resume after restart/rescale
+        for batch in loader:
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+            if agent.step_boundary():   # saved + should stop
+                break
+    """
+
+    def __init__(self, engine, save_dir: str,
+                 signals=(signal.SIGTERM,),
+                 on_preempt: Optional[Callable] = None,
+                 install_handlers: bool = True):
+        self.engine = engine
+        self.save_dir = save_dir
+        self.on_preempt = on_preempt
+        self._preempted = False
+        self._prev_handlers = {}
+        if install_handlers:
+            for sig in signals:
+                self._prev_handlers[sig] = signal.signal(sig, self._on_signal)
+
+    # ------------------------------------------------------------------
+    def _on_signal(self, signum, frame):
+        logger.warning(f"preemption signal {signum} received; will "
+                       "checkpoint at the next step boundary")
+        self._preempted = True
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted
+
+    def signal_preemption(self):
+        """Programmatic preemption (tests / external watchdogs)."""
+        self._preempted = True
+
+    def step_boundary(self) -> bool:
+        """Call once per optimizer step; True = checkpointed, stop now."""
+        if not self._preempted:
+            return False
+        self.engine.save_checkpoint(self.save_dir, tag=PREEMPT_TAG)
+        log_dist(f"preemption checkpoint saved to {self.save_dir} "
+                 f"(tag={PREEMPT_TAG!r})", ranks=[0])
+        if self.on_preempt is not None:
+            self.on_preempt()
+        return True
+
+    # ------------------------------------------------------------------
+    def restore_if_any(self):
+        """Load the preemption (or latest) checkpoint onto the current
+        mesh. Returns the tag restored, or None. The current mesh may have
+        a different shape than the one that saved — the checkpoint layer
+        reshards (test_sharded_checkpoint.py proves both directions)."""
+        if not os.path.isdir(self.save_dir):
+            return None
+        tag = None
+        if os.path.isdir(os.path.join(self.save_dir, PREEMPT_TAG)):
+            tag = PREEMPT_TAG
+        elif os.path.exists(os.path.join(self.save_dir, "latest")):
+            tag = None  # engine resolves from the latest file
+        else:
+            return None
+        loaded_tag, _ = self.engine.load_checkpoint(self.save_dir, tag=tag)
+        if loaded_tag is not None:
+            log_dist(f"elastic restore: resumed from {loaded_tag!r} at "
+                     f"step {self.engine.global_steps}", ranks=[0])
+        return loaded_tag
+
+    def close(self):
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev)
+        self._prev_handlers.clear()
+
+
+def elastic_batch_for_world(ds_config: dict, world_size: int):
+    """Re-pick (global_batch, micro_batch) for a new chip count using the
+    elasticity planner (reference ``compute_elastic_config``,
+    ``elasticity/elasticity.py:287``) — the rescale half of the restart.
+    ``ds_config`` is the full engine config carrying an ``elasticity``
+    section."""
+    result = compute_elastic_config(ds_config, world_size=world_size,
+                                    return_microbatch=True)
+    batch, _valid, micro = result
+    return batch, micro

@@ -1,0 +1,128 @@
+"""Runtime elasticity agent (reference ``elasticity/elastic_agent.py:23``):
+checkpoint-on-preemption + restore-at-new-mesh."""
+
+import os
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                    PREEMPT_TAG,
+                                                    elastic_batch_for_world)
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    reset_topology()
+    yield
+    reset_topology()
+
+
+def _engine(axis_sizes, sharded=True):
+    topo = MeshTopology(axis_sizes=axis_sizes)
+    dp = topo.get_data_parallel_world_size()
+    model = GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32, n_layer=2))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, mesh=topo,
+        config={"train_batch_size": 2 * dp,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+                "checkpoint": {"sharded": sharded},
+                "steps_per_print": 10_000})
+    return engine, dp
+
+
+def _step(engine, dp, seed=0):
+    ids = np.random.default_rng(seed).integers(
+        0, 256, (2 * dp, 32)).astype(np.int32)
+    loss = engine({"input_ids": ids})
+    engine.backward(loss)
+    engine.step()
+    return float(loss)
+
+
+class TestPreemption:
+    def test_signal_triggers_checkpoint_at_boundary(self, tmp_path):
+        engine, dp = _engine({"data": 8})
+        agent = DSElasticAgent(engine, str(tmp_path),
+                               install_handlers=False)
+        _step(engine, dp)
+        assert agent.step_boundary() is False  # no signal yet
+        agent.signal_preemption()
+        _step(engine, dp)
+        assert agent.step_boundary() is True
+        assert (tmp_path / PREEMPT_TAG).is_dir()
+        agent.close()
+
+    def test_real_signal_handler(self, tmp_path):
+        engine, dp = _engine({"data": 8})
+        agent = DSElasticAgent(engine, str(tmp_path),
+                               signals=(signal.SIGUSR1,))
+        _step(engine, dp)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert agent.preempted
+        assert agent.step_boundary() is True
+        agent.close()
+
+    def test_on_preempt_callback(self, tmp_path):
+        engine, dp = _engine({"data": 8})
+        called = []
+        agent = DSElasticAgent(engine, str(tmp_path),
+                               on_preempt=lambda: called.append(1),
+                               install_handlers=False)
+        _step(engine, dp)
+        agent.signal_preemption()
+        agent.step_boundary()
+        assert called == [1]
+        agent.close()
+
+
+class TestElasticRestore:
+    def test_restore_at_new_mesh(self, tmp_path):
+        """Preempt on {data:8}, restart on {data:4, model:2} — the restore
+        reshards and training continues from the saved step."""
+        engine, dp = _engine({"data": 8})
+        agent = DSElasticAgent(engine, str(tmp_path),
+                               install_handlers=False)
+        for i in range(3):
+            _step(engine, dp, seed=i)
+        step_at_preempt = engine.global_steps
+        agent.signal_preemption()
+        assert agent.step_boundary() is True
+        agent.close()
+
+        reset_topology()
+        engine2, dp2 = _engine({"data": 4, "model": 2})
+        _step(engine2, dp2)  # builds state (template for sharded restore)
+        agent2 = DSElasticAgent(engine2, str(tmp_path),
+                                install_handlers=False)
+        tag = agent2.restore_if_any()
+        assert tag == PREEMPT_TAG
+        assert engine2.global_steps == step_at_preempt
+        assert np.isfinite(_step(engine2, dp2, seed=9))
+        agent2.close()
+
+    def test_restore_without_checkpoint_is_noop(self, tmp_path):
+        engine, _ = _engine({"data": 8})
+        agent = DSElasticAgent(engine, str(tmp_path / "nothing"),
+                               install_handlers=False)
+        assert agent.restore_if_any() is None
+        agent.close()
+
+
+class TestElasticRescale:
+    def test_batch_replan_for_new_world(self):
+        cfg = {"elasticity": {
+            "enabled": True, "max_train_batch_size": 512,
+            "micro_batch_sizes": [2, 4, 8], "min_gpus": 1, "max_gpus": 64,
+            "min_time": 0, "version": 0.1, "prefer_larger_batch": True,
+            "ignore_non_elastic_batch_info": True}}
+        b8, m8 = elastic_batch_for_world(cfg, 8)
+        b6, m6 = elastic_batch_for_world(cfg, 6)
+        assert b8 % (8 * m8) == 0
+        assert b6 % (6 * m6) == 0

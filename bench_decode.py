@@ -1,0 +1,84 @@
+"""Inference benchmark: GPT-2 125M decode throughput + TTFT on one chip.
+
+The BASELINE.md inference metric ("DS-Inference p50 TTFT"; reference
+benchmarks/inference/gpt-bench.py prints p50/p90 latency). Prints ONE JSON
+line::
+
+    {"metric": "gpt2_125m_decode", "ttft_ms_p50": ..., "decode_tokens_per_sec":
+     ..., "per_token_ms": ...}
+
+TTFT = prefill latency on the prompt (first compiled forward after warmup);
+decode tokens/s = steady-state autoregressive rate through the jitted
+scanned decode loop with the Pallas decode-attention kernel on the KV
+cache. On CPU a tiny proxy keeps the script runnable anywhere.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=768,
+                         n_layer=12, n_head=12, dtype=jnp.bfloat16,
+                         scan_layers=True)
+        batch, prompt, new_tokens, reps = 8, 128, 128, 5
+    else:
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        batch, prompt, new_tokens, reps = 2, 8, 8, 2
+
+    engine = deepspeed_tpu.init_inference(
+        GPT2LMHeadModel(cfg),
+        dtype=cfg.dtype, tensor_parallel={"tp_size": 1},
+        max_out_tokens=cfg.n_positions)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
+
+    # --- TTFT: prefill-only latency (the first forward of a request) ---
+    engine.forward(ids)  # compile
+    ttfts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = engine.forward(ids)
+        np.asarray(jax.device_get(out[:, -1, :8]))  # fence through tunnel
+        ttfts.append(1e3 * (time.perf_counter() - t0))
+    ttft_p50 = float(np.percentile(ttfts, 50))
+
+    # --- steady-state decode rate: marginal cost between two generation
+    # lengths — (T(2N) - T(N)) / N cancels prefill, dispatch, and the
+    # tunnel's per-call overhead (same methodology as tools/perf_sparse.py)
+    def gen_time(n):
+        engine.generate(ids, max_new_tokens=n, do_sample=False)  # warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            engine.generate(ids, max_new_tokens=n, do_sample=False)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1 = gen_time(new_tokens)
+    t2 = gen_time(2 * new_tokens)
+    per_token_s = max(1e-9, (t2 - t1) / new_tokens)
+    per_token_ms = 1e3 * per_token_s
+    tokens_per_sec = batch / per_token_s
+
+    print(json.dumps({
+        "metric": "gpt2_125m_decode",
+        "ttft_ms_p50": round(ttft_p50, 2),
+        "decode_tokens_per_sec": round(tokens_per_sec, 1),
+        "per_token_ms": round(per_token_ms, 3),
+        "batch": batch, "prompt": prompt, "new_tokens": new_tokens,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,339 @@
+"""Llama model family — TPU-native flax implementation.
+
+Covers the BASELINE.md tracked config "Llama-2 7B ZeRO-3 on v5p-64" and
+the reference's HF-architecture support surface
+(``model_implementations/``, ``module_inject/replace_policy.py`` LLaMA-style
+archs): RMSNorm, rotary position embeddings, SwiGLU MLP, grouped-query
+attention, no biases. Mirrors models/gpt2.py's engine integration — scanned
+layers (one compiled block, per-layer ZeRO-3 gathers), config-driven remat,
+KV-cache decode mode, and the ``*ForTraining`` wrapper contract.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.gpt2 import (chunked_softmax_xent,
+                                       cross_entropy_loss)
+from deepspeed_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_position_embeddings: int = 4096
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None  # None = MHA; < heads = GQA
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+    remat_policy: str = "full"
+    use_flash: Optional[bool] = None
+    decode: bool = False
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    def for_decode(self):
+        return dataclasses.replace(self, decode=True)
+
+    @staticmethod
+    def llama2_7b(**kw):
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_position_embeddings", 64)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        return LlamaConfig(**kw)
+
+
+def _init(scale=0.02):
+    return nn.initializers.normal(stddev=scale)
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square layernorm (no mean subtraction, no bias)."""
+
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        x32 = x.astype(jnp.float32)
+        x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1,
+                                           keepdims=True) + self.eps)
+        return (x32 * scale).astype(self.dtype)
+
+
+def rope_frequencies(head_dim: int, positions, theta: float):
+    """cos/sin tables for the given absolute positions: [..., head_dim//2]."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32)
+                           / head_dim))
+    ang = positions.astype(jnp.float32)[..., None] * inv  # [..., hd/2]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, T, H, D]; cos/sin: [T, D/2] (or broadcastable). Rotates pairs
+    (x_even, x_odd) — the interleaved convention HF Llama uses after its
+    half-split equivalence."""
+    x1, x2 = jnp.split(x, 2, axis=-1)  # HF half-split convention
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        B, T, C = x.shape
+        H, KV, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+        q = nn.Dense(H * D, use_bias=False, dtype=cfg.dtype,
+                     kernel_init=_init(), name="q_proj")(x)
+        k = nn.Dense(KV * D, use_bias=False, dtype=cfg.dtype,
+                     kernel_init=_init(), name="k_proj")(x)
+        v = nn.Dense(KV * D, use_bias=False, dtype=cfg.dtype,
+                     kernel_init=_init(), name="v_proj")(x)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, KV, D)
+        v = v.reshape(B, T, KV, D)
+
+        if cfg.decode:
+            is_prefill = not self.has_variable("cache", "cached_key")
+            S = cfg.max_position_embeddings
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (B, S, KV, D), cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (B, S, KV, D), cfg.dtype)
+            cidx = self.variable("cache", "cache_index",
+                                 lambda: jnp.zeros((), jnp.int32))
+            idx = cidx.value
+            pos = idx + jnp.arange(T)
+            cos, sin = rope_frequencies(D, pos, cfg.rope_theta)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k,
+                                                    (0, idx, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v,
+                                                    (0, idx, 0, 0))
+            cidx.value = idx + T
+            if not is_prefill:
+                kc = ck.value
+                vc = cv.value
+                rep = H // KV
+                kc = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
+                vc = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
+                from deepspeed_tpu.ops.attention import use_decode_kernel
+
+                if use_decode_kernel():
+                    from deepspeed_tpu.ops.decode_attention import (
+                        decode_attention)
+
+                    y = decode_attention(q, kc, vc, idx).transpose(0, 2, 1, 3)
+                else:
+                    key_pos = jnp.arange(S)
+                    q_pos = idx + jnp.arange(T)
+                    mask = key_pos[None, :] <= q_pos[:, None]
+                    y = attention(q.transpose(0, 2, 1, 3),
+                                  kc.transpose(0, 2, 1, 3),
+                                  vc.transpose(0, 2, 1, 3),
+                                  mask=mask[None, None], causal=False,
+                                  use_flash=False)
+                y = y.transpose(0, 2, 1, 3).reshape(B, T, H * D)
+                return nn.Dense(C, use_bias=False, dtype=cfg.dtype,
+                                kernel_init=_init(), name="o_proj")(y)
+        else:
+            cos, sin = rope_frequencies(D, jnp.arange(T), cfg.rope_theta)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+
+        # training forward / decode prefill: causal attention over own keys
+        rep = H // KV
+        if rep > 1:  # GQA: expand kv heads to match q heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        y = attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                      v.transpose(0, 2, 1, 3), causal=True,
+                      use_flash=cfg.use_flash)
+        y = y.transpose(0, 2, 1, 3).reshape(B, T, H * D)
+        return nn.Dense(C, use_bias=False, dtype=cfg.dtype,
+                        kernel_init=_init(), name="o_proj")(y)
+
+
+class LlamaMLP(nn.Module):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        g = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=cfg.dtype,
+                     kernel_init=_init(), name="gate_proj")(x)
+        u = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=cfg.dtype,
+                     kernel_init=_init(), name="up_proj")(x)
+        return nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                        kernel_init=_init(), name="down_proj")(
+            nn.silu(g) * u)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        x = x + LlamaAttention(cfg, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x),
+            deterministic=deterministic)
+        x = x + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                    name="post_attention_layernorm")(x))
+        return x
+
+
+def _remat_block(cfg):
+    """Same policy surface as models/gpt2.py:_remat_block."""
+    if not cfg.remat:
+        return LlamaBlock
+    policy = None
+    if cfg.remat_policy == "dots":
+        policy = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.checkpoint_dots,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_q", "flash_k", "flash_v", "flash_o", "flash_lse"))
+    return nn.remat(LlamaBlock, prevent_cse=False, policy=policy,
+                    static_argnums=(2,))
+
+
+class _ScanBody(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic):
+        x = _remat_block(self.config)(self.config, name="block")(
+            x, deterministic)
+        return x, None
+
+
+class LlamaModel(nn.Module):
+    """Decoder stack → final RMSNorm → (tied or separate) LM head."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True, return_hidden=False):
+        cfg = self.config
+        embed = self.param("embed_tokens", _init(),
+                           (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        x = embed[input_ids].astype(cfg.dtype)
+        if cfg.scan_layers:
+            Scanned = nn.scan(
+                _ScanBody,
+                variable_axes={"params": 0, "cache": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=nn.broadcast,
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            x, _ = Scanned(cfg, name="layers")(x, deterministic)
+        else:
+            block_cls = _remat_block(cfg)
+            for i in range(cfg.num_hidden_layers):
+                x = block_cls(cfg, name=f"layers_{i}")(x, deterministic)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
+        if cfg.tie_word_embeddings:
+            head = embed
+        else:
+            head = self.param("lm_head", _init(),
+                              (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        if return_hidden:
+            return x, head
+        return jnp.einsum("btc,vc->btv", x, head.astype(cfg.dtype),
+                          preferred_element_type=jnp.float32)
+
+
+def llama_loss_fn(model: LlamaModel):
+    """Engine-facing loss (same contract/dense-vs-chunked-head logic as
+    models/gpt2.py:gpt2_loss_fn)."""
+
+    def loss_fn(params, batch, rngs=None):
+        if isinstance(batch, dict):
+            input_ids, labels = batch["input_ids"], batch.get("labels")
+        else:
+            input_ids, labels = batch
+        if labels is None:
+            labels = input_ids
+        hidden, head = model.apply({"params": params}, input_ids,
+                                   deterministic=rngs is None, rngs=rngs,
+                                   return_hidden=True)
+        shifted = jnp.concatenate(
+            [labels[:, 1:],
+             jnp.full((labels.shape[0], 1), -100, labels.dtype)], axis=1)
+        B, T, _ = hidden.shape
+        V = model.config.vocab_size
+        dense_budget = (3_500_000_000 if model.config.remat
+                        else 1_000_000_000)
+        if B * T * V * 4 <= dense_budget:
+            logits = jnp.einsum("btc,vc->btv", hidden,
+                                head.astype(hidden.dtype),
+                                preferred_element_type=jnp.float32)
+            return cross_entropy_loss(logits, shifted)
+        return chunked_softmax_xent(hidden, head, shifted, chunk=512)
+
+    return loss_fn
+
+
+class LlamaForTraining:
+    """Engine-ready wrapper (same contract as GPT2ForTraining)."""
+
+    def __init__(self, config: LlamaConfig):
+        self.config = config
+        self.model = LlamaModel(config)
+        self.loss_fn = llama_loss_fn(self.model)
+
+    @staticmethod
+    def _input_ids(batch):
+        if isinstance(batch, dict):
+            return batch["input_ids"]
+        if isinstance(batch, (tuple, list)):
+            return batch[0]
+        return batch
+
+    def init(self, rng, batch):
+        return self.model.init(rng, self._input_ids(batch))
+
+    def apply(self, variables, batch, rngs=None):
+        return self.model.apply(variables, self._input_ids(batch), rngs=rngs)
+
+    def with_activation_checkpointing(self, enabled: bool,
+                                      policy: str = "full"):
+        if policy == "none":
+            enabled, policy = False, "full"
+        return LlamaForTraining(dataclasses.replace(
+            self.config, remat=enabled, remat_policy=policy))

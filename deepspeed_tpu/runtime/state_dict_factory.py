@@ -158,12 +158,26 @@ class HFWeightMap:
                if (m := self.layer_re.match(k))]
         return max(ids) + 1 if ids else 0
 
+    @staticmethod
+    def lookup(sd, key):
+        """Fetch ``key`` tolerating the model-prefix variants hub
+        checkpoints ship: ``GPT2LMHeadModel`` saves ``transformer.*`` /
+        ``BloomForCausalLM`` saves ``transformer.*``, but the bare
+        ``GPT2Model``/``BloomModel`` checkpoints omit the prefix and OPT
+        ships both ``model.decoder.*`` and ``decoder.*`` forms."""
+        if key in sd:
+            return sd[key]
+        for prefix in ("transformer.", "model."):
+            if key.startswith(prefix) and key[len(prefix):] in sd:
+                return sd[key[len(prefix):]]
+        return None
+
     def layer_weights(self, sd, i: int) -> Dict[str, np.ndarray]:
         out = {}
         for canon, suffix in self.layer_map.items():
-            key = self.layer_key(i, suffix)
-            if key in sd:
-                out[canon] = self.convert(canon, sd[key])
+            w = self.lookup(sd, self.layer_key(i, suffix))
+            if w is not None:
+                out[canon] = self.convert(canon, w)
         return out
 
     def layer_key(self, i: int, suffix: str) -> str:
@@ -175,8 +189,12 @@ class HFWeightMap:
         return w
 
     def top_weights(self, sd) -> Dict[str, np.ndarray]:
-        return {canon: self.convert(canon, sd[key])
-                for canon, key in self.top_map.items() if key in sd}
+        out = {}
+        for canon, key in self.top_map.items():
+            w = self.lookup(sd, key)
+            if w is not None:
+                out[canon] = self.convert(canon, w)
+        return out
 
 
 class GPT2WeightMap(HFWeightMap):
@@ -184,6 +202,7 @@ class GPT2WeightMap(HFWeightMap):
 
     arch = "gpt2"
     transpose_linear = False
+    layer_re = re.compile(r"^(?:transformer\.)?h\.(\d+)\.(.+)$")
     layer_map = {
         "ln_1.scale": "ln_1.weight", "ln_1.bias": "ln_1.bias",
         "c_attn.kernel": "attn.c_attn.weight", "c_attn.bias": "attn.c_attn.bias",
@@ -208,7 +227,7 @@ class OPTWeightMap(HFWeightMap):
     transposed and merged into the canonical fused c_attn."""
 
     arch = "opt"
-    layer_re = re.compile(r"^model\.decoder\.layers\.(\d+)\.(.+)$")
+    layer_re = re.compile(r"^(?:model\.)?decoder\.layers\.(\d+)\.(.+)$")
     layer_map = {
         "ln_1.scale": "self_attn_layer_norm.weight",
         "ln_1.bias": "self_attn_layer_norm.bias",
@@ -232,14 +251,13 @@ class OPTWeightMap(HFWeightMap):
     def layer_weights(self, sd, i):
         out = super().layer_weights(sd, i)
         pre = f"model.decoder.layers.{i}.self_attn"
-        try:
-            qw, kw, vw = (np.ascontiguousarray(sd[f"{pre}.{n}_proj.weight"].T)
-                          for n in "qkv")
-            qb, kb, vb = (sd[f"{pre}.{n}_proj.bias"] for n in "qkv")
-        except KeyError:
+        ws = [self.lookup(sd, f"{pre}.{n}_proj.weight") for n in "qkv"]
+        bs = [self.lookup(sd, f"{pre}.{n}_proj.bias") for n in "qkv"]
+        if any(w is None for w in ws) or any(b is None for b in bs):
             return out
+        qw, kw, vw = (np.ascontiguousarray(w.T) for w in ws)
         out["c_attn.kernel"] = merge_qkv(qw, kw, vw)
-        out["c_attn.bias"] = np.concatenate([qb, kb, vb], axis=-1)
+        out["c_attn.bias"] = np.concatenate(bs, axis=-1)
         return out
 
 
@@ -278,19 +296,54 @@ class BloomWeightMap(HFWeightMap):
 
     def layer_weights(self, sd, i):
         out = super().layer_weights(sd, i)
-        key = self.layer_key(i, "self_attention.query_key_value.weight")
-        if key in sd:
-            w = np.ascontiguousarray(sd[key].T)  # [C, 3C], head-interleaved
-            out["c_attn.kernel"] = deinterleave_bloom_qkv(w, self.n_head)
-        bkey = self.layer_key(i, "self_attention.query_key_value.bias")
-        if bkey in sd:
+        w = self.lookup(sd, self.layer_key(
+            i, "self_attention.query_key_value.weight"))
+        if w is not None:  # [C, 3C] after transpose, head-interleaved
+            out["c_attn.kernel"] = deinterleave_bloom_qkv(
+                np.ascontiguousarray(w.T), self.n_head)
+        b = self.lookup(sd, self.layer_key(
+            i, "self_attention.query_key_value.bias"))
+        if b is not None:
             out["c_attn.bias"] = deinterleave_bloom_qkv(
-                sd[bkey][None], self.n_head)[0]
+                b[None], self.n_head)[0]
         return out
 
 
+class LlamaWeightMap(HFWeightMap):
+    """HF ``LlamaForCausalLM``: separate no-bias q/k/v/o linears, SwiGLU
+    MLP, RMSNorms. Canonical keys here name the flax tree directly (the
+    Llama model keeps the HF module names, models/llama.py)."""
+
+    arch = "llama"
+    layer_re = re.compile(r"^(?:model\.)?layers\.(\d+)\.(.+)$")
+    layer_map = {
+        "input_layernorm.scale": "input_layernorm.weight",
+        "post_attention_layernorm.scale": "post_attention_layernorm.weight",
+        "self_attn.q_proj.kernel": "self_attn.q_proj.weight",
+        "self_attn.k_proj.kernel": "self_attn.k_proj.weight",
+        "self_attn.v_proj.kernel": "self_attn.v_proj.weight",
+        "self_attn.o_proj.kernel": "self_attn.o_proj.weight",
+        "mlp.gate_proj.kernel": "mlp.gate_proj.weight",
+        "mlp.up_proj.kernel": "mlp.up_proj.weight",
+        "mlp.down_proj.kernel": "mlp.down_proj.weight",
+    }
+    top_map = {
+        "embed_tokens": "model.embed_tokens.weight",
+        "norm.scale": "model.norm.weight",
+        "lm_head": "lm_head.weight",  # [V, C]: our head einsum wants [V, C]
+    }
+
+    def layer_key(self, i, suffix):
+        return f"model.layers.{i}.{suffix}"
+
+    def convert(self, canon, w):
+        if canon == "lm_head" or canon == "embed_tokens":
+            return w  # [V, C] both sides
+        return super().convert(canon, w)
+
+
 _WEIGHT_MAPS = {"gpt2": GPT2WeightMap, "opt": OPTWeightMap,
-                "bloom": BloomWeightMap}
+                "bloom": BloomWeightMap, "llama": LlamaWeightMap}
 
 
 def get_weight_map(arch: str, **kw) -> HFWeightMap:
@@ -308,6 +361,8 @@ def detect_arch(sd: Dict[str, Any]) -> Optional[str]:
         return "opt"
     if any("self_attention.query_key_value" in k for k in keys):
         return "bloom"
+    if any("mlp.gate_proj" in k for k in keys):
+        return "llama"
     return None
 
 
@@ -379,4 +434,89 @@ def load_hf_gpt2(src, scan_layers: bool = True, dtype=None,
         lambda x: np.asarray(x, np.float32), params)
     logger.info(f"loaded HF GPT-2: {n_layer} layers, n_embd={n_embd}, "
                 f"vocab={wte.shape[0]}")
+    return config, params
+
+
+def load_hf_llama(src, scan_layers: bool = True, dtype=None,
+                  num_attention_heads: Optional[int] = None,
+                  num_key_value_heads: Optional[int] = None,
+                  rope_theta: Optional[float] = None,
+                  rms_norm_eps: Optional[float] = None,
+                  max_position_embeddings: Optional[int] = None):
+    """HF Llama checkpoint → (LlamaConfig, flax params) for
+    :class:`deepspeed_tpu.models.llama.LlamaModel`. For every config knob
+    an explicit argument wins; unset knobs come from the model dir's
+    config.json when present, else the Llama-2 defaults. Pass head counts
+    for bare state_dicts (k_proj's out-dim reveals kv heads only up to
+    head_dim)."""
+    import json
+
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.llama import LlamaConfig
+
+    if isinstance(src, (str, os.PathLike)) and os.path.isdir(str(src)):
+        cfg_json = os.path.join(str(src), "config.json")
+        if os.path.exists(cfg_json):
+            with open(cfg_json) as f:
+                hf = json.load(f)
+            num_attention_heads = num_attention_heads or hf.get(
+                "num_attention_heads")
+            num_key_value_heads = num_key_value_heads or hf.get(
+                "num_key_value_heads")
+            if rope_theta is None:
+                rope_theta = hf.get("rope_theta")
+            if rms_norm_eps is None:
+                rms_norm_eps = hf.get("rms_norm_eps")
+            max_position_embeddings = max_position_embeddings or hf.get(
+                "max_position_embeddings")
+    rope_theta = 10000.0 if rope_theta is None else rope_theta
+    rms_norm_eps = 1e-5 if rms_norm_eps is None else rms_norm_eps
+    sd = SDLoaderFactory.load(src)
+    wm = LlamaWeightMap()
+    n_layer = wm.n_layers(sd)
+    top = wm.top_weights(sd)
+    embed = top["embed_tokens"]
+    hidden = embed.shape[1]
+    layers = [wm.layer_weights(sd, i) for i in range(n_layer)]
+    inter = layers[0]["mlp.gate_proj.kernel"].shape[1]
+    heads = num_attention_heads or max(1, hidden // 128)
+    kv_dim = layers[0]["self_attn.k_proj.kernel"].shape[1]
+    kv_heads = num_key_value_heads or max(1, kv_dim // (hidden // heads))
+    tied = "lm_head" not in top
+    config = LlamaConfig(
+        vocab_size=embed.shape[0], hidden_size=hidden,
+        intermediate_size=inter, num_hidden_layers=n_layer,
+        num_attention_heads=heads, num_key_value_heads=kv_heads,
+        rope_theta=rope_theta, rms_norm_eps=rms_norm_eps,
+        max_position_embeddings=max_position_embeddings or 4096,
+        tie_word_embeddings=tied,
+        dtype=dtype if dtype is not None else jnp.float32,
+        scan_layers=scan_layers)
+
+    def block_tree(lw):
+        return {
+            "input_layernorm": {"scale": lw["input_layernorm.scale"]},
+            "post_attention_layernorm": {
+                "scale": lw["post_attention_layernorm.scale"]},
+            "self_attn": {n: {"kernel": lw[f"self_attn.{n}.kernel"]}
+                          for n in ("q_proj", "k_proj", "v_proj", "o_proj")},
+            "mlp": {n: {"kernel": lw[f"mlp.{n}.kernel"]}
+                    for n in ("gate_proj", "up_proj", "down_proj")},
+        }
+
+    if scan_layers:
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs, axis=0), *[block_tree(l) for l in layers])
+        body = {"layers": {"block": stacked}}
+    else:
+        body = {f"layers_{i}": block_tree(l) for i, l in enumerate(layers)}
+    params = {"embed_tokens": embed, "norm": {"scale": top["norm.scale"]},
+              **body}
+    if not tied:
+        params["lm_head"] = top["lm_head"]
+    params = jax.tree_util.tree_map(
+        lambda x: np.asarray(x, np.float32), params)
+    logger.info(f"loaded HF Llama: {n_layer} layers, hidden={hidden}, "
+                f"heads={heads}/{kv_heads}kv, vocab={embed.shape[0]}")
     return config, params

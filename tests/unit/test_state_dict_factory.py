@@ -108,6 +108,41 @@ class TestLoaders:
         assert lw["c_attn.kernel"].shape == (c, 3 * c)
         assert detect_arch(sd) == "bloom"
 
+    def test_bare_checkpoint_layer_counts(self):
+        """layer_re must accept the un-prefixed key forms (bare GPT2Model /
+        OPTModel / LlamaModel checkpoints), matching lookup()'s tolerance."""
+        from deepspeed_tpu.runtime.state_dict_factory import LlamaWeightMap
+
+        assert GPT2WeightMap().n_layers(
+            {"h.1.attn.c_attn.weight": 0}) == 2
+        assert OPTWeightMap().n_layers(
+            {"decoder.layers.2.fc1.weight": 0}) == 3
+        assert LlamaWeightMap().n_layers(
+            {"layers.0.mlp.gate_proj.weight": 0}) == 1
+
+    def test_unprefixed_hub_keys_resolve(self):
+        """bigscience/bloom* hub checkpoints omit the 'transformer.' prefix
+        ('h.0. ...', 'word_embeddings.weight') — lookups must still hit."""
+        n_head, hd = 2, 4
+        c = n_head * hd
+        rng = np.random.default_rng(0)
+        sd = {
+            "h.0.self_attention.query_key_value.weight":
+                rng.normal(size=(3 * c, c)).astype(np.float32),
+            "h.0.input_layernorm.weight": np.ones(c, np.float32),
+            "word_embeddings.weight":
+                rng.normal(size=(32, c)).astype(np.float32),
+            "ln_f.weight": np.ones(c, np.float32),
+        }
+        wm = BloomWeightMap(n_head=n_head)
+        assert wm.n_layers(sd) == 1
+        lw = wm.layer_weights(sd, 0)
+        assert lw["c_attn.kernel"].shape == (c, 3 * c)
+        assert lw["ln_1.scale"].shape == (c,)
+        top = wm.top_weights(sd)
+        assert top["wte"].shape == (32, c)
+        assert top["ln_f.scale"].shape == (c,)
+
 
 class TestHFGPT2EndToEnd:
     def test_logits_match_hf(self):

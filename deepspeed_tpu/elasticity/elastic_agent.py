@@ -64,11 +64,37 @@ class DSElasticAgent:
         """Programmatic preemption (tests / external watchdogs)."""
         self._preempted = True
 
+    def _any_host_preempted(self) -> bool:
+        """Cross-process agreement on the flag: the scheduler may deliver
+        SIGTERM to hosts at different instants, and the checkpoint save is
+        collective — one host saving while another trains would deadlock
+        both on mismatched collectives."""
+        import jax
+
+        if jax.process_count() == 1:
+            return self._preempted
+        import numpy as np
+
+        from deepspeed_tpu import comm as dist
+
+        flag = np.asarray([1 if self._preempted else 0], np.int32)
+        agreed = np.asarray(dist.all_reduce(flag, op=dist.ReduceOp.MAX))
+        return bool(agreed[0])
+
     def step_boundary(self) -> bool:
-        """Call once per optimizer step; True = checkpointed, stop now."""
-        if not self._preempted:
+        """Call once per optimizer step; True = checkpointed, stop now.
+
+        Multi-host: call on EVERY host each step (it agrees on the flag
+        collectively); single-host: cheap local check.
+        """
+        if not self._any_host_preempted():
             return False
-        self.engine.save_checkpoint(self.save_dir, tag=PREEMPT_TAG)
+        self._preempted = True  # another host was signaled: join the save
+        # save_latest=False: the preempt tag is consumed on restore, and a
+        # "latest" pointer at it would dangle afterwards — regular saves
+        # keep owning "latest"
+        self.engine.save_checkpoint(self.save_dir, tag=PREEMPT_TAG,
+                                    save_latest=False)
         log_dist(f"preemption checkpoint saved to {self.save_dir} "
                  f"(tag={PREEMPT_TAG!r})", ranks=[0])
         if self.on_preempt is not None:
@@ -80,11 +106,17 @@ class DSElasticAgent:
         """Load the preemption (or latest) checkpoint onto the current
         mesh. Returns the tag restored, or None. The current mesh may have
         a different shape than the one that saved — the checkpoint layer
-        reshards (test_sharded_checkpoint.py proves both directions)."""
+        reshards (test_sharded_checkpoint.py proves both directions).
+
+        A restored preempt checkpoint is CONSUMED (renamed): leaving it on
+        disk would roll training back to it after any later, unrelated
+        restart, silently discarding progress saved since.
+        """
         if not os.path.isdir(self.save_dir):
             return None
         tag = None
-        if os.path.isdir(os.path.join(self.save_dir, PREEMPT_TAG)):
+        preempt_dir = os.path.join(self.save_dir, PREEMPT_TAG)
+        if os.path.isdir(preempt_dir):
             tag = PREEMPT_TAG
         elif os.path.exists(os.path.join(self.save_dir, "latest")):
             tag = None  # engine resolves from the latest file
@@ -94,11 +126,23 @@ class DSElasticAgent:
         if loaded_tag is not None:
             log_dist(f"elastic restore: resumed from {loaded_tag!r} at "
                      f"step {self.engine.global_steps}", ranks=[0])
+        if loaded_tag == PREEMPT_TAG:
+            import jax
+
+            from deepspeed_tpu import comm as dist
+
+            if jax.process_index() == 0:
+                os.rename(preempt_dir,
+                          preempt_dir + f".restored_step"
+                                        f"{self.engine.global_steps}")
+            dist.barrier()
         return loaded_tag
 
     def close(self):
         for sig, prev in self._prev_handlers.items():
-            signal.signal(sig, prev)
+            # prev is None when the prior handler was installed at the C
+            # level (gRPC etc.) — nothing restorable from Python
+            signal.signal(sig, prev if prev is not None else signal.SIG_DFL)
         self._prev_handlers.clear()
 
 

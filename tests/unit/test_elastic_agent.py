@@ -107,6 +107,37 @@ class TestElasticRestore:
         assert np.isfinite(_step(engine2, dp2, seed=9))
         agent2.close()
 
+    def test_preempt_checkpoint_consumed_on_restore(self, tmp_path):
+        """A restored preempt checkpoint must not roll back a later,
+        unrelated restart — it is renamed after a successful restore."""
+        engine, dp = _engine({"data": 8})
+        agent = DSElasticAgent(engine, str(tmp_path),
+                               install_handlers=False)
+        _step(engine, dp)
+        agent.signal_preemption()
+        agent.step_boundary()
+        agent.close()
+
+        reset_topology()
+        engine2, dp2 = _engine({"data": 8})
+        _step(engine2, dp2)
+        agent2 = DSElasticAgent(engine2, str(tmp_path),
+                                install_handlers=False)
+        assert agent2.restore_if_any() == PREEMPT_TAG
+        assert not (tmp_path / PREEMPT_TAG).exists()  # consumed
+        # a second restore finds nothing preempt-tagged
+        assert agent2.restore_if_any() is None
+        agent2.close()
+
+    def test_close_survives_c_level_prior_handler(self, tmp_path):
+        engine, _ = _engine({"data": 8})
+        agent = DSElasticAgent(engine, str(tmp_path),
+                               signals=(signal.SIGUSR2,))
+        # simulate signal.signal having returned None for the prior handler
+        agent._prev_handlers[signal.SIGUSR2] = None
+        agent.close()  # must not raise
+        assert agent._prev_handlers == {}
+
     def test_restore_without_checkpoint_is_noop(self, tmp_path):
         engine, _ = _engine({"data": 8})
         agent = DSElasticAgent(engine, str(tmp_path / "nothing"),

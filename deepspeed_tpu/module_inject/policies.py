@@ -107,7 +107,54 @@ GPT2_POLICY = TPPolicy(
     "gpt2",
     [("c_proj", ROW), ("c_attn", COLUMN), ("c_fc", COLUMN), ("wte", VOCAB)])
 
-_POLICIES: Dict[str, TPPolicy] = {"auto": AUTO_POLICY, "gpt2": GPT2_POLICY}
+# Per-architecture policy zoo (reference replace_policy.py arch classes,
+# module_inject/replace_policy.py:174-712 — BERT/CLIP/GPT-Neo/GPT-J/
+# Megatron/GPT2/BLOOM/GPT-NeoX/OPT): each names the arch's column-parallel
+# inputs (QKV + MLP up), row-parallel outputs (attn out + MLP down), and
+# vocab-sharded embeddings. The reference slices weights per these maps;
+# here they become PartitionSpec rules GSPMD executes.
+LLAMA_POLICY = TPPolicy(
+    "llama",
+    [("o_proj", ROW), ("down_proj", ROW),
+     ("q_proj", COLUMN), ("k_proj", COLUMN), ("v_proj", COLUMN),
+     ("gate_proj", COLUMN), ("up_proj", COLUMN),
+     ("embed_tokens", VOCAB), ("lm_head", VOCAB)])
+
+OPT_POLICY = TPPolicy(
+    "opt",
+    [("out_proj", ROW), ("fc2", ROW),
+     ("q_proj", COLUMN), ("k_proj", COLUMN), ("v_proj", COLUMN),
+     ("fc1", COLUMN), ("embed_tokens", VOCAB), ("lm_head", VOCAB)])
+
+BLOOM_POLICY = TPPolicy(
+    "bloom",
+    [("dense", ROW), ("dense_4h_to_h", ROW),
+     ("query_key_value", COLUMN), ("dense_h_to_4h", COLUMN),
+     ("word_embeddings", VOCAB), ("lm_head", VOCAB)])
+
+GPTJ_POLICY = TPPolicy(
+    "gptj",
+    [("out_proj", ROW), ("fc_out", ROW),
+     ("q_proj", COLUMN), ("k_proj", COLUMN), ("v_proj", COLUMN),
+     ("fc_in", COLUMN), ("wte", VOCAB), ("lm_head", VOCAB)])
+
+GPT_NEOX_POLICY = TPPolicy(
+    "gpt-neox",
+    [("dense", ROW), ("dense_4h_to_h", ROW),
+     ("query_key_value", COLUMN), ("dense_h_to_4h", COLUMN),
+     ("embed_in", VOCAB), ("embed_out", VOCAB)])
+
+BERT_POLICY = TPPolicy(
+    "bert",
+    [("output", ROW),  # attention.output.dense + layer output.dense
+     ("query", COLUMN), ("key", COLUMN), ("value", COLUMN),
+     ("intermediate", COLUMN), ("word_embeddings", VOCAB)])
+
+_POLICIES: Dict[str, TPPolicy] = {
+    "auto": AUTO_POLICY, "gpt2": GPT2_POLICY, "llama": LLAMA_POLICY,
+    "opt": OPT_POLICY, "bloom": BLOOM_POLICY, "gptj": GPTJ_POLICY,
+    "gpt-neox": GPT_NEOX_POLICY, "bert": BERT_POLICY,
+}
 
 
 def register_tp_policy(policy: TPPolicy):

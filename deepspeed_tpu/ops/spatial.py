@@ -1,0 +1,45 @@
+"""Spatial (diffusers/UNet/VAE) fused ops.
+
+Capability parity with the reference ``csrc/spatial/csrc/opt_bias_add.cu``
+(``opt_bias_add``, ``opt_bias_add_add``, ``opt_bias_add_bias_add`` — fused
+channels-last bias-add variants used by DeepSpeed's diffusers inference
+path, exposed via ``op_builder/spatial_inference.py``). On TPU these are
+pure XLA element-wise fusions — the compiler fuses them into neighboring
+convs/matmuls, so the "kernel" is the right broadcasting contract, kept as
+named functions so injection policies can target them.
+
+Layout: NHWC (channels last), bias ``[C]``.
+"""
+
+import jax.numpy as jnp
+
+
+def bias_add(activation, bias):
+    """out = activation + bias (reference ``opt_bias_add``)."""
+    return activation + bias.astype(activation.dtype)
+
+
+def bias_add_add(activation, bias, other):
+    """out = (activation + bias) + other (reference ``opt_bias_add_add``):
+    the residual form used after UNet attention blocks."""
+    return activation + bias.astype(activation.dtype) + other
+
+
+def bias_add_bias_add(activation, bias, other, other_bias):
+    """out = (activation + bias) + (other + other_bias)
+    (reference ``opt_bias_add_bias_add``): joins two biased branches."""
+    return (activation + bias.astype(activation.dtype)
+            + other + other_bias.astype(other.dtype))
+
+
+def nhwc_group_norm(x, groups: int, scale, bias, eps: float = 1e-5):
+    """GroupNorm over channels-last activations — the other hot spatial op
+    in the reference's diffusers path (fused there via cuDNN/custom
+    kernels; one fused XLA reduction here). x: [N, H, W, C]."""
+    n, h, w, c = x.shape
+    xg = x.reshape(n, h, w, groups, c // groups).astype(jnp.float32)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    out = xg.reshape(n, h, w, c)
+    return (out * scale + bias).astype(x.dtype)

@@ -1,0 +1,80 @@
+"""Spatial/diffusers ops (reference csrc/spatial/csrc/opt_bias_add.cu) and
+the per-arch TP policy zoo (reference module_inject/replace_policy.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.spatial import (bias_add, bias_add_add,
+                                       bias_add_bias_add, nhwc_group_norm)
+
+
+class TestSpatialOps:
+    def setup_method(self, _):
+        rng = np.random.default_rng(0)
+        self.x = jnp.asarray(rng.standard_normal((2, 4, 4, 8)), jnp.float32)
+        self.y = jnp.asarray(rng.standard_normal((2, 4, 4, 8)), jnp.float32)
+        self.b1 = jnp.asarray(rng.standard_normal(8), jnp.float32)
+        self.b2 = jnp.asarray(rng.standard_normal(8), jnp.float32)
+
+    def test_bias_add(self):
+        np.testing.assert_allclose(bias_add(self.x, self.b1),
+                                   np.asarray(self.x) + np.asarray(self.b1))
+
+    def test_bias_add_add(self):
+        np.testing.assert_allclose(
+            bias_add_add(self.x, self.b1, self.y),
+            np.asarray(self.x) + np.asarray(self.b1) + np.asarray(self.y),
+            rtol=1e-6)
+
+    def test_bias_add_bias_add(self):
+        np.testing.assert_allclose(
+            bias_add_bias_add(self.x, self.b1, self.y, self.b2),
+            np.asarray(self.x) + np.asarray(self.b1) + np.asarray(self.y)
+            + np.asarray(self.b2), rtol=1e-6)
+
+    def test_group_norm_matches_reference(self):
+        groups = 4
+        scale = jnp.ones(8)
+        bias = jnp.zeros(8)
+        out = np.asarray(nhwc_group_norm(self.x, groups, scale, bias))
+        # torch reference on NCHW
+        torch = pytest.importorskip("torch")
+        xt = torch.tensor(np.asarray(self.x)).permute(0, 3, 1, 2)
+        ref = torch.nn.functional.group_norm(xt, groups).permute(0, 2, 3, 1)
+        np.testing.assert_allclose(out, ref.numpy(), atol=1e-5)
+
+
+class TestPolicyZoo:
+    @pytest.mark.parametrize("name,col,row", [
+        ("llama", "self_attn/q_proj/kernel", "self_attn/o_proj/kernel"),
+        ("opt", "self_attn/k_proj/kernel", "fc2/kernel"),
+        ("bloom", "self_attention/query_key_value/kernel",
+         "mlp/dense_4h_to_h/kernel"),
+        ("gptj", "mlp/fc_in/kernel", "mlp/fc_out/kernel"),
+        ("gpt-neox", "attention/query_key_value/kernel",
+         "mlp/dense_4h_to_h/kernel"),
+        ("bert", "attention/self/query/kernel", "output/dense/kernel"),
+    ])
+    def test_roles(self, name, col, row):
+        from deepspeed_tpu.module_inject.policies import (COLUMN, ROW,
+                                                          get_tp_policy)
+
+        p = get_tp_policy(name)
+        assert p.role_for(col) == COLUMN
+        assert p.role_for(row) == ROW
+
+    def test_specs_shard_correct_dims(self):
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.module_inject.policies import get_tp_policy
+
+        p = get_tp_policy("llama")
+        # column: output dim sharded
+        assert p.spec_for("layers/block/self_attn/q_proj/kernel",
+                          (64, 64), tp_size=2) == P(None, "model")
+        # row: input dim sharded, bias replicated
+        assert p.spec_for("layers/block/self_attn/o_proj/kernel",
+                          (64, 64), tp_size=2) == P("model", None)
+        assert p.spec_for("embed_tokens", (256, 64), tp_size=2) == \
+            P("model", None)

@@ -40,10 +40,16 @@ class DSElasticAgent:
     def __init__(self, engine, save_dir: str,
                  signals=(signal.SIGTERM,),
                  on_preempt: Optional[Callable] = None,
-                 install_handlers: bool = True):
+                 install_handlers: bool = True,
+                 agree_every: int = 16):
         self.engine = engine
         self.save_dir = save_dir
         self.on_preempt = on_preempt
+        # multi-host: how often (in optimizer steps) hosts agree on the
+        # flag — the agreement is a host-synchronizing collective, so
+        # per-step would cap run-ahead; preemption notice periods are tens
+        # of seconds, a K-step save latency is immaterial
+        self.agree_every = max(1, int(agree_every))
         self._preempted = False
         self._prev_handlers = {}
         if install_handlers:
@@ -84,10 +90,18 @@ class DSElasticAgent:
     def step_boundary(self) -> bool:
         """Call once per optimizer step; True = checkpointed, stop now.
 
-        Multi-host: call on EVERY host each step (it agrees on the flag
-        collectively); single-host: cheap local check.
+        Multi-host: call on EVERY host each step — hosts agree on the flag
+        collectively every ``agree_every`` steps (same cadence everywhere:
+        keyed to the engine's step counter). Single-host: cheap local check.
         """
-        if not self._any_host_preempted():
+        import jax
+
+        if jax.process_count() > 1:
+            if self.engine.global_steps % self.agree_every != 0:
+                return False  # between agreement points: no collective
+            if not self._any_host_preempted():
+                return False
+        elif not self._preempted:
             return False
         self._preempted = True  # another host was signaled: join the save
         # save_latest=False: the preempt tag is consumed on restore, and a
@@ -102,40 +116,47 @@ class DSElasticAgent:
         return True
 
     # ------------------------------------------------------------------
-    def restore_if_any(self):
-        """Load the preemption (or latest) checkpoint onto the current
-        mesh. Returns the tag restored, or None. The current mesh may have
-        a different shape than the one that saved — the checkpoint layer
-        reshards (test_sharded_checkpoint.py proves both directions).
+    @staticmethod
+    def _tag_step(tag_dir: str) -> int:
+        """global_steps recorded in a checkpoint tag directory (the engine
+        aux file is the consolidated npz/json format in every mode)."""
+        try:
+            from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (  # noqa: E501
+                ArrayCheckpointEngine)
 
-        A restored preempt checkpoint is CONSUMED (renamed): leaving it on
-        disk would roll training back to it after any later, unrelated
-        restart, silently discarding progress saved since.
-        """
+            state = ArrayCheckpointEngine().load(
+                os.path.join(tag_dir, "engine"))
+            return int(state.get("global_steps", -1))
+        except Exception:
+            return -1
+
+    def restore_if_any(self):
+        """Load the NEWEST of {preempt checkpoint, 'latest' checkpoint}
+        onto the current mesh, by comparing their recorded step counters —
+        a stale preempt tag never rolls back past a newer regular save, and
+        nothing is deleted (a crash right after restore still finds every
+        checkpoint on disk). Returns the tag restored, or None. The current
+        mesh may differ from the saving mesh — the checkpoint layer
+        reshards (test_sharded_checkpoint.py proves both directions)."""
         if not os.path.isdir(self.save_dir):
             return None
-        tag = None
+        candidates = []  # (step, tag_or_None)
         preempt_dir = os.path.join(self.save_dir, PREEMPT_TAG)
         if os.path.isdir(preempt_dir):
-            tag = PREEMPT_TAG
-        elif os.path.exists(os.path.join(self.save_dir, "latest")):
-            tag = None  # engine resolves from the latest file
-        else:
+            candidates.append((self._tag_step(preempt_dir), PREEMPT_TAG))
+        latest_file = os.path.join(self.save_dir, "latest")
+        if os.path.exists(latest_file):
+            with open(latest_file) as f:
+                latest_tag = f.read().strip()
+            candidates.append((self._tag_step(
+                os.path.join(self.save_dir, latest_tag)), None))
+        if not candidates:
             return None
+        _, tag = max(candidates, key=lambda c: c[0])
         loaded_tag, _ = self.engine.load_checkpoint(self.save_dir, tag=tag)
         if loaded_tag is not None:
             log_dist(f"elastic restore: resumed from {loaded_tag!r} at "
                      f"step {self.engine.global_steps}", ranks=[0])
-        if loaded_tag == PREEMPT_TAG:
-            import jax
-
-            from deepspeed_tpu import comm as dist
-
-            if jax.process_index() == 0:
-                os.rename(preempt_dir,
-                          preempt_dir + f".restored_step"
-                                        f"{self.engine.global_steps}")
-            dist.barrier()
         return loaded_tag
 
     def close(self):

@@ -107,15 +107,20 @@ class TestElasticRestore:
         assert np.isfinite(_step(engine2, dp2, seed=9))
         agent2.close()
 
-    def test_preempt_checkpoint_consumed_on_restore(self, tmp_path):
-        """A restored preempt checkpoint must not roll back a later,
-        unrelated restart — it is renamed after a successful restore."""
+    def test_newest_checkpoint_wins(self, tmp_path):
+        """A stale preempt tag must not roll back past a newer regular
+        save; a newer preempt tag must win over an older regular save.
+        Nothing is deleted either way."""
         engine, dp = _engine({"data": 8})
         agent = DSElasticAgent(engine, str(tmp_path),
                                install_handlers=False)
         _step(engine, dp)
         agent.signal_preemption()
-        agent.step_boundary()
+        agent.step_boundary()  # preempt tag at step 1
+        agent._preempted = False
+        _step(engine, dp, seed=1)
+        _step(engine, dp, seed=2)
+        engine.save_checkpoint(str(tmp_path))  # regular save at step 3
         agent.close()
 
         reset_topology()
@@ -123,11 +128,24 @@ class TestElasticRestore:
         _step(engine2, dp2)
         agent2 = DSElasticAgent(engine2, str(tmp_path),
                                 install_handlers=False)
-        assert agent2.restore_if_any() == PREEMPT_TAG
-        assert not (tmp_path / PREEMPT_TAG).exists()  # consumed
-        # a second restore finds nothing preempt-tagged
-        assert agent2.restore_if_any() is None
+        tag = agent2.restore_if_any()
+        assert tag != PREEMPT_TAG  # the newer regular save won
+        assert engine2.global_steps == 3
+        assert (tmp_path / PREEMPT_TAG).exists()  # nothing deleted
+
+        # now a NEWER preemption: it must win over the step-3 save
+        _step(engine2, dp2, seed=3)
+        agent2.signal_preemption()
+        agent2.step_boundary()  # preempt tag now at step 4
         agent2.close()
+        reset_topology()
+        engine3, dp3 = _engine({"data": 8})
+        _step(engine3, dp3)
+        agent3 = DSElasticAgent(engine3, str(tmp_path),
+                                install_handlers=False)
+        assert agent3.restore_if_any() == PREEMPT_TAG
+        assert engine3.global_steps == 4
+        agent3.close()
 
     def test_close_survives_c_level_prior_handler(self, tmp_path):
         engine, _ = _engine({"data": 8})

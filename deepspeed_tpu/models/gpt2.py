@@ -17,6 +17,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepspeed_tpu.ops.attention import attention
 
@@ -43,6 +44,17 @@ class GPT2Config:
     # collection) — the TPU-native form of the reference's inference
     # workspace (csrc/transformer/inference/includes/inference_context.h)
     decode: bool = False
+    # --- canonical-decoder knobs: this model executes the whole fused-
+    # c_attn decoder family the state-dict factory normalizes to (GPT-2,
+    # OPT, BLOOM — reference model_implementations/ arch classes) ---
+    # MLP activation: "gelu" (GPT-2/BLOOM) | "relu" (OPT)
+    activation: str = "gelu"
+    # positions: "learned" (GPT-2/OPT wpe table) | "alibi" (BLOOM slopes)
+    position_embedding: str = "learned"
+    # OPT quirk: its embed_positions table has 2 pad rows; lookups offset
+    position_offset: int = 0
+    # BLOOM applies a layernorm right after the token embedding
+    embedding_layernorm: bool = False
     # progressive layer drop (reference runtime/progressive_layer_drop.py:5):
     # when on, the forward accepts a traced ``pld_theta`` scalar and each
     # block's residual is stochastically ZEROED with depth-scaled keep
@@ -77,6 +89,30 @@ class GPT2Config:
 
 def _dense_init(scale=0.02):
     return nn.initializers.normal(stddev=scale)
+
+
+def alibi_slopes(n_head: int) -> np.ndarray:
+    """ALiBi per-head slopes (BLOOM's formula: geometric 2^(-8i/n) for
+    power-of-two head counts, interpolated otherwise)."""
+    import math
+
+    def pow2(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start ** (i + 1) for i in range(n)]
+
+    if math.log2(n_head).is_integer():
+        return np.asarray(pow2(n_head), np.float32)
+    p = 2 ** int(math.floor(math.log2(n_head)))
+    return np.asarray(pow2(p) + pow2(2 * p)[0::2][:n_head - p], np.float32)
+
+
+def _alibi_bias(cfg, key_positions):
+    """[1, H, 1, K] additive logits bias: slope * key_position. Softmax is
+    shift-invariant per query row, so this equals the slope*(j-i) distance
+    form under the causal mask (the identity HF BLOOM also relies on)."""
+    slopes = jnp.asarray(alibi_slopes(cfg.n_head))
+    return (slopes[:, None, None]
+            * key_positions.astype(jnp.float32)[None, None, :])[None]
 
 
 def _remat_block(cfg):
@@ -137,7 +173,8 @@ class CausalSelfAttention(nn.Module):
             if not is_prefill:
                 from deepspeed_tpu.ops.attention import use_decode_kernel
 
-                if use_decode_kernel():
+                alibi = cfg.position_embedding == "alibi"
+                if use_decode_kernel() and not alibi:
                     # Pallas decode kernel: reads the cache in its native
                     # [B, S, H, D] layout (no per-token cache transpose) and
                     # only the valid [0, idx+T) prefix does compute
@@ -153,15 +190,18 @@ class CausalSelfAttention(nn.Module):
                     key_pos = jnp.arange(cfg.n_positions)
                     q_pos = idx + jnp.arange(T)
                     mask = key_pos[None, :] <= q_pos[:, None]
+                    bias = _alibi_bias(cfg, key_pos) if alibi else None
                     y = attention(q4.transpose(0, 2, 1, 3), kc, vc,
-                                  mask=mask[None, None],
+                                  mask=mask[None, None], bias=bias,
                                   causal=False, use_flash=False)
                 cached_attn = True
         if not cached_attn:  # training forward, or decode-mode prefill
             k = k.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
             v = v.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
+            bias = (_alibi_bias(cfg, jnp.arange(T))
+                    if cfg.position_embedding == "alibi" else None)
             y = attention(q4.transpose(0, 2, 1, 3), k, v, causal=True,
-                          use_flash=cfg.use_flash)
+                          bias=bias, use_flash=cfg.use_flash)
         y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
         y = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
                      kernel_init=_dense_init(0.02 / (2 * cfg.n_layer) ** 0.5),
@@ -179,7 +219,8 @@ class MLP(nn.Module):
         cfg = self.config
         h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, kernel_init=_dense_init(),
                      name="c_fc")(x)
-        h = nn.gelu(h, approximate=True)
+        h = (nn.relu(h) if cfg.activation == "relu"
+             else nn.gelu(h, approximate=True))
         h = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
                      kernel_init=_dense_init(0.02 / (2 * cfg.n_layer) ** 0.5),
                      name="c_proj")(h)
@@ -286,17 +327,29 @@ class GPT2LMHeadModel(nn.Module):
         cfg = self.config
         B, T = input_ids.shape
         wte = self.param("wte", _dense_init(), (cfg.vocab_size, cfg.n_embd), jnp.float32)
-        wpe = self.param("wpe", _dense_init(0.01), (cfg.n_positions, cfg.n_embd), jnp.float32)
-        if cfg.decode:
-            # track the absolute position across prefill/decode calls
-            pos_var = self.variable("cache", "position",
-                                    lambda: jnp.zeros((), jnp.int32))
-            pos = pos_var.value
-            pos_var.value = pos + T
-            pos_emb = jax.lax.dynamic_slice(wpe, (pos, 0), (T, cfg.n_embd))[None]
-        else:
-            pos_emb = wpe[None, :T]
-        x = wte[input_ids].astype(cfg.dtype) + pos_emb.astype(cfg.dtype)
+        x = wte[input_ids].astype(cfg.dtype)
+        if cfg.position_embedding == "learned":
+            # table carries position_offset pad rows (OPT stores 2)
+            wpe = self.param("wpe", _dense_init(0.01),
+                             (cfg.n_positions + cfg.position_offset,
+                              cfg.n_embd), jnp.float32)
+            if cfg.decode:
+                # track the absolute position across prefill/decode calls
+                pos_var = self.variable("cache", "position",
+                                        lambda: jnp.zeros((), jnp.int32))
+                pos = pos_var.value
+                pos_var.value = pos + T
+                pos_emb = jax.lax.dynamic_slice(
+                    wpe, (pos + cfg.position_offset, 0),
+                    (T, cfg.n_embd))[None]
+            else:
+                pos_emb = wpe[None, cfg.position_offset:
+                              cfg.position_offset + T]
+            x = x + pos_emb.astype(cfg.dtype)
+        # "alibi": no position table — the bias lives in attention logits
+        if cfg.embedding_layernorm:  # BLOOM's word_embeddings_layernorm
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                             name="emb_ln")(x)
         if cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
         blocks = ScanBlocks if cfg.scan_layers else LoopBlocks

@@ -30,17 +30,21 @@ def use_decode_kernel() -> bool:
 
 
 def attention_reference(q, k, v, mask=None, causal=True, softmax_scale=None,
-                        dropout_rate=0.0, dropout_rng=None):
+                        dropout_rate=0.0, dropout_rng=None, bias=None):
     """Plain XLA attention: q,k,v [batch, heads, seq, head_dim].
 
     Softmax in fp32 regardless of input dtype (the reference CUDA softmax
     also accumulates in fp32: ``csrc/transformer/softmax_kernels.cu``).
+    ``bias``: additive logits bias broadcastable to [batch, heads, q, k]
+    (ALiBi slopes, relative-position biases).
     """
     *_, q_len, head_dim = q.shape
     k_len = k.shape[-2]
     scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
     logits = jnp.einsum("...qd,...kd->...qk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     if causal:
         causal_mask = jnp.tril(jnp.ones((q_len, k_len), dtype=bool), k=k_len - q_len)
         logits = jnp.where(causal_mask, logits, jnp.finfo(jnp.float32).min)
@@ -54,14 +58,26 @@ def attention_reference(q, k, v, mask=None, causal=True, softmax_scale=None,
 
 
 def attention(q, k, v, mask=None, causal=True, softmax_scale=None,
-              dropout_rate=0.0, dropout_rng=None, use_flash: Optional[bool] = None):
+              dropout_rate=0.0, dropout_rng=None,
+              use_flash: Optional[bool] = None, bias=None):
     """Dispatching attention entry point.
 
     Auto mode (``use_flash=None``): seq axis active on the mesh → ring
     attention (sequence parallelism) when shapes allow; else the Pallas flash
     kernel on TPU; else the XLA reference. An explicit ``use_flash`` bool
     bypasses ring dispatch (the escape hatch for numerics comparison).
+    ``bias`` (additive logits bias, e.g. ALiBi) always takes the XLA
+    reference path — the Pallas kernels don't consume it.
     """
+    if bias is not None:
+        if use_flash or (use_flash is None and _on_tpu() and mask is None):
+            _warn_fallback(q.shape, k.shape,
+                           "additive logits bias (ALiBi/rpe) — the Pallas "
+                           "kernels don't consume it")
+        return attention_reference(q, k, v, mask=mask, causal=causal,
+                                   softmax_scale=softmax_scale,
+                                   dropout_rate=dropout_rate,
+                                   dropout_rng=dropout_rng, bias=bias)
     from deepspeed_tpu.parallel.topology import AXIS_SEQ, get_topology
 
     topo = get_topology(create_if_missing=False)

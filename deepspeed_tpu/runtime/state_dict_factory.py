@@ -367,7 +367,28 @@ def detect_arch(sd: Dict[str, Any]) -> Optional[str]:
 
 
 # ----------------------------------------------------------------------
-# Materialize into this framework's GPT-2 model
+# Materialize into this framework's models
+
+
+def _sniff_config(src, *keys):
+    """First matching value from the model dir's config.json (``src`` may
+    be a dir, a file inside one, or a non-path — then None)."""
+    if not isinstance(src, (str, os.PathLike)):
+        return None
+    path = str(src)
+    if not os.path.isdir(path):
+        path = os.path.dirname(path)
+    cfg_json = os.path.join(path, "config.json") if path else None
+    if not cfg_json or not os.path.exists(cfg_json):
+        return None
+    import json
+
+    with open(cfg_json) as f:
+        hf = json.load(f)
+    for key in keys:
+        if key in hf:
+            return hf[key]
+    return None
 
 
 def load_hf_gpt2(src, scan_layers: bool = True, dtype=None,
@@ -381,17 +402,12 @@ def load_hf_gpt2(src, scan_layers: bool = True, dtype=None,
     dims. The returned params slot straight into
     ``initialize(model_parameters=...)`` or ``init_inference(params=...)``.
     """
-    import json
-
     import jax.numpy as jnp
 
     from deepspeed_tpu.models.gpt2 import GPT2Config
 
-    if n_head is None and isinstance(src, (str, os.PathLike)):
-        cfg_json = os.path.join(str(src), "config.json")
-        if os.path.isdir(str(src)) and os.path.exists(cfg_json):
-            with open(cfg_json) as f:
-                n_head = json.load(f).get("n_head")
+    if n_head is None:
+        n_head = _sniff_config(src, "n_head", "num_attention_heads")
     sd = SDLoaderFactory.load(src)
     wm = GPT2WeightMap()
     n_layer = wm.n_layers(sd)
@@ -405,7 +421,20 @@ def load_hf_gpt2(src, scan_layers: bool = True, dtype=None,
         dtype=dtype if dtype is not None else jnp.float32,
         scan_layers=scan_layers)
 
+    params = _canonical_gpt2_tree(layers, top, scan_layers, wpe=wpe)
+    logger.info(f"loaded HF GPT-2: {n_layer} layers, n_embd={n_embd}, "
+                f"vocab={wte.shape[0]}")
+    return config, params
+
+
+def _canonical_gpt2_tree(layers, top, scan_layers, wpe=None, emb_ln=False):
+    """Canonical per-layer dicts → the flax GPT2LMHeadModel param tree
+    (the one model that executes the whole fused-c_attn decoder family)."""
+
     def block_tree(lw):
+        # direct indexing throughout: every arch this tree serves
+        # (gpt2/opt/bloom) has all these weights — a missing one means a
+        # truncated checkpoint and must fail loudly, not zero-fill
         return {
             "ln_1": {"scale": lw["ln_1.scale"], "bias": lw["ln_1.bias"]},
             "attn": {"c_attn": {"kernel": lw["c_attn.kernel"],
@@ -426,14 +455,88 @@ def load_hf_gpt2(src, scan_layers: bool = True, dtype=None,
     else:
         transformer = {f"h_{i}": block_tree(l) for i, l in enumerate(layers)}
     params = {
-        "wte": wte, "wpe": wpe,
+        "wte": top["wte"],
         "ln_f": {"scale": top["ln_f.scale"], "bias": top["ln_f.bias"]},
         "transformer": transformer,
     }
-    params = jax.tree_util.tree_map(
+    if wpe is not None:
+        params["wpe"] = wpe
+    if emb_ln:
+        params["emb_ln"] = {"scale": top["emb_ln.scale"],
+                            "bias": top["emb_ln.bias"]}
+    return jax.tree_util.tree_map(
         lambda x: np.asarray(x, np.float32), params)
-    logger.info(f"loaded HF GPT-2: {n_layer} layers, n_embd={n_embd}, "
+
+
+def load_hf_opt(src, scan_layers: bool = True, dtype=None,
+                n_head: Optional[int] = None):
+    """HF ``OPTForCausalLM`` checkpoint → (GPT2Config, flax params): the
+    canonical decoder runs OPT as relu activation + learned positions with
+    the 2-row pad offset HF's embed_positions carries. (Pre-LN variants
+    only — the 350m post-LN oddity is not supported.)"""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    if n_head is None:
+        n_head = _sniff_config(src, "num_attention_heads", "n_head")
+    if n_head is None:
+        # unlike GPT-2's uniform head_dim-64, real OPT sizes (2.7b+) use
+        # head_dim 80 — a silent guess divides evenly and produces wrong
+        # logits with no error
+        raise ValueError("load_hf_opt needs n_head (config.json or arg)")
+    sd = SDLoaderFactory.load(src)
+    wm = OPTWeightMap()
+    n_layer = wm.n_layers(sd)
+    top = wm.top_weights(sd)
+    wte, wpe = top["wte"], top["wpe"]
+    n_embd = wte.shape[1]
+    layers = [wm.layer_weights(sd, i) for i in range(n_layer)]
+    config = GPT2Config(
+        vocab_size=wte.shape[0], n_positions=wpe.shape[0] - 2,
+        n_embd=n_embd, n_layer=n_layer, n_head=n_head,
+        activation="relu", position_offset=2,
+        dtype=dtype if dtype is not None else jnp.float32,
+        scan_layers=scan_layers)
+    params = _canonical_gpt2_tree(layers, top, scan_layers, wpe=wpe)
+    logger.info(f"loaded HF OPT: {n_layer} layers, n_embd={n_embd}, "
                 f"vocab={wte.shape[0]}")
+    return config, params
+
+
+def load_hf_bloom(src, scan_layers: bool = True, dtype=None,
+                  n_head: Optional[int] = None,
+                  max_positions: int = 2048):
+    """HF ``BloomForCausalLM`` checkpoint → (GPT2Config, flax params): the
+    canonical decoder runs BLOOM as ALiBi positions (no table), gelu, and
+    the word-embedding layernorm; QKV is de-interleaved by the weight map.
+    ``n_head`` is required for bare state_dicts (ALiBi slopes and the QKV
+    layout both depend on it)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    if n_head is None:
+        n_head = _sniff_config(src, "n_head", "num_attention_heads")
+    if n_head is None:
+        raise ValueError("load_hf_bloom needs n_head (config.json or arg): "
+                         "ALiBi slopes and QKV de-interleave depend on it")
+    sd = SDLoaderFactory.load(src)
+    wm = BloomWeightMap(n_head=n_head)
+    n_layer = wm.n_layers(sd)
+    top = wm.top_weights(sd)
+    wte = top["wte"]
+    n_embd = wte.shape[1]
+    layers = [wm.layer_weights(sd, i) for i in range(n_layer)]
+    config = GPT2Config(
+        vocab_size=wte.shape[0], n_positions=max_positions,
+        n_embd=n_embd, n_layer=n_layer, n_head=n_head,
+        position_embedding="alibi", embedding_layernorm=True,
+        dtype=dtype if dtype is not None else jnp.float32,
+        scan_layers=scan_layers)
+    params = _canonical_gpt2_tree(layers, top, scan_layers, emb_ln=True)
+    logger.info(f"loaded HF BLOOM: {n_layer} layers, n_embd={n_embd}, "
+                f"vocab={wte.shape[0]}, alibi heads={n_head}")
     return config, params
 
 
@@ -449,27 +552,20 @@ def load_hf_llama(src, scan_layers: bool = True, dtype=None,
     config.json when present, else the Llama-2 defaults. Pass head counts
     for bare state_dicts (k_proj's out-dim reveals kv heads only up to
     head_dim)."""
-    import json
-
     import jax.numpy as jnp
 
     from deepspeed_tpu.models.llama import LlamaConfig
 
-    if isinstance(src, (str, os.PathLike)) and os.path.isdir(str(src)):
-        cfg_json = os.path.join(str(src), "config.json")
-        if os.path.exists(cfg_json):
-            with open(cfg_json) as f:
-                hf = json.load(f)
-            num_attention_heads = num_attention_heads or hf.get(
-                "num_attention_heads")
-            num_key_value_heads = num_key_value_heads or hf.get(
-                "num_key_value_heads")
-            if rope_theta is None:
-                rope_theta = hf.get("rope_theta")
-            if rms_norm_eps is None:
-                rms_norm_eps = hf.get("rms_norm_eps")
-            max_position_embeddings = max_position_embeddings or hf.get(
-                "max_position_embeddings")
+    num_attention_heads = (num_attention_heads
+                           or _sniff_config(src, "num_attention_heads"))
+    num_key_value_heads = (num_key_value_heads
+                           or _sniff_config(src, "num_key_value_heads"))
+    if rope_theta is None:
+        rope_theta = _sniff_config(src, "rope_theta")
+    if rms_norm_eps is None:
+        rms_norm_eps = _sniff_config(src, "rms_norm_eps")
+    max_position_embeddings = (max_position_embeddings or _sniff_config(
+        src, "max_position_embeddings"))
     rope_theta = 10000.0 if rope_theta is None else rope_theta
     rms_norm_eps = 1e-5 if rms_norm_eps is None else rms_norm_eps
     sd = SDLoaderFactory.load(src)

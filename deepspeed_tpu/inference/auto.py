@@ -1,0 +1,99 @@
+"""Arch-detecting inference entry: HF checkpoint → serving engine.
+
+The reference routes every supported architecture through
+``init_inference`` + per-arch replace policies + the state-dict loaders
+(``inference/engine.py:269,369`` + ``module_inject/replace_policy.py``).
+Here the same flow is one call::
+
+    engine = deepspeed_tpu.inference.from_pretrained(
+        "/path/to/hf-model", tensor_parallel={"tp_size": 4})
+    out = engine.generate(ids, max_new_tokens=64)
+
+Supported: GPT-2, OPT, BLOOM (canonical fused decoder), Llama (native
+family) — detected from the checkpoint's weight names; the matching TP
+injection policy is selected automatically.
+"""
+
+from typing import Optional
+
+from deepspeed_tpu.runtime.state_dict_factory import (SDLoaderFactory,
+                                                      detect_arch,
+                                                      load_hf_bloom,
+                                                      load_hf_gpt2,
+                                                      load_hf_llama,
+                                                      load_hf_opt)
+from deepspeed_tpu.utils.logging import logger
+
+_POLICY_FOR_ARCH = {"gpt2": "gpt2", "opt": "gpt2", "bloom": "gpt2",
+                    "llama": "llama"}
+# gpt2 policy fits opt/bloom here because their weights are NORMALIZED to
+# the canonical fused layout (c_attn/c_proj/c_fc names) before sharding
+
+
+# config.json keys each loader needs when handed a pre-loaded state dict
+# (the dict carries no metadata; the loaders sniff these themselves only
+# when given a path)
+_SNIFF_KW = {
+    "gpt2": {"n_head": ("n_head", "num_attention_heads")},
+    "opt": {"n_head": ("num_attention_heads", "n_head")},
+    "bloom": {"n_head": ("n_head", "num_attention_heads")},
+    "llama": {"num_attention_heads": ("num_attention_heads",),
+              "num_key_value_heads": ("num_key_value_heads",),
+              "rope_theta": ("rope_theta",),
+              "rms_norm_eps": ("rms_norm_eps",),
+              "max_position_embeddings": ("max_position_embeddings",)},
+}
+
+
+def load_pretrained(src, arch: Optional[str] = None, dtype=None,
+                    scan_layers: bool = True, **loader_kw):
+    """(flax_model, params) from an HF checkpoint, arch auto-detected.
+
+    The checkpoint is deserialized ONCE (it may be many GB): arch detection
+    and the loader share the same state dict; config.json metadata is
+    sniffed separately from the original path.
+    """
+    from deepspeed_tpu.runtime.state_dict_factory import _sniff_config
+
+    sd = src if isinstance(src, dict) else SDLoaderFactory.load(src)
+    arch = arch or detect_arch(sd)
+    if arch is None:
+        raise ValueError(
+            "could not detect the checkpoint's architecture; pass arch= "
+            "(one of gpt2/opt/bloom/llama)")
+    for kw_name, keys in _SNIFF_KW[arch].items():
+        if kw_name not in loader_kw:
+            val = _sniff_config(src, *keys)
+            if val is not None:
+                loader_kw[kw_name] = val
+    if arch == "llama":
+        from deepspeed_tpu.models.llama import LlamaModel
+
+        config, params = load_hf_llama(sd, scan_layers=scan_layers,
+                                       dtype=dtype, **loader_kw)
+        model = LlamaModel(config)
+    else:
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+
+        loader = {"gpt2": load_hf_gpt2, "opt": load_hf_opt,
+                  "bloom": load_hf_bloom}[arch]
+        config, params = loader(sd, scan_layers=scan_layers,
+                                dtype=dtype, **loader_kw)
+        model = GPT2LMHeadModel(config)
+    logger.info(f"load_pretrained: arch={arch}")
+    return model, params, arch
+
+
+def from_pretrained(src, arch: Optional[str] = None, dtype=None,
+                    scan_layers: bool = True, loader_kw=None, **engine_kw):
+    """One-call serving engine for an HF checkpoint (reference
+    ``init_inference`` + policy + loader flow)."""
+    import deepspeed_tpu
+
+    model, params, arch = load_pretrained(src, arch=arch, dtype=dtype,
+                                          scan_layers=scan_layers,
+                                          **(loader_kw or {}))
+    engine_kw.setdefault("injection_policy", _POLICY_FOR_ARCH[arch])
+    if dtype is not None:
+        engine_kw.setdefault("dtype", dtype)
+    return deepspeed_tpu.init_inference(model, params=params, **engine_kw)

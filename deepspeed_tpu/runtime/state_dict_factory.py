@@ -370,9 +370,24 @@ def detect_arch(sd: Dict[str, Any]) -> Optional[str]:
 # Materialize into this framework's models
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _load_config_json(path: str):
+    import json
+
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except OSError:
+        return None
+
+
 def _sniff_config(src, *keys):
     """First matching value from the model dir's config.json (``src`` may
-    be a dir, a file inside one, or a non-path — then None)."""
+    be a dir, a file inside one, or a non-path — then None). The json is
+    parsed once per path (lru-cached) however many keys get sniffed."""
     if not isinstance(src, (str, os.PathLike)):
         return None
     path = str(src)
@@ -381,10 +396,9 @@ def _sniff_config(src, *keys):
     cfg_json = os.path.join(path, "config.json") if path else None
     if not cfg_json or not os.path.exists(cfg_json):
         return None
-    import json
-
-    with open(cfg_json) as f:
-        hf = json.load(f)
+    hf = _load_config_json(cfg_json)
+    if hf is None:
+        return None
     for key in keys:
         if key in hf:
             return hf[key]

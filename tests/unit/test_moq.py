@@ -1,0 +1,111 @@
+"""MoQ training quantizer (reference ``runtime/quantize.py:9`` +
+``engine._configure_quantization``, engine.py:1400)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.topology import reset_topology
+from deepspeed_tpu.runtime.quantize import MoQQuantizer, MoQSchedule
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    reset_topology()
+    yield
+    reset_topology()
+
+
+class TestSchedule:
+    def test_transitions_step_down_bits(self):
+        s = MoQSchedule(start_bits=16, target_bits=13, period=10, offset=5)
+        tr = s.transitions()
+        assert [t["bits"] for t in tr] == [15, 14, 13]
+        # period doubling: 10, 20, 40 after the offset
+        assert [t["offset"] for t in tr] == [15, 35, 75]
+
+    def test_eigenvalue_factor_stretches(self):
+        s = MoQSchedule(start_bits=16, target_bits=15, period=10)
+        assert s.transitions(1.0)[0]["offset"] == 10
+        assert s.transitions(3.0)[0]["offset"] == 30
+
+    def test_rejects_increasing_bits(self):
+        with pytest.raises(ValueError):
+            MoQSchedule(start_bits=8, target_bits=16)
+
+
+class TestPlans:
+    def _abstract(self):
+        return {
+            "dense": {"kernel": jax.ShapeDtypeStruct((8, 8), jnp.float32),
+                      "bias": jax.ShapeDtypeStruct((8,), jnp.float32)},
+            "wte": jax.ShapeDtypeStruct((16, 8), jnp.float32),
+        }
+
+    def test_selects_2d_weights_only(self):
+        q = MoQQuantizer(MoQSchedule(16, 14, period=5))
+        plans = q.build_plans(self._abstract())
+        assert "dense/kernel" in plans and "wte" in plans
+        assert "dense/bias" not in plans
+        bits = [e["params"]["bits"] for e in plans["dense/kernel"]]
+        assert bits == [15, 14]
+
+    def test_eigenvalues_scale_periods(self):
+        q = MoQQuantizer(MoQSchedule(16, 15, period=10))
+        q.set_eigenvalues({"dense": 1.0, "wte": 0.1})
+        plans = q.build_plans(self._abstract())
+        # dense: factor 1+floor(1.0*4)=5 -> offset 50; wte: 1+0=1 -> 10
+        assert plans["dense/kernel"][0]["schedule_offset"] == 50
+        assert plans["wte"][0]["schedule_offset"] == 10
+
+
+class TestEngineMoQ:
+    def _train(self, cfg_extra, steps=6, seed=0):
+        from tests.unit.simple_model import random_dataset, simple_loss_fn, \
+            simple_params
+
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config={"train_batch_size": 32,
+                    "optimizer": {"type": "Adam", "params": {"lr": 0.05}},
+                    "steps_per_print": 10_000, **cfg_extra})
+        x, y = random_dataset(128, 8, seed)
+        losses = []
+        for i in range(steps):
+            loss = engine((x[:32], y[:32]))
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        return engine, losses
+
+    def test_moq_schedule_changes_training(self):
+        reset_topology()
+        _, base = self._train({})
+        reset_topology()
+        engine, moq = self._train({"quantize_training": {
+            "enabled": True,
+            "quantize_bits": {"start_bits": 6, "target_bits": 4},
+            "schedule": {"quantize_period": 2, "schedule_offset": 0},
+            "quantize_groups": 1}})
+        assert engine._compressor is not None and engine._compressor.any_active()
+        assert all(np.isfinite(moq))
+        # after the first transitions the quantized trajectory diverges
+        assert not np.allclose(moq[3:], base[3:], rtol=1e-4)
+
+    def test_eigenvalue_adaptive_refresh(self):
+        # reference-style config: the eigenvalue block nested INSIDE
+        # quantize_training alone must activate the measurement
+        engine, losses = self._train({
+            "quantize_training": {
+                "enabled": True,
+                "quantize_bits": {"start_bits": 8, "target_bits": 7},
+                "schedule": {"quantize_period": 3},
+                "eigenvalue": {"enabled": True, "max_iter": 8,
+                               "tol": 1e-1}}},
+            steps=3)
+        assert engine._moq_eig_pending is False
+        assert engine._moq.eigenvalues  # measured, normalized
+        assert max(engine._moq.eigenvalues.values()) == pytest.approx(1.0)
+        assert all(np.isfinite(losses))

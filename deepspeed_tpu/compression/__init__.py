@@ -4,6 +4,10 @@ from deepspeed_tpu.compression.compress import (Compressor,
                                                 get_compression_config,
                                                 init_compression,
                                                 redundancy_clean)
+from deepspeed_tpu.compression.distillation import (init_layer_reduction,
+                                                    kd_loss_fn,
+                                                    student_initialization)
 
 __all__ = ["Compressor", "get_compression_config", "init_compression",
-           "redundancy_clean"]
+           "init_layer_reduction", "kd_loss_fn", "redundancy_clean",
+           "student_initialization"]

@@ -57,10 +57,11 @@ def load_pretrained(src, arch: Optional[str] = None, dtype=None,
 
     sd = src if isinstance(src, dict) else SDLoaderFactory.load(src)
     arch = arch or detect_arch(sd)
-    if arch is None:
+    if arch not in _SNIFF_KW:
         raise ValueError(
-            "could not detect the checkpoint's architecture; pass arch= "
-            "(one of gpt2/opt/bloom/llama)")
+            f"unsupported architecture {arch!r}; supported: "
+            f"{sorted(_SNIFF_KW)} (auto-detected from weight names when "
+            "arch is omitted)")
     for kw_name, keys in _SNIFF_KW[arch].items():
         if kw_name not in loader_kw:
             val = _sniff_config(src, *keys)

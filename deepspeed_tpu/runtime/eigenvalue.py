@@ -78,8 +78,11 @@ class Eigenvalue:
 
         leaves, treedef = jax.tree_util.tree_flatten(sub)
         keys = jax.random.split(rng, len(leaves))
+        # tangents must match the primal dtypes (jvp rejects fp32 tangents
+        # against bf16/fp16 params — exactly the mixed-precision configs
+        # MoQ targets)
         v0 = jax.tree_util.tree_unflatten(
-            treedef, [jax.random.normal(k, l.shape, jnp.float32)
+            treedef, [jax.random.normal(k, l.shape, l.dtype)
                       for k, l in zip(keys, leaves)])
         v0, _ = _normalize(v0)
 

@@ -39,9 +39,10 @@ class MoQSchedule:
         self.period_doubling = period_doubling
 
     def transitions(self, period_factor: float = 1.0) -> List[Dict]:
-        """[(step_offset, bits)] for each precision drop; ``period_factor``
-        stretches the schedule (the eigenvalue adaptation)."""
-        out = []
+        """[(step_offset, bits)] — the first entry applies ``start_bits``
+        AT the offset (so start==target is fixed-bits QAT, not a no-op);
+        later entries drop one bit per (stretched, doubling) period."""
+        out = [{"offset": self.offset, "bits": self.start_bits}]
         step = self.offset
         period = max(1, int(round(self.period * period_factor)))
         for bits in range(self.start_bits - 1, self.target_bits - 1, -1):
@@ -80,10 +81,12 @@ class MoQQuantizer:
         self.eigenvalues = {k: abs(v) / mx for k, v in eigenvalues.items()}
 
     def _factor_for(self, path: str) -> float:
-        for prefix, eig in self.eigenvalues.items():
-            if path.startswith(prefix) or f"/{prefix}" in f"/{path}":
-                return 1.0 + math.floor(eig * 4)
-        return 1.0
+        # eigenvalue keys are whole top-level blocks: match the path's
+        # FIRST SEGMENT exactly (prefix matching would let "dense" claim
+        # "dense2/kernel")
+        head = path.split("/", 1)[0]
+        eig = self.eigenvalues.get(head)
+        return 1.0 + math.floor(eig * 4) if eig is not None else 1.0
 
     def build_plans(self, params_abstract) -> Dict[str, List[Dict]]:
         """Compressor-style plans: one fake-quant entry per bit transition,

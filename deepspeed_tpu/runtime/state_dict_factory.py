@@ -375,13 +375,12 @@ import functools
 
 @functools.lru_cache(maxsize=32)
 def _load_config_json(path: str):
+    # open/parse errors propagate: a present-but-unreadable config.json
+    # must not silently degrade to default hyperparameters
     import json
 
-    try:
-        with open(path) as f:
-            return json.load(f)
-    except OSError:
-        return None
+    with open(path) as f:
+        return json.load(f)
 
 
 def _sniff_config(src, *keys):
@@ -397,8 +396,6 @@ def _sniff_config(src, *keys):
     if not cfg_json or not os.path.exists(cfg_json):
         return None
     hf = _load_config_json(cfg_json)
-    if hf is None:
-        return None
     for key in keys:
         if key in hf:
             return hf[key]

@@ -1,0 +1,143 @@
+"""Knowledge distillation + layer reduction (reference compression
+``layer_reduction`` config, constants.py:21-26, and the staged-KD
+recipes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.compression.distillation import (init_layer_reduction,
+                                                    kd_loss_fn,
+                                                    student_initialization)
+from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2ForTraining,
+                                       GPT2LMHeadModel)
+from deepspeed_tpu.parallel.topology import reset_topology
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    reset_topology()
+    yield
+    reset_topology()
+
+
+def _model_and_params(n_layer, scan=True, seed=0):
+    cfg = GPT2Config.tiny(dtype=jnp.float32, n_layer=n_layer,
+                          scan_layers=scan)
+    model = GPT2LMHeadModel(cfg)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+class TestStudentInit:
+    def test_scanned_layout_gathers_teacher_rows(self):
+        _, _, teacher = _model_and_params(4)
+        _, _, student = _model_and_params(2, seed=1)
+        out = student_initialization(student, teacher, [1, 3])
+        t_stack = teacher["transformer"]["h"]["block"]["attn"]["c_attn"]["kernel"]
+        s_stack = out["transformer"]["h"]["block"]["attn"]["c_attn"]["kernel"]
+        np.testing.assert_array_equal(np.asarray(s_stack),
+                                      np.asarray(t_stack)[[1, 3]])
+        # non-layer weights copied straight from the teacher
+        np.testing.assert_array_equal(np.asarray(out["wte"]),
+                                      np.asarray(teacher["wte"]))
+
+    def test_unrolled_layout_maps_layers(self):
+        _, _, teacher = _model_and_params(4, scan=False)
+        _, _, student = _model_and_params(2, scan=False, seed=1)
+        out = student_initialization(student, teacher, [0, 3])
+        np.testing.assert_array_equal(
+            np.asarray(out["transformer"]["h_1"]["mlp"]["c_fc"]["kernel"]),
+            np.asarray(teacher["transformer"]["h_3"]["mlp"]["c_fc"]["kernel"]))
+
+    def test_config_driven_entry(self):
+        _, _, teacher = _model_and_params(4)
+        _, _, student = _model_and_params(2, seed=1)
+        out = init_layer_reduction(student, teacher, {
+            "layer_reduction": {"enabled": True,
+                                "teacher_layer": [0, 2]}})
+        t_stack = teacher["transformer"]["h"]["block"]["ln_1"]["scale"]
+        np.testing.assert_array_equal(
+            np.asarray(out["transformer"]["h"]["block"]["ln_1"]["scale"]),
+            np.asarray(t_stack)[[0, 2]])
+
+    def test_disabled_passthrough(self):
+        _, _, student = _model_and_params(2, seed=1)
+        assert init_layer_reduction(student, None, {}) is student
+
+    def test_same_depth_remap_applies(self):
+        """Equal depths with a non-identity map must still gather (a direct
+        copy would silently ignore teacher_layers)."""
+        _, _, teacher = _model_and_params(2)
+        _, _, student = _model_and_params(2, seed=1)
+        out = student_initialization(student, teacher, [1, 0])
+        t = teacher["transformer"]["h"]["block"]["mlp"]["c_fc"]["kernel"]
+        s = out["transformer"]["h"]["block"]["mlp"]["c_fc"]["kernel"]
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(t)[[1, 0]])
+
+    def test_out_of_range_raises(self):
+        _, _, teacher = _model_and_params(4)
+        _, _, student = _model_and_params(2, seed=1)
+        with pytest.raises(ValueError, match="out of range"):
+            student_initialization(student, teacher, [1, 5])
+
+    def test_unrolled_out_of_range_raises(self):
+        _, _, teacher = _model_and_params(4, scan=False)
+        _, _, student = _model_and_params(2, scan=False, seed=1)
+        with pytest.raises(ValueError, match="missing teacher layer"):
+            student_initialization(student, teacher, [0, 9])
+
+    def test_keep_number_layer_on_unrolled_teacher(self):
+        """_teacher_depth must count h_i siblings, not read a leaf shape."""
+        _, _, teacher = _model_and_params(4, scan=False)
+        _, _, student = _model_and_params(2, scan=False, seed=1)
+        out = init_layer_reduction(student, teacher, {
+            "layer_reduction": {"enabled": True, "keep_number_layer": 2}})
+        # evenly spaced over 4 layers -> teacher layers [0, 3]
+        np.testing.assert_array_equal(
+            np.asarray(out["transformer"]["h_1"]["ln_1"]["scale"]),
+            np.asarray(teacher["transformer"]["h_3"]["ln_1"]["scale"]))
+
+
+class TestKDTraining:
+    def test_distillation_trains_student_toward_teacher(self):
+        t_cfg, t_model, t_params = _model_and_params(4)
+        s_cfg, s_model, s_params = _model_and_params(2, seed=1)
+        s_params = student_initialization(s_params, t_params, [1, 3])
+        student = GPT2ForTraining(s_cfg)
+
+        def s_logits(p, batch):
+            return s_model.apply({"params": p}, batch["input_ids"])
+
+        def t_logits(p, batch):
+            return t_model.apply({"params": p}, batch["input_ids"])
+
+        loss = kd_loss_fn(student.loss_fn, s_logits, t_logits, t_params,
+                          alpha=0.5, temperature=2.0)
+
+        class _KDModel:
+            config = s_cfg
+
+            def init(self, rng, batch):
+                return {"params": s_params}
+
+            loss_fn = staticmethod(loss)
+
+        engine, *_ = deepspeed_tpu.initialize(
+            model=_KDModel(),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "steps_per_print": 10_000})
+        ids = np.random.default_rng(0).integers(0, 256, (8, 16)).astype(
+            np.int32)
+        losses = []
+        for _ in range(6):
+            l = engine({"input_ids": ids})
+            engine.backward(l)
+            engine.step()
+            losses.append(float(l))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]

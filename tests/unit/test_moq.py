@@ -22,14 +22,19 @@ class TestSchedule:
     def test_transitions_step_down_bits(self):
         s = MoQSchedule(start_bits=16, target_bits=13, period=10, offset=5)
         tr = s.transitions()
-        assert [t["bits"] for t in tr] == [15, 14, 13]
-        # period doubling: 10, 20, 40 after the offset
-        assert [t["offset"] for t in tr] == [15, 35, 75]
+        assert [t["bits"] for t in tr] == [16, 15, 14, 13]
+        # start bits at the offset; then period doubling: 10, 20, 40
+        assert [t["offset"] for t in tr] == [5, 15, 35, 75]
+
+    def test_fixed_bits_qat_not_a_noop(self):
+        """start == target = fixed-precision QAT from the offset on."""
+        tr = MoQSchedule(start_bits=8, target_bits=8, offset=7).transitions()
+        assert tr == [{"offset": 7, "bits": 8}]
 
     def test_eigenvalue_factor_stretches(self):
         s = MoQSchedule(start_bits=16, target_bits=15, period=10)
-        assert s.transitions(1.0)[0]["offset"] == 10
-        assert s.transitions(3.0)[0]["offset"] == 30
+        assert s.transitions(1.0)[1]["offset"] == 10
+        assert s.transitions(3.0)[1]["offset"] == 30
 
     def test_rejects_increasing_bits(self):
         with pytest.raises(ValueError):
@@ -50,15 +55,21 @@ class TestPlans:
         assert "dense/kernel" in plans and "wte" in plans
         assert "dense/bias" not in plans
         bits = [e["params"]["bits"] for e in plans["dense/kernel"]]
-        assert bits == [15, 14]
+        assert bits == [16, 15, 14]
 
     def test_eigenvalues_scale_periods(self):
         q = MoQQuantizer(MoQSchedule(16, 15, period=10))
         q.set_eigenvalues({"dense": 1.0, "wte": 0.1})
         plans = q.build_plans(self._abstract())
-        # dense: factor 1+floor(1.0*4)=5 -> offset 50; wte: 1+0=1 -> 10
-        assert plans["dense/kernel"][0]["schedule_offset"] == 50
-        assert plans["wte"][0]["schedule_offset"] == 10
+        # dense: factor 1+floor(1.0*4)=5 -> drop at 50; wte: 1+0=1 -> 10
+        assert plans["dense/kernel"][1]["schedule_offset"] == 50
+        assert plans["wte"][1]["schedule_offset"] == 10
+
+    def test_factor_matches_whole_segment_only(self):
+        q = MoQQuantizer(MoQSchedule(16, 15, period=10))
+        q.set_eigenvalues({"dense": 1.0})
+        assert q._factor_for("dense/kernel") == 5.0
+        assert q._factor_for("dense2/kernel") == 1.0  # no prefix bleed
 
 
 class TestEngineMoQ:

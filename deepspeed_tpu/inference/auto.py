@@ -20,14 +20,17 @@ from deepspeed_tpu.runtime.state_dict_factory import (SDLoaderFactory,
                                                       detect_arch,
                                                       load_hf_bloom,
                                                       load_hf_gpt2,
+                                                      load_hf_gpt_neox,
+                                                      load_hf_gptj,
                                                       load_hf_llama,
                                                       load_hf_opt)
 from deepspeed_tpu.utils.logging import logger
 
 _POLICY_FOR_ARCH = {"gpt2": "gpt2", "opt": "gpt2", "bloom": "gpt2",
-                    "llama": "llama"}
-# gpt2 policy fits opt/bloom here because their weights are NORMALIZED to
-# the canonical fused layout (c_attn/c_proj/c_fc names) before sharding
+                    "gptj": "gpt2", "gpt-neox": "gpt2", "llama": "llama"}
+# gpt2 policy fits opt/bloom/gptj/neox here because their weights are
+# NORMALIZED to the canonical fused layout (c_attn/c_proj/c_fc names)
+# before sharding
 
 
 # config.json keys each loader needs when handed a pre-loaded state dict
@@ -37,6 +40,14 @@ _SNIFF_KW = {
     "gpt2": {"n_head": ("n_head", "num_attention_heads")},
     "opt": {"n_head": ("num_attention_heads", "n_head")},
     "bloom": {"n_head": ("n_head", "num_attention_heads")},
+    "gptj": {"n_head": ("n_head", "num_attention_heads"),
+             "rotary_dim": ("rotary_dim",),
+             "n_positions": ("n_positions",)},
+    "gpt-neox": {"n_head": ("num_attention_heads",),
+                 "rotary_pct": ("rotary_pct",),
+                 "rope_theta": ("rotary_emb_base",),
+                 "use_parallel_residual": ("use_parallel_residual",),
+                 "max_positions": ("max_position_embeddings",)},
     "llama": {"num_attention_heads": ("num_attention_heads",),
               "num_key_value_heads": ("num_key_value_heads",),
               "rope_theta": ("rope_theta",),
@@ -77,7 +88,8 @@ def load_pretrained(src, arch: Optional[str] = None, dtype=None,
         from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
 
         loader = {"gpt2": load_hf_gpt2, "opt": load_hf_opt,
-                  "bloom": load_hf_bloom}[arch]
+                  "bloom": load_hf_bloom, "gptj": load_hf_gptj,
+                  "gpt-neox": load_hf_gpt_neox}[arch]
         config, params = loader(sd, scan_layers=scan_layers,
                                 dtype=dtype, **loader_kw)
         model = GPT2LMHeadModel(config)

@@ -47,14 +47,35 @@ class GPT2Config:
     # --- canonical-decoder knobs: this model executes the whole fused-
     # c_attn decoder family the state-dict factory normalizes to (GPT-2,
     # OPT, BLOOM — reference model_implementations/ arch classes) ---
-    # MLP activation: "gelu" (GPT-2/BLOOM) | "relu" (OPT)
+    # MLP activation: "gelu" tanh-approx (GPT-2/GPT-J) | "gelu_exact"
+    # erf-based (GPT-NeoX) | "relu" (OPT)
     activation: str = "gelu"
     # positions: "learned" (GPT-2/OPT wpe table) | "alibi" (BLOOM slopes)
+    # | "rotary" (GPT-J/GPT-NeoX — applied to q/k inside attention)
     position_embedding: str = "learned"
     # OPT quirk: its embed_positions table has 2 pad rows; lookups offset
     position_offset: int = 0
     # BLOOM applies a layernorm right after the token embedding
     embedding_layernorm: bool = False
+    # --- rotary knobs (position_embedding="rotary") ---
+    # rotate only the first rotary_dim dims of each head (GPT-J 64 of 256,
+    # NeoX rotary_pct); 0 = full head_dim
+    rotary_dim: int = 0
+    # GPT-J interleaves rotated pairs (rotate_every_two); NeoX splits the
+    # rotary slice in contiguous halves (rotate_half)
+    rotary_interleaved: bool = False
+    rope_theta: float = 10000.0
+    # --- block residual layout ---
+    # "sequential": x + attn(ln_1 x); then + mlp(ln_2 ·)  (GPT-2/OPT/BLOOM)
+    # "parallel_single_ln": h = ln_1 x; x + attn(h) + mlp(h)  (GPT-J)
+    # "parallel_two_ln": x + attn(ln_1 x) + mlp(ln_2 x)  (GPT-NeoX)
+    residual: str = "sequential"
+    # GPT-J's attention projections carry no bias terms
+    attn_bias: bool = True
+    # tied_head: LM head reuses wte (GPT-2/OPT/BLOOM); GPT-J/NeoX train a
+    # separate lm_head matrix (GPT-J's with a bias)
+    tied_head: bool = True
+    lm_head_bias: bool = False
     # progressive layer drop (reference runtime/progressive_layer_drop.py:5):
     # when on, the forward accepts a traced ``pld_theta`` scalar and each
     # block's residual is stochastically ZEROED with depth-scaled keep
@@ -115,6 +136,34 @@ def _alibi_bias(cfg, key_positions):
             * key_positions.astype(jnp.float32)[None, None, :])[None]
 
 
+def apply_rotary(x, positions, rotary_dim: int, theta: float,
+                 interleaved: bool):
+    """Rotary position embedding on [B, T, H, D] (reference capability:
+    ``apply_rotary_pos_emb.cu``, csrc/transformer/inference/csrc/, which
+    serves the same GPT-J/NeoX archs). Only the first ``rotary_dim`` dims
+    rotate; ``interleaved`` picks GPT-J's rotate-every-two pairing over
+    NeoX's contiguous-halves rotate-half."""
+    D = x.shape[-1]
+    rd = rotary_dim or D
+    inv = 1.0 / (theta ** (jnp.arange(0, rd, 2, dtype=jnp.float32) / rd))
+    freqs = positions.astype(jnp.float32)[:, None] * inv[None]  # [T, rd/2]
+    cos = jnp.cos(freqs)[None, :, None, :]  # [1, T, 1, rd/2]
+    sin = jnp.sin(freqs)[None, :, None, :]
+    rot, rest = x[..., :rd].astype(jnp.float32), x[..., rd:]
+    if interleaved:
+        x1, x2 = rot[..., 0::2], rot[..., 1::2]
+    else:
+        x1, x2 = rot[..., : rd // 2], rot[..., rd // 2:]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    if interleaved:
+        out = jnp.stack([o1, o2], axis=-1).reshape(rot.shape)
+    else:
+        out = jnp.concatenate([o1, o2], axis=-1)
+    out = out.astype(x.dtype)
+    return jnp.concatenate([out, rest], axis=-1) if rd < D else out
+
+
 def _remat_block(cfg):
     """Block wrapped per the config's activation-checkpointing policy."""
     if not cfg.remat:
@@ -145,9 +194,16 @@ class CausalSelfAttention(nn.Module):
         head_dim = cfg.n_embd // cfg.n_head
         # fused QKV projection: one big matmul for the MXU
         qkv = nn.Dense(3 * cfg.n_embd, dtype=cfg.dtype, kernel_init=_dense_init(),
-                       name="c_attn")(x)
+                       use_bias=cfg.attn_bias, name="c_attn")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q4 = q.reshape(B, T, cfg.n_head, head_dim)  # [B, T, H, D]
+        rotary = cfg.position_embedding == "rotary"
+        if rotary and not cfg.decode:
+            q4 = apply_rotary(q4, jnp.arange(T), cfg.rotary_dim,
+                              cfg.rope_theta, cfg.rotary_interleaved)
+            k = apply_rotary(k.reshape(B, T, cfg.n_head, head_dim),
+                             jnp.arange(T), cfg.rotary_dim, cfg.rope_theta,
+                             cfg.rotary_interleaved).reshape(B, T, C)
         cached_attn = False
         if cfg.decode:
             # KV cache: [B, n_positions, H, D] append buffer (the TPU-native
@@ -167,6 +223,14 @@ class CausalSelfAttention(nn.Module):
             cidx = self.variable("cache", "cache_index",
                                  lambda: jnp.zeros((), jnp.int32))
             idx = cidx.value  # 0 on prefill (freshly created)
+            if rotary:
+                # rotate by absolute position BEFORE caching: cached keys are
+                # post-rotation, so decode attention needs no re-rotation
+                pos = idx + jnp.arange(T)
+                q4 = apply_rotary(q4, pos, cfg.rotary_dim, cfg.rope_theta,
+                                  cfg.rotary_interleaved)
+                k4 = apply_rotary(k4, pos, cfg.rotary_dim, cfg.rope_theta,
+                                  cfg.rotary_interleaved)
             ck.value = jax.lax.dynamic_update_slice(ck.value, k4, (0, idx, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(cv.value, v4, (0, idx, 0, 0))
             cidx.value = idx + T
@@ -196,8 +260,13 @@ class CausalSelfAttention(nn.Module):
                                   causal=False, use_flash=False)
                 cached_attn = True
         if not cached_attn:  # training forward, or decode-mode prefill
-            k = k.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
-            v = v.reshape(B, T, cfg.n_head, head_dim).transpose(0, 2, 1, 3)
+            if cfg.decode:  # k4/v4 exist (and carry the rotary rotation)
+                k, v = k4, v4
+            else:
+                k = k.reshape(B, T, cfg.n_head, head_dim)
+                v = v.reshape(B, T, cfg.n_head, head_dim)
+            k = k.transpose(0, 2, 1, 3)
+            v = v.transpose(0, 2, 1, 3)
             bias = (_alibi_bias(cfg, jnp.arange(T))
                     if cfg.position_embedding == "alibi" else None)
             y = attention(q4.transpose(0, 2, 1, 3), k, v, causal=True,
@@ -205,7 +274,7 @@ class CausalSelfAttention(nn.Module):
         y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
         y = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
                      kernel_init=_dense_init(0.02 / (2 * cfg.n_layer) ** 0.5),
-                     name="c_proj")(y)
+                     use_bias=cfg.attn_bias, name="c_proj")(y)
         if cfg.dropout > 0:
             y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         return y
@@ -220,7 +289,7 @@ class MLP(nn.Module):
         h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, kernel_init=_dense_init(),
                      name="c_fc")(x)
         h = (nn.relu(h) if cfg.activation == "relu"
-             else nn.gelu(h, approximate=True))
+             else nn.gelu(h, approximate=cfg.activation != "gelu_exact"))
         h = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
                      kernel_init=_dense_init(0.02 / (2 * cfg.n_layer) ** 0.5),
                      name="c_proj")(h)
@@ -247,9 +316,26 @@ class Block(nn.Module):
                 g = jax.random.bernoulli(self.make_rng("pld"), keep)
                 return jnp.where(g, residual / keep.astype(residual.dtype),
                                  jnp.zeros_like(residual))
+        ln_1 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                            name="ln_1")
+        if cfg.residual != "sequential":
+            # parallel residual (GPT-J single-LN / NeoX two-LN): the attn and
+            # MLP branches read the SAME input and their outputs sum into one
+            # residual add — XLA overlaps the two branch matmul chains
+            h1 = ln_1(x)
+            if cfg.residual == "parallel_two_ln":
+                h2 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                                  dtype=cfg.dtype, name="ln_2")(x)
+            else:  # "parallel_single_ln"
+                h2 = h1
+            attn_out = CausalSelfAttention(cfg, name="attn")(
+                h1, deterministic=deterministic)
+            mlp_out = MLP(cfg, name="mlp")(h2, deterministic=deterministic)
+            if pld_on:
+                attn_out, mlp_out = _gate(attn_out), _gate(mlp_out)
+            return x + attn_out + mlp_out
         attn_out = CausalSelfAttention(cfg, name="attn")(
-            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_1")(x),
-            deterministic=deterministic)
+            ln_1(x), deterministic=deterministic)
         if pld_on:
             attn_out = _gate(attn_out)
         x = x + attn_out
@@ -356,16 +442,26 @@ class GPT2LMHeadModel(nn.Module):
         x = blocks(cfg, name="transformer")(x, deterministic=deterministic,
                                             pld_theta=pld_theta)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
+        if cfg.tied_head:
+            head_w, head_b = wte, None
+        else:  # GPT-J/NeoX: separate lm_head (GPT-J's carries a bias)
+            head_w = self.param("lm_head", _dense_init(),
+                                (cfg.vocab_size, cfg.n_embd), jnp.float32)
+            head_b = (self.param("lm_head_bias", nn.initializers.zeros,
+                                 (cfg.vocab_size,), jnp.float32)
+                      if cfg.lm_head_bias else None)
         if return_hidden:
-            return x, wte
-        # tied LM head; logits in fp32 for a stable softmax-xent
-        logits = jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype),
+            return x, head_w
+        # logits in fp32 for a stable softmax-xent
+        logits = jnp.einsum("btc,vc->btv", x, head_w.astype(cfg.dtype),
                             preferred_element_type=jnp.float32)
+        if head_b is not None:
+            logits = logits + head_b
         return logits
 
 
 def chunked_softmax_xent(hidden, wte, labels, chunk: int = 128,
-                         ignore_index: int = -100):
+                         ignore_index: int = -100, bias=None):
     """Softmax cross-entropy against a tied embedding WITHOUT materializing
     the full [B, T, V] fp32 logits — the LM-head memory hog on long
     sequences. Computes per-sequence-chunk logits inside a remat'd scan, so
@@ -387,6 +483,8 @@ def chunked_softmax_xent(hidden, wte, labels, chunk: int = 128,
     def chunk_loss(hc, lc):
         logits = jnp.einsum("btc,vc->btv", hc, w,
                             preferred_element_type=jnp.float32)
+        if bias is not None:
+            logits = logits + bias
         valid = lc != ignore_index
         safe = jnp.where(valid, lc, 0)
         logz = jax.nn.logsumexp(logits, axis=-1)
@@ -537,6 +635,9 @@ def gpt2_loss_fn(model: GPT2LMHeadModel):
         hidden, wte = model.apply({"params": params}, input_ids,
                                   deterministic=rngs is None, rngs=rngs,
                                   return_hidden=True, pld_theta=pld_theta)
+        # wte is the LM-head matrix: the tied embedding, or the separate
+        # lm_head (whose optional bias lives beside it in the param tree)
+        head_bias = params.get("lm_head_bias")
         # shift for next-token prediction by padding the label stream
         shifted = jnp.concatenate(
             [labels[:, 1:], jnp.full((labels.shape[0], 1), -100, labels.dtype)],
@@ -552,8 +653,11 @@ def gpt2_loss_fn(model: GPT2LMHeadModel):
             logits = jnp.einsum("btc,vc->btv", hidden,
                                 wte.astype(hidden.dtype),
                                 preferred_element_type=jnp.float32)
+            if head_bias is not None:
+                logits = logits + head_bias
             return cross_entropy_loss(logits, shifted)
         # chunked head: avoids the full [B, T, V] fp32 logits tensor
-        return chunked_softmax_xent(hidden, wte, shifted, chunk=512)
+        return chunked_softmax_xent(hidden, wte, shifted, chunk=512,
+                                    bias=head_bias)
 
     return loss_fn

@@ -105,7 +105,10 @@ AUTO_POLICY = TPPolicy(
 
 GPT2_POLICY = TPPolicy(
     "gpt2",
-    [("c_proj", ROW), ("c_attn", COLUMN), ("c_fc", COLUMN), ("wte", VOCAB)])
+    [("c_proj", ROW), ("c_attn", COLUMN), ("c_fc", COLUMN), ("wte", VOCAB),
+     # untied heads of canonical-decoder archs (GPT-J/NeoX); GPT-2 itself
+     # has no lm_head param, so the rule is inert there
+     ("lm_head", VOCAB)])
 
 # Per-architecture policy zoo (reference replace_policy.py arch classes,
 # module_inject/replace_policy.py:174-712 — BERT/CLIP/GPT-Neo/GPT-J/

@@ -342,8 +342,110 @@ class LlamaWeightMap(HFWeightMap):
         return super().convert(canon, w)
 
 
+class GPTJWeightMap(HFWeightMap):
+    """HF ``GPTJForCausalLM``: separate bias-free q/k/v/out linears,
+    fc_in/fc_out MLP, a single per-block layernorm (parallel residual),
+    and an untied lm_head with bias."""
+
+    arch = "gptj"
+    layer_re = re.compile(r"^(?:transformer\.)?h\.(\d+)\.(.+)$")
+    layer_map = {
+        "ln_1.scale": "ln_1.weight", "ln_1.bias": "ln_1.bias",
+        "c_proj.kernel": "attn.out_proj.weight",
+        "c_fc.kernel": "mlp.fc_in.weight", "c_fc.bias": "mlp.fc_in.bias",
+        "mlp_c_proj.kernel": "mlp.fc_out.weight",
+        "mlp_c_proj.bias": "mlp.fc_out.bias",
+    }
+    top_map = {
+        "wte": "transformer.wte.weight",
+        "ln_f.scale": "transformer.ln_f.weight",
+        "ln_f.bias": "transformer.ln_f.bias",
+        "lm_head": "lm_head.weight",  # [V, C]: head einsum wants [V, C]
+        "lm_head_bias": "lm_head.bias",
+    }
+
+    def layer_key(self, i, suffix):
+        return f"transformer.h.{i}.{suffix}"
+
+    def convert(self, canon, w):
+        if canon == "lm_head":
+            return w
+        return super().convert(canon, w)
+
+    def layer_weights(self, sd, i):
+        out = super().layer_weights(sd, i)
+        ws = [self.lookup(sd, self.layer_key(i, f"attn.{n}_proj.weight"))
+              for n in "qkv"]
+        if all(w is not None for w in ws):
+            qw, kw, vw = (np.ascontiguousarray(w.T) for w in ws)
+            out["c_attn.kernel"] = merge_qkv(qw, kw, vw)
+        return out
+
+
+class GPTNeoXWeightMap(HFWeightMap):
+    """HF ``GPTNeoXForCausalLM``: fused ``query_key_value`` packed per head
+    (same [n_head, 3, head_dim] interleave as BLOOM), parallel residual with
+    two layernorms, untied ``embed_out`` head. ``n_head`` must be supplied
+    (the de-interleave depends on it)."""
+
+    arch = "gpt-neox"
+    layer_re = re.compile(r"^(?:gpt_neox\.)?layers\.(\d+)\.(.+)$")
+    layer_map = {
+        "ln_1.scale": "input_layernorm.weight",
+        "ln_1.bias": "input_layernorm.bias",
+        "ln_2.scale": "post_attention_layernorm.weight",
+        "ln_2.bias": "post_attention_layernorm.bias",
+        "c_proj.kernel": "attention.dense.weight",
+        "c_proj.bias": "attention.dense.bias",
+        "c_fc.kernel": "mlp.dense_h_to_4h.weight",
+        "c_fc.bias": "mlp.dense_h_to_4h.bias",
+        "mlp_c_proj.kernel": "mlp.dense_4h_to_h.weight",
+        "mlp_c_proj.bias": "mlp.dense_4h_to_h.bias",
+    }
+    top_map = {
+        "wte": "gpt_neox.embed_in.weight",
+        "ln_f.scale": "gpt_neox.final_layer_norm.weight",
+        "ln_f.bias": "gpt_neox.final_layer_norm.bias",
+        "lm_head": "embed_out.weight",
+    }
+
+    def __init__(self, n_head: int):
+        self.n_head = n_head
+
+    @staticmethod
+    def lookup(sd, key):
+        if key in sd:
+            return sd[key]
+        if key.startswith("gpt_neox.") and key[len("gpt_neox."):] in sd:
+            return sd[key[len("gpt_neox."):]]
+        return None
+
+    def layer_key(self, i, suffix):
+        return f"gpt_neox.layers.{i}.{suffix}"
+
+    def convert(self, canon, w):
+        if canon == "lm_head":
+            return w
+        return super().convert(canon, w)
+
+    def layer_weights(self, sd, i):
+        out = super().layer_weights(sd, i)
+        w = self.lookup(sd, self.layer_key(
+            i, "attention.query_key_value.weight"))
+        if w is not None:  # [C, 3C] after transpose, head-interleaved
+            out["c_attn.kernel"] = deinterleave_bloom_qkv(
+                np.ascontiguousarray(w.T), self.n_head)
+        b = self.lookup(sd, self.layer_key(
+            i, "attention.query_key_value.bias"))
+        if b is not None:
+            out["c_attn.bias"] = deinterleave_bloom_qkv(
+                b[None], self.n_head)[0]
+        return out
+
+
 _WEIGHT_MAPS = {"gpt2": GPT2WeightMap, "opt": OPTWeightMap,
-                "bloom": BloomWeightMap, "llama": LlamaWeightMap}
+                "bloom": BloomWeightMap, "llama": LlamaWeightMap,
+                "gptj": GPTJWeightMap, "gpt-neox": GPTNeoXWeightMap}
 
 
 def get_weight_map(arch: str, **kw) -> HFWeightMap:
@@ -363,6 +465,10 @@ def detect_arch(sd: Dict[str, Any]) -> Optional[str]:
         return "bloom"
     if any("mlp.gate_proj" in k for k in keys):
         return "llama"
+    if any("mlp.fc_in" in k for k in keys):
+        return "gptj"
+    if any("attention.query_key_value" in k for k in keys):
+        return "gpt-neox"
     return None
 
 
@@ -438,26 +544,35 @@ def load_hf_gpt2(src, scan_layers: bool = True, dtype=None,
     return config, params
 
 
-def _canonical_gpt2_tree(layers, top, scan_layers, wpe=None, emb_ln=False):
+def _canonical_gpt2_tree(layers, top, scan_layers, wpe=None, emb_ln=False,
+                         attn_bias=True, has_ln_2=True, untied_head=False):
     """Canonical per-layer dicts → the flax GPT2LMHeadModel param tree
-    (the one model that executes the whole fused-c_attn decoder family)."""
+    (the one model that executes the whole fused-c_attn decoder family).
+    ``attn_bias=False`` (GPT-J) drops the attention bias leaves,
+    ``has_ln_2=False`` (GPT-J single-LN parallel residual) drops ln_2, and
+    ``untied_head`` adds the separate lm_head (+bias when present)."""
 
     def block_tree(lw):
-        # direct indexing throughout: every arch this tree serves
-        # (gpt2/opt/bloom) has all these weights — a missing one means a
-        # truncated checkpoint and must fail loudly, not zero-fill
-        return {
+        # direct indexing throughout: every arch this tree serves has all
+        # the weights its flag set names — a missing one means a truncated
+        # checkpoint and must fail loudly, not zero-fill
+        attn = {"c_attn": {"kernel": lw["c_attn.kernel"]},
+                "c_proj": {"kernel": lw["c_proj.kernel"]}}
+        if attn_bias:
+            attn["c_attn"]["bias"] = lw["c_attn.bias"]
+            attn["c_proj"]["bias"] = lw["c_proj.bias"]
+        tree = {
             "ln_1": {"scale": lw["ln_1.scale"], "bias": lw["ln_1.bias"]},
-            "attn": {"c_attn": {"kernel": lw["c_attn.kernel"],
-                                "bias": lw["c_attn.bias"]},
-                     "c_proj": {"kernel": lw["c_proj.kernel"],
-                                "bias": lw["c_proj.bias"]}},
-            "ln_2": {"scale": lw["ln_2.scale"], "bias": lw["ln_2.bias"]},
+            "attn": attn,
             "mlp": {"c_fc": {"kernel": lw["c_fc.kernel"],
                              "bias": lw["c_fc.bias"]},
                     "c_proj": {"kernel": lw["mlp_c_proj.kernel"],
                                "bias": lw["mlp_c_proj.bias"]}},
         }
+        if has_ln_2:
+            tree["ln_2"] = {"scale": lw["ln_2.scale"],
+                            "bias": lw["ln_2.bias"]}
+        return tree
 
     if scan_layers:
         stacked = jax.tree_util.tree_map(
@@ -475,6 +590,10 @@ def _canonical_gpt2_tree(layers, top, scan_layers, wpe=None, emb_ln=False):
     if emb_ln:
         params["emb_ln"] = {"scale": top["emb_ln.scale"],
                             "bias": top["emb_ln.bias"]}
+    if untied_head:
+        params["lm_head"] = top["lm_head"]
+        if "lm_head_bias" in top:
+            params["lm_head_bias"] = top["lm_head_bias"]
     return jax.tree_util.tree_map(
         lambda x: np.asarray(x, np.float32), params)
 
@@ -548,6 +667,114 @@ def load_hf_bloom(src, scan_layers: bool = True, dtype=None,
     params = _canonical_gpt2_tree(layers, top, scan_layers, emb_ln=True)
     logger.info(f"loaded HF BLOOM: {n_layer} layers, n_embd={n_embd}, "
                 f"vocab={wte.shape[0]}, alibi heads={n_head}")
+    return config, params
+
+
+def load_hf_gptj(src, scan_layers: bool = True, dtype=None,
+                 n_head: Optional[int] = None,
+                 rotary_dim: Optional[int] = None,
+                 n_positions: Optional[int] = None):
+    """HF ``GPTJForCausalLM`` checkpoint → (GPT2Config, flax params): the
+    canonical decoder runs GPT-J as partial interleaved rotary positions,
+    bias-free attention, single-LN parallel residual, and an untied lm_head
+    with bias (reference arch policy: module_inject/replace_policy.py
+    GPTJ entry)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    if n_head is None:
+        n_head = _sniff_config(src, "n_head", "num_attention_heads")
+    if n_head is None:
+        raise ValueError("load_hf_gptj needs n_head (config.json or arg): "
+                         "GPT-J's head_dim 256 breaks the 64-dim guess")
+    if rotary_dim is None:
+        rotary_dim = _sniff_config(src, "rotary_dim")
+    if rotary_dim is None:
+        # every real GPT-J checkpoint rotates a PARTIAL head slice (64 of
+        # 256); defaulting to full-head rotation would be silently wrong
+        raise ValueError("load_hf_gptj needs rotary_dim (config.json or "
+                         "arg): GPT-J rotates a partial head slice")
+    if n_positions is None:
+        n_positions = _sniff_config(src, "n_positions") or 2048
+    sd = SDLoaderFactory.load(src)
+    wm = GPTJWeightMap()
+    n_layer = wm.n_layers(sd)
+    top = wm.top_weights(sd)
+    wte = top["wte"]
+    n_embd = wte.shape[1]
+    layers = [wm.layer_weights(sd, i) for i in range(n_layer)]
+    config = GPT2Config(
+        vocab_size=wte.shape[0], n_positions=n_positions,
+        n_embd=n_embd, n_layer=n_layer, n_head=n_head,
+        position_embedding="rotary", rotary_dim=rotary_dim,
+        rotary_interleaved=True, residual="parallel_single_ln",
+        attn_bias=False, tied_head=False,
+        lm_head_bias="lm_head_bias" in top,
+        dtype=dtype if dtype is not None else jnp.float32,
+        scan_layers=scan_layers)
+    params = _canonical_gpt2_tree(layers, top, scan_layers, attn_bias=False,
+                                  has_ln_2=False, untied_head=True)
+    logger.info(f"loaded HF GPT-J: {n_layer} layers, n_embd={n_embd}, "
+                f"vocab={wte.shape[0]}, rotary_dim={rotary_dim}")
+    return config, params
+
+
+def load_hf_gpt_neox(src, scan_layers: bool = True, dtype=None,
+                     n_head: Optional[int] = None,
+                     rotary_pct: Optional[float] = None,
+                     rope_theta: Optional[float] = None,
+                     use_parallel_residual: Optional[bool] = None,
+                     max_positions: Optional[int] = None):
+    """HF ``GPTNeoXForCausalLM`` checkpoint → (GPT2Config, flax params):
+    the canonical decoder runs NeoX as partial rotate-half rotary, two-LN
+    parallel residual (or sequential when the checkpoint trained with
+    ``use_parallel_residual=false``), exact gelu, and the untied
+    ``embed_out`` head (reference arch policy: replace_policy.py GPTNEOX
+    entry)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    if n_head is None:
+        n_head = _sniff_config(src, "num_attention_heads", "n_head")
+    if n_head is None:
+        raise ValueError("load_hf_gpt_neox needs n_head (config.json or "
+                         "arg): the fused-QKV de-interleave depends on it")
+    if rotary_pct is None:
+        rotary_pct = _sniff_config(src, "rotary_pct")
+        rotary_pct = 1.0 if rotary_pct is None else rotary_pct
+    if rope_theta is None:
+        rope_theta = _sniff_config(src, "rotary_emb_base") or 10000.0
+    if use_parallel_residual is None:
+        v = _sniff_config(src, "use_parallel_residual")
+        use_parallel_residual = True if v is None else bool(v)
+    if max_positions is None:
+        max_positions = _sniff_config(src, "max_position_embeddings") or 2048
+    sd = SDLoaderFactory.load(src)
+    wm = GPTNeoXWeightMap(n_head=n_head)
+    n_layer = wm.n_layers(sd)
+    top = wm.top_weights(sd)
+    wte = top["wte"]
+    n_embd = wte.shape[1]
+    head_dim = n_embd // n_head
+    layers = [wm.layer_weights(sd, i) for i in range(n_layer)]
+    config = GPT2Config(
+        vocab_size=wte.shape[0], n_positions=max_positions,
+        n_embd=n_embd, n_layer=n_layer, n_head=n_head,
+        position_embedding="rotary",
+        rotary_dim=int(head_dim * rotary_pct),
+        rotary_interleaved=False, rope_theta=float(rope_theta),
+        residual="parallel_two_ln" if use_parallel_residual
+        else "sequential",
+        activation="gelu_exact", tied_head=False,
+        dtype=dtype if dtype is not None else jnp.float32,
+        scan_layers=scan_layers)
+    params = _canonical_gpt2_tree(layers, top, scan_layers,
+                                  untied_head=True)
+    logger.info(f"loaded HF GPT-NeoX: {n_layer} layers, n_embd={n_embd}, "
+                f"vocab={wte.shape[0]}, rotary_dim={config.rotary_dim}, "
+                f"parallel_residual={use_parallel_residual}")
     return config, params
 
 

@@ -59,15 +59,19 @@ def attention_reference(q, k, v, mask=None, causal=True, softmax_scale=None,
 
 def attention(q, k, v, mask=None, causal=True, softmax_scale=None,
               dropout_rate=0.0, dropout_rng=None,
-              use_flash: Optional[bool] = None, bias=None):
+              use_flash: Optional[bool] = None, bias=None,
+              _sp_dispatch=True):
     """Dispatching attention entry point.
 
-    Auto mode (``use_flash=None``): seq axis active on the mesh → ring
-    attention (sequence parallelism) when shapes allow; else the Pallas flash
-    kernel on TPU; else the XLA reference. An explicit ``use_flash`` bool
-    bypasses ring dispatch (the escape hatch for numerics comparison).
-    ``bias`` (additive logits bias, e.g. ALiBi) always takes the XLA
-    reference path — the Pallas kernels don't consume it.
+    Auto mode (``use_flash=None``): seq axis active on the mesh → sequence
+    parallelism when shapes allow — ulysses all-to-all when the head count
+    divides the seq axis (full-seq flash locally), ring otherwise; else the
+    Pallas flash kernel on TPU; else the XLA reference. An explicit
+    ``use_flash`` bool bypasses SP dispatch (the escape hatch for numerics
+    comparison). ``bias`` (additive logits bias, e.g. ALiBi) always takes
+    the XLA reference path — the Pallas kernels don't consume it.
+    ``_sp_dispatch=False`` is the internal re-entry guard for SP bodies
+    that are already under ``shard_map``.
     """
     if bias is not None:
         if use_flash or (use_flash is None and _on_tpu() and mask is None):
@@ -81,11 +85,27 @@ def attention(q, k, v, mask=None, causal=True, softmax_scale=None,
     from deepspeed_tpu.parallel.topology import AXIS_SEQ, get_topology
 
     topo = get_topology(create_if_missing=False)
-    if (use_flash is None and topo is not None
+    if (_sp_dispatch and use_flash is None and topo is not None
             and topo.axis_size(AXIS_SEQ) > 1
             and mask is None and dropout_rate == 0.0
             and q.shape[-2] == k.shape[-2]
             and q.shape[-2] % topo.axis_size(AXIS_SEQ) == 0):
+        from deepspeed_tpu.parallel.topology import AXIS_MODEL
+
+        n_seq = topo.axis_size(AXIS_SEQ)
+        # heads are sharded over the model axis when TP is active — the
+        # all_to_all scatters each device's LOCAL head group, so the
+        # per-device head count is what must divide the seq axis
+        n_tp = topo.axis_size(AXIS_MODEL)
+        heads = q.shape[-3]
+        if heads % n_tp == 0 and (heads // n_tp) % n_seq == 0:
+            # enough heads to scatter: one all_to_all each way and the
+            # attention itself stays a full-sequence flash-kernel call
+            from deepspeed_tpu.ops.ulysses_attention import ulysses_attention
+
+            return ulysses_attention(q, k, v, causal=causal,
+                                     softmax_scale=softmax_scale,
+                                     mesh=topo.mesh)
         from deepspeed_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, causal=causal,

@@ -1,0 +1,100 @@
+"""Ulysses-style all-to-all sequence parallelism over the ``seq`` mesh axis.
+
+The second sequence-parallel regime beside :mod:`ring_attention` (the
+reference v0.8.0 has neither — SURVEY.md §5.7 treats SP as the TPU
+capability upgrade; the all-to-all head-scatter design follows the
+DeepSpeed-Ulysses paper, which this framework mirrors as a capability):
+
+- ring: k/v blocks rotate via ``ppermute``; comm spread over n-1 hops,
+  attention runs on [Tl, Tl] tiles — best when T/n is still large.
+- ulysses (this module): ONE ``all_to_all`` re-shards q/k/v from
+  seq-sharded [B, H, T/n, D] to head-sharded [B, H/n, T, D], each device
+  runs full-sequence attention over its head group — through the Pallas
+  flash kernel — then a second ``all_to_all`` restores seq sharding.
+  Comm volume is 2·(B·H·T·D)/n per tensor either way, but ulysses pays it
+  in two dense ICI collectives and keeps the attention itself a single
+  large-tile kernel call, so it wins when heads are plentiful and the
+  flash kernel's efficiency dominates (the usual TPU regime).
+
+Constraint: ``n_head %% seq_axis == 0`` (heads distribute across the axis);
+ring attention has no head constraint — the dispatcher picks accordingly.
+Differentiable end-to-end (``all_to_all`` is its own transpose).
+"""
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+    AXIS_SEQ,
+)
+
+
+def _ulysses_body(q, k, v, *, axis_name, causal, scale, use_flash):
+    """Per-device body. q/k/v local: [B, H, Tl, D] (seq-sharded)."""
+    from deepspeed_tpu.ops.attention import attention
+
+    # seq-sharded → head-sharded: split local heads n ways, concat the
+    # received blocks along seq — [B, H/n, T, D] with ALL positions present
+    q, k, v = (jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True) for x in (q, k, v))
+    y = attention(q, k, v, causal=causal, softmax_scale=scale,
+                  use_flash=use_flash, _sp_dispatch=False)
+    # head-sharded → seq-sharded (inverse permutation of the same volume)
+    return jax.lax.all_to_all(y, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def ulysses_attention(q, k, v,
+                      causal: bool = True,
+                      softmax_scale: Optional[float] = None,
+                      axis_name: str = AXIS_SEQ,
+                      mesh=None,
+                      batch_axes: Sequence[str] = (AXIS_DATA, AXIS_EXPERT),
+                      use_flash: Optional[bool] = None):
+    """All-to-all sequence-parallel attention. q,k,v: [batch, heads, seq,
+    head_dim] with seq sharded over ``axis_name`` on the mesh.
+
+    Falls back to the XLA reference path when the seq axis is absent/1.
+    """
+    from deepspeed_tpu.ops.attention import attention_reference
+    from deepspeed_tpu.parallel.topology import axis_spec_entry, get_topology
+
+    if mesh is None:
+        topo = get_topology(create_if_missing=False)
+        mesh = topo.mesh if topo is not None else None
+    if mesh is None or mesh.shape.get(axis_name, 1) <= 1:
+        return attention_reference(q, k, v, causal=causal,
+                                   softmax_scale=softmax_scale)
+    n = int(mesh.shape[axis_name])
+    if q.shape[2] != k.shape[2]:
+        raise ValueError(
+            f"ulysses_attention requires seq_q == seq_k (got {q.shape[2]} "
+            f"vs {k.shape[2]}); cross-length (kv-cache) attention uses the "
+            "decode path")
+    if q.shape[2] % n:
+        raise ValueError(f"seq len {q.shape[2]} not divisible by seq axis {n}")
+    # heads shard over the model axis when TP is active; the all_to_all
+    # scatters LOCAL heads, so per-device head count must divide the axis
+    n_tp = int(mesh.shape.get(AXIS_MODEL, 1))
+    if q.shape[1] % n_tp or (q.shape[1] // n_tp) % n:
+        raise ValueError(
+            f"ulysses_attention needs per-device head count "
+            f"({q.shape[1]}/{n_tp} TP shards) divisible by the seq axis "
+            f"({n}) — use ring_attention for head-scarce models")
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+
+    bspec = axis_spec_entry(mesh, batch_axes, q.shape[0])
+    # heads shard over the model axis when TP is active (column-parallel qkv)
+    hspec = axis_spec_entry(mesh, (AXIS_MODEL,), q.shape[1])
+    spec = P(bspec, hspec, axis_name, None)
+    body = functools.partial(_ulysses_body, axis_name=axis_name,
+                             causal=causal, scale=scale, use_flash=use_flash)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)

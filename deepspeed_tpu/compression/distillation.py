@@ -23,8 +23,7 @@ from deepspeed_tpu.utils.logging import logger
 
 
 def student_initialization(student_params, teacher_params,
-                           teacher_layers: Sequence[int],
-                           layer_container: str = "transformer"):
+                           teacher_layers: Sequence[int]):
     """Copy selected teacher layers (plus every non-layer weight) into a
     shallower student (reference ``layer_reduction``/``teacher_layer``
     config: student layer i gets teacher layer ``teacher_layers[i]``).
@@ -107,12 +106,13 @@ def student_initialization(student_params, teacher_params,
     return _copy(student_params, teacher_params)
 
 
-def kd_loss_fn(student_loss_fn: Callable,
+def kd_loss_fn(student_loss_fn: Optional[Callable],
                student_logits_fn: Callable,
                teacher_logits_fn: Callable,
                teacher_params,
                alpha: float = 0.5,
-               temperature: float = 2.0) -> Callable:
+               temperature: float = 2.0,
+               task_loss_from_logits: Optional[Callable] = None) -> Callable:
     """Engine-compatible distillation objective:
 
         loss = alpha * task_loss(student)
@@ -120,12 +120,34 @@ def kd_loss_fn(student_loss_fn: Callable,
 
     ``*_logits_fn(params, batch) -> [B, T, V]``; the teacher runs frozen
     (``stop_gradient`` + closure params) inside the same compiled step.
+
+    Two task-loss forms: ``task_loss_from_logits(logits, batch)`` derives
+    the task term from the SAME student forward that feeds the KL — one
+    forward per step (standard Hinton KD; required when dropout is active,
+    where two stochastic forwards can't be fused away). The
+    ``student_loss_fn(params, batch, rngs)`` form runs the model's own loss
+    separately — with deterministic forwards XLA CSEs the duplicate, so it
+    costs nothing, and it composes with losses that are not a function of
+    the logits alone (e.g. chunked heads, aux losses).
     """
+    if (student_loss_fn is None) == (task_loss_from_logits is None):
+        raise ValueError("kd_loss_fn needs exactly one of student_loss_fn "
+                         "or task_loss_from_logits")
     t_const = jax.lax.stop_gradient(teacher_params)
 
     def loss_fn(params, batch, rngs=None, **kw):
-        task = student_loss_fn(params, batch, rngs=rngs, **kw)
-        s_logits = student_logits_fn(params, batch).astype(jnp.float32)
+        if rngs is not None:
+            try:
+                s_logits = student_logits_fn(params, batch, rngs=rngs)
+            except TypeError:  # deterministic logits fn
+                s_logits = student_logits_fn(params, batch)
+        else:
+            s_logits = student_logits_fn(params, batch)
+        s_logits = s_logits.astype(jnp.float32)
+        if task_loss_from_logits is not None:
+            task = task_loss_from_logits(s_logits, batch)
+        else:
+            task = student_loss_fn(params, batch, rngs=rngs, **kw)
         t_logits = jax.lax.stop_gradient(
             teacher_logits_fn(t_const, batch)).astype(jnp.float32)
         s_logp = jax.nn.log_softmax(s_logits / temperature, axis=-1)
@@ -149,21 +171,19 @@ def init_layer_reduction(student_params, teacher_params,
     lr = (compression_config or {}).get("layer_reduction", {})
     if not lr.get("enabled", False):
         return student_params
+    container = lr.get("module_name_prefix", default_container)
     teacher_layers = lr.get("teacher_layer")
     if teacher_layers is None:
         keep = int(lr["keep_number_layer"])
         # evenly-spaced default, biased late (the reference recipes keep
         # the deepest layers)
-        total = _teacher_depth(teacher_params, default_container)
+        total = _teacher_depth(teacher_params, container)
         teacher_layers = [int(round(i * (total - 1) / max(1, keep - 1)))
                           for i in range(keep)]
     logger.info(f"layer_reduction: student from teacher layers "
                 f"{list(teacher_layers)}")
     return student_initialization(student_params, teacher_params,
-                                  teacher_layers,
-                                  layer_container=lr.get(
-                                      "module_name_prefix",
-                                      default_container))
+                                  teacher_layers)
 
 
 def _teacher_depth(teacher_params, container: str) -> int:

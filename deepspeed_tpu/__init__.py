@@ -43,8 +43,18 @@ def initialize(args=None,
     if config is None and args is not None and hasattr(args, "deepspeed_config"):
         config = args.deepspeed_config
 
-    engine_cls = PipelineEngine if isinstance(model, PipelineModule) \
-        else DeepSpeedEngine
+    from deepspeed_tpu.runtime.zero.infinity import (ZeroInfinityEngine,
+                                                     wants_param_offload)
+
+    if isinstance(model, PipelineModule):
+        engine_cls = PipelineEngine
+    elif wants_param_offload(config):
+        # ZeRO-Infinity tier: parameters live on host/NVMe and stream to
+        # the chip per layer (reference selects the stage-3 offload
+        # machinery from the same config key)
+        engine_cls = ZeroInfinityEngine
+    else:
+        engine_cls = DeepSpeedEngine
     engine = engine_cls(args=args,
                              model=model,
                              optimizer=optimizer,

@@ -134,13 +134,19 @@ def kd_loss_fn(student_loss_fn: Optional[Callable],
         raise ValueError("kd_loss_fn needs exactly one of student_loss_fn "
                          "or task_loss_from_logits")
     t_const = jax.lax.stop_gradient(teacher_params)
+    # decide ONCE whether the logits fn takes rngs — a call-and-retry would
+    # mask TypeErrors raised inside the function itself
+    import inspect
+
+    try:
+        _logits_takes_rngs = "rngs" in inspect.signature(
+            student_logits_fn).parameters
+    except (TypeError, ValueError):
+        _logits_takes_rngs = False
 
     def loss_fn(params, batch, rngs=None, **kw):
-        if rngs is not None:
-            try:
-                s_logits = student_logits_fn(params, batch, rngs=rngs)
-            except TypeError:  # deterministic logits fn
-                s_logits = student_logits_fn(params, batch)
+        if rngs is not None and _logits_takes_rngs:
+            s_logits = student_logits_fn(params, batch, rngs=rngs)
         else:
             s_logits = student_logits_fn(params, batch)
         s_logits = s_logits.astype(jnp.float32)

@@ -41,7 +41,11 @@ class DeepSpeedCPUAdam:
 
     # ------------------------------------------------------------------
     def register_param(self, name: str, value: np.ndarray):
-        value = np.ascontiguousarray(np.asarray(value, np.float32))
+        # ALWAYS copy: the C++ kernel updates masters in place through raw
+        # pointers, and on CPU backends np.asarray(jax_array) can alias the
+        # caller's buffer — without the copy a step would silently mutate
+        # the user's param tree (and any other optimizer registered from it)
+        value = np.array(value, dtype=np.float32, order="C", copy=True)
         self._state[name] = {
             "param": value,
             "exp_avg": np.zeros_like(value),

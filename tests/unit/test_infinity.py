@@ -1,0 +1,175 @@
+"""ZeRO-Infinity parameter-offload tier (runtime/zero/infinity.py):
+layer-streamed training with host/NVMe-resident masters must be
+numerically the same optimizer as the device engine, and its checkpoint
+must resume exactly (reference capability: ZeRO-3 param offload +
+swap-tensor engines, runtime/swap_tensor/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+from deepspeed_tpu.parallel.topology import reset_topology
+from deepspeed_tpu.runtime.zero.infinity import (ZeroInfinityEngine,
+                                                 wants_param_offload)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    reset_topology()
+    yield
+    reset_topology()
+
+
+def _cfg():
+    return GPT2Config.tiny(dtype=jnp.float32)
+
+
+def _init_params(model):
+    ids = np.zeros((1, 8), np.int32)
+    return model.init(jax.random.PRNGKey(0), {"input_ids": ids})["params"]
+
+
+def _ds_config(extra_zero=None, **kw):
+    cfg = {"train_batch_size": 4,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "steps_per_print": 10_000}
+    zero = {"stage": 3, "offload_param": {"device": "cpu"},
+            "offload_optimizer": {"device": "cpu"}}
+    zero.update(extra_zero or {})
+    cfg["zero_optimization"] = zero
+    cfg.update(kw)
+    return cfg
+
+
+def _batch(seed=0, B=4, T=32):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 256, (B, T)).astype(np.int32)}
+
+
+class TestInfinityEngine:
+    def test_initialize_selects_infinity(self):
+        model = GPT2ForTraining(_cfg())
+        engine, *_ = deepspeed_tpu.initialize(model=model,
+                                              config=_ds_config())
+        assert isinstance(engine, ZeroInfinityEngine)
+        assert engine.zero_optimization_stage() == 3
+
+    def test_wants_param_offload(self):
+        assert wants_param_offload(_ds_config())
+        assert not wants_param_offload({"zero_optimization": {"stage": 3}})
+        assert not wants_param_offload(None)
+        # legacy flag the parser migrates to offload_param.device=cpu
+        assert wants_param_offload(
+            {"zero_optimization": {"cpu_offload_param": True}})
+        # a section with device unset (default "none") does NOT offload
+        assert not wants_param_offload(
+            {"zero_optimization": {"offload_param": {"pin_memory": True}}})
+
+    def test_matches_device_offload_engine(self):
+        """Streamed fwd/bwd + cpu_adam must reproduce the device engine's
+        optimizer-offload path (same kernel, params on device) step for
+        step — the streaming changes WHERE weights live, not the math."""
+        model = GPT2ForTraining(_cfg())
+        params = _init_params(model)
+
+        from deepspeed_tpu.parallel.topology import MeshTopology
+
+        ref_engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            mesh=MeshTopology(axis_sizes={"data": 1},
+                              devices=jax.devices()[:1]),
+            config={"train_batch_size": 4,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {
+                        "offload_optimizer": {"device": "cpu"}},
+                    "steps_per_print": 10_000})
+        inf_engine = ZeroInfinityEngine(model=model, model_parameters=params,
+                                        config=_ds_config())
+        ref_losses, inf_losses = [], []
+        for i in range(4):
+            b = _batch(i)
+            l1 = ref_engine(b); ref_engine.backward(l1); ref_engine.step()
+            l2 = inf_engine(b); inf_engine.backward(l2); inf_engine.step()
+            ref_losses.append(float(l1))
+            inf_losses.append(float(l2))
+        np.testing.assert_allclose(inf_losses, ref_losses, rtol=2e-5,
+                                   atol=2e-6)
+        assert inf_engine.get_global_grad_norm() is not None
+
+    def test_gradient_accumulation(self):
+        """gas=2: two streamed micro-steps accumulate before one update —
+        equivalent to one batch of twice the size."""
+        model = GPT2ForTraining(_cfg())
+        params = _init_params(model)
+        big = ZeroInfinityEngine(
+            model=model, model_parameters=params,
+            config=_ds_config(train_batch_size=8,
+                              train_micro_batch_size_per_gpu=8))
+        acc = ZeroInfinityEngine(
+            model=model, model_parameters=params,
+            config=_ds_config(train_batch_size=8,
+                              train_micro_batch_size_per_gpu=4))
+        b = _batch(0, B=8)
+        halves = [{"input_ids": b["input_ids"][:4]},
+                  {"input_ids": b["input_ids"][4:]}]
+        l = big(b); big.backward(l); big.step()
+        for h in halves:
+            l = acc(h); acc.backward(l); acc.step()
+        assert big.global_steps == acc.global_steps == 1
+        after_big = float(big.eval_loss(b))
+        after_acc = float(acc.eval_loss(b))
+        np.testing.assert_allclose(after_acc, after_big, rtol=2e-5)
+
+    def test_nvme_masters_are_file_backed(self, tmp_path):
+        model = GPT2ForTraining(_cfg())
+        engine = ZeroInfinityEngine(
+            model=model, model_parameters=_init_params(model),
+            config=_ds_config(extra_zero={"offload_param": {
+                "device": "nvme", "nvme_path": str(tmp_path)}}))
+        # masters must be memmaps under nvme_path, and training must work
+        mm = [st["param"] for st in engine._host_opt.opt._state.values()]
+        assert all(isinstance(m, np.memmap) for m in mm)
+        assert any(p.suffix == ".mm" for p in tmp_path.iterdir())
+        losses = []
+        for _ in range(3):
+            l = engine(_batch(0)); engine.backward(l); engine.step()
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        model = GPT2ForTraining(_cfg())
+        params = _init_params(model)
+        e1 = ZeroInfinityEngine(model=model, model_parameters=params,
+                                config=_ds_config())
+        for i in range(2):
+            l = e1(_batch(i)); e1.backward(l); e1.step()
+        e1.save_checkpoint(tmp_path)
+        e2 = ZeroInfinityEngine(model=model, model_parameters=params,
+                                config=_ds_config())
+        e2.load_checkpoint(tmp_path)
+        assert e2.global_steps == e1.global_steps
+        b = _batch(7)
+        l1 = e1(b); e1.backward(l1); e1.step()
+        l2 = e2(b); e2.backward(l2); e2.step()
+        np.testing.assert_allclose(float(l2), float(l1), rtol=1e-6)
+
+    def test_rejects_unsupported_configs(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+
+        model = GPT2ForTraining(_cfg())
+        with pytest.raises(DeepSpeedConfigError):
+            ZeroInfinityEngine(
+                model=GPT2ForTraining(GPT2Config.tiny(
+                    dtype=jnp.float32, scan_layers=False)),
+                config=_ds_config())
+        with pytest.raises(DeepSpeedConfigError):
+            ZeroInfinityEngine(
+                model=GPT2ForTraining(GPT2Config.tiny(
+                    dtype=jnp.float32, dropout=0.1)),
+                config=_ds_config())
+        with pytest.raises(DeepSpeedConfigError):
+            ZeroInfinityEngine(model=model, config=_ds_config(
+                optimizer={"type": "Lamb", "params": {"lr": 1e-3}}))

@@ -1,0 +1,290 @@
+"""BERT encoder family, TPU-first.
+
+The reference's marquee training kernels are BERT-shaped
+(``DeepSpeedTransformerLayer``, ops/transformer/transformer.py:459 over
+csrc/transformer/ — the "fastest BERT" headline in BASELINE.md), and
+BASELINE.json tracks BERT-large + ZeRO-2 + fused Adam. Here the encoder is
+native: post-LN blocks whose attention routes through
+``deepspeed_tpu.ops.attention`` (Pallas flash kernel for the unmasked
+path), scanned layers for per-layer ZeRO-3 gathers, and module names that
+mirror HF (``attention.self.query`` / ``attention.output.dense`` /
+``intermediate`` / ``output``) so the per-arch ``bert`` TP policy
+(module_inject/policies.py) and the HF weight map apply verbatim.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = False
+    remat_policy: str = "full"
+    use_flash: Optional[bool] = None
+
+    @staticmethod
+    def bert_large(**kw):
+        return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                          num_attention_heads=16, intermediate_size=4096,
+                          **kw)
+
+    @staticmethod
+    def tiny(**kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("max_position_embeddings", 64)
+        return BertConfig(**kw)
+
+
+def _init(scale=0.02):
+    return nn.initializers.normal(stddev=scale)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        cfg = self.config
+        B, T, C = x.shape
+        H = cfg.num_attention_heads
+        D = C // H
+        q = nn.Dense(C, dtype=cfg.dtype, kernel_init=_init(), name="query")(x)
+        k = nn.Dense(C, dtype=cfg.dtype, kernel_init=_init(), name="key")(x)
+        v = nn.Dense(C, dtype=cfg.dtype, kernel_init=_init(), name="value")(x)
+        q, k, v = (t.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+                   for t in (q, k, v))
+        # bidirectional; padding mask [B, T] → [B, 1, 1, T] keep-mask (the
+        # masked path falls back to the XLA kernel; unmasked uses flash)
+        mask4 = None if mask is None else mask[:, None, None, :].astype(bool)
+        y = attention(q, k, v, mask=mask4, causal=False,
+                      use_flash=cfg.use_flash if mask is None else False)
+        return y.transpose(0, 2, 1, 3).reshape(B, T, C)
+
+
+class BertAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        cfg = self.config
+        y = BertSelfAttention(cfg, name="self")(x, mask, deterministic)
+        y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, kernel_init=_init(),
+                     name="output_dense")(y)
+        if cfg.dropout > 0:
+            y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        # post-LN (original transformer): normalize the residual SUM
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                            name="output_ln")(x + y)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        cfg = self.config
+        x = BertAttention(cfg, name="attention")(x, mask, deterministic)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     kernel_init=_init(), name="intermediate")(x)
+        h = nn.gelu(h, approximate=False)  # HF BERT uses exact gelu
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, kernel_init=_init(),
+                     name="output")(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                            name="output_ln")(x + h)
+
+
+def _remat_layer(cfg):
+    if not cfg.remat:
+        return BertLayer
+    policy = None
+    if cfg.remat_policy == "dots":
+        policy = jax.checkpoint_policies.checkpoint_dots
+    return nn.remat(BertLayer, prevent_cse=False, policy=policy,
+                    static_argnums=(3,))
+
+
+class _ScanBody(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic):
+        x = _remat_layer(self.config)(self.config, name="layer")(
+            x, mask, deterministic)
+        return x, None
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        cfg = self.config
+        if cfg.scan_layers:
+            Scanned = nn.scan(
+                _ScanBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, nn.broadcast),
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"},
+            )
+            x, _ = Scanned(cfg, name="layers")(x, mask, deterministic)
+            return x
+        layer_cls = _remat_layer(cfg)
+        for i in range(cfg.num_hidden_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, mask, deterministic)
+        return x
+
+
+class BertModel(nn.Module):
+    """Embeddings → encoder stack; returns final hidden states (and the
+    word-embedding table for head tying)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        B, T = input_ids.shape
+        wte = self.param("word_embeddings", _init(),
+                         (cfg.vocab_size, cfg.hidden_size), jnp.float32)
+        wpe = self.param("position_embeddings", _init(),
+                         (cfg.max_position_embeddings, cfg.hidden_size),
+                         jnp.float32)
+        tte = self.param("token_type_embeddings", _init(),
+                         (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (wte[input_ids] + wpe[None, :T] + tte[token_type_ids]).astype(
+            cfg.dtype)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="embeddings_ln")(x)
+        if cfg.dropout > 0:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        x = BertEncoder(cfg, name="encoder")(x, attention_mask, deterministic)
+        return x, wte
+
+
+class BertForMaskedLM(nn.Module):
+    """MLM head: transform (dense+gelu+LN) → tied decoder + bias
+    (HF ``cls.predictions``)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        x, wte = BertModel(cfg, name="bert")(input_ids, attention_mask,
+                                             token_type_ids, deterministic)
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, kernel_init=_init(),
+                     name="transform")(x)
+        x = nn.gelu(x, approximate=False)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="transform_ln")(x)
+        bias = self.param("decoder_bias", nn.initializers.zeros,
+                          (cfg.vocab_size,), jnp.float32)
+        logits = jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32) + bias
+        return logits
+
+
+class BertForSequenceClassification(nn.Module):
+    """Pooler (first-token tanh dense) → classifier (HF
+    ``BertForSequenceClassification`` — the SQuAD/GLUE fine-tune shape the
+    reference benchmarks, BASELINE.md row 3)."""
+
+    config: BertConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        x, _ = BertModel(cfg, name="bert")(input_ids, attention_mask,
+                                           token_type_ids, deterministic)
+        pooled = jnp.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                                   kernel_init=_init(),
+                                   name="pooler")(x[:, 0]))
+        if cfg.dropout > 0:
+            pooled = nn.Dropout(cfg.dropout)(pooled,
+                                             deterministic=deterministic)
+        return nn.Dense(self.num_labels, dtype=jnp.float32,
+                        kernel_init=_init(), name="classifier")(pooled)
+
+
+def mlm_loss_fn(model: BertForMaskedLM):
+    """Engine-facing MLM objective: mean token xent where labels != -100
+    (no shift — BERT predicts in place)."""
+    from deepspeed_tpu.models.gpt2 import cross_entropy_loss
+
+    def loss_fn(params, batch, rngs=None):
+        if isinstance(batch, dict):
+            ids = batch["input_ids"]
+            labels = batch.get("labels", ids)
+            mask = batch.get("attention_mask")
+            tt = batch.get("token_type_ids")
+        else:
+            ids, labels = batch
+            mask = tt = None
+        logits = model.apply({"params": params}, ids, attention_mask=mask,
+                             token_type_ids=tt,
+                             deterministic=rngs is None, rngs=rngs)
+        return cross_entropy_loss(logits, labels)
+
+    return loss_fn
+
+
+class BertForTraining:
+    """Engine-ready wrapper: ``initialize(model=BertForTraining(cfg))``."""
+
+    def __init__(self, config: BertConfig):
+        self.config = config
+        self.model = BertForMaskedLM(config)
+        self.loss_fn = mlm_loss_fn(self.model)
+
+    @staticmethod
+    def _input_ids(batch):
+        if isinstance(batch, dict):
+            return batch["input_ids"]
+        if isinstance(batch, (tuple, list)):
+            return batch[0]
+        return batch
+
+    def init(self, rng, batch):
+        return self.model.init(rng, self._input_ids(batch))
+
+    def apply(self, variables, batch, rngs=None):
+        return self.model.apply(variables, self._input_ids(batch), rngs=rngs)
+
+    def with_activation_checkpointing(self, enabled: bool,
+                                      policy: str = "full"):
+        if policy == "none":
+            enabled, policy = False, "full"
+        cfg = dataclasses.replace(self.config, remat=enabled,
+                                  remat_policy=policy)
+        return BertForTraining(cfg)

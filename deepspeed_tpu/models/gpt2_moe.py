@@ -1,0 +1,237 @@
+"""GPT decoder with mixture-of-experts FFN layers (DeepSpeed-MoE shape).
+
+The tracked BASELINE config is "MoE 350M×64-expert expert-parallel over
+ICI"; the reference builds this as a Megatron-GPT whose every-other FFN is
+a ``deepspeed.moe.layer.MoE`` (reference moe/layer.py:15 + the engine's
+expert-group plumbing, utils/groups.py:109). Here the same architecture is
+native: GPT-2 blocks where each ``moe_layer_freq``-th MLP is the GShard
+:class:`deepspeed_tpu.moe.layer.MoE`, expert params carry a leading ``[E]``
+axis sharded over the ``expert`` mesh axis (engine ``_tp_base_specs``),
+and the load-balance auxiliary loss rides the scanned stack's carry into
+the objective.
+
+For ``moe_layer_freq == 2`` (the reference default) the scanned unit is a
+[dense block, MoE block] PAIR — one compiled body, depth/2 scan steps,
+per-pair ZeRO-3 gathers. Other frequencies use the unrolled layout.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt2 import (Block, CausalSelfAttention,
+                                       GPT2Config, _dense_init,
+                                       cross_entropy_loss)
+from deepspeed_tpu.moe.layer import MoE
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTMoEConfig:
+    gpt: GPT2Config = GPT2Config()
+    num_experts: int = 8
+    moe_layer_freq: int = 2  # every k-th block's MLP is MoE (reference: 2)
+    k: int = 1
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    use_residual: bool = False
+    expert_hidden_dim: Optional[int] = None
+    aux_loss_coef: float = 0.01
+
+    @staticmethod
+    def tiny(num_experts: int = 4, **kw):
+        gpt = GPT2Config.tiny(**kw.pop("gpt_kw", {}))
+        return GPTMoEConfig(gpt=gpt, num_experts=num_experts, **kw)
+
+    def for_decode(self):
+        return dataclasses.replace(self, gpt=self.gpt.for_decode())
+
+
+class MoEBlock(nn.Module):
+    """GPT-2 block whose MLP is the GShard MoE layer; returns
+    ``(x, l_aux)``."""
+
+    config: GPTMoEConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config.gpt
+        moe = self.config
+        attn_out = CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         name="ln_1")(x), deterministic=deterministic)
+        x = x + attn_out
+        h, l_aux, _ = MoE(
+            model_dim=cfg.n_embd, num_experts=moe.num_experts,
+            expert_hidden_dim=moe.expert_hidden_dim or 4 * cfg.n_embd,
+            k=moe.k, capacity_factor=moe.capacity_factor,
+            eval_capacity_factor=moe.eval_capacity_factor,
+            min_capacity=moe.min_capacity,
+            noisy_gate_policy=moe.noisy_gate_policy,
+            use_residual=moe.use_residual, dtype=cfg.dtype,
+            name="moe")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         name="ln_2")(x), deterministic=deterministic)
+        return x + h, l_aux
+
+
+class _PairBody(nn.Module):
+    """Scanned unit for moe_layer_freq=2: dense block → MoE block."""
+
+    config: GPTMoEConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic):
+        x = Block(self.config.gpt, name="dense")(x, deterministic)
+        x, l_aux = MoEBlock(self.config, name="moe_block")(x, deterministic)
+        return x, l_aux
+
+
+class GPTMoEModel(nn.Module):
+    """Embed → (dense|MoE) blocks → LN → tied head. ``__call__`` returns
+    ``(logits, l_aux_mean)``."""
+
+    config: GPTMoEConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True, return_hidden=False):
+        moe = self.config
+        cfg = moe.gpt
+        B, T = input_ids.shape
+        wte = self.param("wte", _dense_init(), (cfg.vocab_size, cfg.n_embd),
+                         jnp.float32)
+        wpe = self.param("wpe", _dense_init(0.01),
+                         (cfg.n_positions, cfg.n_embd), jnp.float32)
+        if cfg.decode:
+            pos_var = self.variable("cache", "position",
+                                    lambda: jnp.zeros((), jnp.int32))
+            pos = pos_var.value
+            pos_var.value = pos + T
+            pos_emb = jax.lax.dynamic_slice(wpe, (pos, 0),
+                                            (T, cfg.n_embd))[None]
+        else:
+            pos_emb = wpe[None, :T]
+        x = wte[input_ids].astype(cfg.dtype) + pos_emb.astype(cfg.dtype)
+
+        if cfg.scan_layers and moe.moe_layer_freq == 2 \
+                and cfg.n_layer % 2 == 0:
+            Scanned = nn.scan(
+                _PairBody,
+                variable_axes={"params": 0, "cache": 0},
+                split_rngs={"params": True, "dropout": True,
+                            "gating": True},
+                in_axes=(nn.broadcast,),
+                length=cfg.n_layer // 2,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"},
+            )
+            x, l_aux = Scanned(moe, name="h")(x, deterministic)
+            l_aux = jnp.mean(l_aux)
+        else:
+            auxes = []
+            for i in range(cfg.n_layer):
+                if (i + 1) % moe.moe_layer_freq == 0:
+                    x, a = MoEBlock(moe, name=f"moe_{i}")(x, deterministic)
+                    auxes.append(a)
+                else:
+                    x = Block(cfg, name=f"h_{i}")(x, deterministic)
+            l_aux = (jnp.mean(jnp.stack(auxes)) if auxes
+                     else jnp.zeros((), jnp.float32))
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         name="ln_f")(x)
+        if return_hidden:
+            return x, wte, l_aux
+        logits = jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits, l_aux
+
+
+def gpt_moe_loss_fn(model: GPTMoEModel):
+    """Next-token CE + aux_loss_coef · mean load-balance loss (reference
+    engine treats l_aux as part of the training objective)."""
+    coef = model.config.aux_loss_coef
+
+    def loss_fn(params, batch, rngs=None):
+        if isinstance(batch, dict):
+            input_ids, labels = batch["input_ids"], batch.get("labels")
+        else:
+            input_ids, labels = batch
+        if labels is None:
+            labels = input_ids
+        logits, l_aux = model.apply({"params": params}, input_ids,
+                                    deterministic=rngs is None, rngs=rngs)
+        shifted = jnp.concatenate(
+            [labels[:, 1:],
+             jnp.full((labels.shape[0], 1), -100, labels.dtype)], axis=1)
+        return cross_entropy_loss(logits, shifted) + coef * l_aux
+
+    return loss_fn
+
+
+class GPTMoEForTraining:
+    """Engine-ready wrapper: ``initialize(model=GPTMoEForTraining(cfg))``."""
+
+    def __init__(self, config: GPTMoEConfig):
+        self.config = config
+        self.model = GPTMoEModel(config)
+        self.loss_fn = gpt_moe_loss_fn(self.model)
+
+    @staticmethod
+    def _input_ids(batch):
+        if isinstance(batch, dict):
+            return batch["input_ids"]
+        if isinstance(batch, (tuple, list)):
+            return batch[0]
+        return batch
+
+    def init(self, rng, batch):
+        return self.model.init(rng, self._input_ids(batch))
+
+    def apply(self, variables, batch, rngs=None):
+        return self.model.apply(variables, self._input_ids(batch),
+                                rngs=rngs)
+
+    def param_specs(self, params_abstract):
+        """Base PartitionSpecs the engine layers ZeRO on top of
+        (``engine._tp_base_specs`` prefers the model's own): expert params
+        shard over the ``expert`` axis on their EXPERT dim — dim 1 under
+        the scanned pair layout (dim 0 is the layer axis), dim 0 when
+        unrolled. The engine's generic rule assumes a leading expert dim
+        and would mis-shard the scanned stack."""
+        import jax as _jax
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.parallel.topology import (AXIS_EXPERT,
+                                                     get_topology)
+        from deepspeed_tpu.utils.pytree import flatten_with_path_strings
+
+        from deepspeed_tpu.parallel.topology import AXIS_MODEL
+
+        topo = get_topology(create_if_missing=False)
+        ep = topo.axis_size(AXIS_EXPERT) if topo is not None else 1
+        tp = topo.axis_size(AXIS_MODEL) if topo is not None else 1
+        if ep <= 1 and tp <= 1:
+            return None
+        policy = None
+        if tp > 1:
+            from deepspeed_tpu.module_inject import get_tp_policy
+
+            # the dense blocks use the canonical c_attn/c_proj/c_fc names
+            policy = get_tp_policy("gpt2")
+        flat, treedef = flatten_with_path_strings(params_abstract)
+        specs = []
+        for path, leaf in flat:
+            segs = path.split("/")
+            if ep > 1 and "experts" in segs:
+                e_dim = 1 if segs[0] == "h" else 0  # "h" = scanned pairs
+                if leaf.ndim > e_dim and leaf.shape[e_dim] % ep == 0:
+                    entries = [None] * leaf.ndim
+                    entries[e_dim] = AXIS_EXPERT
+                    specs.append(P(*entries))
+                    continue
+            specs.append(policy.spec_for(path, tuple(leaf.shape), tp)
+                         if policy is not None else None)
+        return _jax.tree_util.tree_unflatten(treedef, specs)

@@ -149,7 +149,8 @@ GPT_NEOX_POLICY = TPPolicy(
 
 BERT_POLICY = TPPolicy(
     "bert",
-    [("output", ROW),  # attention.output.dense + layer output.dense
+    [("output_dense", ROW),  # attention output projection (models/bert.py)
+     ("output", ROW),        # FFN down-projection
      ("query", COLUMN), ("key", COLUMN), ("value", COLUMN),
      ("intermediate", COLUMN), ("word_embeddings", VOCAB)])
 

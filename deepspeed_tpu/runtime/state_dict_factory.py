@@ -443,9 +443,60 @@ class GPTNeoXWeightMap(HFWeightMap):
         return out
 
 
+class BertWeightMap(HFWeightMap):
+    """HF ``BertForMaskedLM`` → models/bert.py tree (post-LN encoder,
+    tied MLM decoder + bias)."""
+
+    arch = "bert"
+    layer_re = re.compile(r"^(?:bert\.)?encoder\.layer\.(\d+)\.(.+)$")
+    layer_map = {
+        "attention.self.query.kernel": "attention.self.query.weight",
+        "attention.self.query.bias": "attention.self.query.bias",
+        "attention.self.key.kernel": "attention.self.key.weight",
+        "attention.self.key.bias": "attention.self.key.bias",
+        "attention.self.value.kernel": "attention.self.value.weight",
+        "attention.self.value.bias": "attention.self.value.bias",
+        "attention.output_dense.kernel": "attention.output.dense.weight",
+        "attention.output_dense.bias": "attention.output.dense.bias",
+        "attention.output_ln.scale": "attention.output.LayerNorm.weight",
+        "attention.output_ln.bias": "attention.output.LayerNorm.bias",
+        "intermediate.kernel": "intermediate.dense.weight",
+        "intermediate.bias": "intermediate.dense.bias",
+        "output.kernel": "output.dense.weight",
+        "output.bias": "output.dense.bias",
+        "output_ln.scale": "output.LayerNorm.weight",
+        "output_ln.bias": "output.LayerNorm.bias",
+    }
+    top_map = {
+        "word_embeddings": "bert.embeddings.word_embeddings.weight",
+        "position_embeddings": "bert.embeddings.position_embeddings.weight",
+        "token_type_embeddings":
+            "bert.embeddings.token_type_embeddings.weight",
+        "embeddings_ln.scale": "bert.embeddings.LayerNorm.weight",
+        "embeddings_ln.bias": "bert.embeddings.LayerNorm.bias",
+        "transform.kernel": "cls.predictions.transform.dense.weight",
+        "transform.bias": "cls.predictions.transform.dense.bias",
+        "transform_ln.scale": "cls.predictions.transform.LayerNorm.weight",
+        "transform_ln.bias": "cls.predictions.transform.LayerNorm.bias",
+        "decoder_bias": "cls.predictions.bias",
+    }
+
+    @staticmethod
+    def lookup(sd, key):
+        if key in sd:
+            return sd[key]
+        if key.startswith("bert.") and key[len("bert."):] in sd:
+            return sd[key[len("bert."):]]
+        return None
+
+    def layer_key(self, i, suffix):
+        return f"bert.encoder.layer.{i}.{suffix}"
+
+
 _WEIGHT_MAPS = {"gpt2": GPT2WeightMap, "opt": OPTWeightMap,
                 "bloom": BloomWeightMap, "llama": LlamaWeightMap,
-                "gptj": GPTJWeightMap, "gpt-neox": GPTNeoXWeightMap}
+                "gptj": GPTJWeightMap, "gpt-neox": GPTNeoXWeightMap,
+                "bert": BertWeightMap}
 
 
 def get_weight_map(arch: str, **kw) -> HFWeightMap:
@@ -469,6 +520,8 @@ def detect_arch(sd: Dict[str, Any]) -> Optional[str]:
         return "gptj"
     if any("attention.query_key_value" in k for k in keys):
         return "gpt-neox"
+    if any("attention.self.query" in k for k in keys):
+        return "bert"
     return None
 
 
@@ -775,6 +828,82 @@ def load_hf_gpt_neox(src, scan_layers: bool = True, dtype=None,
     logger.info(f"loaded HF GPT-NeoX: {n_layer} layers, n_embd={n_embd}, "
                 f"vocab={wte.shape[0]}, rotary_dim={config.rotary_dim}, "
                 f"parallel_residual={use_parallel_residual}")
+    return config, params
+
+
+def _nest_dotted(flat: Dict[str, np.ndarray]) -> Dict:
+    """{'a.b.c': w} → {'a': {'b': {'c': w}}} (canonical dotted names →
+    flax param nesting)."""
+    out: Dict = {}
+    for key, w in flat.items():
+        node = out
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = w
+    return out
+
+
+def load_hf_bert(src, scan_layers: bool = True, dtype=None,
+                 num_attention_heads: Optional[int] = None):
+    """HF ``BertForMaskedLM`` checkpoint → (BertConfig, flax params) for
+    :class:`deepspeed_tpu.models.bert.BertForMaskedLM` (the reference's
+    marquee kernel target — BASELINE.md BERT rows)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.bert import BertConfig
+
+    if num_attention_heads is None:
+        num_attention_heads = _sniff_config(src, "num_attention_heads")
+    if num_attention_heads is None:
+        # same stance as load_hf_opt: a silent head_dim-64 guess reshapes
+        # attention across head boundaries and fails parity with no error
+        raise ValueError("load_hf_bert needs num_attention_heads "
+                         "(config.json or arg)")
+    sd = SDLoaderFactory.load(src)
+    wm = BertWeightMap()
+    n_layer = wm.n_layers(sd)
+    top = wm.top_weights(sd)
+    wte = top["word_embeddings"]
+    hidden = wte.shape[1]
+    layers = [wm.layer_weights(sd, i) for i in range(n_layer)]
+    inter = layers[0]["intermediate.kernel"].shape[-1]
+    config = BertConfig(
+        vocab_size=wte.shape[0], hidden_size=hidden,
+        num_hidden_layers=n_layer,
+        num_attention_heads=num_attention_heads,
+        intermediate_size=inter,
+        max_position_embeddings=top["position_embeddings"].shape[0],
+        type_vocab_size=top["token_type_embeddings"].shape[0],
+        dtype=dtype if dtype is not None else jnp.float32,
+        scan_layers=scan_layers)
+
+    block_trees = [_nest_dotted(lw) for lw in layers]
+    if scan_layers:
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs, axis=0), *block_trees)
+        encoder = {"layers": {"layer": stacked}}
+    else:
+        encoder = {f"layer_{i}": t for i, t in enumerate(block_trees)}
+    params = {
+        "bert": {
+            "word_embeddings": wte,
+            "position_embeddings": top["position_embeddings"],
+            "token_type_embeddings": top["token_type_embeddings"],
+            "embeddings_ln": {"scale": top["embeddings_ln.scale"],
+                              "bias": top["embeddings_ln.bias"]},
+            "encoder": encoder,
+        },
+        "transform": {"kernel": top["transform.kernel"],
+                      "bias": top["transform.bias"]},
+        "transform_ln": {"scale": top["transform_ln.scale"],
+                         "bias": top["transform_ln.bias"]},
+        "decoder_bias": top["decoder_bias"],
+    }
+    params = jax.tree_util.tree_map(
+        lambda x: np.asarray(x, np.float32), params)
+    logger.info(f"loaded HF BERT: {n_layer} layers, hidden={hidden}, "
+                f"vocab={wte.shape[0]}")
     return config, params
 
 

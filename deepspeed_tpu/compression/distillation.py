@@ -139,8 +139,10 @@ def kd_loss_fn(student_loss_fn: Optional[Callable],
     import inspect
 
     try:
-        _logits_takes_rngs = "rngs" in inspect.signature(
-            student_logits_fn).parameters
+        _params = inspect.signature(student_logits_fn).parameters
+        _logits_takes_rngs = "rngs" in _params or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in _params.values())
     except (TypeError, ValueError):
         _logits_takes_rngs = False
 

@@ -90,14 +90,19 @@ class ZeroInfinityEngine:
         del args, dist_init_required
         self._config = config if isinstance(config, DeepSpeedConfig) \
             else DeepSpeedConfig(config, world_size=1)
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+
         cfgm = getattr(model, "config", None)
         inner = getattr(model, "model", None)
-        if cfgm is None or inner is None or not getattr(
-                cfgm, "scan_layers", False):
+        if cfgm is None or not isinstance(inner, GPT2LMHeadModel) \
+                or not getattr(cfgm, "scan_layers", False):
             raise DeepSpeedConfigError(
-                "offload_param needs a scanned canonical decoder model "
-                "(GPT2ForTraining with scan_layers=True): the stacked layer "
-                "axis is the streaming schedule")
+                "zero_optimization.offload_param selects the layer-streamed "
+                "ZeRO-Infinity tier, which supports the scanned canonical "
+                "decoder family (GPT2ForTraining — serves GPT-2/OPT/BLOOM/"
+                "GPT-J/NeoX weights — with scan_layers=True). Remove "
+                "offload_param to train this model with the device engine, "
+                "or use offload_optimizer alone for the host-optimizer tier")
         if getattr(cfgm, "dropout", 0.0) or getattr(cfgm, "pld", False):
             raise DeepSpeedConfigError(
                 "offload_param streams a deterministic forward: set "
@@ -306,14 +311,24 @@ class ZeroInfinityEngine:
         def head_loss(top, hidden, labels):
             x = ln("ln_f", top, hidden)
             head_w = top["wte"] if cfg.tied_head else top["lm_head"]
-            logits = jnp.einsum("btc,vc->btv", x, head_w.astype(cfg.dtype),
-                                preferred_element_type=jnp.float32)
-            if cfg.lm_head_bias:
-                logits = logits + top["lm_head_bias"]
+            bias = top["lm_head_bias"] if cfg.lm_head_bias else None
             shifted = jnp.concatenate(
                 [labels[:, 1:],
                  jnp.full((labels.shape[0], 1), -100, labels.dtype)], axis=1)
-            return cross_entropy_loss(logits, shifted)
+            # same dense-vs-chunked budget switch as gpt2_loss_fn: the full
+            # [B, T, V] fp32 logits tensor is exactly the HBM spike this
+            # tier exists to avoid
+            if B * T * cfg.vocab_size * 4 <= 1_000_000_000:
+                logits = jnp.einsum("btc,vc->btv", x,
+                                    head_w.astype(cfg.dtype),
+                                    preferred_element_type=jnp.float32)
+                if bias is not None:
+                    logits = logits + bias
+                return cross_entropy_loss(logits, shifted)
+            from deepspeed_tpu.models.gpt2 import chunked_softmax_xent
+
+            return chunked_softmax_xent(x, head_w, shifted, chunk=512,
+                                        bias=bias)
 
         def block_vjp(bp, x, dy):
             _, vjp = jax.vjp(block_fwd, bp, x)
@@ -447,7 +462,10 @@ class ZeroInfinityEngine:
                 lr = float(self._schedule_fn(self.global_steps))
             elif self.lr_scheduler is not None and hasattr(
                     self.lr_scheduler, "get_lr"):
-                lr = float(self.lr_scheduler.get_lr())
+                lr = self.lr_scheduler.get_lr()
+                if isinstance(lr, (list, tuple)):  # LRScheduler returns [lr]
+                    lr = lr[0]
+                lr = float(lr)
             else:
                 lr = float((self._config.optimizer_params or {}).get(
                     "lr", 1e-3))

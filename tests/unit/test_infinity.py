@@ -173,3 +173,10 @@ class TestInfinityEngine:
         with pytest.raises(DeepSpeedConfigError):
             ZeroInfinityEngine(model=model, config=_ds_config(
                 optimizer={"type": "Lamb", "params": {"lr": 1e-3}}))
+        # non-canonical model families fail with the config error, not a
+        # KeyError deep in tree splitting
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForTraining
+
+        with pytest.raises(DeepSpeedConfigError):
+            ZeroInfinityEngine(model=LlamaForTraining(LlamaConfig.tiny()),
+                               config=_ds_config())

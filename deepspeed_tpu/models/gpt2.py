@@ -501,6 +501,31 @@ def chunked_softmax_xent(hidden, wte, labels, chunk: int = 128,
     return total / jnp.maximum(count, 1)
 
 
+def shift_labels(labels, ignore_index: int = -100):
+    """Next-token shift: labels[t] ← labels[t+1], last column ignored."""
+    return jnp.concatenate(
+        [labels[:, 1:],
+         jnp.full((labels.shape[0], 1), ignore_index, labels.dtype)], axis=1)
+
+
+def lm_head_loss(hidden, head_w, shifted_labels, bias=None,
+                 dense_budget: int = 1_000_000_000, chunk: int = 512):
+    """LM-head cross-entropy with the dense-vs-chunked switch: materialize
+    the full [B, T, V] fp32 logits when they fit ``dense_budget`` bytes
+    (faster — one fused program, no recompute), else the remat'd chunked
+    scan. The single policy point for every engine tier."""
+    B, T, _ = hidden.shape
+    V = head_w.shape[0]
+    if B * T * V * 4 <= dense_budget:
+        logits = jnp.einsum("btc,vc->btv", hidden, head_w.astype(hidden.dtype),
+                            preferred_element_type=jnp.float32)
+        if bias is not None:
+            logits = logits + bias
+        return cross_entropy_loss(logits, shifted_labels)
+    return chunked_softmax_xent(hidden, head_w, shifted_labels, chunk=chunk,
+                                bias=bias)
+
+
 def cross_entropy_loss(logits, labels, ignore_index: int = -100):
     """Mean token cross-entropy, masked where ``labels == ignore_index``."""
     valid = labels != ignore_index
@@ -636,28 +661,12 @@ def gpt2_loss_fn(model: GPT2LMHeadModel):
                                   deterministic=rngs is None, rngs=rngs,
                                   return_hidden=True, pld_theta=pld_theta)
         # wte is the LM-head matrix: the tied embedding, or the separate
-        # lm_head (whose optional bias lives beside it in the param tree)
-        head_bias = params.get("lm_head_bias")
-        # shift for next-token prediction by padding the label stream
-        shifted = jnp.concatenate(
-            [labels[:, 1:], jnp.full((labels.shape[0], 1), -100, labels.dtype)],
-            axis=1)
-        B, T, _ = hidden.shape
-        V = model.config.vocab_size
-        # without remat the saved block activations already crowd HBM — only
-        # afford the dense head a smaller logits budget there
-        dense_budget = 3_500_000_000 if model.config.remat else 1_000_000_000
-        if B * T * V * 4 <= dense_budget:
-            # dense head: materializing [B, T, V] fp32 logits fits in HBM and
-            # beats the chunked scan (no recompute, one fused program)
-            logits = jnp.einsum("btc,vc->btv", hidden,
-                                wte.astype(hidden.dtype),
-                                preferred_element_type=jnp.float32)
-            if head_bias is not None:
-                logits = logits + head_bias
-            return cross_entropy_loss(logits, shifted)
-        # chunked head: avoids the full [B, T, V] fp32 logits tensor
-        return chunked_softmax_xent(hidden, wte, shifted, chunk=512,
-                                    bias=head_bias)
+        # lm_head (whose optional bias lives beside it in the param tree).
+        # Without remat the saved block activations already crowd HBM —
+        # only afford the dense head a smaller logits budget there
+        return lm_head_loss(
+            hidden, wte, shift_labels(labels), bias=params.get("lm_head_bias"),
+            dense_budget=3_500_000_000 if model.config.remat
+            else 1_000_000_000)
 
     return loss_fn

@@ -285,8 +285,8 @@ class ZeroInfinityEngine:
             return self._compiled[key]
         import flax.linen as nn
 
-        from deepspeed_tpu.models.gpt2 import (Block, cross_entropy_loss,
-                                               _remat_block)
+        from deepspeed_tpu.models.gpt2 import (Block, _remat_block,
+                                               lm_head_loss, shift_labels)
 
         cfg = self.model_cfg
         block = _remat_block(cfg)(cfg) if cfg.remat else Block(cfg)
@@ -312,23 +312,12 @@ class ZeroInfinityEngine:
             x = ln("ln_f", top, hidden)
             head_w = top["wte"] if cfg.tied_head else top["lm_head"]
             bias = top["lm_head_bias"] if cfg.lm_head_bias else None
-            shifted = jnp.concatenate(
-                [labels[:, 1:],
-                 jnp.full((labels.shape[0], 1), -100, labels.dtype)], axis=1)
-            # same dense-vs-chunked budget switch as gpt2_loss_fn: the full
-            # [B, T, V] fp32 logits tensor is exactly the HBM spike this
-            # tier exists to avoid
-            if B * T * cfg.vocab_size * 4 <= 1_000_000_000:
-                logits = jnp.einsum("btc,vc->btv", x,
-                                    head_w.astype(cfg.dtype),
-                                    preferred_element_type=jnp.float32)
-                if bias is not None:
-                    logits = logits + bias
-                return cross_entropy_loss(logits, shifted)
-            from deepspeed_tpu.models.gpt2 import chunked_softmax_xent
-
-            return chunked_softmax_xent(x, head_w, shifted, chunk=512,
-                                        bias=bias)
+            # shared head policy (models/gpt2.py lm_head_loss); the tight
+            # 1 GB dense budget is intentional — the full [B, T, V] fp32
+            # logits tensor is exactly the HBM spike this tier exists to
+            # avoid, and there is no remat headroom to spend
+            return lm_head_loss(x, head_w, shift_labels(labels), bias=bias,
+                                dense_budget=1_000_000_000)
 
         def block_vjp(bp, x, dy):
             _, vjp = jax.vjp(block_fwd, bp, x)
@@ -552,4 +541,4 @@ class ZeroInfinityEngine:
             leaf.fill(0.0)
         log_dist(f"loaded infinity checkpoint {tag} from {load_dir}",
                  ranks=[0])
-        return os.path.join(str(load_dir), tag), {}
+        return tag, {}  # same convention as DeepSpeedEngine.load_checkpoint

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.models.gpt2 import (Block, CausalSelfAttention,
                                        GPT2Config, _dense_init,
-                                       cross_entropy_loss)
+                                       cross_entropy_loss, shift_labels)
 from deepspeed_tpu.moe.layer import MoE
 
 
@@ -163,10 +163,7 @@ def gpt_moe_loss_fn(model: GPTMoEModel):
             labels = input_ids
         logits, l_aux = model.apply({"params": params}, input_ids,
                                     deterministic=rngs is None, rngs=rngs)
-        shifted = jnp.concatenate(
-            [labels[:, 1:],
-             jnp.full((labels.shape[0], 1), -100, labels.dtype)], axis=1)
-        return cross_entropy_loss(logits, shifted) + coef * l_aux
+        return cross_entropy_loss(logits, shift_labels(labels)) + coef * l_aux
 
     return loss_fn
 

@@ -17,8 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.models.gpt2 import (chunked_softmax_xent,
-                                       cross_entropy_loss)
+from deepspeed_tpu.models.gpt2 import lm_head_loss, shift_labels
 from deepspeed_tpu.ops.attention import attention
 
 
@@ -292,19 +291,10 @@ def llama_loss_fn(model: LlamaModel):
         hidden, head = model.apply({"params": params}, input_ids,
                                    deterministic=rngs is None, rngs=rngs,
                                    return_hidden=True)
-        shifted = jnp.concatenate(
-            [labels[:, 1:],
-             jnp.full((labels.shape[0], 1), -100, labels.dtype)], axis=1)
-        B, T, _ = hidden.shape
-        V = model.config.vocab_size
-        dense_budget = (3_500_000_000 if model.config.remat
-                        else 1_000_000_000)
-        if B * T * V * 4 <= dense_budget:
-            logits = jnp.einsum("btc,vc->btv", hidden,
-                                head.astype(hidden.dtype),
-                                preferred_element_type=jnp.float32)
-            return cross_entropy_loss(logits, shifted)
-        return chunked_softmax_xent(hidden, head, shifted, chunk=512)
+        return lm_head_loss(
+            hidden, head, shift_labels(labels),
+            dense_budget=3_500_000_000 if model.config.remat
+            else 1_000_000_000)
 
     return loss_fn
 

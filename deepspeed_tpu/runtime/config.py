@@ -38,6 +38,11 @@ class MeshConfig(DeepSpeedConfigModel):
     expert: int = 1
     seq: int = 1
     axis_order: tuple = ("pipe", "data", "expert", "seq", "model")
+    # multi-slice/multi-pod: per-axis factor that crosses the DCN (slice)
+    # boundary, e.g. {"data": 4} trains 4 pods data-parallel with all other
+    # axes riding ICI inside each pod (reference: multinode NCCL topology;
+    # here jax mesh_utils.create_hybrid_device_mesh places the axes)
+    dcn: dict = Field(default_factory=dict)
 
 
 class ActivationCheckpointingConfig(DeepSpeedConfigModel):

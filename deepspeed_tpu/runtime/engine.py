@@ -118,10 +118,14 @@ class DeepSpeedEngine:
         pre_ws = self.topology.get_data_parallel_world_size() if self.topology else None
         self._config = DeepSpeedConfig(config, world_size=pre_ws)
         if self.topology is None:
-            self.topology = MeshTopology(axis_sizes=dict(
-                data=self._config.mesh.data, model=self._config.mesh.model,
-                pipe=self._config.mesh.pipe, expert=self._config.mesh.expert,
-                seq=self._config.mesh.seq))
+            self.topology = MeshTopology(
+                axis_sizes=dict(
+                    data=self._config.mesh.data,
+                    model=self._config.mesh.model,
+                    pipe=self._config.mesh.pipe,
+                    expert=self._config.mesh.expert,
+                    seq=self._config.mesh.seq),
+                dcn_axis_sizes=self._config.mesh.dcn or None)
             # re-resolve batch triangle against the actual mesh
             self._config = DeepSpeedConfig(
                 self._config._param_dict,

@@ -203,13 +203,20 @@ class InferenceEngine:
                 "model config must provide for_decode() for KV-cache generation")
         return type(self.module)(cfg.for_decode())
 
+    @staticmethod
+    def _logits_of(out):
+        """Models may return (logits, aux) — e.g. GPT-MoE's load-balance
+        loss, a training artifact irrelevant at inference."""
+        return out[0] if isinstance(out, tuple) else out
+
     def forward(self, input_ids, **kwargs):
         """Full (non-cached) forward — reference ``engine.py:496``."""
         if self._forward_fn is None:
             module = self.module
 
             def fwd(params, ids):
-                return module.apply({"params": self._dequantize(params)}, ids)
+                return self._logits_of(module.apply(
+                    {"params": self._dequantize(params)}, ids))
 
             self._forward_fn = jax.jit(fwd)
         t = self._timer("model_forward")
@@ -239,8 +246,9 @@ class InferenceEngine:
             input_ids = jax.lax.with_sharding_constraint(
                 input_ids, NamedSharding(self.mesh, batch_spec))
             # prefill: one compiled program over the whole prompt
-            logits, vars_ = dmodule.apply({"params": params}, input_ids,
-                                          mutable=["cache"])
+            out, vars_ = dmodule.apply({"params": params}, input_ids,
+                                       mutable=["cache"])
+            logits = self._logits_of(out)
             cache = vars_["cache"]
 
             def sample(logits, rng):
@@ -259,9 +267,10 @@ class InferenceEngine:
 
             def body(carry, _):
                 cache, token, rng, done = carry
-                logits, vars_ = dmodule.apply(
+                out, vars_ = dmodule.apply(
                     {"params": params, "cache": cache}, token[:, None],
                     mutable=["cache"])
+                logits = self._logits_of(out)
                 cache = vars_["cache"]
                 rng, sub = jax.random.split(rng)
                 nxt = sample(logits[:, -1], sub)

@@ -627,10 +627,7 @@ def gpt2_pipe(config: GPT2Config):
                           preferred_element_type=jnp.float32)
 
     def loss_fn(logits, labels):
-        shifted = jnp.concatenate(
-            [labels[:, 1:], jnp.full((labels.shape[0], 1), -100, labels.dtype)],
-            axis=1)
-        return cross_entropy_loss(logits, shifted)
+        return cross_entropy_loss(logits, shift_labels(labels))
 
     layers = [
         TiedLayerSpec(GPT2Embed, config, key="embed"),

@@ -207,6 +207,10 @@ class MeshTopology:
                 raise ValueError(
                     f"unknown dcn axis names {sorted(unknown)}; valid axes: "
                     f"{list(CANONICAL_AXIS_ORDER)}")
+            bad = {a: v for a, v in (dcn_axis_sizes or {}).items()
+                   if int(v) < 1}
+            if bad:
+                raise ValueError(f"dcn factors must be >= 1; got {bad}")
             dcn = {a: int((dcn_axis_sizes or {}).get(a, 1))
                    for a in CANONICAL_AXIS_ORDER}
             if any(v > 1 for v in dcn.values()):
@@ -236,12 +240,7 @@ class MeshTopology:
         prescribes — collectives on DCN only where declared). Elsewhere
         (CPU test meshes) the same dcn-major ordering is materialized by
         reshape: devices group slice-major per axis."""
-        import numpy as np
-
         for a in CANONICAL_AXIS_ORDER:
-            if dcn[a] < 1:
-                raise ValueError(
-                    f"dcn factor for axis {a!r} must be >= 1; got {dcn[a]}")
             if sizes[a] % dcn[a] != 0:
                 raise ValueError(
                     f"mesh axis {a!r} size {sizes[a]} not divisible by its "
@@ -252,7 +251,8 @@ class MeshTopology:
         # placement MUST come from mesh_utils (a declared-but-unhonored DCN
         # layout silently runs ICI axes across the slice boundary) — errors
         # propagate. The enumeration-order fallback is only for platforms
-        # with no slice structure (CPU test meshes).
+        # with no slice structure (CPU test meshes); declaring dcn on a
+        # single-slice TPU is a misconfiguration, not a fallback case.
         sliced_hw = any(
             getattr(d, "slice_index", None) not in (None, 0) for d in devices)
         if sliced_hw:
@@ -260,6 +260,16 @@ class MeshTopology:
 
             return mesh_utils.create_hybrid_device_mesh(
                 ici_shape, dcn_shape, devices=devices)
+        platform = getattr(devices[0], "platform", "cpu")
+        if platform != "cpu":
+            raise ValueError(
+                "mesh.dcn declares a multi-slice layout but every device is "
+                "in one slice — remove the dcn section (single-pod jobs "
+                "need no DCN axes) or run across slices")
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.info("mesh.dcn on a CPU test mesh: emulating the dcn-major "
+                    "placement by enumeration order")
         n = len(CANONICAL_AXIS_ORDER)
         arr = np.asarray(devices).reshape(*dcn_shape, *ici_shape)
         perm = [x for i in range(n) for x in (i, n + i)]

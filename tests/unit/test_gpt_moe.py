@@ -90,6 +90,25 @@ class TestGPTMoE:
         losses, _ = _train({"data": 4}, scan=False)
         assert losses[-1] < losses[0]
 
+    def test_serves_through_inference_engine(self):
+        """init_inference handles the (logits, aux) output contract: greedy
+        generation continues the argmax chain of the dense forward."""
+        cfg = GPTMoEConfig.tiny(gpt_kw={"dtype": jnp.float32,
+                                        "n_positions": 16})
+        model = GPTMoEModel(cfg)
+        ids = np.array([[3, 17, 42, 99]], np.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        engine = deepspeed_tpu.init_inference(model, params=params)
+        out = np.asarray(engine.generate(ids, max_new_tokens=3,
+                                         do_sample=False))
+        # reference chain: greedy-extend with the dense (non-cached) model
+        cur = ids
+        for _ in range(3):
+            logits, _ = model.apply({"params": params}, cur)
+            nxt = np.argmax(np.asarray(logits[:, -1]), axis=-1)
+            cur = np.concatenate([cur, nxt[:, None].astype(np.int32)], axis=1)
+        np.testing.assert_array_equal(out, cur)
+
     def test_decode_matches_dense(self):
         cfg = GPTMoEConfig.tiny(gpt_kw={"dtype": jnp.float32,
                                         "n_positions": 16})

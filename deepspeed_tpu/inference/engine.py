@@ -15,7 +15,7 @@ Capability parity with the reference ``InferenceEngine``
 - KV-cache workspace (``csrc/.../inference_context.h``) → explicit cache
   arrays in a flax ``cache`` collection, sharded over the ``model`` axis.
 - ``generate`` (``engine.py:524``) → one jitted prefill + ``lax.scan`` over
-  decode steps with greedy/temperature/top-k sampling.
+  decode steps with greedy/temperature/top-k/top-p (nucleus) sampling.
 """
 
 import dataclasses
@@ -236,7 +236,7 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
     def _build_generate(self, prompt_len: int, max_new_tokens: int,
-                        do_sample: bool, top_k: int):
+                        do_sample: bool, top_k: int, top_p: float = 0.0):
         dmodule = self._decode_module()
         dequant = self._dequantize
         batch_spec = P(AXIS_DATA) if self.topo.axis_size(AXIS_DATA) > 1 else P()
@@ -258,6 +258,19 @@ class InferenceEngine:
                     if top_k > 0:
                         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
                         logits = jnp.where(logits < kth, -jnp.inf, logits)
+                    if top_p > 0.0:
+                        # nucleus: keep the smallest prefix of the sorted
+                        # distribution whose mass reaches top_p (the first
+                        # token past the threshold stays, HF-style)
+                        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+                        cum = jnp.cumsum(
+                            jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+                        keep = cum - jax.nn.softmax(sorted_logits,
+                                                    axis=-1) < top_p
+                        cutoff = jnp.min(
+                            jnp.where(keep, sorted_logits, jnp.inf),
+                            axis=-1, keepdims=True)
+                        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
                     return jax.random.categorical(rng, logits, axis=-1)
                 return jnp.argmax(logits, axis=-1)
 
@@ -288,7 +301,7 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
-                 top_k: int = 0, eos_token_id: int = -1,
+                 top_k: int = 0, top_p: float = 0.0, eos_token_id: int = -1,
                  rng=None, **kwargs):
         """Sharded autoregressive generation (reference ``engine.py:524``).
 
@@ -312,7 +325,8 @@ class InferenceEngine:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
 
-        key = (T, int(max_new_tokens), bool(do_sample), int(top_k))
+        key = (T, int(max_new_tokens), bool(do_sample), int(top_k),
+               float(top_p))
         if key not in self._generate_cache:
             self._generate_cache[key] = self._build_generate(*key)
         if rng is None:

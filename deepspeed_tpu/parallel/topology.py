@@ -266,8 +266,6 @@ class MeshTopology:
                 "mesh.dcn declares a multi-slice layout but every device is "
                 "in one slice — remove the dcn section (single-pod jobs "
                 "need no DCN axes) or run across slices")
-        from deepspeed_tpu.utils.logging import logger
-
         logger.info("mesh.dcn on a CPU test mesh: emulating the dcn-major "
                     "placement by enumeration order")
         n = len(CANONICAL_AXIS_ORDER)

@@ -87,6 +87,32 @@ class TestInferenceEngine:
         with pytest.raises(ValueError, match="exceeds"):
             engine.generate(np.zeros((1, 60), np.int32), max_new_tokens=10)
 
+    def test_top_p_nucleus_sampling(self):
+        """top_p → 0 keeps only the most probable token: nucleus sampling
+        must reproduce the greedy chain exactly; a loose top_p still
+        produces in-vocab tokens."""
+        cfg = _tiny()
+        engine = deepspeed_tpu.init_inference(GPT2LMHeadModel(cfg),
+                                              dtype="fp32")
+        ids = np.array([[1, 2, 3]], dtype=np.int32)
+        greedy = engine.generate(ids, max_new_tokens=4, do_sample=False)
+        nucleus = engine.generate(ids, max_new_tokens=4, do_sample=True,
+                                  top_p=1e-9)
+        np.testing.assert_array_equal(nucleus, greedy)
+        # a loose nucleus over the near-flat logits of a random-init model
+        # must actually SAMPLE: different rng draws yield different tokens
+        # (guards against the cutoff degenerating to greedy)
+        import jax
+
+        draws = {
+            tuple(np.asarray(engine.generate(
+                ids, max_new_tokens=4, do_sample=True, top_p=0.95,
+                temperature=1.0, rng=jax.random.PRNGKey(s)))[0].tolist())
+            for s in range(5)}
+        assert len(draws) > 1
+        for d in draws:
+            assert all(t < cfg.vocab_size for t in d)
+
     def test_eos_early_stop_pads_with_eos(self):
         cfg = _tiny()
         engine = deepspeed_tpu.init_inference(GPT2LMHeadModel(cfg), dtype="fp32")

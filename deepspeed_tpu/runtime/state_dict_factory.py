@@ -983,3 +983,160 @@ def load_hf_llama(src, scan_layers: bool = True, dtype=None,
     logger.info(f"loaded HF Llama: {n_layer} layers, hidden={hidden}, "
                 f"heads={heads}/{kv_heads}kv, vocab={embed.shape[0]}")
     return config, params
+
+
+# ----------------------------------------------------------------------
+# Export: flax params → HF state dicts (the interop inverse of the
+# loaders; reference capability: ``save_16bit_model``/``zero_to_fp32``
+# produce reference-consumable checkpoints — these produce
+# transformers-consumable ones)
+
+
+def _f32(a):
+    return np.ascontiguousarray(np.asarray(a, np.float32))
+
+
+def _iter_blocks(container, scanned_path, unrolled_prefix):
+    """Yield per-layer trees from either layout: the scanned stack (leading
+    layer axis) or ``<prefix>_i`` siblings."""
+    node = container
+    for seg in scanned_path:
+        node = node.get(seg, {}) if isinstance(node, dict) else {}
+    if node:  # scanned: every leaf carries the layer axis
+        n = int(jax.tree_util.tree_leaves(node)[0].shape[0])
+        for i in range(n):
+            yield jax.tree_util.tree_map(lambda a, i=i: np.asarray(a)[i],
+                                         node)
+        return
+    i = 0
+    while f"{unrolled_prefix}_{i}" in container:
+        yield container[f"{unrolled_prefix}_{i}"]
+        i += 1
+
+
+def export_hf_gpt2(params) -> Dict[str, np.ndarray]:
+    """Canonical GPT-2 params → HF ``GPT2LMHeadModel`` state dict (plain
+    GPT-2 layout only: tied head, learned positions; Conv1D keeps the
+    [in, out] orientation so kernels pass through untransposed)."""
+    sd = {
+        "transformer.wte.weight": _f32(params["wte"]),
+        "transformer.wpe.weight": _f32(params["wpe"]),
+        "transformer.ln_f.weight": _f32(params["ln_f"]["scale"]),
+        "transformer.ln_f.bias": _f32(params["ln_f"]["bias"]),
+        "lm_head.weight": _f32(params["wte"]),  # tied
+    }
+    for i, b in enumerate(_iter_blocks(params.get("transformer", {}),
+                                       ("h", "block"), "h")):
+        p = f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = _f32(b["ln_1"]["scale"])
+        sd[p + "ln_1.bias"] = _f32(b["ln_1"]["bias"])
+        sd[p + "attn.c_attn.weight"] = _f32(b["attn"]["c_attn"]["kernel"])
+        sd[p + "attn.c_attn.bias"] = _f32(b["attn"]["c_attn"]["bias"])
+        sd[p + "attn.c_proj.weight"] = _f32(b["attn"]["c_proj"]["kernel"])
+        sd[p + "attn.c_proj.bias"] = _f32(b["attn"]["c_proj"]["bias"])
+        sd[p + "ln_2.weight"] = _f32(b["ln_2"]["scale"])
+        sd[p + "ln_2.bias"] = _f32(b["ln_2"]["bias"])
+        sd[p + "mlp.c_fc.weight"] = _f32(b["mlp"]["c_fc"]["kernel"])
+        sd[p + "mlp.c_fc.bias"] = _f32(b["mlp"]["c_fc"]["bias"])
+        sd[p + "mlp.c_proj.weight"] = _f32(b["mlp"]["c_proj"]["kernel"])
+        sd[p + "mlp.c_proj.bias"] = _f32(b["mlp"]["c_proj"]["bias"])
+    return sd
+
+
+def export_hf_llama(params) -> Dict[str, np.ndarray]:
+    """Llama params → HF ``LlamaForCausalLM`` state dict (flax [in, out]
+    kernels transpose back to nn.Linear's [out, in])."""
+    sd = {
+        "model.embed_tokens.weight": _f32(params["embed_tokens"]),
+        "model.norm.weight": _f32(params["norm"]["scale"]),
+        "lm_head.weight": _f32(params.get("lm_head",
+                                          params["embed_tokens"])),
+    }
+    for i, b in enumerate(_iter_blocks(params, ("layers", "block"),
+                                       "layers")):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = _f32(
+            b["input_layernorm"]["scale"])
+        sd[p + "post_attention_layernorm.weight"] = _f32(
+            b["post_attention_layernorm"]["scale"])
+        for n in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            sd[p + f"self_attn.{n}.weight"] = _f32(
+                b["self_attn"][n]["kernel"].T)
+        for n in ("gate_proj", "up_proj", "down_proj"):
+            sd[p + f"mlp.{n}.weight"] = _f32(b["mlp"][n]["kernel"].T)
+    return sd
+
+
+def export_hf_bert(params) -> Dict[str, np.ndarray]:
+    """BERT params → HF ``BertForMaskedLM`` state dict."""
+    bert = params["bert"]
+    sd = {
+        "bert.embeddings.word_embeddings.weight":
+            _f32(bert["word_embeddings"]),
+        "bert.embeddings.position_embeddings.weight":
+            _f32(bert["position_embeddings"]),
+        "bert.embeddings.token_type_embeddings.weight":
+            _f32(bert["token_type_embeddings"]),
+        "bert.embeddings.LayerNorm.weight": _f32(
+            bert["embeddings_ln"]["scale"]),
+        "bert.embeddings.LayerNorm.bias": _f32(
+            bert["embeddings_ln"]["bias"]),
+        "cls.predictions.transform.dense.weight": _f32(
+            params["transform"]["kernel"].T),
+        "cls.predictions.transform.dense.bias": _f32(
+            params["transform"]["bias"]),
+        "cls.predictions.transform.LayerNorm.weight": _f32(
+            params["transform_ln"]["scale"]),
+        "cls.predictions.transform.LayerNorm.bias": _f32(
+            params["transform_ln"]["bias"]),
+        "cls.predictions.bias": _f32(params["decoder_bias"]),
+        "cls.predictions.decoder.weight": _f32(bert["word_embeddings"]),
+        "cls.predictions.decoder.bias": _f32(params["decoder_bias"]),
+    }
+    for i, b in enumerate(_iter_blocks(bert.get("encoder", {}),
+                                       ("layers", "layer"), "layer")):
+        p = f"bert.encoder.layer.{i}."
+        for n in ("query", "key", "value"):
+            sd[p + f"attention.self.{n}.weight"] = _f32(
+                b["attention"]["self"][n]["kernel"].T)
+            sd[p + f"attention.self.{n}.bias"] = _f32(
+                b["attention"]["self"][n]["bias"])
+        sd[p + "attention.output.dense.weight"] = _f32(
+            b["attention"]["output_dense"]["kernel"].T)
+        sd[p + "attention.output.dense.bias"] = _f32(
+            b["attention"]["output_dense"]["bias"])
+        sd[p + "attention.output.LayerNorm.weight"] = _f32(
+            b["attention"]["output_ln"]["scale"])
+        sd[p + "attention.output.LayerNorm.bias"] = _f32(
+            b["attention"]["output_ln"]["bias"])
+        sd[p + "intermediate.dense.weight"] = _f32(
+            b["intermediate"]["kernel"].T)
+        sd[p + "intermediate.dense.bias"] = _f32(b["intermediate"]["bias"])
+        sd[p + "output.dense.weight"] = _f32(b["output"]["kernel"].T)
+        sd[p + "output.dense.bias"] = _f32(b["output"]["bias"])
+        sd[p + "output.LayerNorm.weight"] = _f32(b["output_ln"]["scale"])
+        sd[p + "output.LayerNorm.bias"] = _f32(b["output_ln"]["bias"])
+    return sd
+
+
+_EXPORTERS = {"gpt2": export_hf_gpt2, "llama": export_hf_llama,
+              "bert": export_hf_bert}
+
+
+def _plain_dicts(tree):
+    """Any Mapping (flax FrozenDict included) → plain nested dicts: the
+    exporters walk with dict methods and an isinstance(dict) check."""
+    from collections.abc import Mapping
+
+    if isinstance(tree, Mapping):
+        return {k: _plain_dicts(v) for k, v in tree.items()}
+    return tree
+
+
+def export_hf_state_dict(params, arch: str) -> Dict[str, np.ndarray]:
+    """Flax params → HF-named numpy state dict for a supported arch."""
+    params = _plain_dicts(jax.device_get(params))
+    if arch not in _EXPORTERS:
+        raise ValueError(f"no HF exporter for arch {arch!r}; "
+                         f"have {sorted(_EXPORTERS)}")
+    return _EXPORTERS[arch](params)

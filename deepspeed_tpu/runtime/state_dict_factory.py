@@ -996,36 +996,43 @@ def _f32(a):
     return np.ascontiguousarray(np.asarray(a, np.float32))
 
 
-def _iter_blocks(container, scanned_path, unrolled_prefix):
-    """Yield per-layer trees from either layout: the scanned stack (leading
-    layer axis) or ``<prefix>_i`` siblings."""
+def _blocks_list(container, scanned_path, unrolled_prefix):
+    """Per-layer trees from either layout: the scanned stack (leading layer
+    axis) or ``<prefix>_i`` siblings. Zero layers or an index gap is a
+    LAYOUT ERROR, not an empty model — a silently truncated export would
+    pass ``load_state_dict(strict=False)`` and produce garbage logits."""
     node = container
     for seg in scanned_path:
         node = node.get(seg, {}) if isinstance(node, dict) else {}
     if node:  # scanned: every leaf carries the layer axis
         n = int(jax.tree_util.tree_leaves(node)[0].shape[0])
-        for i in range(n):
-            yield jax.tree_util.tree_map(lambda a, i=i: np.asarray(a)[i],
-                                         node)
-        return
-    i = 0
-    while f"{unrolled_prefix}_{i}" in container:
-        yield container[f"{unrolled_prefix}_{i}"]
-        i += 1
+        return [jax.tree_util.tree_map(lambda a, i=i: np.asarray(a)[i],
+                                       node) for i in range(n)]
+    pre = unrolled_prefix + "_"
+    idxs = sorted(int(k[len(pre):]) for k in container
+                  if k.startswith(pre) and k[len(pre):].isdigit())
+    if not idxs or idxs != list(range(len(idxs))):
+        raise ValueError(
+            f"no transformer layers found under "
+            f"{'/'.join(scanned_path)!r} or contiguous "
+            f"{unrolled_prefix}_i keys (got indices {idxs}); the params "
+            "tree does not match this exporter's expected layout")
+    return [container[f"{pre}{i}"] for i in idxs]
 
 
 def export_hf_gpt2(params) -> Dict[str, np.ndarray]:
     """Canonical GPT-2 params → HF ``GPT2LMHeadModel`` state dict (plain
     GPT-2 layout only: tied head, learned positions; Conv1D keeps the
     [in, out] orientation so kernels pass through untransposed)."""
+    wte = _f32(params["wte"])
     sd = {
-        "transformer.wte.weight": _f32(params["wte"]),
+        "transformer.wte.weight": wte,
         "transformer.wpe.weight": _f32(params["wpe"]),
         "transformer.ln_f.weight": _f32(params["ln_f"]["scale"]),
         "transformer.ln_f.bias": _f32(params["ln_f"]["bias"]),
-        "lm_head.weight": _f32(params["wte"]),  # tied
+        "lm_head.weight": wte,  # tied: same array, HF re-ties on load
     }
-    for i, b in enumerate(_iter_blocks(params.get("transformer", {}),
+    for i, b in enumerate(_blocks_list(params.get("transformer", {}),
                                        ("h", "block"), "h")):
         p = f"transformer.h.{i}."
         sd[p + "ln_1.weight"] = _f32(b["ln_1"]["scale"])
@@ -1046,13 +1053,15 @@ def export_hf_gpt2(params) -> Dict[str, np.ndarray]:
 def export_hf_llama(params) -> Dict[str, np.ndarray]:
     """Llama params → HF ``LlamaForCausalLM`` state dict (flax [in, out]
     kernels transpose back to nn.Linear's [out, in])."""
+    embed = _f32(params["embed_tokens"])
     sd = {
-        "model.embed_tokens.weight": _f32(params["embed_tokens"]),
+        "model.embed_tokens.weight": embed,
         "model.norm.weight": _f32(params["norm"]["scale"]),
-        "lm_head.weight": _f32(params.get("lm_head",
-                                          params["embed_tokens"])),
+        # untied: own matrix; tied: the same array (HF re-ties on load)
+        "lm_head.weight": (_f32(params["lm_head"])
+                           if "lm_head" in params else embed),
     }
-    for i, b in enumerate(_iter_blocks(params, ("layers", "block"),
+    for i, b in enumerate(_blocks_list(params, ("layers", "block"),
                                        "layers")):
         p = f"model.layers.{i}."
         sd[p + "input_layernorm.weight"] = _f32(
@@ -1070,9 +1079,10 @@ def export_hf_llama(params) -> Dict[str, np.ndarray]:
 def export_hf_bert(params) -> Dict[str, np.ndarray]:
     """BERT params → HF ``BertForMaskedLM`` state dict."""
     bert = params["bert"]
+    wte = _f32(bert["word_embeddings"])
+    dec_bias = _f32(params["decoder_bias"])
     sd = {
-        "bert.embeddings.word_embeddings.weight":
-            _f32(bert["word_embeddings"]),
+        "bert.embeddings.word_embeddings.weight": wte,
         "bert.embeddings.position_embeddings.weight":
             _f32(bert["position_embeddings"]),
         "bert.embeddings.token_type_embeddings.weight":
@@ -1089,11 +1099,11 @@ def export_hf_bert(params) -> Dict[str, np.ndarray]:
             params["transform_ln"]["scale"]),
         "cls.predictions.transform.LayerNorm.bias": _f32(
             params["transform_ln"]["bias"]),
-        "cls.predictions.bias": _f32(params["decoder_bias"]),
-        "cls.predictions.decoder.weight": _f32(bert["word_embeddings"]),
-        "cls.predictions.decoder.bias": _f32(params["decoder_bias"]),
+        "cls.predictions.bias": dec_bias,
+        "cls.predictions.decoder.weight": wte,  # tied
+        "cls.predictions.decoder.bias": dec_bias,
     }
-    for i, b in enumerate(_iter_blocks(bert.get("encoder", {}),
+    for i, b in enumerate(_blocks_list(bert.get("encoder", {}),
                                        ("layers", "layer"), "layer")):
         p = f"bert.encoder.layer.{i}."
         for n in ("query", "key", "value"):

@@ -1,0 +1,63 @@
+"""Model-level convergence (reference ``tests/model`` tier, SURVEY §4):
+not a parity check against another engine but an end-to-end "does the
+whole stack actually learn" gate — a structured task whose loss must fall
+well below the random-guess floor, swept across ZeRO stages like the
+reference's ds_config matrix (tests/model/Megatron_GPT2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+from deepspeed_tpu.parallel.topology import reset_topology
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    reset_topology()
+    yield
+    reset_topology()
+
+
+def _copy_task_batches(vocab, B, T, n, seed=0):
+    """Copy task: second half of each sequence repeats the first half —
+    a transformer with attention solves it nearly perfectly; a bigram
+    model cannot. Random-guess floor = ln(vocab)."""
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        half = rng.integers(4, vocab, (B, T // 2)).astype(np.int32)
+        yield {"input_ids": np.concatenate([half, half], axis=1)}
+
+
+@pytest.mark.parametrize("zero_stage", [0, 3])
+def test_copy_task_convergence(zero_stage):
+    vocab, B, T = 64, 32, 32
+    model = GPT2ForTraining(GPT2Config(
+        vocab_size=vocab, n_positions=T, n_embd=128, n_layer=2, n_head=4,
+        dtype=jnp.float32))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_batch_size": B,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_num_steps": 20}},
+                "gradient_clipping": 1.0,
+                "zero_optimization": {"stage": zero_stage},
+                "steps_per_print": 10_000})
+    floor = np.log(vocab)
+    losses = []
+    for batch in _copy_task_batches(vocab, B, T, n=160):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    # every batch is FRESH random data, so the only way below the floor is
+    # learning the copy circuit; the optimum is ~floor/2 (first half stays
+    # unpredictable, copied half → ~0). Measured: ~2.0 by step 150.
+    tail = float(np.mean(losses[-5:]))
+    assert tail < floor * 0.55, (
+        f"stage {zero_stage}: tail loss {tail:.3f} vs random floor "
+        f"{floor:.3f} — the stack is not learning")
+    assert np.isfinite(losses).all()

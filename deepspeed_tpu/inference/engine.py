@@ -196,11 +196,21 @@ class InferenceEngine:
         return jax.tree_util.tree_unflatten(treedef, out)
 
     # ------------------------------------------------------------------
-    def _decode_module(self):
+    def _decode_module(self, padded: bool = False):
         cfg = self.model_config
         if cfg is None or not hasattr(cfg, "for_decode"):
             raise ValueError(
                 "model config must provide for_decode() for KV-cache generation")
+        if padded:
+            try:
+                dcfg = cfg.for_decode(padded=True)
+            except TypeError:
+                raise ValueError(
+                    "attention_mask generation (left-padded batches) needs "
+                    "a model whose for_decode accepts padded=True — the "
+                    "canonical decoder family (GPT2LMHeadModel) supports "
+                    "it; pad-free prompts work with every model") from None
+            return type(self.module)(dcfg)
         return type(self.module)(cfg.for_decode())
 
     @staticmethod
@@ -236,18 +246,22 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
     def _build_generate(self, prompt_len: int, max_new_tokens: int,
-                        do_sample: bool, top_k: int, top_p: float = 0.0):
-        dmodule = self._decode_module()
+                        do_sample: bool, top_k: int, top_p: float = 0.0,
+                        padded: bool = False):
+        dmodule = self._decode_module(padded)
         dequant = self._dequantize
         batch_spec = P(AXIS_DATA) if self.topo.axis_size(AXIS_DATA) > 1 else P()
 
-        def generate_fn(qparams, input_ids, rng, temperature, eos_id):
+        def generate_fn(qparams, input_ids, attention_mask, rng, temperature,
+                        eos_id):
             params = dequant(qparams)
             input_ids = jax.lax.with_sharding_constraint(
                 input_ids, NamedSharding(self.mesh, batch_spec))
-            # prefill: one compiled program over the whole prompt
+            # prefill: one compiled program over the whole prompt (with a
+            # left-padding mask, positions/keys follow each row's pads)
+            kw = {"attention_mask": attention_mask} if padded else {}
             out, vars_ = dmodule.apply({"params": params}, input_ids,
-                                       mutable=["cache"])
+                                       mutable=["cache"], **kw)
             logits = self._logits_of(out)
             cache = vars_["cache"]
 
@@ -302,11 +316,14 @@ class InferenceEngine:
     def generate(self, input_ids, max_new_tokens: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 0.0, eos_token_id: int = -1,
-                 rng=None, **kwargs):
+                 attention_mask=None, rng=None, **kwargs):
         """Sharded autoregressive generation (reference ``engine.py:524``).
 
         Returns ``[batch, prompt_len + max_new_tokens]`` token ids (prompt
         included, HF-style). ``eos_token_id=-1`` disables early-stop padding.
+        ``attention_mask`` ([B, T], 0 = LEFT padding) batches prompts of
+        unequal length: per-row positions start at the first real token and
+        padded cache slots are masked throughout decode.
         """
         input_ids = jnp.asarray(input_ids)
         if input_ids.ndim == 1:
@@ -325,8 +342,19 @@ class InferenceEngine:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
 
+        padded = attention_mask is not None
+        if padded:
+            attention_mask = jnp.asarray(attention_mask, jnp.int32)
+            if attention_mask.ndim == 1:
+                attention_mask = attention_mask[None]
+            if attention_mask.shape != input_ids.shape:
+                # a mis-shaped mask broadcasts through every position/
+                # validity computation and generates garbage with no error
+                raise ValueError(
+                    f"attention_mask shape {attention_mask.shape} must "
+                    f"match input_ids shape {tuple(input_ids.shape)}")
         key = (T, int(max_new_tokens), bool(do_sample), int(top_k),
-               float(top_p))
+               float(top_p), padded)
         if key not in self._generate_cache:
             self._generate_cache[key] = self._build_generate(*key)
         if rng is None:
@@ -334,7 +362,7 @@ class InferenceEngine:
         t = self._timer("generate")
         t.start()
         new = self._generate_cache[key](
-            self.params, input_ids, rng,
+            self.params, input_ids, attention_mask, rng,
             jnp.asarray(temperature, jnp.float32),
             jnp.asarray(eos_token_id, jnp.int32))
         new.block_until_ready()

@@ -44,6 +44,11 @@ class GPT2Config:
     # collection) — the TPU-native form of the reference's inference
     # workspace (csrc/transformer/inference/includes/inference_context.h)
     decode: bool = False
+    # padded decode: the batch was prefetched with LEFT-padded prompts
+    # (attention_mask at prefill); decode steps mask the padded cache
+    # prefix per row and compute per-row positions. Static so unpadded
+    # serving keeps the Pallas decode kernel
+    padded: bool = False
     # --- canonical-decoder knobs: this model executes the whole fused-
     # c_attn decoder family the state-dict factory normalizes to (GPT-2,
     # OPT, BLOOM — reference model_implementations/ arch classes) ---
@@ -87,8 +92,9 @@ class GPT2Config:
     # XLA cannot express inside one compiled step)
     pld: bool = False
 
-    def for_decode(self):
-        return dataclasses.replace(self, decode=True, dropout=0.0)
+    def for_decode(self, padded: bool = False):
+        return dataclasses.replace(self, decode=True, dropout=0.0,
+                                   padded=padded)
 
     @staticmethod
     def gpt2_125m(**kw):
@@ -142,13 +148,17 @@ def apply_rotary(x, positions, rotary_dim: int, theta: float,
     ``apply_rotary_pos_emb.cu``, csrc/transformer/inference/csrc/, which
     serves the same GPT-J/NeoX archs). Only the first ``rotary_dim`` dims
     rotate; ``interleaved`` picks GPT-J's rotate-every-two pairing over
-    NeoX's contiguous-halves rotate-half."""
+    NeoX's contiguous-halves rotate-half. ``positions``: [T] shared, or
+    [B, T] per-row (left-padded batches)."""
     D = x.shape[-1]
     rd = rotary_dim or D
     inv = 1.0 / (theta ** (jnp.arange(0, rd, 2, dtype=jnp.float32) / rd))
-    freqs = positions.astype(jnp.float32)[:, None] * inv[None]  # [T, rd/2]
-    cos = jnp.cos(freqs)[None, :, None, :]  # [1, T, 1, rd/2]
-    sin = jnp.sin(freqs)[None, :, None, :]
+    positions = jnp.asarray(positions, jnp.float32)
+    if positions.ndim == 1:
+        positions = positions[None]  # [1, T] broadcasts over batch
+    freqs = positions[:, :, None] * inv[None, None]  # [B|1, T, rd/2]
+    cos = jnp.cos(freqs)[:, :, None, :]  # [B|1, T, 1, rd/2]
+    sin = jnp.sin(freqs)[:, :, None, :]
     rot, rest = x[..., :rd].astype(jnp.float32), x[..., rd:]
     if interleaved:
         x1, x2 = rot[..., 0::2], rot[..., 1::2]
@@ -162,6 +172,19 @@ def apply_rotary(x, positions, rotary_dim: int, theta: float,
         out = jnp.concatenate([o1, o2], axis=-1)
     out = out.astype(x.dtype)
     return jnp.concatenate([out, rest], axis=-1) if rd < D else out
+
+
+def _row_positions(attention_mask):
+    """[B, T] per-row positions for LEFT-padded prompts: 0 at each row's
+    first real token (pads clip to 0; their outputs are masked anyway).
+    The single source for every position computation — the learned table
+    lookup, rotary, and the cache mask must agree on this convention."""
+    return jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+
+
+def _pad_lengths(attention_mask, T: int):
+    """[B] padded-prefix lengths (left padding occupies [0, pad))."""
+    return (T - jnp.sum(attention_mask, axis=1)).astype(jnp.int32)
 
 
 def _remat_block(cfg):
@@ -188,7 +211,7 @@ class CausalSelfAttention(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, attention_mask=None):
         cfg = self.config
         B, T, C = x.shape
         head_dim = cfg.n_embd // cfg.n_head
@@ -198,11 +221,15 @@ class CausalSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q4 = q.reshape(B, T, cfg.n_head, head_dim)  # [B, T, H, D]
         rotary = cfg.position_embedding == "rotary"
+        # left-padded rows: position 0 at the first REAL token
+        row_pos = (_row_positions(attention_mask)
+                   if attention_mask is not None else None)
         if rotary and not cfg.decode:
-            q4 = apply_rotary(q4, jnp.arange(T), cfg.rotary_dim,
+            pos = row_pos if row_pos is not None else jnp.arange(T)
+            q4 = apply_rotary(q4, pos, cfg.rotary_dim,
                               cfg.rope_theta, cfg.rotary_interleaved)
             k = apply_rotary(k.reshape(B, T, cfg.n_head, head_dim),
-                             jnp.arange(T), cfg.rotary_dim, cfg.rope_theta,
+                             pos, cfg.rotary_dim, cfg.rope_theta,
                              cfg.rotary_interleaved).reshape(B, T, C)
         cached_attn = False
         if cfg.decode:
@@ -223,10 +250,25 @@ class CausalSelfAttention(nn.Module):
             cidx = self.variable("cache", "cache_index",
                                  lambda: jnp.zeros((), jnp.int32))
             idx = cidx.value  # 0 on prefill (freshly created)
+            pad = None
+            if cfg.padded:
+                # per-row padded-prefix length, set at prefill from the
+                # attention mask (left padding: pads occupy cache [0, pad))
+                pl = self.variable("cache", "pad_len",
+                                   lambda: jnp.zeros((B,), jnp.int32))
+                if is_prefill and attention_mask is not None:
+                    pl.value = _pad_lengths(attention_mask, T)
+                pad = pl.value
             if rotary:
                 # rotate by absolute position BEFORE caching: cached keys are
                 # post-rotation, so decode attention needs no re-rotation
-                pos = idx + jnp.arange(T)
+                if cfg.padded and is_prefill and row_pos is not None:
+                    pos = row_pos  # [B, T]: 0 at each row's first real token
+                elif cfg.padded and not is_prefill:
+                    pos = jnp.clip(
+                        (idx + jnp.arange(T))[None] - pad[:, None], 0)
+                else:
+                    pos = idx + jnp.arange(T)
                 q4 = apply_rotary(q4, pos, cfg.rotary_dim, cfg.rope_theta,
                                   cfg.rotary_interleaved)
                 k4 = apply_rotary(k4, pos, cfg.rotary_dim, cfg.rope_theta,
@@ -238,7 +280,7 @@ class CausalSelfAttention(nn.Module):
                 from deepspeed_tpu.ops.attention import use_decode_kernel
 
                 alibi = cfg.position_embedding == "alibi"
-                if use_decode_kernel() and not alibi:
+                if use_decode_kernel() and not alibi and not cfg.padded:
                     # Pallas decode kernel: reads the cache in its native
                     # [B, S, H, D] layout (no per-token cache transpose) and
                     # only the valid [0, idx+T) prefix does compute
@@ -253,10 +295,17 @@ class CausalSelfAttention(nn.Module):
                     # query at position idx+t sees keys at positions <= idx+t
                     key_pos = jnp.arange(cfg.n_positions)
                     q_pos = idx + jnp.arange(T)
-                    mask = key_pos[None, :] <= q_pos[:, None]
+                    mask = key_pos[None, :] <= q_pos[:, None]  # [T, S]
+                    if cfg.padded:
+                        # padded prefix [0, pad) is garbage per row
+                        mask = mask[None] & (key_pos[None, None, :]
+                                             >= pad[:, None, None])
+                        mask = mask[:, None]  # [B, 1, T, S]
+                    else:
+                        mask = mask[None, None]
                     bias = _alibi_bias(cfg, key_pos) if alibi else None
                     y = attention(q4.transpose(0, 2, 1, 3), kc, vc,
-                                  mask=mask[None, None], bias=bias,
+                                  mask=mask, bias=bias,
                                   causal=False, use_flash=False)
                 cached_attn = True
         if not cached_attn:  # training forward, or decode-mode prefill
@@ -269,8 +318,12 @@ class CausalSelfAttention(nn.Module):
             v = v.transpose(0, 2, 1, 3)
             bias = (_alibi_bias(cfg, jnp.arange(T))
                     if cfg.position_embedding == "alibi" else None)
+            key_valid = (attention_mask[:, None, None, :].astype(bool)
+                         if attention_mask is not None else None)
             y = attention(q4.transpose(0, 2, 1, 3), k, v, causal=True,
-                          bias=bias, use_flash=cfg.use_flash)
+                          mask=key_valid, bias=bias,
+                          use_flash=cfg.use_flash
+                          if attention_mask is None else False)
         y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
         y = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
                      kernel_init=_dense_init(0.02 / (2 * cfg.n_layer) ** 0.5),
@@ -302,7 +355,8 @@ class Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic=True, pld_theta=None, layer_frac=0.0):
+    def __call__(self, x, deterministic=True, pld_theta=None, layer_frac=0.0,
+                 attention_mask=None):
         cfg = self.config
         pld_on = cfg.pld and pld_theta is not None and not deterministic
         if pld_on:
@@ -329,13 +383,15 @@ class Block(nn.Module):
             else:  # "parallel_single_ln"
                 h2 = h1
             attn_out = CausalSelfAttention(cfg, name="attn")(
-                h1, deterministic=deterministic)
+                h1, deterministic=deterministic,
+                attention_mask=attention_mask)
             mlp_out = MLP(cfg, name="mlp")(h2, deterministic=deterministic)
             if pld_on:
                 attn_out, mlp_out = _gate(attn_out), _gate(mlp_out)
             return x + attn_out + mlp_out
         attn_out = CausalSelfAttention(cfg, name="attn")(
-            ln_1(x), deterministic=deterministic)
+            ln_1(x), deterministic=deterministic,
+            attention_mask=attention_mask)
         if pld_on:
             attn_out = _gate(attn_out)
         x = x + attn_out
@@ -352,10 +408,11 @@ class _ScanBody(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic, pld_theta, layer_frac):
+    def __call__(self, x, deterministic, pld_theta, layer_frac,
+                 attention_mask):
         cfg = self.config
         x = _remat_block(cfg)(cfg, name="block")(
-            x, deterministic, pld_theta, layer_frac)
+            x, deterministic, pld_theta, layer_frac, attention_mask)
         return x, None
 
 
@@ -367,13 +424,14 @@ class ScanBlocks(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic=True, pld_theta=None):
+    def __call__(self, x, deterministic=True, pld_theta=None,
+                 attention_mask=None):
         cfg = self.config
         ScannedBlock = nn.scan(
             _ScanBody,
             variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True, "dropout": True, "pld": True},
-            in_axes=(nn.broadcast, nn.broadcast, 0),
+            in_axes=(nn.broadcast, nn.broadcast, 0, nn.broadcast),
             length=cfg.n_layer,
             metadata_params={nn.meta.PARTITION_NAME: "layers"},
         )
@@ -381,7 +439,8 @@ class ScanBlocks(nn.Module):
         # of L keeps with prob 1 - i/L*(1-theta), i = 1..L
         fracs = (jnp.arange(cfg.n_layer, dtype=jnp.float32) + 1.0) / max(
             1, cfg.n_layer)
-        x, _ = ScannedBlock(cfg, name="h")(x, deterministic, pld_theta, fracs)
+        x, _ = ScannedBlock(cfg, name="h")(x, deterministic, pld_theta, fracs,
+                                           attention_mask)
         return x
 
 
@@ -389,12 +448,14 @@ class LoopBlocks(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic=True, pld_theta=None):
+    def __call__(self, x, deterministic=True, pld_theta=None,
+                 attention_mask=None):
         cfg = self.config
         block_cls = _remat_block(cfg)
         for i in range(cfg.n_layer):
             x = block_cls(cfg, name=f"h_{i}")(
-                x, deterministic, pld_theta, (i + 1) / max(1, cfg.n_layer))
+                x, deterministic, pld_theta, (i + 1) / max(1, cfg.n_layer),
+                attention_mask)
         return x
 
 
@@ -409,7 +470,7 @@ class GPT2LMHeadModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, deterministic=True, return_hidden=False,
-                 pld_theta=None):
+                 pld_theta=None, attention_mask=None):
         cfg = self.config
         B, T = input_ids.shape
         wte = self.param("wte", _dense_init(), (cfg.vocab_size, cfg.n_embd), jnp.float32)
@@ -425,14 +486,32 @@ class GPT2LMHeadModel(nn.Module):
                                         lambda: jnp.zeros((), jnp.int32))
                 pos = pos_var.value
                 pos_var.value = pos + T
-                pos_emb = jax.lax.dynamic_slice(
-                    wpe, (pos + cfg.position_offset, 0),
-                    (T, cfg.n_embd))[None]
+                if cfg.padded:
+                    # per-row positions: pads shift each row's position 0
+                    # to its first real token (left padding)
+                    pl = self.variable("cache", "pad_len",
+                                       lambda: jnp.zeros((B,), jnp.int32))
+                    if attention_mask is not None:  # prefill
+                        pl.value = _pad_lengths(attention_mask, T)
+                        pos_ids = _row_positions(attention_mask)
+                    else:  # decode step
+                        pos_ids = jnp.clip(
+                            (pos + jnp.arange(T))[None] - pl.value[:, None],
+                            0)
+                    pos_emb = wpe[pos_ids + cfg.position_offset]  # [B, T, C]
+                else:
+                    pos_emb = jax.lax.dynamic_slice(
+                        wpe, (pos + cfg.position_offset, 0),
+                        (T, cfg.n_embd))[None]
+            elif attention_mask is not None:
+                pos_ids = _row_positions(attention_mask)
+                pos_emb = wpe[pos_ids + cfg.position_offset]
             else:
                 pos_emb = wpe[None, cfg.position_offset:
                               cfg.position_offset + T]
             x = x + pos_emb.astype(cfg.dtype)
         # "alibi": no position table — the bias lives in attention logits
+        # (per-row pad shifts cancel under softmax's shift invariance)
         if cfg.embedding_layernorm:  # BLOOM's word_embeddings_layernorm
             x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                              name="emb_ln")(x)
@@ -440,7 +519,8 @@ class GPT2LMHeadModel(nn.Module):
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
         blocks = ScanBlocks if cfg.scan_layers else LoopBlocks
         x = blocks(cfg, name="transformer")(x, deterministic=deterministic,
-                                            pld_theta=pld_theta)
+                                            pld_theta=pld_theta,
+                                            attention_mask=attention_mask)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
         if cfg.tied_head:
             head_w, head_b = wte, None

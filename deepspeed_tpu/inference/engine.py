@@ -208,8 +208,9 @@ class InferenceEngine:
                 raise ValueError(
                     "attention_mask generation (left-padded batches) needs "
                     "a model whose for_decode accepts padded=True — the "
-                    "canonical decoder family (GPT2LMHeadModel) supports "
-                    "it; pad-free prompts work with every model") from None
+                    "canonical decoder family (GPT2LMHeadModel) and Llama "
+                    "support it; pad-free prompts work with every model"
+                ) from None
             return type(self.module)(dcfg)
         return type(self.module)(cfg.for_decode())
 
@@ -257,6 +258,9 @@ class InferenceEngine:
             params = dequant(qparams)
             input_ids = jax.lax.with_sharding_constraint(
                 input_ids, NamedSharding(self.mesh, batch_spec))
+            if padded:  # same batch layout as input_ids
+                attention_mask = jax.lax.with_sharding_constraint(
+                    attention_mask, NamedSharding(self.mesh, batch_spec))
             # prefill: one compiled program over the whole prompt (with a
             # left-padding mask, positions/keys follow each row's pads)
             kw = {"attention_mask": attention_mask} if padded else {}
@@ -353,6 +357,18 @@ class InferenceEngine:
                 raise ValueError(
                     f"attention_mask shape {attention_mask.shape} must "
                     f"match input_ids shape {tuple(input_ids.shape)}")
+            host_mask = np.asarray(attention_mask)
+            if not (np.diff(host_mask, axis=1) >= 0).all():
+                # right padding would mask REAL cache slots and sample from
+                # a pad position — wrong output, no error
+                raise ValueError(
+                    "attention_mask must be LEFT-padded (non-decreasing "
+                    "along the sequence): pad tokens go before the prompt")
+            if host_mask.all():
+                # the ubiquitous generate(**tokenizer(...)) pattern with an
+                # equal-length batch: keep the unpadded fast path (Pallas
+                # decode kernel + flash prefill)
+                padded, attention_mask = False, None
         key = (T, int(max_new_tokens), bool(do_sample), int(top_k),
                float(top_p), padded)
         if key not in self._generate_cache:

@@ -187,6 +187,26 @@ def _pad_lengths(attention_mask, T: int):
     return (T - jnp.sum(attention_mask, axis=1)).astype(jnp.int32)
 
 
+def _decode_positions(idx, T: int, pad):
+    """[B, T] per-row positions for a padded decode step: absolute cache
+    slot minus the row's padded prefix (clipped at 0)."""
+    return jnp.clip((idx + jnp.arange(T))[None] - pad[:, None], 0)
+
+
+def _cache_attn_mask(S: int, idx, T: int, pad=None):
+    """Decode-step attention mask over the [B?, 1, T, S] cache window:
+    causal bound (key slot <= query slot) plus, when ``pad`` is given, the
+    per-row padded-prefix exclusion. The single mask builder shared by
+    every decode path (gpt2 family + llama)."""
+    key_pos = jnp.arange(S)
+    q_pos = idx + jnp.arange(T)
+    mask = key_pos[None, :] <= q_pos[:, None]  # [T, S]
+    if pad is None:
+        return mask[None, None]  # [1, 1, T, S]
+    mask = mask[None] & (key_pos[None, None, :] >= pad[:, None, None])
+    return mask[:, None]  # [B, 1, T, S]
+
+
 def _remat_block(cfg):
     """Block wrapped per the config's activation-checkpointing policy."""
     if not cfg.remat:
@@ -265,8 +285,7 @@ class CausalSelfAttention(nn.Module):
                 if cfg.padded and is_prefill and row_pos is not None:
                     pos = row_pos  # [B, T]: 0 at each row's first real token
                 elif cfg.padded and not is_prefill:
-                    pos = jnp.clip(
-                        (idx + jnp.arange(T))[None] - pad[:, None], 0)
+                    pos = _decode_positions(idx, T, pad)
                 else:
                     pos = idx + jnp.arange(T)
                 q4 = apply_rotary(q4, pos, cfg.rotary_dim, cfg.rope_theta,
@@ -292,18 +311,12 @@ class CausalSelfAttention(nn.Module):
                 else:
                     kc = ck.value.transpose(0, 2, 1, 3)
                     vc = cv.value.transpose(0, 2, 1, 3)
-                    # query at position idx+t sees keys at positions <= idx+t
-                    key_pos = jnp.arange(cfg.n_positions)
-                    q_pos = idx + jnp.arange(T)
-                    mask = key_pos[None, :] <= q_pos[:, None]  # [T, S]
-                    if cfg.padded:
-                        # padded prefix [0, pad) is garbage per row
-                        mask = mask[None] & (key_pos[None, None, :]
-                                             >= pad[:, None, None])
-                        mask = mask[:, None]  # [B, 1, T, S]
-                    else:
-                        mask = mask[None, None]
-                    bias = _alibi_bias(cfg, key_pos) if alibi else None
+                    # query at slot idx+t sees keys at slots <= idx+t,
+                    # minus each row's padded prefix
+                    mask = _cache_attn_mask(cfg.n_positions, idx, T,
+                                            pad if cfg.padded else None)
+                    bias = (_alibi_bias(cfg, jnp.arange(cfg.n_positions))
+                            if alibi else None)
                     y = attention(q4.transpose(0, 2, 1, 3), kc, vc,
                                   mask=mask, bias=bias,
                                   causal=False, use_flash=False)

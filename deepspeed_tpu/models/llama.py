@@ -39,6 +39,10 @@ class LlamaConfig:
     remat_policy: str = "full"
     use_flash: Optional[bool] = None
     decode: bool = False
+    # padded decode: LEFT-padded prompts (attention_mask at prefill);
+    # decode steps mask each row's padded cache prefix and shift positions.
+    # Static so unpadded serving keeps the Pallas decode kernel
+    padded: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -48,8 +52,8 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
 
-    def for_decode(self):
-        return dataclasses.replace(self, decode=True)
+    def for_decode(self, padded: bool = False):
+        return dataclasses.replace(self, decode=True, padded=padded)
 
     @staticmethod
     def llama2_7b(**kw):
@@ -95,12 +99,14 @@ def rope_frequencies(head_dim: int, positions, theta: float):
 
 
 def apply_rope(x, cos, sin):
-    """x: [B, T, H, D]; cos/sin: [T, D/2] (or broadcastable). Rotates pairs
-    (x_even, x_odd) — the interleaved convention HF Llama uses after its
-    half-split equivalence."""
+    """x: [B, T, H, D]; cos/sin: [T, D/2] shared or [B, T, D/2] per-row
+    (left-padded batches). Rotates pairs (x_even, x_odd) — the interleaved
+    convention HF Llama uses after its half-split equivalence."""
     x1, x2 = jnp.split(x, 2, axis=-1)  # HF half-split convention
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    if cos.ndim == 2:
+        cos, sin = cos[None], sin[None]
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
     return jnp.concatenate(
         [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
 
@@ -109,7 +115,11 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, attention_mask=None):
+        from deepspeed_tpu.models.gpt2 import (_cache_attn_mask,
+                                               _decode_positions,
+                                               _pad_lengths, _row_positions)
+
         cfg = self.config
         B, T, C = x.shape
         H, KV, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
@@ -133,7 +143,19 @@ class LlamaAttention(nn.Module):
             cidx = self.variable("cache", "cache_index",
                                  lambda: jnp.zeros((), jnp.int32))
             idx = cidx.value
-            pos = idx + jnp.arange(T)
+            pad = None
+            if cfg.padded:
+                pl = self.variable("cache", "pad_len",
+                                   lambda: jnp.zeros((B,), jnp.int32))
+                if is_prefill and attention_mask is not None:
+                    pl.value = _pad_lengths(attention_mask, T)
+                pad = pl.value
+            if cfg.padded and is_prefill and attention_mask is not None:
+                pos = _row_positions(attention_mask)  # [B, T]
+            elif cfg.padded and not is_prefill:
+                pos = _decode_positions(idx, T, pad)
+            else:
+                pos = idx + jnp.arange(T)
             cos, sin = rope_frequencies(D, pos, cfg.rope_theta)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
@@ -150,25 +172,26 @@ class LlamaAttention(nn.Module):
                 vc = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
                 from deepspeed_tpu.ops.attention import use_decode_kernel
 
-                if use_decode_kernel():
+                if use_decode_kernel() and not cfg.padded:
                     from deepspeed_tpu.ops.decode_attention import (
                         decode_attention)
 
                     y = decode_attention(q, kc, vc, idx).transpose(0, 2, 1, 3)
                 else:
-                    key_pos = jnp.arange(S)
-                    q_pos = idx + jnp.arange(T)
-                    mask = key_pos[None, :] <= q_pos[:, None]
+                    mask = _cache_attn_mask(S, idx, T,
+                                            pad if cfg.padded else None)
                     y = attention(q.transpose(0, 2, 1, 3),
                                   kc.transpose(0, 2, 1, 3),
                                   vc.transpose(0, 2, 1, 3),
-                                  mask=mask[None, None], causal=False,
+                                  mask=mask, causal=False,
                                   use_flash=False)
                 y = y.transpose(0, 2, 1, 3).reshape(B, T, H * D)
                 return nn.Dense(C, use_bias=False, dtype=cfg.dtype,
                                 kernel_init=_init(), name="o_proj")(y)
         else:
-            cos, sin = rope_frequencies(D, jnp.arange(T), cfg.rope_theta)
+            pos = (_row_positions(attention_mask)
+                   if attention_mask is not None else jnp.arange(T))
+            cos, sin = rope_frequencies(D, pos, cfg.rope_theta)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
 
@@ -177,9 +200,12 @@ class LlamaAttention(nn.Module):
         if rep > 1:  # GQA: expand kv heads to match q heads
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
+        key_valid = (attention_mask[:, None, None, :].astype(bool)
+                     if attention_mask is not None else None)
         y = attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                      v.transpose(0, 2, 1, 3), causal=True,
-                      use_flash=cfg.use_flash)
+                      v.transpose(0, 2, 1, 3), causal=True, mask=key_valid,
+                      use_flash=cfg.use_flash
+                      if attention_mask is None else False)
         y = y.transpose(0, 2, 1, 3).reshape(B, T, H * D)
         return nn.Dense(C, use_bias=False, dtype=cfg.dtype,
                         kernel_init=_init(), name="o_proj")(y)
@@ -206,11 +232,11 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, attention_mask=None):
         cfg = self.config
         x = x + LlamaAttention(cfg, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x),
-            deterministic=deterministic)
+            deterministic=deterministic, attention_mask=attention_mask)
         x = x + LlamaMLP(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype,
                     name="post_attention_layernorm")(x))
@@ -235,9 +261,9 @@ class _ScanBody(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, deterministic):
+    def __call__(self, x, deterministic, attention_mask):
         x = _remat_block(self.config)(self.config, name="block")(
-            x, deterministic)
+            x, deterministic, attention_mask)
         return x, None
 
 
@@ -247,7 +273,8 @@ class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, deterministic=True, return_hidden=False):
+    def __call__(self, input_ids, deterministic=True, return_hidden=False,
+                 attention_mask=None):
         cfg = self.config
         embed = self.param("embed_tokens", _init(),
                            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
@@ -257,14 +284,16 @@ class LlamaModel(nn.Module):
                 _ScanBody,
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
-                in_axes=nn.broadcast,
+                in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.meta.PARTITION_NAME: "layers"})
-            x, _ = Scanned(cfg, name="layers")(x, deterministic)
+            x, _ = Scanned(cfg, name="layers")(x, deterministic,
+                                               attention_mask)
         else:
             block_cls = _remat_block(cfg)
             for i in range(cfg.num_hidden_layers):
-                x = block_cls(cfg, name=f"layers_{i}")(x, deterministic)
+                x = block_cls(cfg, name=f"layers_{i}")(x, deterministic,
+                                                       attention_mask)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
         if cfg.tie_word_embeddings:
             head = embed

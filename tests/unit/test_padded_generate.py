@@ -45,10 +45,15 @@ def _hf_tiny(arch):
             vocab_size=128, n_embd=32, n_layer=2, n_head=4, n_positions=32,
             rotary_dim=4, resid_pdrop=0.0, embd_pdrop=0.0,
             attn_pdrop=0.0)).eval()
+    if arch == "llama":
+        return transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=32)).eval()
     raise ValueError(arch)
 
 
-@pytest.mark.parametrize("arch", ["gpt2", "bloom", "gptj"])
+@pytest.mark.parametrize("arch", ["gpt2", "bloom", "gptj", "llama"])
 def test_padded_generate_matches_hf(arch, tmp_path):
     hf = _hf_tiny(arch)
     hf.save_pretrained(tmp_path)
@@ -89,13 +94,32 @@ def test_unsupported_model_raises(tmp_path):
     import jax.numpy as jnp
 
     import deepspeed_tpu
-    from deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+    from deepspeed_tpu.models.gpt2_moe import GPTMoEConfig, GPTMoEModel
 
-    cfg = LlamaConfig.tiny(dtype=jnp.float32)
-    model = LlamaModel(cfg)
+    cfg = GPTMoEConfig.tiny(gpt_kw={"dtype": jnp.float32,
+                                    "n_positions": 16})
+    model = GPTMoEModel(cfg)
     ids = np.array([[1, 2, 3]], np.int32)
     params = model.init(jax.random.PRNGKey(0), ids)["params"]
     engine = deepspeed_tpu.init_inference(model, params=params)
     with pytest.raises(ValueError, match="padded"):
-        engine.generate(ids, attention_mask=np.ones_like(ids),
+        engine.generate(ids, attention_mask=np.array([[0, 1, 1]], np.int32),
                         max_new_tokens=2)
+
+
+def test_mask_conventions_enforced(tmp_path):
+    """Right-padded masks and all-ones masks get the right treatment: the
+    former is a loud error (it would sample from a pad slot), the latter
+    silently keeps the unpadded fast path."""
+    hf = _hf_tiny("gpt2")
+    hf.save_pretrained(tmp_path)
+    engine = from_pretrained(str(tmp_path))
+    ids = np.array([[7, 23, 56, 11, 9]], np.int32)
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        engine.generate(ids, attention_mask=np.array(
+            [[1, 1, 1, 0, 0]], np.int32), max_new_tokens=2)
+    plain = np.asarray(engine.generate(ids, max_new_tokens=3,
+                                       do_sample=False))
+    ones = np.asarray(engine.generate(ids, attention_mask=np.ones_like(ids),
+                                      max_new_tokens=3, do_sample=False))
+    np.testing.assert_array_equal(ones, plain)

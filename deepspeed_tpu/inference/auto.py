@@ -20,6 +20,7 @@ from deepspeed_tpu.runtime.state_dict_factory import (SDLoaderFactory,
                                                       detect_arch,
                                                       load_hf_bloom,
                                                       load_hf_gpt2,
+                                                      load_hf_gpt_neo,
                                                       load_hf_gpt_neox,
                                                       load_hf_gptj,
                                                       load_hf_llama,
@@ -27,7 +28,8 @@ from deepspeed_tpu.runtime.state_dict_factory import (SDLoaderFactory,
 from deepspeed_tpu.utils.logging import logger
 
 _POLICY_FOR_ARCH = {"gpt2": "gpt2", "opt": "gpt2", "bloom": "gpt2",
-                    "gptj": "gpt2", "gpt-neox": "gpt2", "llama": "llama"}
+                    "gptj": "gpt2", "gpt-neox": "gpt2", "gpt-neo": "gpt2",
+                    "llama": "llama"}
 # gpt2 policy fits opt/bloom/gptj/neox here because their weights are
 # NORMALIZED to the canonical fused layout (c_attn/c_proj/c_fc names)
 # before sharding
@@ -43,6 +45,9 @@ _SNIFF_KW = {
     "gptj": {"n_head": ("n_head", "num_attention_heads"),
              "rotary_dim": ("rotary_dim",),
              "n_positions": ("n_positions",)},
+    "gpt-neo": {"n_head": ("num_heads", "num_attention_heads"),
+                "attention_types": ("attention_layers",),
+                "window_size": ("window_size",)},
     "gpt-neox": {"n_head": ("num_attention_heads",),
                  "rotary_pct": ("rotary_pct",),
                  "rope_theta": ("rotary_emb_base",),
@@ -89,9 +94,13 @@ def load_pretrained(src, arch: Optional[str] = None, dtype=None,
 
         loader = {"gpt2": load_hf_gpt2, "opt": load_hf_opt,
                   "bloom": load_hf_bloom, "gptj": load_hf_gptj,
-                  "gpt-neox": load_hf_gpt_neox}[arch]
-        config, params = loader(sd, scan_layers=scan_layers,
-                                dtype=dtype, **loader_kw)
+                  "gpt-neox": load_hf_gpt_neox,
+                  "gpt-neo": load_hf_gpt_neo}[arch]
+        if arch == "gpt-neo":  # per-layer windows force the unrolled layout
+            config, params = loader(sd, dtype=dtype, **loader_kw)
+        else:
+            config, params = loader(sd, scan_layers=scan_layers,
+                                    dtype=dtype, **loader_kw)
         model = GPT2LMHeadModel(config)
     logger.info(f"load_pretrained: arch={arch}")
     return model, params, arch

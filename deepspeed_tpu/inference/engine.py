@@ -333,7 +333,12 @@ class InferenceEngine:
         if input_ids.ndim == 1:
             input_ids = input_ids[None]
         B, T = input_ids.shape
-        limit = getattr(self.model_config, "n_positions", None)
+        # GPT-2 family names the window n_positions; Llama (and HF configs
+        # generally) max_position_embeddings — missing BOTH would silently
+        # overwrite the last cache slot once the window overflows
+        limit = (getattr(self.model_config, "n_positions", None)
+                 or getattr(self.model_config, "max_position_embeddings",
+                            None))
         if max_new_tokens is None:
             cap = self._config.max_out_tokens
             if limit is not None:
@@ -364,6 +369,13 @@ class InferenceEngine:
                 raise ValueError(
                     "attention_mask must be LEFT-padded (non-decreasing "
                     "along the sequence): pad tokens go before the prompt")
+            if not host_mask[:, -1].all():
+                # an all-pad row softmaxes over nothing (NaN logits) and
+                # the first token samples from the masked last position
+                raise ValueError(
+                    "attention_mask has a row whose final position is "
+                    "padding — every prompt needs at least one real token, "
+                    "and left padding puts it last")
             if host_mask.all():
                 # the ubiquitous generate(**tokenizer(...)) pattern with an
                 # equal-length batch: keep the unpadded fast path (Pallas

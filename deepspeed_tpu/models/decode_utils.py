@@ -1,0 +1,45 @@
+"""Shared decode/padding position math for every autoregressive family
+(gpt2 canonical decoder, llama, future archs).
+
+The single source for the left-padding convention: positions start at 0
+at each row's first real token, the padded prefix occupies cache slots
+``[0, pad)``, and the decode-step mask combines the causal bound, the
+per-row pad exclusion, and an optional sliding window (GPT-Neo local
+attention). Model files must use these — a private re-implementation
+desynchronizing any one of them produces wrong positions with no error.
+"""
+
+import jax.numpy as jnp
+
+
+def row_positions(attention_mask):
+    """[B, T] per-row positions for LEFT-padded prompts: 0 at each row's
+    first real token (pads clip to 0; their outputs are masked anyway)."""
+    return jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+
+
+def pad_lengths(attention_mask, T: int):
+    """[B] padded-prefix lengths (left padding occupies [0, pad))."""
+    return (T - jnp.sum(attention_mask, axis=1)).astype(jnp.int32)
+
+
+def decode_positions(idx, T: int, pad):
+    """[B, T] per-row positions for a padded decode step: absolute cache
+    slot minus the row's padded prefix (clipped at 0)."""
+    return jnp.clip((idx + jnp.arange(T))[None] - pad[:, None], 0)
+
+
+def cache_attn_mask(S: int, idx, T: int, pad=None, window: int = 0):
+    """Decode-step attention mask over the [B?, 1, T, S] cache window:
+    causal bound (key slot <= query slot) plus, when ``pad`` is given, the
+    per-row padded-prefix exclusion, plus an optional sliding window
+    (GPT-Neo local attention)."""
+    key_pos = jnp.arange(S)
+    q_pos = idx + jnp.arange(T)
+    mask = key_pos[None, :] <= q_pos[:, None]  # [T, S]
+    if window:
+        mask = mask & (key_pos[None, :] > q_pos[:, None] - window)
+    if pad is None:
+        return mask[None, None]  # [1, 1, T, S]
+    mask = mask[None] & (key_pos[None, None, :] >= pad[:, None, None])
+    return mask[:, None]  # [B, 1, T, S]

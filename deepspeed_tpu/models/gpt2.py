@@ -77,6 +77,17 @@ class GPT2Config:
     residual: str = "sequential"
     # GPT-J's attention projections carry no bias terms
     attn_bias: bool = True
+    # GPT-Neo quirk: bias-free q/k/v but a BIASED output projection
+    # (None = follow attn_bias)
+    attn_out_bias: Optional[bool] = None
+    # GPT-Neo quirk: attention logits are NOT scaled by 1/sqrt(head_dim)
+    # (None = standard scaling)
+    attn_scale: Optional[float] = None
+    # sliding-window ("local") attention per layer (GPT-Neo alternates
+    # global/local with window 256): entry i is 0 for global or the window
+    # size. Requires scan_layers=False (the window is a static per-layer
+    # property; a scanned body would force the masked path on all layers)
+    attention_windows: Optional[tuple] = None
     # tied_head: LM head reuses wte (GPT-2/OPT/BLOOM); GPT-J/NeoX train a
     # separate lm_head matrix (GPT-J's with a bias)
     tied_head: bool = True
@@ -174,37 +185,13 @@ def apply_rotary(x, positions, rotary_dim: int, theta: float,
     return jnp.concatenate([out, rest], axis=-1) if rd < D else out
 
 
-def _row_positions(attention_mask):
-    """[B, T] per-row positions for LEFT-padded prompts: 0 at each row's
-    first real token (pads clip to 0; their outputs are masked anyway).
-    The single source for every position computation — the learned table
-    lookup, rotary, and the cache mask must agree on this convention."""
-    return jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0)
-
-
-def _pad_lengths(attention_mask, T: int):
-    """[B] padded-prefix lengths (left padding occupies [0, pad))."""
-    return (T - jnp.sum(attention_mask, axis=1)).astype(jnp.int32)
-
-
-def _decode_positions(idx, T: int, pad):
-    """[B, T] per-row positions for a padded decode step: absolute cache
-    slot minus the row's padded prefix (clipped at 0)."""
-    return jnp.clip((idx + jnp.arange(T))[None] - pad[:, None], 0)
-
-
-def _cache_attn_mask(S: int, idx, T: int, pad=None):
-    """Decode-step attention mask over the [B?, 1, T, S] cache window:
-    causal bound (key slot <= query slot) plus, when ``pad`` is given, the
-    per-row padded-prefix exclusion. The single mask builder shared by
-    every decode path (gpt2 family + llama)."""
-    key_pos = jnp.arange(S)
-    q_pos = idx + jnp.arange(T)
-    mask = key_pos[None, :] <= q_pos[:, None]  # [T, S]
-    if pad is None:
-        return mask[None, None]  # [1, 1, T, S]
-    mask = mask[None] & (key_pos[None, None, :] >= pad[:, None, None])
-    return mask[:, None]  # [B, 1, T, S]
+from deepspeed_tpu.models.decode_utils import (cache_attn_mask as
+                                                _cache_attn_mask,
+                                                decode_positions as
+                                                _decode_positions,
+                                                pad_lengths as _pad_lengths,
+                                                row_positions as
+                                                _row_positions)
 
 
 def _remat_block(cfg):
@@ -229,6 +216,9 @@ def _remat_block(cfg):
 
 class CausalSelfAttention(nn.Module):
     config: GPT2Config
+    # sliding-window size for this layer (0 = global); a static module
+    # attribute so each unrolled layer compiles its own mask shape
+    window: int = 0
 
     @nn.compact
     def __call__(self, x, deterministic=True, attention_mask=None):
@@ -299,27 +289,31 @@ class CausalSelfAttention(nn.Module):
                 from deepspeed_tpu.ops.attention import use_decode_kernel
 
                 alibi = cfg.position_embedding == "alibi"
-                if use_decode_kernel() and not alibi and not cfg.padded:
+                if (use_decode_kernel() and not alibi and not cfg.padded
+                        and not self.window):
                     # Pallas decode kernel: reads the cache in its native
                     # [B, S, H, D] layout (no per-token cache transpose) and
                     # only the valid [0, idx+T) prefix does compute
                     from deepspeed_tpu.ops.decode_attention import (
                         decode_attention)
 
-                    y4 = decode_attention(q4, ck.value, cv.value, idx)
+                    y4 = decode_attention(q4, ck.value, cv.value, idx,
+                                          softmax_scale=cfg.attn_scale)
                     y = y4.transpose(0, 2, 1, 3)
                 else:
                     kc = ck.value.transpose(0, 2, 1, 3)
                     vc = cv.value.transpose(0, 2, 1, 3)
                     # query at slot idx+t sees keys at slots <= idx+t,
-                    # minus each row's padded prefix
+                    # minus each row's padded prefix / local window
                     mask = _cache_attn_mask(cfg.n_positions, idx, T,
-                                            pad if cfg.padded else None)
+                                            pad if cfg.padded else None,
+                                            window=self.window)
                     bias = (_alibi_bias(cfg, jnp.arange(cfg.n_positions))
                             if alibi else None)
                     y = attention(q4.transpose(0, 2, 1, 3), kc, vc,
-                                  mask=mask, bias=bias,
-                                  causal=False, use_flash=False)
+                                  mask=mask, bias=bias, causal=False,
+                                  softmax_scale=cfg.attn_scale,
+                                  use_flash=False)
                 cached_attn = True
         if not cached_attn:  # training forward, or decode-mode prefill
             if cfg.decode:  # k4/v4 exist (and carry the rotary rotation)
@@ -333,14 +327,24 @@ class CausalSelfAttention(nn.Module):
                     if cfg.position_embedding == "alibi" else None)
             key_valid = (attention_mask[:, None, None, :].astype(bool)
                          if attention_mask is not None else None)
+            if self.window:
+                # banded causal window (GPT-Neo local attention): query t
+                # sees keys in (t - window, t]
+                t_idx = jnp.arange(T)
+                band = (t_idx[None, :] > t_idx[:, None] - self.window
+                        )[None, None]
+                key_valid = band if key_valid is None else key_valid & band
             y = attention(q4.transpose(0, 2, 1, 3), k, v, causal=True,
                           mask=key_valid, bias=bias,
+                          softmax_scale=cfg.attn_scale,
                           use_flash=cfg.use_flash
-                          if attention_mask is None else False)
+                          if (attention_mask is None and not self.window)
+                          else False)
         y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
         y = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
                      kernel_init=_dense_init(0.02 / (2 * cfg.n_layer) ** 0.5),
-                     use_bias=cfg.attn_bias, name="c_proj")(y)
+                     use_bias=cfg.attn_bias if cfg.attn_out_bias is None
+                     else cfg.attn_out_bias, name="c_proj")(y)
         if cfg.dropout > 0:
             y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         return y
@@ -366,6 +370,7 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     config: GPT2Config
+    window: int = 0  # sliding-window size for this layer (0 = global)
 
     @nn.compact
     def __call__(self, x, deterministic=True, pld_theta=None, layer_frac=0.0,
@@ -395,14 +400,16 @@ class Block(nn.Module):
                                   dtype=cfg.dtype, name="ln_2")(x)
             else:  # "parallel_single_ln"
                 h2 = h1
-            attn_out = CausalSelfAttention(cfg, name="attn")(
+            attn_out = CausalSelfAttention(cfg, window=self.window,
+                                           name="attn")(
                 h1, deterministic=deterministic,
                 attention_mask=attention_mask)
             mlp_out = MLP(cfg, name="mlp")(h2, deterministic=deterministic)
             if pld_on:
                 attn_out, mlp_out = _gate(attn_out), _gate(mlp_out)
             return x + attn_out + mlp_out
-        attn_out = CausalSelfAttention(cfg, name="attn")(
+        attn_out = CausalSelfAttention(cfg, window=self.window,
+                                       name="attn")(
             ln_1(x), deterministic=deterministic,
             attention_mask=attention_mask)
         if pld_on:
@@ -465,8 +472,9 @@ class LoopBlocks(nn.Module):
                  attention_mask=None):
         cfg = self.config
         block_cls = _remat_block(cfg)
+        windows = cfg.attention_windows or (0,) * cfg.n_layer
         for i in range(cfg.n_layer):
-            x = block_cls(cfg, name=f"h_{i}")(
+            x = block_cls(cfg, window=windows[i], name=f"h_{i}")(
                 x, deterministic, pld_theta, (i + 1) / max(1, cfg.n_layer),
                 attention_mask)
         return x
@@ -530,6 +538,11 @@ class GPT2LMHeadModel(nn.Module):
                              name="emb_ln")(x)
         if cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        if cfg.attention_windows is not None and cfg.scan_layers:
+            raise ValueError(
+                "attention_windows (per-layer local attention) needs "
+                "scan_layers=False: the window is a static per-layer "
+                "property, but a scanned stack compiles ONE body")
         blocks = ScanBlocks if cfg.scan_layers else LoopBlocks
         x = blocks(cfg, name="transformer")(x, deterministic=deterministic,
                                             pld_theta=pld_theta,

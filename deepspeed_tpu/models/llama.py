@@ -116,9 +116,10 @@ class LlamaAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic=True, attention_mask=None):
-        from deepspeed_tpu.models.gpt2 import (_cache_attn_mask,
-                                               _decode_positions,
-                                               _pad_lengths, _row_positions)
+        from deepspeed_tpu.models.decode_utils import (cache_attn_mask,
+                                                       decode_positions,
+                                                       pad_lengths,
+                                                       row_positions)
 
         cfg = self.config
         B, T, C = x.shape
@@ -148,12 +149,12 @@ class LlamaAttention(nn.Module):
                 pl = self.variable("cache", "pad_len",
                                    lambda: jnp.zeros((B,), jnp.int32))
                 if is_prefill and attention_mask is not None:
-                    pl.value = _pad_lengths(attention_mask, T)
+                    pl.value = pad_lengths(attention_mask, T)
                 pad = pl.value
             if cfg.padded and is_prefill and attention_mask is not None:
-                pos = _row_positions(attention_mask)  # [B, T]
+                pos = row_positions(attention_mask)  # [B, T]
             elif cfg.padded and not is_prefill:
-                pos = _decode_positions(idx, T, pad)
+                pos = decode_positions(idx, T, pad)
             else:
                 pos = idx + jnp.arange(T)
             cos, sin = rope_frequencies(D, pos, cfg.rope_theta)
@@ -178,7 +179,7 @@ class LlamaAttention(nn.Module):
 
                     y = decode_attention(q, kc, vc, idx).transpose(0, 2, 1, 3)
                 else:
-                    mask = _cache_attn_mask(S, idx, T,
+                    mask = cache_attn_mask(S, idx, T,
                                             pad if cfg.padded else None)
                     y = attention(q.transpose(0, 2, 1, 3),
                                   kc.transpose(0, 2, 1, 3),
@@ -189,7 +190,7 @@ class LlamaAttention(nn.Module):
                 return nn.Dense(C, use_bias=False, dtype=cfg.dtype,
                                 kernel_init=_init(), name="o_proj")(y)
         else:
-            pos = (_row_positions(attention_mask)
+            pos = (row_positions(attention_mask)
                    if attention_mask is not None else jnp.arange(T))
             cos, sin = rope_frequencies(D, pos, cfg.rope_theta)
             q = apply_rope(q, cos, sin)

@@ -493,10 +493,45 @@ class BertWeightMap(HFWeightMap):
         return f"bert.encoder.layer.{i}.{suffix}"
 
 
+class GPTNeoWeightMap(HFWeightMap):
+    """HF ``GPTNeoForCausalLM``: separate bias-free q/k/v under
+    ``attn.attention``, a biased out_proj, nn.Linear MLP (transpose),
+    learned positions, tied head."""
+
+    arch = "gpt-neo"
+    layer_re = re.compile(r"^(?:transformer\.)?h\.(\d+)\.(.+)$")
+    layer_map = {
+        "ln_1.scale": "ln_1.weight", "ln_1.bias": "ln_1.bias",
+        "c_proj.kernel": "attn.attention.out_proj.weight",
+        "c_proj.bias": "attn.attention.out_proj.bias",
+        "ln_2.scale": "ln_2.weight", "ln_2.bias": "ln_2.bias",
+        "c_fc.kernel": "mlp.c_fc.weight", "c_fc.bias": "mlp.c_fc.bias",
+        "mlp_c_proj.kernel": "mlp.c_proj.weight",
+        "mlp_c_proj.bias": "mlp.c_proj.bias",
+    }
+    top_map = {
+        "wte": "transformer.wte.weight", "wpe": "transformer.wpe.weight",
+        "ln_f.scale": "transformer.ln_f.weight",
+        "ln_f.bias": "transformer.ln_f.bias",
+    }
+
+    def layer_key(self, i, suffix):
+        return f"transformer.h.{i}.{suffix}"
+
+    def layer_weights(self, sd, i):
+        out = super().layer_weights(sd, i)
+        ws = [self.lookup(sd, self.layer_key(
+            i, f"attn.attention.{n}_proj.weight")) for n in "qkv"]
+        if all(w is not None for w in ws):
+            qw, kw, vw = (np.ascontiguousarray(w.T) for w in ws)
+            out["c_attn.kernel"] = merge_qkv(qw, kw, vw)
+        return out
+
+
 _WEIGHT_MAPS = {"gpt2": GPT2WeightMap, "opt": OPTWeightMap,
                 "bloom": BloomWeightMap, "llama": LlamaWeightMap,
                 "gptj": GPTJWeightMap, "gpt-neox": GPTNeoXWeightMap,
-                "bert": BertWeightMap}
+                "gpt-neo": GPTNeoWeightMap, "bert": BertWeightMap}
 
 
 def get_weight_map(arch: str, **kw) -> HFWeightMap:
@@ -518,6 +553,8 @@ def detect_arch(sd: Dict[str, Any]) -> Optional[str]:
         return "llama"
     if any("mlp.fc_in" in k for k in keys):
         return "gptj"
+    if any("attn.attention.q_proj" in k for k in keys):
+        return "gpt-neo"
     if any("attention.query_key_value" in k for k in keys):
         return "gpt-neox"
     if any("attention.self.query" in k for k in keys):
@@ -598,7 +635,8 @@ def load_hf_gpt2(src, scan_layers: bool = True, dtype=None,
 
 
 def _canonical_gpt2_tree(layers, top, scan_layers, wpe=None, emb_ln=False,
-                         attn_bias=True, has_ln_2=True, untied_head=False):
+                         attn_bias=True, attn_out_bias=None, has_ln_2=True,
+                         untied_head=False):
     """Canonical per-layer dicts → the flax GPT2LMHeadModel param tree
     (the one model that executes the whole fused-c_attn decoder family).
     ``attn_bias=False`` (GPT-J) drops the attention bias leaves,
@@ -613,6 +651,8 @@ def _canonical_gpt2_tree(layers, top, scan_layers, wpe=None, emb_ln=False,
                 "c_proj": {"kernel": lw["c_proj.kernel"]}}
         if attn_bias:
             attn["c_attn"]["bias"] = lw["c_attn.bias"]
+        # same None-means-follow-attn_bias rule the model applies to c_proj
+        if (attn_bias if attn_out_bias is None else attn_out_bias):
             attn["c_proj"]["bias"] = lw["c_proj.bias"]
         tree = {
             "ln_1": {"scale": lw["ln_1.scale"], "bias": lw["ln_1.bias"]},
@@ -770,6 +810,52 @@ def load_hf_gptj(src, scan_layers: bool = True, dtype=None,
                                   has_ln_2=False, untied_head=True)
     logger.info(f"loaded HF GPT-J: {n_layer} layers, n_embd={n_embd}, "
                 f"vocab={wte.shape[0]}, rotary_dim={rotary_dim}")
+    return config, params
+
+
+def load_hf_gpt_neo(src, dtype=None, n_head: Optional[int] = None,
+                    attention_types=None, window_size: Optional[int] = None):
+    """HF ``GPTNeoForCausalLM`` checkpoint → (GPT2Config, flax params): the
+    canonical decoder runs GPT-Neo as learned positions, UNSCALED attention
+    logits, bias-free q/k/v with a biased out-projection, and alternating
+    global/local (sliding-window) attention layers — which forces the
+    unrolled layout (per-layer windows are static properties)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    if n_head is None:
+        n_head = _sniff_config(src, "num_heads", "num_attention_heads")
+    if n_head is None:
+        raise ValueError("load_hf_gpt_neo needs n_head (config.json or arg)")
+    if attention_types is None:
+        at = _sniff_config(src, "attention_layers")
+        attention_types = list(at) if at is not None else None
+    if window_size is None:
+        window_size = _sniff_config(src, "window_size") or 256
+    sd = SDLoaderFactory.load(src)
+    wm = GPTNeoWeightMap()
+    n_layer = wm.n_layers(sd)
+    top = wm.top_weights(sd)
+    wte, wpe = top["wte"], top["wpe"]
+    n_embd = wte.shape[1]
+    layers = [wm.layer_weights(sd, i) for i in range(n_layer)]
+    if attention_types is None:
+        # HF default: global/local alternating starting global
+        attention_types = ["global" if i % 2 == 0 else "local"
+                           for i in range(n_layer)]
+    windows = tuple(int(window_size) if t == "local" else 0
+                    for t in attention_types)
+    config = GPT2Config(
+        vocab_size=wte.shape[0], n_positions=wpe.shape[0], n_embd=n_embd,
+        n_layer=n_layer, n_head=n_head,
+        attn_bias=False, attn_out_bias=True, attn_scale=1.0,
+        attention_windows=windows, scan_layers=False,
+        dtype=dtype if dtype is not None else jnp.float32)
+    params = _canonical_gpt2_tree(layers, top, scan_layers=False, wpe=wpe,
+                                  attn_bias=False, attn_out_bias=True)
+    logger.info(f"loaded HF GPT-Neo: {n_layer} layers, n_embd={n_embd}, "
+                f"vocab={wte.shape[0]}, windows={windows}")
     return config, params
 
 

@@ -1,0 +1,106 @@
+"""GPT-Neo served by the canonical fused decoder: unscaled attention,
+bias-free q/k/v with biased out-proj, alternating global/local
+(sliding-window) attention layers (reference arch policy:
+module_inject/replace_policy.py GPT-Neo entry)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import from_pretrained
+from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+from deepspeed_tpu.parallel.topology import reset_topology
+from deepspeed_tpu.runtime.state_dict_factory import (detect_arch,
+                                                      load_hf_gpt_neo)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    reset_topology()
+    yield
+    reset_topology()
+
+
+def _tiny_hf_neo(window=3):
+    # window smaller than the prompt so LOCAL layers actually truncate
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        max_position_embeddings=32, window_size=window,
+        attention_types=[[["global", "local"], 1]],
+        resid_dropout=0.0, embed_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(0)
+    return transformers.GPTNeoForCausalLM(cfg).eval(), cfg
+
+
+IDS = np.array([[3, 17, 42, 99, 7, 23, 56, 1]], np.int32)
+
+
+class TestGPTNeo:
+    def test_logits_match_hf(self):
+        hf, cfg = _tiny_hf_neo()
+        config, params = load_hf_gpt_neo(hf.state_dict(),
+                                         n_head=cfg.num_heads,
+                                         attention_types=cfg.attention_layers,
+                                         window_size=cfg.window_size)
+        assert config.attn_scale == 1.0
+        assert not config.attn_bias and config.attn_out_bias
+        assert config.attention_windows == (0, 3)
+        assert not config.scan_layers
+        ours = np.asarray(GPT2LMHeadModel(config).apply(
+            {"params": params}, IDS))
+        with torch.no_grad():
+            theirs = hf(torch.tensor(IDS, dtype=torch.long)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=3e-4)
+
+    def test_detect_arch(self):
+        hf, _ = _tiny_hf_neo()
+        assert detect_arch({k: None for k in hf.state_dict()}) == "gpt-neo"
+
+    def test_decode_matches_dense(self):
+        """Token-by-token decode (incl. the windowed cache mask on local
+        layers) reproduces the dense forward."""
+        hf, cfg = _tiny_hf_neo()
+        config, params = load_hf_gpt_neo(hf.state_dict(),
+                                         n_head=cfg.num_heads,
+                                         attention_types=cfg.attention_layers,
+                                         window_size=cfg.window_size)
+        model = GPT2LMHeadModel(config)
+        dense = np.asarray(model.apply({"params": params}, IDS))
+        dmodel = GPT2LMHeadModel(config.for_decode())
+        vars0 = dmodel.init(jax.random.PRNGKey(0), IDS[:, :1])
+        cache = jax.tree_util.tree_map(jnp.zeros_like, vars0["cache"])
+        logits, mut = dmodel.apply({"params": params, "cache": cache},
+                                   IDS[:, :4], mutable=["cache"])
+        cache = mut["cache"]
+        np.testing.assert_allclose(np.asarray(logits[:, -1]), dense[:, 3],
+                                   atol=3e-4, rtol=3e-4)
+        for t in range(4, 8):
+            logits, mut = dmodel.apply({"params": params, "cache": cache},
+                                       IDS[:, t:t + 1], mutable=["cache"])
+            cache = mut["cache"]
+            np.testing.assert_allclose(np.asarray(logits[:, -1]),
+                                       dense[:, t], atol=3e-4, rtol=3e-4)
+
+    def test_from_pretrained_generate(self, tmp_path):
+        hf, cfg = _tiny_hf_neo()
+        hf.save_pretrained(tmp_path)
+        engine = from_pretrained(str(tmp_path))
+        out = np.asarray(engine.generate(IDS, max_new_tokens=4,
+                                         do_sample=False))
+        with torch.no_grad():
+            ref = hf.generate(torch.tensor(IDS, dtype=torch.long),
+                              max_new_tokens=4, do_sample=False,
+                              pad_token_id=0).numpy()
+        np.testing.assert_array_equal(out, ref)
+
+    def test_windows_require_unrolled(self):
+        from deepspeed_tpu.models.gpt2 import GPT2Config
+
+        cfg = GPT2Config.tiny(dtype=jnp.float32, scan_layers=True,
+                              attention_windows=(0, 3))
+        with pytest.raises(ValueError, match="scan_layers=False"):
+            GPT2LMHeadModel(cfg).init(jax.random.PRNGKey(0), IDS)

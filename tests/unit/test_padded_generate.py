@@ -118,6 +118,9 @@ def test_mask_conventions_enforced(tmp_path):
     with pytest.raises(ValueError, match="LEFT-padded"):
         engine.generate(ids, attention_mask=np.array(
             [[1, 1, 1, 0, 0]], np.int32), max_new_tokens=2)
+    with pytest.raises(ValueError, match="at least one real token"):
+        engine.generate(ids, attention_mask=np.zeros_like(ids),
+                        max_new_tokens=2)
     plain = np.asarray(engine.generate(ids, max_new_tokens=3,
                                        do_sample=False))
     ones = np.asarray(engine.generate(ids, attention_mask=np.ones_like(ids),

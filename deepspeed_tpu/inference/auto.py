@@ -96,11 +96,16 @@ def load_pretrained(src, arch: Optional[str] = None, dtype=None,
                   "bloom": load_hf_bloom, "gptj": load_hf_gptj,
                   "gpt-neox": load_hf_gpt_neox,
                   "gpt-neo": load_hf_gpt_neo}[arch]
-        if arch == "gpt-neo":  # per-layer windows force the unrolled layout
-            config, params = loader(sd, dtype=dtype, **loader_kw)
-        else:
-            config, params = loader(sd, scan_layers=scan_layers,
-                                    dtype=dtype, **loader_kw)
+        if arch == "gpt-neo" and scan_layers:
+            # per-layer windows force the unrolled layout; scan_layers=True
+            # is from_pretrained's generic default, so downgrade with a
+            # note instead of erroring on every auto-detected checkpoint
+            # (direct load_hf_gpt_neo(scan_layers=True) calls DO raise)
+            logger.info("gpt-neo: alternating local/global attention "
+                        "forces scan_layers=False")
+            scan_layers = False
+        config, params = loader(sd, scan_layers=scan_layers,
+                                dtype=dtype, **loader_kw)
         model = GPT2LMHeadModel(config)
     logger.info(f"load_pretrained: arch={arch}")
     return model, params, arch

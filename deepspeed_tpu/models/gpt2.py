@@ -19,6 +19,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.models.decode_utils import (cache_attn_mask,
+                                               decode_positions,
+                                               pad_lengths, row_positions)
 from deepspeed_tpu.ops.attention import attention
 
 
@@ -185,15 +188,6 @@ def apply_rotary(x, positions, rotary_dim: int, theta: float,
     return jnp.concatenate([out, rest], axis=-1) if rd < D else out
 
 
-from deepspeed_tpu.models.decode_utils import (cache_attn_mask as
-                                                _cache_attn_mask,
-                                                decode_positions as
-                                                _decode_positions,
-                                                pad_lengths as _pad_lengths,
-                                                row_positions as
-                                                _row_positions)
-
-
 def _remat_block(cfg):
     """Block wrapped per the config's activation-checkpointing policy."""
     if not cfg.remat:
@@ -232,7 +226,7 @@ class CausalSelfAttention(nn.Module):
         q4 = q.reshape(B, T, cfg.n_head, head_dim)  # [B, T, H, D]
         rotary = cfg.position_embedding == "rotary"
         # left-padded rows: position 0 at the first REAL token
-        row_pos = (_row_positions(attention_mask)
+        row_pos = (row_positions(attention_mask)
                    if attention_mask is not None else None)
         if rotary and not cfg.decode:
             pos = row_pos if row_pos is not None else jnp.arange(T)
@@ -267,7 +261,7 @@ class CausalSelfAttention(nn.Module):
                 pl = self.variable("cache", "pad_len",
                                    lambda: jnp.zeros((B,), jnp.int32))
                 if is_prefill and attention_mask is not None:
-                    pl.value = _pad_lengths(attention_mask, T)
+                    pl.value = pad_lengths(attention_mask, T)
                 pad = pl.value
             if rotary:
                 # rotate by absolute position BEFORE caching: cached keys are
@@ -275,7 +269,7 @@ class CausalSelfAttention(nn.Module):
                 if cfg.padded and is_prefill and row_pos is not None:
                     pos = row_pos  # [B, T]: 0 at each row's first real token
                 elif cfg.padded and not is_prefill:
-                    pos = _decode_positions(idx, T, pad)
+                    pos = decode_positions(idx, T, pad)
                 else:
                     pos = idx + jnp.arange(T)
                 q4 = apply_rotary(q4, pos, cfg.rotary_dim, cfg.rope_theta,
@@ -305,7 +299,7 @@ class CausalSelfAttention(nn.Module):
                     vc = cv.value.transpose(0, 2, 1, 3)
                     # query at slot idx+t sees keys at slots <= idx+t,
                     # minus each row's padded prefix / local window
-                    mask = _cache_attn_mask(cfg.n_positions, idx, T,
+                    mask = cache_attn_mask(cfg.n_positions, idx, T,
                                             pad if cfg.padded else None,
                                             window=self.window)
                     bias = (_alibi_bias(cfg, jnp.arange(cfg.n_positions))
@@ -513,8 +507,8 @@ class GPT2LMHeadModel(nn.Module):
                     pl = self.variable("cache", "pad_len",
                                        lambda: jnp.zeros((B,), jnp.int32))
                     if attention_mask is not None:  # prefill
-                        pl.value = _pad_lengths(attention_mask, T)
-                        pos_ids = _row_positions(attention_mask)
+                        pl.value = pad_lengths(attention_mask, T)
+                        pos_ids = row_positions(attention_mask)
                     else:  # decode step
                         pos_ids = jnp.clip(
                             (pos + jnp.arange(T))[None] - pl.value[:, None],
@@ -525,7 +519,7 @@ class GPT2LMHeadModel(nn.Module):
                         wpe, (pos + cfg.position_offset, 0),
                         (T, cfg.n_embd))[None]
             elif attention_mask is not None:
-                pos_ids = _row_positions(attention_mask)
+                pos_ids = row_positions(attention_mask)
                 pos_emb = wpe[pos_ids + cfg.position_offset]
             else:
                 pos_emb = wpe[None, cfg.position_offset:

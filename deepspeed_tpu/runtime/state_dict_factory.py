@@ -813,17 +813,46 @@ def load_hf_gptj(src, scan_layers: bool = True, dtype=None,
     return config, params
 
 
-def load_hf_gpt_neo(src, dtype=None, n_head: Optional[int] = None,
+def _expand_attention_types(attention_types, n_layer: int):
+    """Normalize GPT-Neo attention-type declarations: the expanded
+    per-layer list (config.attention_layers) passes through; HF's compact
+    ``[[["global", "local"], N]]`` form (config.attention_types) expands.
+    Unknown entries raise — a typo silently running global attention on
+    every layer produces wrong logits with no error."""
+    out = []
+    for t in attention_types:
+        if isinstance(t, (list, tuple)):
+            kinds, count = t
+            out.extend(list(kinds) * int(count))
+        else:
+            out.append(t)
+    bad = {t for t in out if t not in ("global", "local")}
+    if bad:
+        raise ValueError(f"unknown attention types {sorted(bad)}; "
+                         "expected 'global'/'local'")
+    if len(out) != n_layer:
+        raise ValueError(f"attention_types expands to {len(out)} layers "
+                         f"but the checkpoint has {n_layer}")
+    return out
+
+
+def load_hf_gpt_neo(src, scan_layers: bool = False, dtype=None,
+                    n_head: Optional[int] = None,
                     attention_types=None, window_size: Optional[int] = None):
     """HF ``GPTNeoForCausalLM`` checkpoint → (GPT2Config, flax params): the
     canonical decoder runs GPT-Neo as learned positions, UNSCALED attention
     logits, bias-free q/k/v with a biased out-projection, and alternating
     global/local (sliding-window) attention layers — which forces the
-    unrolled layout (per-layer windows are static properties)."""
+    unrolled layout (per-layer windows are static properties;
+    ``scan_layers=True`` is rejected rather than silently ignored)."""
     import jax.numpy as jnp
 
     from deepspeed_tpu.models.gpt2 import GPT2Config
 
+    if scan_layers:
+        raise ValueError(
+            "GPT-Neo's alternating local/global attention needs the "
+            "unrolled layout: call with scan_layers=False")
     if n_head is None:
         n_head = _sniff_config(src, "num_heads", "num_attention_heads")
     if n_head is None:
@@ -844,6 +873,7 @@ def load_hf_gpt_neo(src, dtype=None, n_head: Optional[int] = None,
         # HF default: global/local alternating starting global
         attention_types = ["global" if i % 2 == 0 else "local"
                            for i in range(n_layer)]
+    attention_types = _expand_attention_types(attention_types, n_layer)
     windows = tuple(int(window_size) if t == "local" else 0
                     for t in attention_types)
     config = GPT2Config(
@@ -1215,8 +1245,96 @@ def export_hf_bert(params) -> Dict[str, np.ndarray]:
     return sd
 
 
+def export_hf_opt(params) -> Dict[str, np.ndarray]:
+    """Canonical OPT params → HF ``OPTForCausalLM`` state dict: the fused
+    c_attn splits back into q/k/v and kernels transpose to nn.Linear's
+    [out, in] (inverse of OPTWeightMap)."""
+    wte = _f32(params["wte"])
+    sd = {
+        "model.decoder.embed_tokens.weight": wte,
+        "model.decoder.embed_positions.weight": _f32(params["wpe"]),
+        "model.decoder.final_layer_norm.weight": _f32(
+            params["ln_f"]["scale"]),
+        "model.decoder.final_layer_norm.bias": _f32(params["ln_f"]["bias"]),
+        "lm_head.weight": wte,  # tied
+    }
+    for i, b in enumerate(_blocks_list(params.get("transformer", {}),
+                                       ("h", "block"), "h")):
+        p = f"model.decoder.layers.{i}."
+        sd[p + "self_attn_layer_norm.weight"] = _f32(b["ln_1"]["scale"])
+        sd[p + "self_attn_layer_norm.bias"] = _f32(b["ln_1"]["bias"])
+        qw, kw, vw = split_qkv(np.asarray(b["attn"]["c_attn"]["kernel"]))
+        qb, kb, vb = split_qkv(np.asarray(b["attn"]["c_attn"]["bias"]))
+        for n, w, bias in (("q", qw, qb), ("k", kw, kb), ("v", vw, vb)):
+            sd[p + f"self_attn.{n}_proj.weight"] = _f32(w.T)
+            sd[p + f"self_attn.{n}_proj.bias"] = _f32(bias)
+        sd[p + "self_attn.out_proj.weight"] = _f32(
+            np.asarray(b["attn"]["c_proj"]["kernel"]).T)
+        sd[p + "self_attn.out_proj.bias"] = _f32(b["attn"]["c_proj"]["bias"])
+        sd[p + "final_layer_norm.weight"] = _f32(b["ln_2"]["scale"])
+        sd[p + "final_layer_norm.bias"] = _f32(b["ln_2"]["bias"])
+        sd[p + "fc1.weight"] = _f32(np.asarray(b["mlp"]["c_fc"]["kernel"]).T)
+        sd[p + "fc1.bias"] = _f32(b["mlp"]["c_fc"]["bias"])
+        sd[p + "fc2.weight"] = _f32(
+            np.asarray(b["mlp"]["c_proj"]["kernel"]).T)
+        sd[p + "fc2.bias"] = _f32(b["mlp"]["c_proj"]["bias"])
+    return sd
+
+
+def _interleave_bloom_qkv(w: np.ndarray, n_head: int) -> np.ndarray:
+    """Inverse of :func:`deinterleave_bloom_qkv`: canonical Q|K|V concat →
+    BLOOM's per-head [h0q, h0k, h0v, h1q, ...] packing ([..., 3C])."""
+    *lead, out = w.shape
+    c = out // 3
+    hd = c // n_head
+    q, k, v = (x.reshape(*lead, n_head, hd)
+               for x in np.split(w, 3, axis=-1))
+    return np.stack([q, k, v], axis=-2).reshape(*lead, out)
+
+
+def export_hf_bloom(params, n_head: int) -> Dict[str, np.ndarray]:
+    """Canonical BLOOM params → HF ``BloomForCausalLM`` state dict:
+    QKV re-interleaves per head (``n_head`` required for the packing)."""
+    wte = _f32(params["wte"])
+    sd = {
+        "transformer.word_embeddings.weight": wte,
+        "transformer.word_embeddings_layernorm.weight": _f32(
+            params["emb_ln"]["scale"]),
+        "transformer.word_embeddings_layernorm.bias": _f32(
+            params["emb_ln"]["bias"]),
+        "transformer.ln_f.weight": _f32(params["ln_f"]["scale"]),
+        "transformer.ln_f.bias": _f32(params["ln_f"]["bias"]),
+        "lm_head.weight": wte,  # tied
+    }
+    for i, b in enumerate(_blocks_list(params.get("transformer", {}),
+                                       ("h", "block"), "h")):
+        p = f"transformer.h.{i}."
+        sd[p + "input_layernorm.weight"] = _f32(b["ln_1"]["scale"])
+        sd[p + "input_layernorm.bias"] = _f32(b["ln_1"]["bias"])
+        sd[p + "self_attention.query_key_value.weight"] = _f32(
+            _interleave_bloom_qkv(
+                np.asarray(b["attn"]["c_attn"]["kernel"]), n_head).T)
+        sd[p + "self_attention.query_key_value.bias"] = _f32(
+            _interleave_bloom_qkv(
+                np.asarray(b["attn"]["c_attn"]["bias"])[None], n_head)[0])
+        sd[p + "self_attention.dense.weight"] = _f32(
+            np.asarray(b["attn"]["c_proj"]["kernel"]).T)
+        sd[p + "self_attention.dense.bias"] = _f32(
+            b["attn"]["c_proj"]["bias"])
+        sd[p + "post_attention_layernorm.weight"] = _f32(b["ln_2"]["scale"])
+        sd[p + "post_attention_layernorm.bias"] = _f32(b["ln_2"]["bias"])
+        sd[p + "mlp.dense_h_to_4h.weight"] = _f32(
+            np.asarray(b["mlp"]["c_fc"]["kernel"]).T)
+        sd[p + "mlp.dense_h_to_4h.bias"] = _f32(b["mlp"]["c_fc"]["bias"])
+        sd[p + "mlp.dense_4h_to_h.weight"] = _f32(
+            np.asarray(b["mlp"]["c_proj"]["kernel"]).T)
+        sd[p + "mlp.dense_4h_to_h.bias"] = _f32(b["mlp"]["c_proj"]["bias"])
+    return sd
+
+
 _EXPORTERS = {"gpt2": export_hf_gpt2, "llama": export_hf_llama,
-              "bert": export_hf_bert}
+              "bert": export_hf_bert, "opt": export_hf_opt,
+              "bloom": export_hf_bloom}
 
 
 def _plain_dicts(tree):
@@ -1229,10 +1347,12 @@ def _plain_dicts(tree):
     return tree
 
 
-def export_hf_state_dict(params, arch: str) -> Dict[str, np.ndarray]:
-    """Flax params → HF-named numpy state dict for a supported arch."""
+def export_hf_state_dict(params, arch: str, **kw) -> Dict[str, np.ndarray]:
+    """Flax params → HF-named numpy state dict for a supported arch.
+    ``kw`` forwards arch-specific requirements (bloom: ``n_head`` for the
+    per-head QKV re-interleave)."""
     params = _plain_dicts(jax.device_get(params))
     if arch not in _EXPORTERS:
         raise ValueError(f"no HF exporter for arch {arch!r}; "
                          f"have {sorted(_EXPORTERS)}")
-    return _EXPORTERS[arch](params)
+    return _EXPORTERS[arch](params, **kw)

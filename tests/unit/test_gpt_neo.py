@@ -97,6 +97,23 @@ class TestGPTNeo:
                               pad_token_id=0).numpy()
         np.testing.assert_array_equal(out, ref)
 
+    def test_compact_attention_types_expand(self):
+        """HF's compact config.attention_types form ([[["global",
+        "local"], N]]) expands to the per-layer list — previously it
+        silently ran every layer global."""
+        hf, cfg = _tiny_hf_neo()
+        a, pa = load_hf_gpt_neo(hf.state_dict(), n_head=cfg.num_heads,
+                                attention_types=[[["global", "local"], 1]],
+                                window_size=cfg.window_size)
+        assert a.attention_windows == (0, 3)
+        with pytest.raises(ValueError, match="unknown attention types"):
+            load_hf_gpt_neo(hf.state_dict(), n_head=cfg.num_heads,
+                            attention_types=["global", "sparse"],
+                            window_size=3)
+        with pytest.raises(ValueError, match="scan_layers=False"):
+            load_hf_gpt_neo(hf.state_dict(), n_head=cfg.num_heads,
+                            scan_layers=True)
+
     def test_windows_require_unrolled(self):
         from deepspeed_tpu.models.gpt2 import GPT2Config
 

@@ -126,6 +126,45 @@ class TestExport:
             theirs = hf(torch.tensor(IDS, dtype=torch.long)).logits.numpy()
         np.testing.assert_allclose(theirs, ours, atol=3e-4, rtol=3e-4)
 
+    def test_opt_roundtrip(self):
+        cfg = transformers.OPTConfig(
+            vocab_size=128, hidden_size=32, ffn_dim=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=32, dropout=0.0,
+            activation_function="relu", do_layer_norm_before=True,
+            word_embed_proj_dim=32)
+        torch.manual_seed(0)
+        from deepspeed_tpu.runtime.state_dict_factory import load_hf_opt
+
+        hf = transformers.OPTForCausalLM(cfg).eval()
+        _, params = load_hf_opt(hf.state_dict(), n_head=4)
+        sd = export_hf_state_dict(params, "opt")
+        hf2 = transformers.OPTForCausalLM(cfg).eval()
+        _, unexpected = hf2.load_state_dict(_torch_sd(sd), strict=False)
+        assert not unexpected, unexpected
+        with torch.no_grad():
+            a = hf(torch.tensor(IDS, dtype=torch.long)).logits.numpy()
+            b = hf2(torch.tensor(IDS, dtype=torch.long)).logits.numpy()
+        np.testing.assert_allclose(b, a, atol=1e-5, rtol=1e-5)
+
+    def test_bloom_roundtrip(self):
+        cfg = transformers.BloomConfig(
+            vocab_size=128, hidden_size=32, n_layer=2, n_head=4,
+            hidden_dropout=0.0, attention_dropout=0.0)
+        torch.manual_seed(0)
+        from deepspeed_tpu.runtime.state_dict_factory import load_hf_bloom
+
+        hf = transformers.BloomForCausalLM(cfg).eval()
+        _, params = load_hf_bloom(hf.state_dict(), n_head=4)
+        sd = export_hf_state_dict(params, "bloom", n_head=4)
+        hf2 = transformers.BloomForCausalLM(cfg).eval()
+        _, unexpected = hf2.load_state_dict(_torch_sd(sd), strict=False)
+        assert not unexpected, unexpected
+        with torch.no_grad():
+            a = hf(torch.tensor(IDS, dtype=torch.long)).logits.numpy()
+            b = hf2(torch.tensor(IDS, dtype=torch.long)).logits.numpy()
+        np.testing.assert_allclose(b, a, atol=1e-5, rtol=1e-5)
+
     def test_unknown_arch_raises(self):
         with pytest.raises(ValueError, match="no HF exporter"):
             export_hf_state_dict({}, "gpt-neox")

@@ -38,6 +38,24 @@ class BertConfig:
     remat: bool = False
     remat_policy: str = "full"
     use_flash: Optional[bool] = None
+    # ds-config "sparse_attention" section (mode/block/...): encoder
+    # attention runs through the block-sparse layout zoo instead of dense
+    # (reference BertSparseSelfAttention + SparseAttentionUtils patcher,
+    # ops/sparse_attention/). Accepts a dict; stored as a sorted item
+    # tuple so the frozen config stays hashable
+    sparse_attention: Optional[Any] = None
+
+    def __post_init__(self):
+        if isinstance(self.sparse_attention, dict):
+            def freeze(v):  # JSON configs carry lists (e.g. block indices)
+                if isinstance(v, (list, tuple)):
+                    return tuple(freeze(x) for x in v)
+                return v
+
+            object.__setattr__(
+                self, "sparse_attention",
+                tuple(sorted((k, freeze(v))
+                             for k, v in self.sparse_attention.items())))
 
     @staticmethod
     def bert_large(**kw):
@@ -74,6 +92,23 @@ class BertSelfAttention(nn.Module):
         v = nn.Dense(C, dtype=cfg.dtype, kernel_init=_init(), name="value")(x)
         q, k, v = (t.reshape(B, T, H, D).transpose(0, 2, 1, 3)
                    for t in (q, k, v))
+        if cfg.sparse_attention is not None:
+            # block-sparse encoder attention (reference
+            # BertSparseSelfAttention): the layout zoo bounds compute;
+            # padding becomes a multiplicative key mask
+            from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (  # noqa: E501
+                SparseSelfAttention)
+            from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+                sparsity_config_from_dict)
+
+            sp = SparseSelfAttention(
+                sparsity_config_from_dict(dict(cfg.sparse_attention), H),
+                key_padding_mask_mode="mul",
+                max_seq_length=cfg.max_position_embeddings)
+            y = sp(q, k, v,
+                   key_padding_mask=None if mask is None
+                   else mask.astype(jnp.float32))
+            return y.transpose(0, 2, 1, 3).reshape(B, T, C)
         # bidirectional; padding mask [B, T] → [B, 1, 1, T] keep-mask (the
         # masked path falls back to the XLA kernel; unmasked uses flash)
         mask4 = None if mask is None else mask[:, None, None, :].astype(bool)
@@ -287,4 +322,12 @@ class BertForTraining:
             enabled, policy = False, "full"
         cfg = dataclasses.replace(self.config, remat=enabled,
                                   remat_policy=policy)
+        return BertForTraining(cfg)
+
+    def with_sparse_attention(self, sparse_config):
+        """Engine hook: the ds-config ``sparse_attention`` section swaps
+        the encoder onto the block-sparse layout zoo (reference
+        SparseAttentionUtils HF patching flow)."""
+        cfg = dataclasses.replace(self.config,
+                                  sparse_attention=sparse_config)
         return BertForTraining(cfg)

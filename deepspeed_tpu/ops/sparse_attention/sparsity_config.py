@@ -18,6 +18,28 @@ from typing import List, Optional
 import numpy as np
 
 
+def sparsity_config_from_dict(d, num_heads: int):
+    """DS-config ``sparse_attention`` section → SparsityConfig instance
+    (reference parses the same keys in runtime/config.py:269-451:
+    ``{"mode": "fixed"|"variable"|"bigbird"|"bslongformer"|"dense"|
+    "local", ...mode-specific params}``)."""
+    d = dict(d or {})
+    mode = d.pop("mode", "fixed")
+    d.pop("num_heads", None)  # the model's head count wins
+    registry = {
+        "dense": DenseSparsityConfig,
+        "fixed": FixedSparsityConfig,
+        "variable": VariableSparsityConfig,
+        "bigbird": BigBirdSparsityConfig,
+        "bslongformer": BSLongformerSparsityConfig,
+        "local": LocalSlidingWindowSparsityConfig,
+    }
+    if mode not in registry:
+        raise ValueError(f"unknown sparse_attention mode {mode!r}; "
+                         f"have {sorted(registry)}")
+    return registry[mode](num_heads=num_heads, **d)
+
+
 class SparsityConfig:
     """Base config (reference ``sparsity_config.py:9``)."""
 

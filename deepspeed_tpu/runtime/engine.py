@@ -150,6 +150,12 @@ class DeepSpeedEngine:
                                                 "with_progressive_layer_drop"):
             model = model.with_progressive_layer_drop(True)
             self.client_model = model
+        if self._config.sparse_attention and hasattr(
+                model, "with_sparse_attention"):
+            # reference: SparseAttentionUtils patches HF BERT layers when
+            # the sparse_attention config section is present
+            model = model.with_sparse_attention(self._config.sparse_attention)
+            self.client_model = model
 
         # --- model contract: a flax module returning loss, or a loss_fn ---
         self.module = model

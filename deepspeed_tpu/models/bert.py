@@ -78,6 +78,29 @@ def _init(scale=0.02):
     return nn.initializers.normal(stddev=scale)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=16)
+def _sparse_attn_for(frozen_cfg, num_heads: int, max_seq: int):
+    """One SparseSelfAttention per (config, heads, window): the layout
+    build (per-head numpy block loops) and its mask cache are reused
+    across layers and retraces instead of rebuilt every __call__."""
+    from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+        SparseSelfAttention)
+    from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+        sparsity_config_from_dict)
+
+    d = dict(frozen_cfg)
+    if d.get("mode", "fixed") != "dense":
+        # an ENCODER must see rightward context: "local" defaults to
+        # unidirectional, which would silently break BERT
+        d.setdefault("attention", "bidirectional")
+    return SparseSelfAttention(sparsity_config_from_dict(d, num_heads),
+                               key_padding_mask_mode="mul",
+                               max_seq_length=max_seq)
+
+
 class BertSelfAttention(nn.Module):
     config: BertConfig
 
@@ -96,15 +119,8 @@ class BertSelfAttention(nn.Module):
             # block-sparse encoder attention (reference
             # BertSparseSelfAttention): the layout zoo bounds compute;
             # padding becomes a multiplicative key mask
-            from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (  # noqa: E501
-                SparseSelfAttention)
-            from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
-                sparsity_config_from_dict)
-
-            sp = SparseSelfAttention(
-                sparsity_config_from_dict(dict(cfg.sparse_attention), H),
-                key_padding_mask_mode="mul",
-                max_seq_length=cfg.max_position_embeddings)
+            sp = _sparse_attn_for(cfg.sparse_attention, H,
+                                  cfg.max_position_embeddings)
             y = sp(q, k, v,
                    key_padding_mask=None if mask is None
                    else mask.astype(jnp.float32))

@@ -17,6 +17,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepspeed_tpu.ops.attention import attention_reference
 from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
@@ -45,11 +46,15 @@ class SparseSelfAttention:
         self.max_seq_length = max_seq_length
         self._mask_cache = {}
 
-    def _layout_mask(self, seq_len: int) -> jnp.ndarray:
+    def _layout_mask(self, seq_len: int):
         if seq_len not in self._mask_cache:
             cfg = self.sparsity_config
             layout = cfg.make_layout(seq_len)
-            self._mask_cache[seq_len] = jnp.asarray(
+            # cache NUMPY: instances may outlive a jit trace (the BERT
+            # layer memoizes them) and a cached jnp constant would leak
+            # its tracer across traces; numpy lifts to a fresh constant
+            # wherever it is consumed
+            self._mask_cache[seq_len] = np.asarray(
                 cfg.expand_mask(layout, seq_len))  # [H, S, S] bool
         return self._mask_cache[seq_len]
 

@@ -45,11 +45,23 @@ class SparseSelfAttention:
         self.attn_mask_mode = attn_mask_mode
         self.max_seq_length = max_seq_length
         self._mask_cache = {}
+        self._layout_cache = {}
+
+    def _layout(self, seq_len: int):
+        """Block layout drawn ONCE per seq_len: random-layout configs
+        (bigbird/variable) advance a stateful RNG in make_layout, so a
+        shared/memoized instance must not redraw per call — the kernel
+        path, the masked path, and every retrace must agree on one
+        pattern."""
+        if seq_len not in self._layout_cache:
+            self._layout_cache[seq_len] = \
+                self.sparsity_config.make_layout(seq_len)
+        return self._layout_cache[seq_len]
 
     def _layout_mask(self, seq_len: int):
         if seq_len not in self._mask_cache:
             cfg = self.sparsity_config
-            layout = cfg.make_layout(seq_len)
+            layout = self._layout(seq_len)
             # cache NUMPY: instances may outlive a jit trace (the BERT
             # layer memoizes them) and a cached jnp constant would leak
             # its tracer across traces; numpy lifts to a fresh constant
@@ -84,8 +96,8 @@ class SparseSelfAttention:
             from deepspeed_tpu.ops.sparse_attention.block_sparse_kernel import (
                 block_sparse_attention)
 
-            layout = self.sparsity_config.make_layout(S)
-            return block_sparse_attention(query, key, value, layout)
+            return block_sparse_attention(query, key, value,
+                                          self._layout(S))
         mask = self._layout_mask(S)[None]  # [1, H, S, S]
         if attn_mask is not None:
             am = jnp.asarray(attn_mask)

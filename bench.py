@@ -12,6 +12,11 @@ import time
 
 import numpy as np
 
+from deepspeed_tpu.utils.chip_probe import (assert_platform, require_backend,
+                                            run_guarded)
+
+METRIC = "gpt2_125m_train_tokens_per_sec_per_chip"
+
 
 def load_autotuned():
     """Best config from ``python -m deepspeed_tpu.autotuning``, if tuned
@@ -58,13 +63,18 @@ def peak_flops_per_chip() -> float:
 
 
 def main():
+    # subprocess probe with timeout + bounded retry: a tunnel outage becomes
+    # a structured {"error": ...} line, never a stack trace or a hang
+    platform = require_backend(METRIC)
+
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    assert_platform(METRIC, platform)
+    on_tpu = platform == "tpu"
     tuned = load_autotuned() if on_tpu else None
     if on_tpu:
         # tuned: selective ("dots") remat keeps matmul + flash-attention
@@ -138,14 +148,23 @@ def main():
     model_flops_per_token = ModelProfile(
         n_params=n_params, n_layer=cfg.n_layer, n_embd=cfg.n_embd,
         vocab_size=cfg.vocab_size, seq_len=seq).flops_per_token
-    mfu = tokens_per_sec * model_flops_per_token / peak_flops_per_chip()
+    peak = peak_flops_per_chip()
+    mfu = tokens_per_sec * model_flops_per_token / peak
+    # peak + formula inline so the driver capture is self-auditing (no
+    # PERF.md cross-reference needed to re-derive the MFU arithmetic)
     print(json.dumps({
-        "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
+        "mfu": round(mfu, 4),
+        "peak_tflops_bf16": round(peak / 1e12, 1),
+        "flops_per_token": int(model_flops_per_token),
+        "mfu_formula": ("mfu = tokens_per_sec * flops_per_token / peak_bf16;"
+                        " flops_per_token = 6N + 12*L*T*C/2 (causal attn,"
+                        " PaLM appx B); vs_baseline = mfu / 0.40"),
     }))
 
 
 if __name__ == "__main__":
-    main()
+    run_guarded(METRIC, main)

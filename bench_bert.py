@@ -13,17 +13,24 @@ import time
 
 import numpy as np
 
+from deepspeed_tpu.utils.chip_probe import (assert_platform, require_backend,
+                                            run_guarded)
+
 REF_TFLOPS = 64.0  # docs/_posts/2020-05-28-fastest-bert-training.md:37
+METRIC = "bert_large_mlm_tflops_per_chip"
 
 
 def main():
+    platform = require_backend(METRIC)
+
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.models.bert import BertConfig, BertForTraining
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    assert_platform(METRIC, platform)
+    on_tpu = platform == "tpu"
     if on_tpu:
         cfg = BertConfig.bert_large(dtype=jnp.bfloat16, remat=True,
                                     remat_policy="dots",
@@ -78,13 +85,16 @@ def main():
                        + 12 * cfg.num_hidden_layers * seq * cfg.hidden_size)
     tflops = samples_per_sec * seq * flops_per_token / 1e12
     print(json.dumps({
-        "metric": "bert_large_mlm_tflops_per_chip" if on_tpu
-        else "bert_tiny_cpu_smoke_tflops",
+        "metric": METRIC if on_tpu else "bert_tiny_cpu_smoke_tflops",
         "value": round(tflops, 2),
         "unit": "TFLOP/s",
         "vs_baseline": round(tflops / REF_TFLOPS, 4),
+        "flops_formula": ("tflops = samples_per_sec * seq * (6N + 12*L*T*C)"
+                          " / 1e12, T=seq (bidirectional attn);"
+                          f" vs_baseline = tflops / {REF_TFLOPS} (reference"
+                          " V100 seq-128 headline)"),
     }))
 
 
 if __name__ == "__main__":
-    main()
+    run_guarded(METRIC, main)

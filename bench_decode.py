@@ -18,15 +18,23 @@ import time
 
 import numpy as np
 
+from deepspeed_tpu.utils.chip_probe import (assert_platform, require_backend,
+                                            run_guarded)
+
+METRIC = "gpt2_125m_decode"
+
 
 def main():
+    platform = require_backend(METRIC)
+
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    assert_platform(METRIC, platform)
+    on_tpu = platform == "tpu"
     if on_tpu:
         cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=768,
                          n_layer=12, n_head=12, dtype=jnp.bfloat16,
@@ -72,7 +80,7 @@ def main():
     tokens_per_sec = batch / per_token_s
 
     print(json.dumps({
-        "metric": "gpt2_125m_decode",
+        "metric": METRIC,
         "ttft_ms_p50": round(ttft_p50, 2),
         "decode_tokens_per_sec": round(tokens_per_sec, 1),
         "per_token_ms": round(per_token_ms, 3),
@@ -81,4 +89,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    run_guarded(METRIC, main)

@@ -13,9 +13,12 @@ import time
 import numpy as np
 
 from deepspeed_tpu.utils.chip_probe import (assert_platform, require_backend,
-                                            run_guarded)
+                                            resolve_metric, run_guarded)
 
-METRIC = "gpt2_125m_train_tokens_per_sec_per_chip"
+# smoke-metric name under explicit JAX_PLATFORMS=cpu so a CPU run (or its
+# failure) can never be misfiled into the TPU headline series
+METRIC = resolve_metric("gpt2_125m_train_tokens_per_sec_per_chip",
+                        "gpt2_tiny_cpu_smoke_tokens_per_sec")
 
 
 def load_autotuned():

@@ -14,10 +14,11 @@ import time
 import numpy as np
 
 from deepspeed_tpu.utils.chip_probe import (assert_platform, require_backend,
-                                            run_guarded)
+                                            resolve_metric, run_guarded)
 
 REF_TFLOPS = 64.0  # docs/_posts/2020-05-28-fastest-bert-training.md:37
-METRIC = "bert_large_mlm_tflops_per_chip"
+METRIC = resolve_metric("bert_large_mlm_tflops_per_chip",
+                        "bert_tiny_cpu_smoke_tflops")
 
 
 def main():
@@ -85,7 +86,7 @@ def main():
                        + 12 * cfg.num_hidden_layers * seq * cfg.hidden_size)
     tflops = samples_per_sec * seq * flops_per_token / 1e12
     print(json.dumps({
-        "metric": METRIC if on_tpu else "bert_tiny_cpu_smoke_tflops",
+        "metric": METRIC,
         "value": round(tflops, 2),
         "unit": "TFLOP/s",
         "vs_baseline": round(tflops / REF_TFLOPS, 4),

@@ -19,9 +19,9 @@ import time
 import numpy as np
 
 from deepspeed_tpu.utils.chip_probe import (assert_platform, require_backend,
-                                            run_guarded)
+                                            resolve_metric, run_guarded)
 
-METRIC = "gpt2_125m_decode"
+METRIC = resolve_metric("gpt2_125m_decode", "gpt2_decode_cpu_smoke")
 
 
 def main():

@@ -1,0 +1,8 @@
+"""Device abstraction layer (reference ``deepspeed/accelerator/``)."""
+
+from .abstract_accelerator import Accelerator
+from .real_accelerator import get_accelerator, set_accelerator
+from .tpu_accelerator import TpuAccelerator
+
+__all__ = ["Accelerator", "TpuAccelerator", "get_accelerator",
+           "set_accelerator"]

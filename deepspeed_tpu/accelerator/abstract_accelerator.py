@@ -1,0 +1,137 @@
+"""Accelerator abstraction (reference ``deepspeed/accelerator/abstract_accelerator.py:5``).
+
+The reference's ``DeepSpeedAccelerator`` ABC is the seam that lets every
+device-touching call site run on CUDA/ROCm/CPU/XPU. The TPU-native surface
+keeps the *capabilities* — device inventory, synchronization, memory
+introspection, RNG seeding, profiler ranges, precision probes — but drops
+the torch-isms that have no XLA analog (streams/events/graph capture: XLA
+owns scheduling and fuses/orders ops itself; those appear here only as
+documented no-ops so reference call sites stay mechanical to port).
+
+Memory model note: XLA owns HBM; there is no allocator cache to empty and
+no per-tensor alloc hooks. Introspection comes from PJRT
+``device.memory_stats()`` (bytes_in_use / peak_bytes_in_use / bytes_limit
+on TPU) with a live-buffer fallback on backends that return ``None``.
+"""
+
+import abc
+
+
+class Accelerator(abc.ABC):
+    """Device abstraction. One instance serves the whole process."""
+
+    _name: str = "abstract"
+
+    # --- identity -----------------------------------------------------
+    @abc.abstractmethod
+    def device_name(self, device_index=None) -> str:
+        """Platform name, optionally suffixed ``:<index>``."""
+
+    @abc.abstractmethod
+    def device(self, device_index=None):
+        """The underlying device handle (a ``jax.Device``)."""
+
+    @abc.abstractmethod
+    def current_device(self) -> int:
+        """Default device index for this process."""
+
+    def current_device_name(self) -> str:
+        return self.device_name(self.current_device())
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        """Local (process-visible) device count."""
+
+    @abc.abstractmethod
+    def is_available(self) -> bool:
+        """True when at least one accelerator device initializes."""
+
+    # --- execution ----------------------------------------------------
+    @abc.abstractmethod
+    def synchronize(self, device_index=None) -> None:
+        """Drain the async dispatch queue (torch.cuda.synchronize analog)."""
+
+    def set_device(self, device_index) -> None:
+        """No-op: JAX routes placement via shardings, not a thread-local
+        current device. Kept so reference call sites port mechanically."""
+
+    def empty_cache(self) -> None:
+        """No-op + host GC: XLA owns HBM, there is no allocator cache."""
+        import gc
+
+        gc.collect()
+
+    # --- RNG ----------------------------------------------------------
+    @abc.abstractmethod
+    def manual_seed(self, seed: int) -> None:
+        """Set the process-level seed consumed by framework init paths.
+        JAX RNG is functional (explicit keys); this records the seed that
+        ``initial_seed()`` hands to key construction."""
+
+    def manual_seed_all(self, seed: int) -> None:
+        self.manual_seed(seed)
+
+    @abc.abstractmethod
+    def initial_seed(self) -> int:
+        ...
+
+    # --- memory introspection ----------------------------------------
+    @abc.abstractmethod
+    def memory_stats(self, device_index=None) -> dict:
+        """Normalized dict with at least ``bytes_in_use``,
+        ``peak_bytes_in_use``, ``bytes_limit`` (0 when unknown)."""
+
+    def memory_allocated(self, device_index=None) -> int:
+        return self.memory_stats(device_index)["bytes_in_use"]
+
+    def max_memory_allocated(self, device_index=None) -> int:
+        return self.memory_stats(device_index)["peak_bytes_in_use"]
+
+    def total_memory(self, device_index=None) -> int:
+        return self.memory_stats(device_index)["bytes_limit"]
+
+    def available_memory(self, device_index=None) -> int:
+        s = self.memory_stats(device_index)
+        return max(0, s["bytes_limit"] - s["bytes_in_use"])
+
+    @abc.abstractmethod
+    def reset_peak_memory_stats(self, device_index=None) -> None:
+        ...
+
+    # memory_reserved == memory_allocated on XLA (no allocator cache tier)
+    def memory_reserved(self, device_index=None) -> int:
+        return self.memory_allocated(device_index)
+
+    def max_memory_reserved(self, device_index=None) -> int:
+        return self.max_memory_allocated(device_index)
+
+    # --- precision probes ---------------------------------------------
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool:
+        ...
+
+    # --- profiler ranges (reference: utils/nvtx.py) -------------------
+    @abc.abstractmethod
+    def range_push(self, msg: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def range_pop(self) -> None:
+        ...
+
+    # --- misc ---------------------------------------------------------
+    def communication_backend_name(self) -> str:
+        return "xla"
+
+    def lazy_call(self, callback) -> None:
+        """Reference defers some calls until CUDA init; JAX needs no
+        deferral — run immediately."""
+        callback()
+
+    def pin_memory(self, tensor):
+        """Host arrays are always DMA-able for PJRT transfers; identity."""
+        return tensor

@@ -1,0 +1,28 @@
+"""Process-wide accelerator singleton (reference
+``deepspeed/accelerator/real_accelerator.py:15,33``).
+
+``get_accelerator()`` lazily constructs the JAX-backed accelerator;
+``set_accelerator()`` lets tests or alternative backends (a future
+multi-slice proxy, a fake for unit tests) install their own implementation
+before first use — the same plug-point the reference offers downstream
+frameworks.
+"""
+
+from .abstract_accelerator import Accelerator
+
+_accelerator = None
+
+
+def get_accelerator() -> Accelerator:
+    global _accelerator
+    if _accelerator is None:
+        from .tpu_accelerator import TpuAccelerator
+
+        _accelerator = TpuAccelerator()
+    return _accelerator
+
+
+def set_accelerator(accel: Accelerator) -> None:
+    global _accelerator
+    assert isinstance(accel, Accelerator), type(accel)
+    _accelerator = accel

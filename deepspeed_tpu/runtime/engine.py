@@ -292,6 +292,7 @@ class DeepSpeedEngine:
             batch_size=self.train_batch_size(),
             steps_per_output=self.steps_per_print())
         self.wall_clock_breakdown_ = self._config.wall_clock_breakdown
+        self.memory_breakdown_ = self._config.memory_breakdown
 
         # --- monitor ---
         from deepspeed_tpu.monitor.monitor import MonitorMaster
@@ -1073,6 +1074,12 @@ class DeepSpeedEngine:
             loss = float(self._last_loss) if self._last_loss is not None else float("nan")
             log_dist(f"step={self.global_steps}, skipped={self.get_skipped_steps()}, "
                      f"lr={lr}, loss={loss:.6f}", ranks=[0])
+            if self.memory_breakdown_:
+                # per-step HBM/host usage (reference see_memory_usage +
+                # memory_breakdown config; accelerator/abstract_accelerator.py:5)
+                from deepspeed_tpu.utils.memory import see_memory_usage
+
+                see_memory_usage(f"step={self.global_steps}", force=True)
         if self.monitor.enabled:
             self.monitor.write_events([
                 ("Train/Samples/train_loss", float(self._last_loss), self.global_samples),
@@ -1081,6 +1088,13 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------
     # reference accessor surface (engine.py:502-883)
+    def memory_stats(self):
+        """Device + host memory snapshot (reference ``see_memory_usage``
+        capability, ``runtime/utils.py:821``)."""
+        from deepspeed_tpu.utils.memory import memory_stats
+
+        return memory_stats()
+
     def train_batch_size(self):
         return self._config.train_batch_size
 

@@ -1,2 +1,3 @@
 from deepspeed_tpu.utils.logging import logger, log_dist, print_rank_0
+from deepspeed_tpu.utils.memory import memory_stats, see_memory_usage
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer

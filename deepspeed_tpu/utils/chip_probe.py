@@ -37,6 +37,22 @@ _PROBE_SRC = (
 )
 
 
+def cpu_requested() -> bool:
+    """True when the operator *explicitly* asked for CPU via JAX_PLATFORMS
+    (smoke-run mode). Distinguishes an intentional CPU run from a silent
+    fallback after a tunnel outage."""
+    explicit = os.environ.get("JAX_PLATFORMS", "")
+    return bool(explicit) and set(
+        explicit.replace(" ", "").split(",")) <= {"cpu"}
+
+
+def resolve_metric(tpu_metric: str, smoke_metric: str) -> str:
+    """Metric name for this run: the TPU headline normally, the smoke name
+    when CPU was explicitly requested — so a smoke failure can never be
+    misfiled into the TPU metric series."""
+    return smoke_metric if cpu_requested() else tpu_metric
+
+
 def reassert_platform_env():
     """Make the JAX_PLATFORMS env var effective even when a site hook
     already overrode ``jax_platforms`` at interpreter start."""
@@ -80,9 +96,17 @@ def require_backend(metric: str, attempts: int = 2, wait_s: float = 45.0,
         if i:
             time.sleep(wait_s)
         platform, detail = probe(timeout_s)
-        if platform is not None:
-            reassert_platform_env()
-            return platform
+        if platform is None:
+            continue
+        if platform not in ("tpu", "axon") and not cpu_requested():
+            # the registration hook can swallow a failed tunnel init and
+            # leave JAX to auto-choose CPU: a healthy-looking probe on the
+            # wrong platform is still an outage for a TPU headline bench
+            detail = (f"backend fell back to {platform!r} without an "
+                      "explicit JAX_PLATFORMS=cpu request")
+            continue
+        reassert_platform_env()
+        return platform
     print(json.dumps({
         "metric": metric, "value": None, "unit": "unavailable",
         "vs_baseline": None, "error": "accelerator backend unavailable",

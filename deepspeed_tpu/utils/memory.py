@@ -1,0 +1,55 @@
+"""Device/host memory reporting (reference ``see_memory_usage``,
+``deepspeed/runtime/utils.py:821``).
+
+The reference prints torch.cuda allocator counters (MA/Max_MA/CA/Max_CA) +
+psutil host stats, rank-0 gated, and resets the peak so successive call
+sites bracket phases. The TPU version reads PJRT memory stats through the
+accelerator abstraction (live-array fallback on backends without stats),
+adds host RSS (the number that matters for offload tiers), and keeps the
+same bracket-by-resetting-peaks contract.
+"""
+
+import gc
+
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.utils.logging import log_dist
+
+_GB = 1024 ** 3
+
+
+def memory_stats(device_index=None) -> dict:
+    """Normalized device + host memory snapshot."""
+    import psutil
+
+    acc = get_accelerator()
+    dev = acc.memory_stats(device_index)
+    vm = psutil.virtual_memory()
+    proc = psutil.Process()
+    return {
+        "device": dev,
+        "host_rss_bytes": int(proc.memory_info().rss),
+        "host_used_bytes": int(vm.total - vm.available),
+        "host_percent": float(vm.percent),
+    }
+
+
+def see_memory_usage(message: str, force: bool = False, device_index=None):
+    """Log a one-line memory report (rank 0). ``force`` gates it exactly
+    like the reference so ungated call sites are free in production."""
+    if not force:
+        return
+    gc.collect()  # drop dead jax.Array refs so live-array fallback is honest
+    s = memory_stats(device_index)
+    d = s["device"]
+    limit = d["bytes_limit"] / _GB if d["bytes_limit"] else float("nan")
+    log_dist(
+        f"{message} | device MA {d['bytes_in_use'] / _GB:.2f} GB "
+        f"Max_MA {d['peak_bytes_in_use'] / _GB:.2f} GB "
+        f"limit {limit:.2f} GB ({d.get('source', '?')}) | "
+        f"host RSS {s['host_rss_bytes'] / _GB:.2f} GB "
+        f"used {s['host_used_bytes'] / _GB:.2f} GB ({s['host_percent']:.0f}%)",
+        ranks=[0])
+    # bracket phases: next call's Max_MA starts fresh (reference resets
+    # torch.cuda peak stats here; PJRT peaks are monotonic, so this only
+    # affects the live-array fallback path)
+    get_accelerator().reset_peak_memory_stats(device_index)

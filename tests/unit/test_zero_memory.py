@@ -1,0 +1,113 @@
+"""ZeRO-3 memory-profile verification from compiled memory analysis.
+
+SURVEY §7 hard part #1: the ZeRO-3 design claims per-layer gather/free
+(scan-over-layers + sharding constraints), not a whole-model allgather.
+The reference enforces its analog operationally via explicit
+fetch/release machinery (``runtime/zero/partitioned_param_coordinator.py:239,358``);
+here the compiler owns gather/free, so the proof reads XLA's compiled
+memory statistics (``jit(...).lower().compile().memory_analysis()``) and
+pins the budget:
+
+- argument/output bytes at stage 3 = 1/world of the replicated baseline
+  (the whole TrainState — params, grads, optimizer moments — is sharded);
+- temp bytes (activations + per-layer gathered params + collective
+  scratch) stay well under the full parameter size. A whole-model
+  allgather would force temp >= full param bytes, so the bound fails
+  loudly if a regression flattens the per-layer streaming.
+
+The config is param-dominated (small batch/seq, wide layers) so the
+param-gather term isn't drowned by activations.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology
+
+N_LAYER = 8
+N_EMBD = 512
+SEQ = 32
+WORLD = 8
+
+
+def _compiled_stats(stage):
+    reset_topology()
+    MeshTopology(axis_sizes={"data": WORLD}, devices=jax.devices()[:WORLD])
+    model = GPT2ForTraining(GPT2Config(
+        vocab_size=512, n_positions=SEQ, n_embd=N_EMBD, n_layer=N_LAYER,
+        n_head=4, dtype=jnp.float32, scan_layers=True))
+    zero_cfg = {"stage": stage}
+    if stage >= 3:
+        zero_cfg["stage3_param_persistence_threshold"] = 0
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_batch_size": WORLD,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": zero_cfg,
+                "steps_per_print": 100_000})
+    ids = np.random.default_rng(0).integers(
+        0, 512, (WORLD, SEQ)).astype(np.int32)
+    batch = engine._shard_batch({"input_ids": ids})
+    engine._ensure_state(batch)
+    fn = getattr(engine, "_jit_fused", None) or engine._jit_micro
+    if fn is engine._jit_micro:
+        args = (engine.state, batch)
+    else:
+        args = (engine.state, batch, engine._lr_override())
+    stats = fn.lower(*args).compile().memory_analysis()
+    param_bytes = sum(
+        int(np.prod(p.shape)) * p.dtype.itemsize
+        for p in jax.tree_util.tree_leaves(engine.state.params))
+    return stats, param_bytes
+
+
+@pytest.fixture(scope="module")
+def stats():
+    s0, pb0 = _compiled_stats(0)
+    s3, pb3 = _compiled_stats(3)
+    assert pb0 == pb3
+    return s0, s3, pb0
+
+
+def test_stage3_arguments_are_fully_sharded(stats):
+    s0, s3, _ = stats
+    # per-device live state at stage 3 is exactly 1/world of replicated
+    assert s3.argument_size_in_bytes == pytest.approx(
+        s0.argument_size_in_bytes / WORLD, rel=0.05)
+    assert s3.output_size_in_bytes == pytest.approx(
+        s0.output_size_in_bytes / WORLD, rel=0.05)
+
+
+def test_stage3_state_is_donated(stats):
+    _, s3, _ = stats
+    # donate_argnums=(0,): the TrainState buffers are aliased in-place, so
+    # steady-state live bytes ~= one sharded state, not two
+    assert s3.alias_size_in_bytes >= 0.95 * s3.argument_size_in_bytes
+
+
+def test_stage3_gathers_per_layer_not_whole_model(stats):
+    s0, s3, param_bytes = stats
+    # A whole-model allgather would put >= param_bytes of gathered fp32
+    # params into temp. Per-layer streaming keeps temp (activations +
+    # ~1-2 gathered layer blocks + collective scratch) well below that.
+    # Measured on this config: temp ~= 0.42 * param_bytes.
+    assert s3.temp_size_in_bytes < 0.7 * param_bytes, (
+        f"stage-3 temp {s3.temp_size_in_bytes} vs params {param_bytes}: "
+        "per-layer gather/free regressed toward a whole-model allgather")
+    # and stage 3 must not pay more scratch than the replicated baseline
+    assert s3.temp_size_in_bytes < s0.temp_size_in_bytes
+
+
+def test_stage3_peak_budget_documented(stats):
+    """Peak per-device HBM ~= live state (arguments) + temp. Pin the sum so
+    accidental buffer duplication (lost donation, doubled grad buffers)
+    trips the gate even if the individual terms drift within bounds."""
+    s0, s3, param_bytes = stats
+    peak3 = s3.argument_size_in_bytes + s3.temp_size_in_bytes
+    peak0 = s0.argument_size_in_bytes + s0.temp_size_in_bytes
+    # 4 state copies / world + <0.7 params of scratch, vs >= 4 copies + temp
+    assert peak3 < 0.35 * peak0

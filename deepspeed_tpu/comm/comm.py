@@ -116,7 +116,13 @@ def timed_op(func):
         if not (comms_logger.prof_all or prof or log_name in comms_logger.prof_ops):
             return func(*args, **kwargs)
         group = kwargs.get("group")
-        n = _axis_world_size(_resolve_group(group, tensor)) if tensor is not None else 1
+        try:
+            n = _axis_world_size(_resolve_group(group, tensor)) if tensor is not None else 1
+        except ValueError:
+            # host-level op with no mesh topology: the group is the process set
+            import jax
+
+            n = jax.process_count()
         size = _nbytes(tensor) if tensor is not None else 0
         if tensor is not None and _is_traced(tensor):
             result = func(*args, **kwargs)
@@ -155,6 +161,59 @@ def log_summary(show_straggler=False):
 
 # ----------------------------------------------------------------------
 # init / identity
+_SCHEDULER_ENV_KEYS = (
+    # (rank, size) pairs per launcher family, most specific first
+    ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),   # OpenMPI
+    ("MV2_COMM_WORLD_RANK", "MV2_COMM_WORLD_SIZE"),     # MVAPICH2
+    ("PMI_RANK", "PMI_SIZE"),                           # MVAPICH/Hydra/PMI
+    ("SLURM_PROCID", "SLURM_NTASKS"),                   # srun
+)
+
+
+def mpi_discovery(distributed_port=29500, verbose=True) -> bool:
+    """Map scheduler-launched process identity into RANK/WORLD_SIZE env
+    (reference ``comm/comm.py:661``).
+
+    The reference bootstraps through mpi4py (COMM_WORLD rank/size + a
+    broadcast of rank 0's address). TPU pods need no MPI communicator for
+    this: every scheduler already exports rank/size env vars, and the
+    coordinator address arrives via the launcher's export list
+    (``MASTER_ADDR``, set from the hostfile by
+    ``launcher/multinode_runner.py``) or SLURM's own
+    ``SLURM_LAUNCH_NODE_IPADDR``. Returns True when a scheduler env was
+    found and mapped.
+    """
+    env = os.environ
+    for rank_key, size_key in _SCHEDULER_ENV_KEYS:
+        if rank_key in env and size_key in env:
+            rank, size = int(env[rank_key]), int(env[size_key])
+            break
+    else:
+        return False
+    env.setdefault("RANK", str(rank))
+    env.setdefault("WORLD_SIZE", str(size))
+    local = env.get("OMPI_COMM_WORLD_LOCAL_RANK",
+                    env.get("MV2_COMM_WORLD_LOCAL_RANK",
+                            env.get("SLURM_LOCALID", "0")))
+    env.setdefault("LOCAL_RANK", local)
+    if "MASTER_ADDR" not in env:
+        addr = env.get("SLURM_LAUNCH_NODE_IPADDR")
+        if addr is None and "SLURM_JOB_NODELIST" in env:
+            nodelist = env["SLURM_JOB_NODELIST"]
+            if not any(c in nodelist for c in "[],"):
+                addr = nodelist  # single plain hostname; bracketed ranges
+                # need scontrol, which the launcher-side export avoids
+        if addr:
+            env["MASTER_ADDR"] = addr
+    env.setdefault("MASTER_PORT", str(distributed_port))
+    if verbose:
+        logger.info(
+            f"mpi_discovery: rank={rank} world_size={size} "
+            f"local_rank={local} master={env.get('MASTER_ADDR')}:"
+            f"{env['MASTER_PORT']}")
+    return True
+
+
 def init_distributed(dist_backend="xla",
                      auto_mpi_discovery=True,
                      distributed_port=29500,
@@ -176,6 +235,11 @@ def init_distributed(dist_backend="xla",
 
     if _backend is not None and _backend.is_initialized():
         return _backend
+
+    if (auto_mpi_discovery and "WORLD_SIZE" not in os.environ
+            and world_size <= 0):
+        # scheduler-launched (mpirun/srun) process: adopt its rank/size env
+        mpi_discovery(distributed_port=distributed_port, verbose=verbose)
 
     n_procs = world_size if world_size > 0 else int(
         os.environ.get("WORLD_SIZE", os.environ.get("JAX_NUM_PROCESSES", 1)))
@@ -266,8 +330,8 @@ def _all_reduce_impl(tensor, op, group):
     import jax.numpy as jnp
     from jax import lax
 
-    group = _resolve_group(group, tensor)
     if _is_traced(tensor):
+        group = _resolve_group(group, tensor)
         if op in (ReduceOp.SUM, ReduceOp.AVG):
             out = lax.psum(tensor, group)
             if op == ReduceOp.AVG:
@@ -321,8 +385,10 @@ def all_gather(tensor, group: Group = None, async_op=False, prof=False,
     import jax
     from jax import lax
 
-    group = _resolve_group(group, tensor)
     if _is_traced(tensor):
+        # group resolution is a traced-path concern: host-level collectives
+        # span all processes via the coordination service, no mesh needed
+        group = _resolve_group(group, tensor)
         # DeepSpeed all_gather semantics: every member ends with the full
         # tensor → the result is *invariant* over the group axis. Use the
         # invariant variant so shard_map's replication check agrees.
@@ -360,8 +426,8 @@ def reduce_scatter(tensor, op=ReduceOp.SUM, group: Group = None, async_op=False,
     """Reduce then scatter shards over the group (``lax.psum_scatter``)."""
     from jax import lax
 
-    group = _resolve_group(group, tensor)
     if _is_traced(tensor):
+        group = _resolve_group(group, tensor)
         out = lax.psum_scatter(tensor, group, scatter_dimension=axis, tiled=tiled)
         if op == ReduceOp.AVG:
             out = out / _axis_world_size(group)
@@ -382,8 +448,8 @@ def all_to_all_single(tensor, group: Group = None, async_op=False, prof=False,
     """All-to-all over the group (``lax.all_to_all``), the MoE dispatch op."""
     from jax import lax
 
-    group = _resolve_group(group, tensor)
     if _is_traced(tensor):
+        group = _resolve_group(group, tensor)
         return lax.all_to_all(tensor, group, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
     raise NotImplementedError("all_to_all requires traced tensors (use inside jit/shard_map)")
@@ -404,8 +470,8 @@ def broadcast(tensor, src: int = 0, group: Group = None, async_op=False,
     import jax.numpy as jnp
     from jax import lax
 
-    group = _resolve_group(group, tensor)
     if _is_traced(tensor):
+        group = _resolve_group(group, tensor)
         # linear index over all group axes (row-major in group order), so a
         # multi-axis group broadcasts from exactly one member
         axes = (group,) if isinstance(group, str) else tuple(group)
@@ -460,9 +526,9 @@ def ppermute(tensor, perm, group: Group = None, prof=False, log_name="ppermute",
     along the group axis. This is the TPU-native send/recv."""
     from jax import lax
 
-    group = _resolve_group(group, tensor)
     if not _is_traced(tensor):
         raise NotImplementedError("ppermute requires traced tensors")
+    group = _resolve_group(group, tensor)
     return lax.ppermute(tensor, group, perm)
 
 

@@ -50,8 +50,15 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=29500,
                         help="Coordinator port")
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "pdsh", "local"],
-                        help="Multinode transport")
+                        choices=["ssh", "pdsh", "local", "openmpi", "slurm",
+                                 "mvapich"],
+                        help="Multinode transport: ssh/pdsh fan out one "
+                             "wrapped command per host; openmpi/slurm/"
+                             "mvapich emit a single scheduler command "
+                             "(one process per host, rank discovery from "
+                             "the scheduler env)")
+    parser.add_argument("--slurm_comment", type=str, default="",
+                        help="--comment passed to srun (slurm launcher)")
     parser.add_argument("--launcher_args", type=str, default="",
                         help="Extra flags for the transport (e.g. ssh opts)")
     parser.add_argument("--force_multi", action="store_true",
@@ -218,6 +225,36 @@ def main(args=None):
     pool = fetch_hostfile(args.hostfile)
     if pool is None:
         pool = {"localhost": max(1, args.num_gpus)}
+    if args.launcher in ("openmpi", "slurm", "mvapich"):
+        # scheduler path: one command, the scheduler multiplies it across
+        # hosts; rank/size resolve in-process via comm.mpi_discovery.
+        # Filters/caps are applied to the pool HERE so the runner's task
+        # count always matches the host set it targets (openmpi/mvapich
+        # reject filters in validate_args, mirroring the reference).
+        from deepspeed_tpu.launcher.multinode_runner import (
+            build_scheduler_command)
+
+        sched_pool = pool
+        if args.launcher == "slurm" and (args.include or args.exclude):
+            # include/exclude specs must name hostfile hosts (plain names,
+            # not bracket ranges) so the -n task count stays consistent
+            active = parse_inclusion_exclusion(pool, args.include,
+                                               args.exclude)
+            sched_pool = {h: pool[h] for h in active}
+        if args.num_nodes > 0:
+            if args.launcher != "slurm":
+                raise ValueError(
+                    f"--num_nodes is not supported with "
+                    f"--launcher={args.launcher}; edit the hostfile")
+            sched_pool = dict(list(sched_pool.items())[:args.num_nodes])
+        if args.num_gpus > 0:
+            sched_pool = {h: min(s, args.num_gpus)
+                          for h, s in sched_pool.items()}
+        active = {h: list(range(s)) for h, s in sched_pool.items()}
+        cmd = build_scheduler_command(args, sched_pool, active, _export_env())
+        logger.info(f"scheduler launch ({args.launcher}): "
+                    f"{' '.join(shlex.quote(c) for c in cmd)}")
+        sys.exit(subprocess.call(cmd))
     active = parse_inclusion_exclusion(pool, args.include, args.exclude)
     if args.num_nodes > 0:
         active = dict(list(active.items())[:args.num_nodes])

@@ -1,0 +1,77 @@
+"""Worker for the real 2-process ``jax.distributed`` test.
+
+Launched by ``test_multihost_dist.py`` with scheduler-style env vars
+(OMPI_COMM_WORLD_RANK/SIZE) so ``comm.mpi_discovery`` — not the test —
+resolves rank/size, exactly as under ``mpirun``/``srun``. Exercises the
+multi-host branches that single-process virtual meshes can't reach:
+``jax.distributed.initialize`` (comm/comm.py init_distributed), host
+collectives (barrier / process allgather / broadcast), an in-jit psum
+over a global 2-process mesh, and the elastic agent's cross-host
+preemption agreement.
+"""
+
+import os
+import sys
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # site hook pins axon; repin
+
+    import numpy as np
+
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    backend = dist.init_distributed()
+    assert backend is not None
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+    assert rank == int(os.environ["OMPI_COMM_WORLD_RANK"]), (
+        "mpi_discovery must map the scheduler rank onto the JAX process id")
+    assert dist.get_world_size() == 2
+
+    # --- host-side collectives (outside jit) --------------------------
+    dist.barrier()
+    gathered = np.asarray(dist.all_gather(np.asarray([rank + 1], np.int32)))
+    assert sorted(gathered.ravel().tolist()) == [1, 2], gathered
+    b = dist.broadcast(np.asarray([rank * 7 + 3], np.int32), src=0)
+    assert np.asarray(b).ravel().tolist() == [3], b  # rank 0's value
+
+    # --- in-jit collective over the global 2-process mesh -------------
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    local = np.full((1, 4), rank + 1, np.float32)
+    garr = jax.make_array_from_process_local_data(sharding, local, (2, 4))
+    out = jax.jit(lambda a: a.sum(axis=0),
+                  out_shardings=NamedSharding(mesh, P()))(garr)
+    # replicated output: every process holds the full value locally
+    summed = np.asarray(out.addressable_data(0))
+    assert np.allclose(summed, 3.0), summed
+
+    # --- elastic-agent cross-host agreement ---------------------------
+    class _StubEngine:
+        global_steps = 10  # multiple of agree_every: at an agreement point
+        saved = []
+
+        def save_checkpoint(self, d, tag=None, save_latest=True):
+            self.saved.append((d, tag, save_latest))
+
+    engine = _StubEngine()
+    agent = DSElasticAgent(engine, save_dir="/tmp/ds_tpu_elastic_test",
+                           agree_every=10, install_handlers=False)
+    if rank == 1:
+        agent.signal_preemption()  # only one host gets the signal...
+    stopped = agent.step_boundary()
+    assert stopped, "both hosts must agree to checkpoint"
+    assert engine.saved and engine.saved[0][1] is not None
+
+    dist.barrier()
+    print(f"MULTIHOST-OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

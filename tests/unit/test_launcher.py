@@ -130,3 +130,144 @@ class TestEnvReport:
         tools = env_report.toolchain_report()
         assert any(r[0] == "g++" for r in tools)
         assert env_report.op_report()
+
+
+class TestSchedulerRunners:
+    """Scheduler-provisioned runners (reference multinode_runner.py:109,164,211)."""
+
+    def _args(self, launcher, extra=()):
+        return runner.parse_args([
+            "-H", "/tmp/hostfile", "--launcher", launcher, *extra,
+            "train.py", "--lr", "0.1"])
+
+    def test_openmpi_cmd(self):
+        from deepspeed_tpu.launcher import multinode_runner as mr
+
+        args = self._args("openmpi")
+        r = mr.OpenMPIRunner(args, {"h0": 4, "h1": 4})
+        r.add_export("MASTER_ADDR", "h0")
+        cmd = r.get_cmd({}, {})
+        assert cmd[:3] == ["mpirun", "-n", "2"]          # one proc per HOST
+        assert "--map-by" in cmd and "ppr:1:node" in cmd
+        assert "-x" in cmd and "MASTER_ADDR=h0" in cmd
+        assert "UCX_TLS=tcp" in cmd
+        assert cmd[-3:] == ["train.py", "--lr", "0.1"]
+
+    def test_openmpi_rejects_filters(self):
+        from deepspeed_tpu.launcher import multinode_runner as mr
+
+        args = self._args("openmpi", ["--include", "h0"])
+        with pytest.raises(ValueError, match="include"):
+            mr.OpenMPIRunner(args, {"h0": 4})
+
+    def test_slurm_cmd(self):
+        from deepspeed_tpu.launcher import multinode_runner as mr
+
+        args = self._args("slurm", ["--slurm_comment", "ds-job",
+                                    "--include", "h[0-1]"])
+        r = mr.SlurmRunner(args, {"h0": 4, "h1": 4})
+        r.add_export("MASTER_ADDR", "h0")
+        cmd = r.get_cmd({}, {})
+        assert cmd[:3] == ["srun", "-n", "2"]
+        assert "--ntasks-per-node=1" in cmd
+        assert "--comment" in cmd and "ds-job" in cmd
+        assert "--nodelist" in cmd and "h[0-1]" in cmd
+        exports = [c for c in cmd if c.startswith("--export=")]
+        assert exports and "MASTER_ADDR=h0" in exports[0]
+        assert exports[0].startswith("--export=ALL,")
+        assert cmd[-3:] == ["train.py", "--lr", "0.1"]
+
+    def test_mvapich_cmd(self, tmp_path, monkeypatch):
+        from deepspeed_tpu.launcher import multinode_runner as mr
+
+        monkeypatch.setattr(mr, "MVAPICH_TMP_HOSTFILE",
+                            str(tmp_path / "hosts"))
+        args = self._args("mvapich")
+        r = mr.MVAPICHRunner(args, {"h0": 4, "h1": 4})
+        cmd = r.get_cmd({}, {})
+        assert cmd[:5] == ["mpirun", "-np", "2", "-ppn", "1"]
+        assert (tmp_path / "hosts").read_text() == "h0\nh1\n"
+        assert "-env" in cmd and "MV2_ENABLE_AFFINITY=0" in cmd
+        assert cmd[-3:] == ["train.py", "--lr", "0.1"]
+
+    def test_build_scheduler_command_exports_coordination(self, monkeypatch):
+        from deepspeed_tpu.launcher import multinode_runner as mr
+
+        monkeypatch.setattr(mr.OpenMPIRunner, "backend_exists",
+                            lambda self: True)
+        args = self._args("openmpi")
+        cmd = mr.build_scheduler_command(
+            args, {"h0": 4, "h1": 4}, {}, {"PYTHONPATH": "/x"})
+        joined = " ".join(cmd)
+        assert "MASTER_ADDR=h0" in joined
+        assert "MASTER_PORT=29500" in joined
+        assert "PYTHONPATH=/x" in joined
+        assert "DS_CHIPS_PER_HOST=4" in joined
+
+    def test_missing_backend_raises(self, monkeypatch):
+        from deepspeed_tpu.launcher import multinode_runner as mr
+
+        monkeypatch.setattr(mr.SlurmRunner, "backend_exists",
+                            lambda self: False)
+        args = self._args("slurm")
+        with pytest.raises(RuntimeError, match="client tools"):
+            mr.build_scheduler_command(args, {"h0": 4}, {}, {})
+
+
+class TestMpiDiscovery:
+    """Scheduler env → RANK/WORLD_SIZE mapping (reference comm/comm.py:661)."""
+
+    @pytest.fixture(autouse=True)
+    def _env_guard(self):
+        # mpi_discovery writes os.environ directly; monkeypatch can't
+        # restore keys it never touched, so snapshot/restore wholesale
+        import os
+
+        saved = dict(os.environ)
+        yield
+        os.environ.clear()
+        os.environ.update(saved)
+
+    def _clean(self, monkeypatch):
+        for k in ("RANK", "WORLD_SIZE", "LOCAL_RANK", "MASTER_ADDR",
+                  "MASTER_PORT", "OMPI_COMM_WORLD_RANK",
+                  "OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_LOCAL_RANK",
+                  "SLURM_PROCID", "SLURM_NTASKS", "SLURM_LOCALID",
+                  "SLURM_JOB_NODELIST", "PMI_RANK", "PMI_SIZE"):
+            monkeypatch.delenv(k, raising=False)
+
+    def test_openmpi_env(self, monkeypatch):
+        from deepspeed_tpu import comm as dist
+
+        self._clean(monkeypatch)
+        monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+        monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "8")
+        monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "1")
+        monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+        assert dist.mpi_discovery(verbose=False)
+        import os as _os
+
+        assert _os.environ["RANK"] == "3"
+        assert _os.environ["WORLD_SIZE"] == "8"
+        assert _os.environ["LOCAL_RANK"] == "1"
+        assert _os.environ["MASTER_PORT"] == "29500"
+
+    def test_slurm_env_with_plain_nodelist(self, monkeypatch):
+        from deepspeed_tpu import comm as dist
+
+        self._clean(monkeypatch)
+        monkeypatch.setenv("SLURM_PROCID", "1")
+        monkeypatch.setenv("SLURM_NTASKS", "2")
+        monkeypatch.setenv("SLURM_JOB_NODELIST", "tpu-host-a")
+        assert dist.mpi_discovery(verbose=False)
+        import os as _os
+
+        assert _os.environ["RANK"] == "1"
+        assert _os.environ["WORLD_SIZE"] == "2"
+        assert _os.environ["MASTER_ADDR"] == "tpu-host-a"
+
+    def test_no_scheduler_env(self, monkeypatch):
+        from deepspeed_tpu import comm as dist
+
+        self._clean(monkeypatch)
+        assert not dist.mpi_discovery(verbose=False)

@@ -6,8 +6,9 @@ Capability parity with the reference's ZeRO-3 parameter offload
 ``runtime/swap_tensor/partitioned_param_swapper.py:35`` and
 ``pipelined_optimizer_swapper.py:55``): models whose parameters exceed
 device HBM train by keeping fp32 masters (and Adam moments) in host RAM —
-or NVMe-backed memmaps — and streaming ONE layer's weights to the chip at
-a time.
+or NVMe stride files behind the aio-backed pipelined swapper
+(``runtime/zero/swapper.py``) — and streaming ONE layer's weights to the
+chip at a time.
 
 TPU-native form: where the reference hooks ``nn.Module`` forwards to
 allgather/release parameter shards, here the transformer stack's scanned
@@ -23,9 +24,10 @@ parameter layout (leading layer axis) IS the streaming schedule:
   runs.
 - update: the native C++ ``cpu_adam`` kernel (csrc/adam/cpu_adam.cpp)
   updates masters in place; with ``offload_param.device = "nvme"`` the
-  masters themselves are ``np.memmap``-backed, so the OS pages weight
-  blocks in on demand during streaming (the swap-tensor capability
-  without a bespoke pager).
+  block masters and Adam moments live in per-kind NVMe stride files and
+  the update sweep is layer-pipelined through the C++ aio op — read
+  ``l+1`` / update ``l`` / write ``l-1`` concurrently, host RAM bounded
+  by the staging pool (reference ``pipelined_optimizer_swapper.py:55``).
 
 The device footprint is: embeddings + head + TWO layer-weight buffers +
 activations — independent of depth. Engine surface matches
@@ -151,22 +153,50 @@ class ZeroInfinityEngine:
             nvme_path=nvme_path or (ooff.nvme_path if ooff else None))
 
         host_params = self._initial_params(model_parameters)
-        self._host_opt.init_from_params(host_params)
+        self._swap = None
         if self._nvme:
-            self._masters_to_memmap(nvme_path)
-        # live master views: cpu_adam updates these arrays in place, so the
-        # tree below always reads current weights — no per-step rebuild
-        self._host_params = self._host_opt.params_tree()
-        self._blocks = self._host_params["transformer"]["h"]["block"]
-        self._top = {k: v for k, v in self._host_params.items()
-                     if k != "transformer"}
-        self.n_layer = int(jax.tree_util.tree_leaves(
-            self._blocks)[0].shape[0])
+            # aio-backed pipelined swapper: block masters + moments live in
+            # per-kind stride files; host RAM holds only a bounded staging
+            # pool (reference pipelined_optimizer_swapper.py:55). The top
+            # (embeddings/head/final-LN) stays with the host optimizer —
+            # it is O(vocab), not O(depth).
+            from deepspeed_tpu.runtime.zero.swapper import (
+                PipelinedOptimizerSwapper)
+
+            blocks_init = host_params["transformer"]["h"]["block"]
+            top_init = {k: v for k, v in host_params.items()
+                        if k != "transformer"}
+            self._host_opt.clip = 0.0  # global clip spans top+blocks: engine-owned
+            self._swap = PipelinedOptimizerSwapper(
+                nvme_path, blocks_init,
+                lr=p.get("lr", 1e-3), betas=tuple(p.get("betas", (0.9, 0.999))),
+                eps=p.get("eps", 1e-8),
+                weight_decay=p.get("weight_decay", 0.0),
+                adamw_mode=opt_name == "adamw")
+            self._host_opt.init_from_params(top_init)
+            self._host_params = None
+            self._blocks = None
+            self._gblocks = jax.tree_util.tree_map(
+                lambda a: np.zeros(np.asarray(a).shape, np.float32),
+                blocks_init)
+            self.n_layer = self._swap.n_layers
+            self._top = self._host_opt.params_tree()
+        else:
+            self._host_opt.init_from_params(host_params)
+            # live master views: cpu_adam updates these arrays in place, so
+            # the tree below always reads current weights — no per-step
+            # rebuild
+            self._host_params = self._host_opt.params_tree()
+            self._blocks = self._host_params["transformer"]["h"]["block"]
+            self._top = {k: v for k, v in self._host_params.items()
+                         if k != "transformer"}
+            self.n_layer = int(jax.tree_util.tree_leaves(
+                self._blocks)[0].shape[0])
+            self._gblocks = jax.tree_util.tree_map(
+                lambda a: np.zeros(a.shape, np.float32), self._blocks)
 
         self._top_dev = jax.device_put(self._top, self._device)
         self._gtop = None       # device-accumulated top grads
-        self._gblocks = jax.tree_util.tree_map(
-            lambda a: np.zeros(a.shape, np.float32), self._blocks)
         self._compiled = {}
         self._last_loss = None
         self._last_grad_norm = None
@@ -262,21 +292,6 @@ class ZeroInfinityEngine:
                 params["lm_head_bias"] = np.zeros(V, np.float32)
         return params
 
-    def _masters_to_memmap(self, nvme_path: str):
-        """NVMe tier: masters become file-backed memmaps — the C++ kernel
-        updates them through the mapping and streaming reads page weight
-        blocks in on demand (reference partitioned_param_swapper
-        capability)."""
-        os.makedirs(nvme_path, exist_ok=True)
-        for path in self._host_opt._paths:
-            st = self._host_opt.opt._state[path]
-            fname = os.path.join(nvme_path,
-                                 f"{path.replace('/', '_')}.param.mm")
-            mm = np.memmap(fname, dtype=np.float32, mode="w+",
-                           shape=st["param"].shape)
-            mm[:] = st["param"]
-            st["param"] = mm
-
     # ------------------------------------------------------------------
     # compiled per-layer programs (one compile each; reused for all layers)
     def _fns(self, B, T):
@@ -351,8 +366,23 @@ class ZeroInfinityEngine:
 
     def _row(self, l: int):
         """Layer ``l``'s weights as a host tree of contiguous row views —
-        the unit the H2D stream moves (NVMe masters page in here)."""
+        the unit the H2D stream moves (host-RAM tier)."""
         return jax.tree_util.tree_map(lambda a: a[l], self._blocks)
+
+    def _fetch_row(self, l: int, prefetch: int = -1):
+        """Layer ``l``'s weights on device; NVMe tier streams through the
+        aio staging pool (issue the *next* read before waiting on this
+        one, so disk I/O overlaps the running block program)."""
+        if self._swap is None:
+            return jax.device_put(self._row(l), self._device)
+        if 0 <= prefetch < self.n_layer:
+            self._swap.prefetch_params(prefetch)
+        views = self._swap.get_params(l)
+        # copy out of the staging buffer before release: device_put may
+        # read the host memory asynchronously after this returns
+        row = jax.tree_util.tree_map(np.array, views)
+        self._swap.release_params(l)
+        return jax.device_put(row, self._device)
 
     # ------------------------------------------------------------------
     def forward(self, batch):
@@ -371,9 +401,11 @@ class ZeroInfinityEngine:
         # ---- forward stream: prefetch l+1 while l computes ----
         x = fns["embed"](self._top_dev, jax.device_put(ids, dev))
         acts = [x]
-        nxt = jax.device_put(self._row(0), dev)
+        if self._swap is not None:
+            self._swap.prefetch_params(0)
+        nxt = self._fetch_row(0, prefetch=1)
         for l in range(L):
-            cur, nxt = nxt, (jax.device_put(self._row(l + 1), dev)
+            cur, nxt = nxt, (self._fetch_row(l + 1, prefetch=l + 2)
                              if l + 1 < L else None)
             x = fns["block_fwd"](cur, x)
             acts.append(x)
@@ -384,9 +416,11 @@ class ZeroInfinityEngine:
         # ---- backward stream: reverse prefetch; dparams D2H overlaps the
         # next layer's VJP (async host copy, consumed one step later) ----
         pending = None  # (layer, device grads) awaiting host accumulation
-        nxt = jax.device_put(self._row(L - 1), dev)
+        if self._swap is not None:
+            self._swap.prefetch_params(L - 1)
+        nxt = self._fetch_row(L - 1, prefetch=L - 2)
         for l in range(L - 1, -1, -1):
-            cur, nxt = nxt, (jax.device_put(self._row(l - 1), dev)
+            cur, nxt = nxt, (self._fetch_row(l - 1, prefetch=l - 2)
                              if l > 0 else None)
             dbp, dx = fns["block_vjp"](cur, acts[l], dx)
             for leaf in jax.tree_util.tree_leaves(dbp):
@@ -423,9 +457,11 @@ class ZeroInfinityEngine:
         B, T = ids.shape
         fns = self._fns(B, T)
         x = fns["embed"](self._top_dev, jax.device_put(ids, self._device))
-        nxt = jax.device_put(self._row(0), self._device)
+        if self._swap is not None:
+            self._swap.prefetch_params(0)
+        nxt = self._fetch_row(0, prefetch=1)
         for l in range(self.n_layer):
-            cur, nxt = nxt, (jax.device_put(self._row(l + 1), self._device)
+            cur, nxt = nxt, (self._fetch_row(l + 1, prefetch=l + 2)
                              if l + 1 < self.n_layer else None)
             x = fns["block_fwd"](cur, x)
         return fns["head_loss"](self._top_dev, x,
@@ -445,8 +481,6 @@ class ZeroInfinityEngine:
             raise RuntimeError("step() called before any forward()")
         if self.is_gradient_accumulation_boundary():
             gas = self.gradient_accumulation_steps()
-            grads = dict(jax.device_get(self._gtop))
-            grads["transformer"] = {"h": {"block": self._gblocks}}
             if self._schedule_fn is not None:
                 lr = float(self._schedule_fn(self.global_steps))
             elif self.lr_scheduler is not None and hasattr(
@@ -458,11 +492,34 @@ class ZeroInfinityEngine:
             else:
                 lr = float((self._config.optimizer_params or {}).get(
                     "lr", 1e-3))
-            # mean over micro-steps: apply() already multiplies grads by
-            # 1/loss_scale leaf-by-leaf — no extra full-tree scaling pass
-            _, _, grad_norm = self._host_opt.apply(grads, lr=lr,
-                                                   loss_scale=float(gas))
-            self._last_grad_norm = grad_norm
+            if self._swap is None:
+                grads = dict(jax.device_get(self._gtop))
+                grads["transformer"] = {"h": {"block": self._gblocks}}
+                # mean over micro-steps: apply() already multiplies grads by
+                # 1/loss_scale leaf-by-leaf — no extra full-tree scaling pass
+                _, _, grad_norm = self._host_opt.apply(grads, lr=lr,
+                                                       loss_scale=float(gas))
+                self._last_grad_norm = grad_norm
+            else:
+                # NVMe tier: global clip spans top + blocks, so the norm is
+                # engine-owned; the pipelined swapper then updates the
+                # blocks layer-by-layer while (param, m, v) records stream
+                grads_top = jax.device_get(self._gtop)
+                sq = sum(float(np.sum(np.square(
+                    np.asarray(g, np.float32), dtype=np.float64)))
+                    for g in jax.tree_util.tree_leaves(grads_top))
+                sq += sum(float(np.sum(np.square(g, dtype=np.float64)))
+                          for g in jax.tree_util.tree_leaves(self._gblocks))
+                grad_norm = float(np.sqrt(sq)) / gas  # norm of the mean
+                clip = float(self._config.gradient_clipping or 0.0)
+                clip_coef = (min(1.0, clip / (grad_norm + 1e-6))
+                             if clip > 0 else 1.0)
+                # top: apply() unscales by 1/loss_scale — fold the clip in
+                self._host_opt.apply(grads_top, lr=lr,
+                                     loss_scale=float(gas) / clip_coef)
+                self._swap.step(self._gblocks, lr=lr,
+                                grad_scale=clip_coef / gas)
+                self._last_grad_norm = grad_norm
             # masters updated in place; only the device-resident top copy
             # needs a commit (block weights re-stream from masters anyway)
             self._top_dev = jax.device_put(self._top, self._device)
@@ -506,6 +563,15 @@ class ZeroInfinityEngine:
             st = self._host_opt.opt._state[path]
             for key in ("param", "exp_avg", "exp_avg_sq"):
                 flat[f"state/{path}/{key}"] = np.asarray(st[key])
+        if self._swap is not None:
+            # blocks live on NVMe: export them under the same path scheme
+            # the host-RAM tier uses, so checkpoints stay interchangeable
+            from deepspeed_tpu.utils.pytree import flatten_with_path_strings
+
+            for key in ("param", "exp_avg", "exp_avg_sq"):
+                tree = self._swap.read_full(key)
+                for path, leaf in flatten_with_path_strings(tree)[0]:
+                    flat[f"state/transformer/h/block/{path}/{key}"] = leaf
         np.savez(os.path.join(d, "infinity_state.npz"), **flat)
         with open(os.path.join(str(save_dir), "latest"), "w") as f:
             f.write(tag)
@@ -523,13 +589,28 @@ class ZeroInfinityEngine:
         with np.load(fname) as z:
             flat = {k: z[k] for k in z.files}
         self._host_opt.load_flat_state(flat)
-        if self._nvme:
-            off = self._config.zero_config.offload_param
-            self._masters_to_memmap(off.nvme_path)
-        self._host_params = self._host_opt.params_tree()
-        self._blocks = self._host_params["transformer"]["h"]["block"]
-        self._top = {k: v for k, v in self._host_params.items()
-                     if k != "transformer"}
+        if self._swap is not None:
+            # rebuild the per-kind stride files from the checkpoint and
+            # adopt the optimizer step for bias correction
+            import jax.tree_util as jtu
+
+            prefix = "state/transformer/h/block/"
+            for key in ("param", "exp_avg", "exp_avg_sq"):
+                leaves = {}
+                for k, v in flat.items():
+                    if k.startswith(prefix) and k.endswith("/" + key):
+                        leaves[k[len(prefix):-len(key) - 1]] = v
+                tree = jtu.tree_unflatten(
+                    self._swap.spec.treedef,
+                    [leaves[p] for p in self._swap.spec.paths])
+                self._swap.write_full(key, tree)
+            self._swap.step_count = int(flat["step"])
+            self._top = self._host_opt.params_tree()
+        else:
+            self._host_params = self._host_opt.params_tree()
+            self._blocks = self._host_params["transformer"]["h"]["block"]
+            self._top = {k: v for k, v in self._host_params.items()
+                         if k != "transformer"}
         self._top_dev = jax.device_put(self._top, self._device)
         self.global_steps = int(flat["global_steps"])
         self.global_samples = int(flat["global_samples"])

@@ -1,0 +1,334 @@
+"""aio-backed pipelined NVMe swapper for layer-streamed training.
+
+Capability parity with the reference swap-tensor engines:
+``runtime/swap_tensor/partitioned_param_swapper.py:35`` (async param
+swap with pinned staging buffers), ``partitioned_optimizer_swapper.py:27``
+(optimizer-state swap around the CPU-Adam update) and
+``pipelined_optimizer_swapper.py:55`` (read layer ``l+1`` / write layer
+``l-1`` while layer ``l`` updates).
+
+The round-2 NVMe tier was ``np.memmap``: synchronous page-fault reads in
+the middle of the H2D stream and unbounded dirty-page writeback. This
+module replaces it with explicit I/O on the C++ aio op
+(``csrc/aio/ds_aio.cpp``): per-kind flat files holding all layers at a
+4 KiB-aligned stride, a bounded pool of aligned host buffers (the pinned
+staging buffers of the reference), ``async_pread`` prefetch ahead of the
+compute stream, and ``async_pwrite`` writeback behind the optimizer
+sweep. Host RAM is bounded by ``num_buffers`` layer-strides per kind —
+never the whole parameter file.
+
+Layout: the scanned block pytree (every leaf ``[L, ...]``) flattens to a
+fixed leaf order; one layer's leaves concatenate into a flat fp32 record
+of ``layer_nbytes``, padded to the 4 KiB stride O_DIRECT wants.
+"""
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.ops.aio import AsyncIOHandle
+from deepspeed_tpu.utils.pytree import flatten_with_path_strings
+
+_ALIGN = 4096
+
+
+class LayerSpec:
+    """Fixed flat layout of one layer's leaves inside a stride record."""
+
+    def __init__(self, blocks_tree: Any):
+        import jax
+
+        flat, self.treedef = flatten_with_path_strings(blocks_tree)
+        self.paths: List[str] = [p for p, _ in flat]
+        leaves = [np.asarray(v) for _, v in flat]
+        L = leaves[0].shape[0]
+        assert all(a.shape[0] == L for a in leaves), (
+            "scanned block leaves must share the leading layer axis")
+        self.n_layers = int(L)
+        self.shapes: List[Tuple[int, ...]] = [a.shape[1:] for a in leaves]
+        self.sizes: List[int] = [int(np.prod(s)) for s in self.shapes]
+        self.offsets: List[int] = list(np.cumsum([0] + self.sizes[:-1]))
+        self.layer_size = int(sum(self.sizes))          # fp32 elements
+        self.layer_nbytes = self.layer_size * 4
+        self.stride = -(-self.layer_nbytes // _ALIGN) * _ALIGN
+
+    def views(self, buf: np.ndarray) -> Any:
+        """Pytree of leaf views into a flat fp32 buffer (no copies)."""
+        import jax
+
+        flat32 = buf.view(np.float32)
+        leaves = [flat32[o:o + n].reshape(s) for o, n, s in
+                  zip(self.offsets, self.sizes, self.shapes)]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def pack(self, layer_tree: Any, out: np.ndarray) -> None:
+        import jax
+
+        flat, _ = flatten_with_path_strings(layer_tree)
+        flat32 = out.view(np.float32)
+        for (path, leaf), o, n in zip(flat, self.offsets, self.sizes):
+            flat32[o:o + n] = np.asarray(leaf, np.float32).reshape(-1)
+
+
+class LayerFileStore:
+    """One on-disk file of ``n_layers`` stride records + a bounded pool of
+    aligned staging buffers with async read/write through the aio op.
+
+    Each buffer slot owns its own aio handle: the C++ ``wait`` drains a
+    whole handle, so per-slot handles are what make the waits *per-layer*
+    — ``get(l)`` waits only for ``l``'s read, never for the prefetch of
+    ``l+1`` issued moments earlier, and ``flush_writes`` only for slots
+    that actually have a write in flight.
+    """
+
+    _READING, _RESIDENT, _WRITING = "reading", "resident", "writing"
+
+    def __init__(self, filename: str, spec: LayerSpec,
+                 num_buffers: int = 3, aio: Optional[AsyncIOHandle] = None):
+        self.filename = filename
+        self.spec = spec
+        self._sync = aio or AsyncIOHandle(num_threads=2)  # bulk init/export
+        self._handles = [AsyncIOHandle(num_threads=1)
+                         for _ in range(num_buffers)]
+        self._buffers = [AsyncIOHandle.aligned_array(spec.stride)
+                         for _ in range(num_buffers)]
+        self._free: List[int] = list(range(num_buffers))
+        self._slot_of: Dict[int, int] = {}   # layer -> slot
+        self._state: Dict[int, str] = {}     # slot -> reading|resident|writing
+
+    # -- bulk init / export -------------------------------------------
+    def write_all(self, blocks_tree: Any) -> None:
+        """Synchronously persist a full ``[L, ...]`` tree (startup/restore)."""
+        import jax
+
+        spec = self.spec
+        # preallocate the file so positional writes are stable
+        with open(self.filename, "wb") as f:
+            f.truncate(spec.stride * spec.n_layers)
+        buf = AsyncIOHandle.aligned_array(spec.stride)
+        for l in range(spec.n_layers):
+            row = jax.tree_util.tree_map(lambda a: np.asarray(a)[l],
+                                         blocks_tree)
+            spec.pack(row, buf)
+            self._sync.sync_pwrite(buf, self.filename, l * spec.stride)
+
+    def read_layer_copy(self, l: int) -> Any:
+        """One layer as fresh RAM arrays (checkpoint export path)."""
+        import jax
+
+        buf = AsyncIOHandle.aligned_array(self.spec.stride)
+        self._sync.sync_pread(buf, self.filename, l * self.spec.stride)
+        return jax.tree_util.tree_map(np.array, self.spec.views(buf))
+
+    # -- streamed access ----------------------------------------------
+    def prefetch(self, l: int) -> None:
+        """Issue an async read of layer ``l`` if not already resident or
+        in flight. Requires a free buffer (callers release as they go)."""
+        if l in self._slot_of or not (0 <= l < self.spec.n_layers):
+            return
+        if not self._free:
+            raise RuntimeError(
+                "LayerFileStore: no free staging buffer for prefetch — "
+                "release() layers as the stream advances")
+        slot = self._free.pop()
+        self._handles[slot].async_pread(self._buffers[slot], self.filename,
+                                        l * self.spec.stride)
+        self._slot_of[l] = slot
+        self._state[slot] = self._READING
+
+    def get(self, l: int) -> Any:
+        """Layer ``l`` as a pytree of buffer views, waiting only for ``l``'s
+        own read (cold miss issues one)."""
+        if l not in self._slot_of:
+            self.prefetch(l)
+        slot = self._slot_of[l]
+        if self._state[slot] == self._READING:
+            self._handles[slot].wait()
+            self._state[slot] = self._RESIDENT
+        return self.spec.views(self._buffers[slot])
+
+    def flat(self, l: int) -> np.ndarray:
+        """Layer ``l``'s resident record as a flat fp32 view (the raw
+        operand the pipelined Adam kernel updates in place)."""
+        slot = self._slot_of[l]
+        assert self._state[slot] == self._RESIDENT, self._state[slot]
+        return self._buffers[slot].view(np.float32)[:self.spec.layer_size]
+
+    def release(self, l: int) -> None:
+        slot = self._slot_of.pop(l, None)
+        if slot is not None:
+            if self._state[slot] == self._WRITING:
+                self._handles[slot].wait()
+            del self._state[slot]
+            self._free.append(slot)
+
+    def write_back(self, l: int) -> None:
+        """Async write of layer ``l``'s (mutated) resident buffer; the
+        buffer stays owned by the layer until ``flush_writes``+``release``."""
+        slot = self._slot_of[l]
+        self._handles[slot].async_pwrite(self._buffers[slot], self.filename,
+                                         l * self.spec.stride)
+        self._state[slot] = self._WRITING
+
+    def flush_writes(self) -> None:
+        for slot, state in self._state.items():
+            if state == self._WRITING:
+                self._handles[slot].wait()
+                self._state[slot] = self._RESIDENT
+
+    @property
+    def _resident(self) -> Dict[int, int]:
+        """layer -> slot for resident/writing layers (introspection only)."""
+        return {l: s for l, s in self._slot_of.items()
+                if self._state[s] != self._READING}
+
+    @property
+    def _reading(self) -> Dict[int, int]:
+        return {l: s for l, s in self._slot_of.items()
+                if self._state[s] == self._READING}
+
+    @property
+    def _writes_pending(self) -> int:
+        return sum(1 for s in self._state.values() if s == self._WRITING)
+
+    def reset(self) -> None:
+        """Drop residency (e.g. after an external restore rewrote the file)."""
+        for slot, state in list(self._state.items()):
+            if state in (self._READING, self._WRITING):
+                self._handles[slot].wait()
+        self._slot_of.clear()
+        self._state.clear()
+        self._free = list(range(len(self._buffers)))
+
+
+class PipelinedOptimizerSwapper:
+    """Layer-pipelined CPU-Adam over NVMe-resident masters and moments
+    (reference ``pipelined_optimizer_swapper.py:55``).
+
+    Per layer ``l``: (param, m, v) records stream in ahead of the update,
+    the native ``ds_adam_step`` kernel runs on the staging buffers, and
+    the mutated records stream back out while layer ``l+1`` updates.
+    Host RAM: ``num_buffers`` strides per store — independent of depth.
+    """
+
+    def __init__(self, nvme_path: str, blocks_tree: Any,
+                 lr: float, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 num_buffers: int = 3):
+        from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam
+
+        os.makedirs(nvme_path, exist_ok=True)
+        self.spec = LayerSpec(blocks_tree)
+        self.stores = {
+            kind: LayerFileStore(
+                os.path.join(nvme_path, f"blocks.{kind}.bin"), self.spec,
+                num_buffers=num_buffers)
+            for kind in ("param", "exp_avg", "exp_avg_sq")}
+        # a private kernel instance provides the opt_id + hyperparams; its
+        # per-name state dict stays empty (slices come from the stores)
+        self._adam = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps,
+                                      weight_decay=weight_decay,
+                                      adamw_mode=adamw_mode)
+        self.step_count = 0
+
+        import jax
+
+        zeros = jax.tree_util.tree_map(
+            lambda a: np.zeros_like(np.asarray(a), dtype=np.float32),
+            blocks_tree)
+        self.stores["param"].write_all(blocks_tree)
+        self.stores["exp_avg"].write_all(zeros)
+        self.stores["exp_avg_sq"].write_all(zeros)
+
+    @property
+    def n_layers(self) -> int:
+        return self.spec.n_layers
+
+    # -- param streaming for the forward/backward compute stream -------
+    def prefetch_params(self, l: int) -> None:
+        self.stores["param"].prefetch(l)
+
+    def get_params(self, l: int) -> Any:
+        return self.stores["param"].get(l)
+
+    def release_params(self, l: int) -> None:
+        self.stores["param"].release(l)
+
+    # -- the pipelined update sweep ------------------------------------
+    def step(self, grads_blocks: Any, lr: float,
+             grad_scale: float = 1.0, clip_coef: float = 1.0) -> None:
+        """One Adam step over every layer.
+
+        ``grads_blocks``: ``[L, ...]`` fp32 grad tree (RAM-resident — the
+        accumulation buffer the backward stream fills). ``grad_scale``
+        multiplies grads (1/gas); ``clip_coef`` applies global-norm
+        clipping decided by the caller (the global norm needs every
+        layer's grads, which the caller already holds).
+        """
+        import ctypes
+        import jax
+
+        if lr != self._adam.lr:
+            self._adam.set_lr(lr)
+        self.step_count += 1
+        p_store = self.stores["param"]
+        m_store = self.stores["exp_avg"]
+        v_store = self.stores["exp_avg_sq"]
+        L = self.spec.n_layers
+        scale = float(grad_scale) * float(clip_coef)
+        lib = self._adam._lib
+        grad_buf = np.empty(self.spec.layer_size, np.float32)
+
+        stores = (p_store, m_store, v_store)
+        for st in stores:
+            st.prefetch(0)
+        for l in range(L):
+            if l + 1 < L:
+                # read of l+1 overlaps this layer's kernel (per-slot waits:
+                # get(l) below never drains these just-issued reads)
+                for st in stores:
+                    st.prefetch(l + 1)
+            for st in stores:
+                st.get(l)  # wait for l's own read (per-slot)
+            pbuf, mbuf, vbuf = (st.flat(l) for st in stores)
+            row = jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[l], grads_blocks)
+            self.spec.pack(row, grad_buf.view(np.uint8))
+            if scale != 1.0:
+                grad_buf *= scale
+            fptr = ctypes.POINTER(ctypes.c_float)
+            rc = lib.ds_adam_step(
+                self._adam.opt_id, self.step_count, self.spec.layer_size,
+                pbuf.ctypes.data_as(fptr),
+                grad_buf.ctypes.data_as(fptr),
+                mbuf.ctypes.data_as(fptr),
+                vbuf.ctypes.data_as(fptr))
+            if rc != 0:
+                raise RuntimeError(f"pipelined cpu_adam failed at layer {l}")
+            if l > 0:
+                # l-1's writes flew during this layer's kernel; drain them
+                # BEFORE issuing l's writes so the wait never touches l,
+                # then free the slots for the l+2 prefetch next iteration
+                for st in stores:
+                    st.flush_writes()
+                    st.release(l - 1)
+            for st in stores:
+                st.write_back(l)  # overlaps layer l+1's kernel
+        for st in stores:
+            st.flush_writes()
+            st.release(L - 1)
+
+    # -- checkpoint surface -------------------------------------------
+    def read_full(self, kind: str) -> Any:
+        """Assemble the full ``[L, ...]`` tree from disk (checkpoint
+        export; transiently allocates the full tree in RAM)."""
+        import jax
+
+        rows = [self.stores[kind].read_layer_copy(l)
+                for l in range(self.spec.n_layers)]
+        return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *rows)
+
+    def write_full(self, kind: str, tree: Any) -> None:
+        self.stores[kind].write_all(tree)
+        self.stores[kind].reset()

@@ -1,0 +1,201 @@
+"""aio-backed pipelined NVMe swapper tests (``runtime/zero/swapper.py``).
+
+Reference capabilities verified: async param swap with bounded staging
+buffers (``partitioned_param_swapper.py:35``), optimizer-state swap
+around CPU-Adam (``partitioned_optimizer_swapper.py:27``), pipelined
+read/update/write overlap (``pipelined_optimizer_swapper.py:55``).
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.zero.swapper import (LayerFileStore, LayerSpec,
+                                                PipelinedOptimizerSwapper)
+
+L, D = 4, 64
+
+
+def _blocks(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "attn": {"w": rng.normal(size=(L, D, D)).astype(np.float32),
+                 "b": rng.normal(size=(L, D)).astype(np.float32)},
+        "mlp": {"w": rng.normal(size=(L, D, 2 * D)).astype(np.float32)},
+    }
+
+
+class TestLayerSpec:
+    def test_layout_and_views_roundtrip(self):
+        blocks = _blocks()
+        spec = LayerSpec(blocks)
+        assert spec.n_layers == L
+        assert spec.layer_size == D * D + D + D * 2 * D
+        assert spec.stride % 4096 == 0 and spec.stride >= spec.layer_nbytes
+        buf = np.zeros(spec.stride, np.uint8)
+        row = {"attn": {"w": blocks["attn"]["w"][2],
+                        "b": blocks["attn"]["b"][2]},
+               "mlp": {"w": blocks["mlp"]["w"][2]}}
+        spec.pack(row, buf)
+        views = spec.views(buf)
+        np.testing.assert_array_equal(views["attn"]["w"], row["attn"]["w"])
+        np.testing.assert_array_equal(views["mlp"]["w"], row["mlp"]["w"])
+
+
+class TestLayerFileStore:
+    def test_write_all_read_back(self, tmp_path):
+        blocks = _blocks()
+        spec = LayerSpec(blocks)
+        store = LayerFileStore(str(tmp_path / "p.bin"), spec, num_buffers=2)
+        store.write_all(blocks)
+        for l in (0, 3, 1):
+            row = store.read_layer_copy(l)
+            np.testing.assert_array_equal(row["attn"]["w"],
+                                          blocks["attn"]["w"][l])
+
+    def test_prefetch_get_release_pool_bounded(self, tmp_path):
+        blocks = _blocks()
+        spec = LayerSpec(blocks)
+        store = LayerFileStore(str(tmp_path / "p.bin"), spec, num_buffers=2)
+        store.write_all(blocks)
+        store.prefetch(0)
+        v0 = store.get(0)
+        np.testing.assert_array_equal(v0["attn"]["b"], blocks["attn"]["b"][0])
+        store.prefetch(1)
+        v1 = store.get(1)
+        np.testing.assert_array_equal(v1["mlp"]["w"], blocks["mlp"]["w"][1])
+        # pool exhausted: prefetching a third layer without release raises
+        with pytest.raises(RuntimeError, match="free staging buffer"):
+            store.prefetch(2)
+        store.release(0)
+        store.prefetch(2)  # now fits
+        v2 = store.get(2)
+        np.testing.assert_array_equal(v2["attn"]["w"], blocks["attn"]["w"][2])
+
+    def test_write_back_persists(self, tmp_path):
+        blocks = _blocks()
+        spec = LayerSpec(blocks)
+        store = LayerFileStore(str(tmp_path / "p.bin"), spec, num_buffers=2)
+        store.write_all(blocks)
+        views = store.get(1)
+        views["attn"]["w"][:] = 7.5
+        store.write_back(1)
+        store.flush_writes()
+        store.release(1)
+        row = store.read_layer_copy(1)
+        assert np.all(row["attn"]["w"] == 7.5)
+        # neighbors untouched
+        np.testing.assert_array_equal(
+            store.read_layer_copy(0)["attn"]["w"], blocks["attn"]["w"][0])
+
+
+def _ref_adam(params, grads, m, v, step, lr, beta1=0.9, beta2=0.999,
+              eps=1e-8, wd=0.0):
+    """Plain numpy AdamW for trajectory comparison."""
+    m = beta1 * m + (1 - beta1) * grads
+    v = beta2 * v + (1 - beta2) * grads * grads
+    mh = m / (1 - beta1 ** step)
+    vh = v / (1 - beta2 ** step)
+    params = params * (1 - lr * wd) - lr * mh / (np.sqrt(vh) + eps)
+    return params, m, v
+
+
+class TestPipelinedOptimizerSwapper:
+    def test_step_matches_reference_adam(self, tmp_path):
+        blocks = _blocks()
+        sw = PipelinedOptimizerSwapper(str(tmp_path), blocks, lr=1e-2,
+                                       weight_decay=0.01, num_buffers=3)
+        rng = np.random.default_rng(1)
+        grads = {k: {kk: rng.normal(size=vv.shape).astype(np.float32)
+                     for kk, vv in sub.items()}
+                 for k, sub in blocks.items()}
+        sw.step(grads, lr=1e-2)
+        sw.step(grads, lr=1e-2)
+
+        p = blocks["attn"]["w"].copy()
+        m = np.zeros_like(p)
+        v = np.zeros_like(p)
+        for step in (1, 2):
+            p, m, v = _ref_adam(p, grads["attn"]["w"], m, v, step,
+                                lr=1e-2, wd=0.01)
+        got = sw.read_full("param")["attn"]["w"]
+        np.testing.assert_allclose(got, p, rtol=2e-5, atol=2e-6)
+        got_m = sw.read_full("exp_avg")["attn"]["w"]
+        np.testing.assert_allclose(got_m, m, rtol=2e-5, atol=2e-6)
+
+    def test_grad_scale_and_clip(self, tmp_path):
+        blocks = _blocks()
+        sw = PipelinedOptimizerSwapper(str(tmp_path), blocks, lr=1e-2)
+        grads = {k: {kk: np.full(vv.shape, 2.0, np.float32)
+                     for kk, vv in sub.items()}
+                 for k, sub in blocks.items()}
+        sw.step(grads, lr=1e-2, grad_scale=0.25)  # == grads of 0.5
+        p = blocks["attn"]["b"].copy()
+        m = np.zeros_like(p)
+        v = np.zeros_like(p)
+        p, m, v = _ref_adam(p, np.full_like(p, 0.5), m, v, 1, lr=1e-2)
+        np.testing.assert_allclose(sw.read_full("param")["attn"]["b"], p,
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_checkpoint_write_full_roundtrip(self, tmp_path):
+        blocks = _blocks()
+        sw = PipelinedOptimizerSwapper(str(tmp_path), blocks, lr=1e-2)
+        new = _blocks(seed=9)
+        sw.write_full("param", new)
+        got = sw.read_full("param")
+        np.testing.assert_array_equal(got["attn"]["w"], new["attn"]["w"])
+        # streamed access sees the rewritten data too
+        sw.prefetch_params(2)
+        views = sw.get_params(2)
+        np.testing.assert_array_equal(views["mlp"]["w"], new["mlp"]["w"][2])
+        sw.release_params(2)
+
+
+class TestBoundedResidency:
+    def test_streamed_training_keeps_masters_off_host(self, tmp_path):
+        """NVMe-tier training: masters+moments (3x model) live on disk; RAM
+        holds only the staging pool + grad accumulator. After every
+        forward/backward/step the pool must be fully released (no leaked
+        residency) and the pool bytes must be a small fraction of what the
+        round-2 memmap design kept page-faulting through."""
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+        from deepspeed_tpu.runtime.zero.infinity import ZeroInfinityEngine
+
+        cfg = GPT2Config(vocab_size=512, n_positions=64, n_embd=128,
+                         n_layer=8, n_head=4, dtype=jnp.float32,
+                         scan_layers=True)
+        import deepspeed_tpu
+
+        engine, *_ = deepspeed_tpu.initialize(
+            model=GPT2ForTraining(cfg),
+            config={"train_batch_size": 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "gradient_clipping": 1.0,
+                    "zero_optimization": {
+                        "stage": 3,
+                        "offload_param": {"device": "nvme",
+                                          "nvme_path": str(tmp_path)},
+                        "offload_optimizer": {"device": "nvme",
+                                              "nvme_path": str(tmp_path)}},
+                    "steps_per_print": 10_000})
+        assert isinstance(engine, ZeroInfinityEngine)
+        sw = engine._swap
+        master_bytes = 3 * sw.spec.layer_nbytes * sw.spec.n_layers  # p+m+v
+        pool_bytes = sum(len(st._buffers) * sw.spec.stride
+                         for st in sw.stores.values())
+        assert pool_bytes < 0.5 * master_bytes, (pool_bytes, master_bytes)
+
+        ids = np.random.default_rng(0).integers(0, 512, (2, 32)).astype(np.int32)
+        losses = []
+        for _ in range(3):
+            loss = engine({"input_ids": ids})
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+            for st in sw.stores.values():
+                assert not st._resident and not st._reading, (
+                    "staging buffers leaked residency across the step")
+                assert st._writes_pending == 0
+                assert len(st._free) == len(st._buffers)
+        assert losses[-1] < losses[0], losses

@@ -18,10 +18,13 @@ import time
 
 import numpy as np
 
-from deepspeed_tpu.utils.chip_probe import (assert_platform, require_backend,
-                                            resolve_metric, run_guarded)
+from deepspeed_tpu.utils.chip_probe import (assert_platform, is_tpu,
+                                            require_backend, resolve_metric,
+                                            run_guarded)
 
-METRIC = resolve_metric("gpt2_125m_decode", "gpt2_decode_cpu_smoke")
+HEADLINE = "gpt2_125m_decode"
+SMOKE = "gpt2_decode_cpu_smoke"
+METRIC = resolve_metric(HEADLINE, SMOKE)
 
 
 def main():
@@ -34,7 +37,8 @@ def main():
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 
     assert_platform(METRIC, platform)
-    on_tpu = platform == "tpu"
+    on_tpu = is_tpu(platform)
+    metric = HEADLINE if on_tpu else SMOKE
     if on_tpu:
         cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=768,
                          n_layer=12, n_head=12, dtype=jnp.bfloat16,
@@ -80,7 +84,7 @@ def main():
     tokens_per_sec = batch / per_token_s
 
     print(json.dumps({
-        "metric": METRIC,
+        "metric": metric,
         "ttft_ms_p50": round(ttft_p50, 2),
         "decode_tokens_per_sec": round(tokens_per_sec, 1),
         "per_token_ms": round(per_token_ms, 3),

@@ -193,3 +193,101 @@ class SparseAttentionUtils:
             return pe[:max_position]
         reps = -(-max_position // orig)
         return jnp.tile(pe, (reps, 1))[:max_position]
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            model, max_position, sparsity_config=None, params=None):
+        """Patch a model to block-sparse self-attention + a longer position
+        window (reference ``sparse_attention_utils.py``
+        ``replace_model_self_attention_with_sparse_self_attention``).
+
+        The reference mutates torch submodules in place; flax modules are
+        config-derived, so the TPU-native patch rebuilds the model with
+        ``sparse_attention`` set on its config (the encoder then routes
+        through the layout zoo + Pallas kernel) and retiles the learned
+        position table in the params tree. Supports any model family whose
+        config carries a ``sparse_attention`` field (the BERT family today
+        — same coverage as the reference's bert/roberta; extend a model by
+        adding the config field and routing its attention like
+        ``models/bert.py`` ``BertSelfAttention``).
+
+        Arguments:
+            model: a config-carrying model (e.g. ``BertForTraining``,
+                ``BertModel``, ``BertForMaskedLM``).
+            max_position: new position-embedding window (sequence budget).
+            sparsity_config: config-section dict (``{"mode": "bigbird",
+                "block": 16, ...}``) or a ``SparsityConfig`` instance.
+                Default: fixed mode.
+            params: optional params pytree; its position table is retiled
+                to ``max_position``.
+
+        Returns ``(patched_model, patched_params)`` (``patched_params`` is
+        None when ``params`` was not given).
+        """
+        import dataclasses
+
+        cfg = getattr(model, "config", None)
+        if cfg is None or not dataclasses.is_dataclass(cfg) or not any(
+                f.name == "sparse_attention"
+                for f in dataclasses.fields(cfg)):
+            raise ValueError(
+                "model's config has no sparse_attention field; supported "
+                "today: the BERT family (models/bert.py). To extend: add a "
+                "sparse_attention config field and route the model's "
+                "attention through SparseSelfAttention like "
+                "BertSelfAttention does")
+        if sparsity_config is None:
+            sparsity_config = {"mode": "fixed"}
+        if not isinstance(sparsity_config, dict):
+            # a SparsityConfig instance → its constructor-arg dict: only
+            # the __init__ parameters round-trip (vars() also carries
+            # derived attributes that the registry constructor rejects)
+            import inspect
+
+            from deepspeed_tpu.ops.sparse_attention import sparsity_config \
+                as sc_mod
+
+            modes = {sc_mod.DenseSparsityConfig: "dense",
+                     sc_mod.FixedSparsityConfig: "fixed",
+                     sc_mod.VariableSparsityConfig: "variable",
+                     sc_mod.BigBirdSparsityConfig: "bigbird",
+                     sc_mod.BSLongformerSparsityConfig: "bslongformer",
+                     sc_mod.LocalSlidingWindowSparsityConfig: "local"}
+            cls = type(sparsity_config)
+            if cls not in modes:
+                raise ValueError(
+                    f"unsupported sparsity_config type {cls.__name__}; pass "
+                    "a config-section dict or one of the registry classes "
+                    f"({sorted(m.__name__ for m in modes)})")
+            attrs = vars(sparsity_config)
+            init_params = [
+                p for p in inspect.signature(cls.__init__).parameters
+                if p not in ("self", "num_heads")]
+            sparsity_config = {"mode": modes[cls],
+                               **{p: attrs[p] for p in init_params
+                                  if p in attrs}}
+        new_cfg = dataclasses.replace(
+            cfg, sparse_attention=dict(sparsity_config),
+            max_position_embeddings=int(max_position))
+        if hasattr(model, "clone"):
+            patched = model.clone(config=new_cfg)  # flax Module
+        else:
+            patched = type(model)(new_cfg)  # plain wrapper (BertForTraining)
+        new_params = None
+        if params is not None:
+            import jax
+
+            flat = jax.tree_util.tree_flatten_with_path(params)
+            paths, leaves = zip(*flat[0]) if flat[0] else ((), ())
+
+            def fix(path, leaf):
+                names = [getattr(k, "key", getattr(k, "name", ""))
+                         for k in path]
+                if any("position_embedding" in str(n) for n in names):
+                    return SparseAttentionUtils.extend_position_embedding(
+                        leaf, int(max_position))
+                return leaf
+
+            new_params = jax.tree_util.tree_unflatten(
+                flat[1], [fix(p, l) for p, l in zip(paths, leaves)])
+        return patched, new_params

@@ -37,6 +37,12 @@ _PROBE_SRC = (
 )
 
 
+def is_tpu(platform: str) -> bool:
+    """True for the real chip — directly ("tpu") or via the tunnel's
+    "axon" platform name (which canonicalizes to tpu)."""
+    return platform in ("tpu", "axon")
+
+
 def cpu_requested() -> bool:
     """True when the operator *explicitly* asked for CPU via JAX_PLATFORMS
     (smoke-run mode). Distinguishes an intentional CPU run from a silent

@@ -169,3 +169,83 @@ class TestUtils:
         ext = SparseAttentionUtils.extend_position_embedding(pe, 20)
         assert ext.shape == (20, 4)
         np.testing.assert_array_equal(ext[8:16], pe)
+
+
+class TestModelPatcher:
+    """replace_model_self_attention_with_sparse_self_attention (reference
+    sparse_attention_utils.py:85): patch a dense model to block-sparse
+    attention + an extended position window."""
+
+    def _bert(self, **kw):
+        from deepspeed_tpu.models.bert import BertConfig, BertForTraining
+
+        return BertForTraining(BertConfig.tiny(dtype=jnp.float32, **kw))
+
+    def test_dense_mode_patch_preserves_logits(self):
+        model = self._bert()
+        ids = np.random.default_rng(0).integers(4, 250, (2, 32)).astype(np.int32)
+        params = model.model.init(jax.random.PRNGKey(0), ids)["params"]
+        logits_before = model.model.apply({"params": params}, ids)
+        patched, p2 = (SparseAttentionUtils
+                       .replace_model_self_attention_with_sparse_self_attention(
+                           model, max_position=64,
+                           sparsity_config={"mode": "dense"}, params=params))
+        assert patched.config.max_position_embeddings == 64
+        logits_after = patched.model.apply({"params": p2}, ids)
+        np.testing.assert_allclose(np.asarray(logits_before),
+                                   np.asarray(logits_after),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_bigbird_patch_runs_beyond_original_window(self):
+        model = self._bert(max_position_embeddings=32)
+        ids_short = np.random.default_rng(0).integers(4, 250, (2, 32)).astype(np.int32)
+        params = model.model.init(jax.random.PRNGKey(0), ids_short)["params"]
+        patched, p2 = (SparseAttentionUtils
+                       .replace_model_self_attention_with_sparse_self_attention(
+                           model, max_position=128,
+                           sparsity_config={"mode": "bigbird", "block": 16,
+                                            "num_random_blocks": 1,
+                                            "num_sliding_window_blocks": 3,
+                                            "num_global_blocks": 1},
+                           params=params))
+        # position table was retiled to the new window
+        pe = p2["model"]["position_embeddings"] if "model" in p2 else None
+        if pe is None:
+            import jax.tree_util as jtu
+
+            pe = [l for path, l in jtu.tree_flatten_with_path(p2)[0]
+                  if any("position_embedding" in str(getattr(k, 'key', ''))
+                         for k in path)][0]
+        assert pe.shape[0] == 128
+        # a 4x-longer sequence than the original window now runs
+        ids_long = np.random.default_rng(1).integers(4, 250, (2, 128)).astype(np.int32)
+        logits = patched.model.apply({"params": p2}, ids_long)
+        assert np.isfinite(np.asarray(logits)).all()
+        assert logits.shape[:2] == (2, 128)
+
+    def test_unsupported_model_raises(self):
+        class _NoCfg:
+            pass
+
+        with pytest.raises(ValueError, match="sparse_attention field"):
+            (SparseAttentionUtils
+             .replace_model_self_attention_with_sparse_self_attention(
+                 _NoCfg(), max_position=64))
+
+    def test_sparsity_config_instance_input(self):
+        """A SparsityConfig *instance* (the reference's default input form)
+        must convert to a valid config dict — only __init__ params survive,
+        derived attrs (num_layout_heads) must not leak through."""
+        from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+            FixedSparsityConfig)
+
+        model = self._bert()
+        ids = np.random.default_rng(0).integers(4, 250, (1, 32)).astype(np.int32)
+        patched, _ = (SparseAttentionUtils
+                      .replace_model_self_attention_with_sparse_self_attention(
+                          model, max_position=64,
+                          sparsity_config=FixedSparsityConfig(
+                              num_heads=4, block=16)))
+        params = patched.model.init(jax.random.PRNGKey(0), ids)["params"]
+        out = patched.model.apply({"params": params}, ids)  # no TypeError
+        assert np.isfinite(np.asarray(out)).all()

@@ -45,4 +45,5 @@ echo "== 3. headline benches (record outputs in PERF.md) =="
 timeout 900 python bench.py
 timeout 900 python bench_decode.py
 timeout 900 python bench_bert.py
+timeout 900 python bench_sparse.py
 echo "== backlog complete: update PERF.md with the three JSON lines =="

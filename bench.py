@@ -17,11 +17,11 @@ from deepspeed_tpu.utils.chip_probe import (assert_platform, is_tpu,
                                             run_guarded)
 
 # smoke-metric name under explicit JAX_PLATFORMS=cpu so a CPU run (or its
-# failure) can never be misfiled into the TPU headline series; the success
-# path re-resolves against the platform the probe actually saw
-HEADLINE = "gpt2_125m_train_tokens_per_sec_per_chip"
-SMOKE = "gpt2_tiny_cpu_smoke_tokens_per_sec"
-METRIC = resolve_metric(HEADLINE, SMOKE)
+# failure) can never be misfiled into the TPU headline series; any OTHER
+# non-TPU platform is rejected by require_backend, so this resolution is
+# total
+METRIC = resolve_metric("gpt2_125m_train_tokens_per_sec_per_chip",
+                        "gpt2_tiny_cpu_smoke_tokens_per_sec")
 
 
 def load_autotuned():
@@ -81,7 +81,6 @@ def main():
 
     assert_platform(METRIC, platform)
     on_tpu = is_tpu(platform)
-    metric = HEADLINE if on_tpu else SMOKE
     tuned = load_autotuned() if on_tpu else None
     if on_tpu:
         # tuned: selective ("dots") remat keeps matmul + flash-attention
@@ -160,7 +159,7 @@ def main():
     # peak + formula inline so the driver capture is self-auditing (no
     # PERF.md cross-reference needed to re-derive the MFU arithmetic)
     print(json.dumps({
-        "metric": metric,
+        "metric": METRIC,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),

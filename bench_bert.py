@@ -18,9 +18,8 @@ from deepspeed_tpu.utils.chip_probe import (assert_platform, is_tpu,
                                             run_guarded)
 
 REF_TFLOPS = 64.0  # docs/_posts/2020-05-28-fastest-bert-training.md:37
-HEADLINE = "bert_large_mlm_tflops_per_chip"
-SMOKE = "bert_tiny_cpu_smoke_tflops"
-METRIC = resolve_metric(HEADLINE, SMOKE)
+METRIC = resolve_metric("bert_large_mlm_tflops_per_chip",
+                        "bert_tiny_cpu_smoke_tflops")
 
 
 def main():
@@ -34,7 +33,6 @@ def main():
 
     assert_platform(METRIC, platform)
     on_tpu = is_tpu(platform)
-    metric = HEADLINE if on_tpu else SMOKE
     if on_tpu:
         cfg = BertConfig.bert_large(dtype=jnp.bfloat16, remat=True,
                                     remat_policy="dots",
@@ -89,7 +87,7 @@ def main():
                        + 12 * cfg.num_hidden_layers * seq * cfg.hidden_size)
     tflops = samples_per_sec * seq * flops_per_token / 1e12
     print(json.dumps({
-        "metric": metric,
+        "metric": METRIC,
         "value": round(tflops, 2),
         "unit": "TFLOP/s",
         "vs_baseline": round(tflops / REF_TFLOPS, 4),

@@ -22,9 +22,7 @@ from deepspeed_tpu.utils.chip_probe import (assert_platform, is_tpu,
                                             require_backend, resolve_metric,
                                             run_guarded)
 
-HEADLINE = "gpt2_125m_decode"
-SMOKE = "gpt2_decode_cpu_smoke"
-METRIC = resolve_metric(HEADLINE, SMOKE)
+METRIC = resolve_metric("gpt2_125m_decode", "gpt2_decode_cpu_smoke")
 
 
 def main():
@@ -38,7 +36,6 @@ def main():
 
     assert_platform(METRIC, platform)
     on_tpu = is_tpu(platform)
-    metric = HEADLINE if on_tpu else SMOKE
     if on_tpu:
         cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=768,
                          n_layer=12, n_head=12, dtype=jnp.bfloat16,
@@ -84,7 +81,7 @@ def main():
     tokens_per_sec = batch / per_token_s
 
     print(json.dumps({
-        "metric": metric,
+        "metric": METRIC,
         "ttft_ms_p50": round(ttft_p50, 2),
         "decode_tokens_per_sec": round(tokens_per_sec, 1),
         "per_token_ms": round(per_token_ms, 3),

@@ -14,50 +14,21 @@ overhead of the tunnel (same as tools/perf_sparse.py).
 """
 
 import json
-import time
 
 import numpy as np
 
 from deepspeed_tpu.utils.chip_probe import (assert_platform, is_tpu,
                                             require_backend, resolve_metric,
                                             run_guarded)
+from deepspeed_tpu.utils.marginal_bench import marginal_cost_ms
 
-HEADLINE = "sparse_attention_longseq_speedup"
-SMOKE = "sparse_longseq_cpu_smoke"
-METRIC = resolve_metric(HEADLINE, SMOKE)
+METRIC = resolve_metric("sparse_attention_longseq_speedup",
+                        "sparse_longseq_cpu_smoke")
 REF_SPEEDUP = 6.3  # docs/_posts/2020-09-09-sparse-attention.md:30
 
 
 def _bench(fn, q, k, v, iters):
-    import jax
-    import jax.numpy as jnp
-
-    def chained(n):
-        def f(q, k, v):
-            def body(qc, _):
-                out = fn(qc, k, v)
-                leaves = jax.tree_util.tree_leaves(out)
-                bump = jnp.max(jnp.abs(
-                    leaves[0][0, 0, 0, :2].astype(jnp.float32)))
-                return qc * (1.0 + 0.0 * bump).astype(qc.dtype), ()
-
-            qf, _ = jax.lax.scan(body, q, None, length=n)
-            return qf[0, 0, 0, :2]
-
-        return jax.jit(f)
-
-    def timed(run):
-        np.asarray(jax.device_get(run(q, k, v)))  # compile + warm
-        best = float("inf")
-        for _ in range(4):
-            t0 = time.perf_counter()
-            np.asarray(jax.device_get(run(q, k, v)))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    t_n = timed(chained(iters))
-    t_1 = timed(chained(1))
-    return 1e3 * max(1e-9, t_n - t_1) / (iters - 1)
+    return marginal_cost_ms(fn, q, k, v, iters=iters, repeats=4)
 
 
 def main():
@@ -74,7 +45,6 @@ def main():
 
     assert_platform(METRIC, platform)
     on_tpu = is_tpu(platform)
-    metric = HEADLINE if on_tpu else SMOKE
     if on_tpu:
         B, H, D, BLOCK = 1, 12, 64, 256
         seqs, iters = (8192, 16384), 8
@@ -131,7 +101,7 @@ def main():
         best_fwdbwd = max(best_fwdbwd, t_fb / t_sb)
 
     print(json.dumps({
-        "metric": metric,
+        "metric": METRIC,
         "value": round(best_fwdbwd, 2),
         "unit": "x_vs_dense_flash",
         "vs_baseline": round(best_fwdbwd / REF_SPEEDUP, 4),

@@ -260,9 +260,25 @@ class SparseAttentionUtils:
                     "a config-section dict or one of the registry classes "
                     f"({sorted(m.__name__ for m in modes)})")
             attrs = vars(sparsity_config)
+            rng = attrs.get("_rng")
+            if rng is not None:
+                import numpy as _np
+
+                default_state = _np.random.default_rng(0).bit_generator.state
+                if rng.bit_generator.state != default_state:
+                    # a Generator can't ride the frozen (hashable) model
+                    # config; silently redrawing the random layout from the
+                    # default seed would diverge from the instance the user
+                    # validated — fail loudly instead
+                    raise ValueError(
+                        "sparsity_config instances with a custom rng cannot "
+                        "be carried through the model config (the layout "
+                        "would be redrawn from the default seed); pass a "
+                        "config dict and rely on the default deterministic "
+                        "rng, or patch before drawing from the generator")
             init_params = [
                 p for p in inspect.signature(cls.__init__).parameters
-                if p not in ("self", "num_heads")]
+                if p not in ("self", "num_heads", "rng")]
             sparsity_config = {"mode": modes[cls],
                                **{p: attrs[p] for p in init_params
                                   if p in attrs}}

@@ -28,37 +28,11 @@ BLOCK = 256
 
 
 def bench(fn, *args, iters=16):
-    """Marginal in-program cost: chain N dependent evaluations inside one
-    compiled program and report (T(N) - T(1)) / (N - 1). Cancels the
-    per-program dispatch/transfer overhead of the axon tunnel AND its
-    cross-dispatch noise (min over repeats: the chip is time-shared)."""
+    """Marginal in-program cost (shared methodology:
+    ``deepspeed_tpu/utils/marginal_bench.py``)."""
+    from deepspeed_tpu.utils.marginal_bench import marginal_cost_ms
 
-    def chained(n):
-        def f(q, k, v):
-            def body(qc, _):
-                out = fn(qc, k, v)
-                leaves = jax.tree_util.tree_leaves(out)
-                bump = jnp.max(jnp.abs(
-                    leaves[0][0, 0, 0, :2].astype(jnp.float32)))
-                return qc * (1.0 + 0.0 * bump).astype(qc.dtype), ()
-
-            qf, _ = jax.lax.scan(body, q, None, length=n)
-            return qf[0, 0, 0, :2]  # tiny transfer
-
-        return jax.jit(f)
-
-    def timed(run):
-        np.asarray(jax.device_get(run(*args)))  # compile + warm
-        best = float("inf")
-        for _ in range(5):
-            t0 = time.perf_counter()
-            np.asarray(jax.device_get(run(*args)))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    t_n = timed(chained(iters))
-    t_1 = timed(chained(1))
-    return 1e3 * (t_n - t_1) / (iters - 1)
+    return marginal_cost_ms(fn, *args, iters=iters)
 
 
 def main():

@@ -46,4 +46,4 @@ timeout 900 python bench.py
 timeout 900 python bench_decode.py
 timeout 900 python bench_bert.py
 timeout 900 python bench_sparse.py
-echo "== backlog complete: update PERF.md with the three JSON lines =="
+echo "== backlog complete: update PERF.md with the four JSON lines =="

@@ -38,6 +38,41 @@ from deepspeed_tpu.runtime.zero.partition import replicated
 from deepspeed_tpu.utils.logging import log_dist
 
 
+def _cond_skip(pred, fn, false_val, operands):
+    """``lax.cond(pred, fn(operands), false_val)`` with an opaque VJP.
+
+    With rng primitives inside one branch only, ``lax.scan``'s partial
+    evaluation of the cond asserts on asymmetric branch residuals
+    (``jax/_src/lax/control_flow/conditionals.py:619``). Hiding the cond
+    behind a ``custom_vjp`` keeps it atomic to the scan: the backward pass
+    re-linearizes the cond from its saved inputs — the same
+    recompute-per-tick memory profile the tick remat already imposes —
+    while the forward still executes only the taken branch (the
+    FLOP-skipping the reference's per-stage instruction dispatch gets for
+    free). ``fn`` must take ALL traced values through ``operands`` (a
+    closure over tracers would leak through the custom_vjp boundary).
+    """
+
+    @jax.custom_vjp
+    def run(pred, false_val, operands):
+        return jax.lax.cond(pred, lambda: fn(operands), lambda: false_val)
+
+    def fwd(pred, false_val, operands):
+        return run(pred, false_val, operands), (pred, false_val, operands)
+
+    def bwd(res, g):
+        pred_, false_val_, operands_ = res
+        _, vjp_fn = jax.vjp(
+            lambda fv, ops: jax.lax.cond(
+                pred_, lambda: fn(ops), lambda: fv),
+            false_val_, operands_)
+        d_fv, d_ops = vjp_fn(g)
+        return (None, d_fv, d_ops)
+
+    run.defvjp(fwd, bwd)
+    return run(pred, false_val, operands)
+
+
 def pipeline_loss_fn(module: PipelineModule, mesh, n_micro: int):
     """Build ``loss(params, (inputs, labels), rng) -> mean loss`` running the
     pipelined schedule over ``n_micro`` micro-batches.
@@ -48,62 +83,100 @@ def pipeline_loss_fn(module: PipelineModule, mesh, n_micro: int):
     n_stages = mesh.shape[AXIS_PIPE]
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     use_rngs = module.use_rngs
+    # micro-batches live SHARDED over the pipe axis (stage s holds the
+    # strided chunk {s, s+P, s+2P, ...} — M/P per stage, not M replicated
+    # copies); each tick the owner stage publishes one micro-batch to the
+    # ring via a where+psum select. Reference analog: LoadMicroBatch only
+    # ever materializes data on stage 0 (pipe/engine.py:785).
+    n_chunk = -(-n_micro // n_stages)
+    n_pad = n_chunk * n_stages
 
     def body(params, inputs, labels, rng):
         stage = jax.lax.axis_index(AXIS_PIPE)
         extras = {"pre": params["pre"], "post": params["post"],
                   "tied": params["tied"]}
         blocks = params["blocks"]  # local view: [L/P, ...]
+        # local strided chunks: [1, Mc, mb, ...] -> [Mc, mb, ...]
+        inputs = jax.tree_util.tree_map(lambda a: a[0], inputs)
+        labels = jax.tree_util.tree_map(lambda a: a[0], labels)
 
-        def stage_rngs(t):
-            if not use_rngs:
-                return None
-            k = jax.random.fold_in(jax.random.fold_in(rng, t), stage)
-            return {"dropout": k}
+        def fetch(chunk, idx, owner):
+            """Micro-batch ``idx`` (held by ``owner``'s chunk) delivered to
+            every stage: owner publishes, psum routes. Transient — nothing
+            [M]-sized is ever resident per stage."""
+            if n_stages == 1:  # single stage owns everything; psum over a
+                # size-1 manual axis trips the SPMD partitioner
+                return jax.tree_util.tree_map(
+                    lambda a: a[jnp.clip(idx, 0, n_chunk - 1)], chunk)
+
+            def sel(a):
+                row = a[jnp.clip(idx, 0, n_chunk - 1)]
+                keep = (stage == owner).astype(a.dtype)
+                shaped = keep.reshape((1,) * row.ndim)
+                return jax.lax.psum(row * shaped, AXIS_PIPE)
+
+            return jax.tree_util.tree_map(sel, chunk)
 
         def run_blocks(x, t):
             def blk(x, bp):
-                return module.block_apply(bp, x, rngs=stage_rngs(t)), None
+                return module.block_apply(bp, x,
+                                          rngs=rngs_of(t, stage, rng)), None
 
             x, _ = jax.lax.scan(blk, x, blocks)
             return x
 
-        mb0 = jax.tree_util.tree_map(lambda a: a[0], inputs)
+        mb0 = jax.tree_util.tree_map(lambda a: a[0], inputs)  # local shape
         act_shape = jax.eval_shape(
             lambda p, b: module.pre_apply(p, b), extras, mb0)
         zero_act = jnp.zeros(act_shape.shape, act_shape.dtype)
 
-        def stage_select(pred, true_fn, false_val):
-            # lax.cond skips the untaken branch's FLOPs (embedding/head run
-            # only on their stage). With dropout rngs active, grad-of-cond
-            # under remat trips a JAX partial-eval assertion (mismatched
-            # branch residuals), so fall back to a both-sides where-select.
+        def rngs_of(t, st, r):
+            # every traced dependency (t, stage, rng key) arrives as an
+            # argument: pre_fn/loss_of run inside _cond_skip's custom_vjp,
+            # where a closure over an outer tracer would leak
             if not use_rngs:
-                return jax.lax.cond(pred, true_fn, lambda: false_val)
-            return jnp.where(pred, true_fn(), false_val)
+                return None
+            return {"dropout": jax.random.fold_in(
+                jax.random.fold_in(r, t), st)}
+
+        def pre_fn(ops):
+            extras_, mb_, t_, st_, r_ = ops
+            return module.pre_apply(extras_, mb_, rngs=rngs_of(t_, st_, r_))
+
+        def loss_of(ops):
+            extras_, y_, lab_, t_, st_, r_ = ops
+            return module.loss_fn(
+                module.post_apply(extras_, y_, rngs=rngs_of(t_, st_, r_)),
+                lab_).astype(jnp.float32)
+
+        def stage_select(pred, fn, false_val, operands):
+            # lax.cond skips the untaken branch's FLOPs at runtime —
+            # embedding/head work runs only on its own stage, bubble ticks
+            # pay nothing. With dropout rngs a plain cond trips scan's
+            # branch-residual assertion; _cond_skip wraps it atomically
+            # (round-2's both-branch jnp.where fallback is gone).
+            if not use_rngs:
+                return jax.lax.cond(pred, lambda: fn(operands),
+                                    lambda: false_val)
+            return _cond_skip(pred, fn, false_val, operands)
 
         @jax.checkpoint
         def tick(carry, t):
             state, loss_sum, count = carry
-            in_idx = jnp.clip(t, 0, n_micro - 1)
-            mb = jax.tree_util.tree_map(lambda a: a[in_idx], inputs)
+            # micro-batch t lives in chunk slot t//P on stage t%P
+            mb = fetch(inputs, t // n_stages, jnp.mod(t, n_stages))
             # LoadMicroBatch on stage 0; other stages use the received act
-            x = stage_select(
-                stage == 0,
-                lambda: module.pre_apply(extras, mb, rngs=stage_rngs(t)),
-                state)
+            x = stage_select(stage == 0, pre_fn, state,
+                             (extras, mb, t, stage, rng))
             y = run_blocks(x, t)
             # last stage: loss of micro-batch t-(P-1) (if one has arrived)
             out_idx = t - (n_stages - 1)
-            lab = jax.tree_util.tree_map(
-                lambda a: a[jnp.clip(out_idx, 0, n_micro - 1)], labels)
+            lab = fetch(labels, out_idx // n_stages,
+                        jnp.mod(out_idx, n_stages))
             take = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
-            loss_t = stage_select(
-                take,
-                lambda: module.loss_fn(
-                    module.post_apply(extras, y, rngs=stage_rngs(t)),
-                    lab).astype(jnp.float32),
-                jnp.zeros((), jnp.float32))
+            loss_t = stage_select(take, loss_of,
+                                  jnp.zeros((), jnp.float32),
+                                  (extras, y, lab, t, stage, rng))
             loss_sum = loss_sum + loss_t
             count = count + take.astype(jnp.int32)
             # SendActivation/RecvActivation: rotate stage outputs forward
@@ -122,13 +195,24 @@ def pipeline_loss_fn(module: PipelineModule, mesh, n_micro: int):
     spec_params = {"pre": P(), "blocks": P(AXIS_PIPE), "post": P(), "tied": P()}
     smapped = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(spec_params, P(), P(), P()),
+        in_specs=(spec_params, P(AXIS_PIPE), P(AXIS_PIPE), P()),
         out_specs=P(),
         axis_names={AXIS_PIPE},
         check_vma=False)
 
+    def stride(a):
+        """[M, mb, ...] -> [P, Mc, mb, ...] with slot [s, k] = a[s + kP]
+        (zero-padded to Mc*P): sharding the leading axis over pipe puts
+        chunk s on stage s."""
+        if n_pad > n_micro:
+            a = jnp.concatenate(
+                [a, jnp.zeros((n_pad - n_micro,) + a.shape[1:], a.dtype)], 0)
+        return a.reshape((n_chunk, n_stages) + a.shape[1:]).swapaxes(0, 1)
+
     def loss_fn(params, batch, rngs=None):
         inputs, labels = batch
+        inputs = jax.tree_util.tree_map(stride, inputs)
+        labels = jax.tree_util.tree_map(stride, labels)
         rng = rngs["dropout"] if isinstance(rngs, dict) else (
             rngs if rngs is not None else jax.random.PRNGKey(0))
         return smapped(params, inputs, labels, rng)

@@ -28,13 +28,13 @@ def marginal_cost_ms(fn, *args, iters: int = 16, repeats: int = 5) -> float:
         def f(first, *rest):
             def body(c, _):
                 out = fn(c, *rest)
-                leaves = jax.tree_util.tree_leaves(out)
+                leaf = jnp.atleast_1d(jax.tree_util.tree_leaves(out)[0])
                 bump = jnp.max(jnp.abs(
-                    leaves[0][(0,) * (leaves[0].ndim - 1)][:2]
-                    .astype(jnp.float32)))
+                    leaf[(0,) * (leaf.ndim - 1)][:2].astype(jnp.float32)))
                 return c * (1.0 + 0.0 * bump).astype(c.dtype), ()
 
             cf, _ = jax.lax.scan(body, first, None, length=n)
+            cf = jnp.atleast_1d(cf)
             return cf[(0,) * (cf.ndim - 1)][:2]  # tiny transfer
 
         return jax.jit(f)

@@ -1,0 +1,88 @@
+"""Pipeline bubble overhead measurement (VERDICT r2 #9).
+
+Runs the compiled 1F1B schedule at pipe=4 on the 8-device CPU mesh and
+compares measured per-micro-batch time against the tick-count ideal:
+a P-stage pipeline over M micro-batches runs M+P-1 ticks, so the ideal
+bubble multiplier is (M+P-1)/M. Reported overhead beyond that is
+schedule inefficiency (cond dispatch, input delivery psum, ppermute).
+Run: python tools/perf_pipe.py [M ...]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, gpt2_pipe
+from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology
+
+SEQ = 128
+
+
+def step_time(n_micro, pipe, data, repeats=5):
+    reset_topology()
+    topo = MeshTopology(axis_sizes={"pipe": pipe, "data": data},
+                        devices=jax.devices()[:pipe * data])
+    cfg = GPT2Config(vocab_size=512, n_positions=SEQ, n_embd=256,
+                     n_layer=8, n_head=4, dtype=np.float32)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=gpt2_pipe(cfg), mesh=topo,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": n_micro,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 1},
+                "steps_per_print": 100_000})
+    rows = n_micro * topo.get_data_parallel_world_size()
+    ids = np.random.default_rng(0).integers(0, 512, (rows, SEQ)).astype(np.int32)
+    batch = {"input_ids": ids}
+    loss = engine.forward(batch)
+    engine.step()
+    float(loss)  # compile + warm
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        loss = engine.forward(batch)
+        engine.step()
+        float(loss)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    """Fit step time against tick count: t(M) ~= a*(M+P-1) + c. If the
+    schedule is tick-dominated (no per-tick overhead beyond the ideal),
+    the bubble fraction at M micro-batches is (P-1)/(M+P-1); the fitted
+    residual beyond the linear model is schedule inefficiency (cond
+    dispatch, input-delivery psum, ppermute). On the shared-core CPU mesh
+    only the tick scaling is meaningful (virtual devices serialize), so
+    this reports the fit, not absolute throughput."""
+    micros = [int(m) for m in sys.argv[1:]] or [4, 8, 16]
+    pipe = 4
+    times = {m: step_time(m, pipe=pipe, data=8 // pipe) for m in micros}
+    for m, t in times.items():
+        ticks = m + pipe - 1
+        print(f"M={m:3d} P={pipe}: step {1e3 * t:8.1f} ms  ticks {ticks:3d}  "
+              f"per-tick {1e3 * t / ticks:7.1f} ms  "
+              f"ideal bubble {(pipe - 1) / ticks:5.1%}")
+    if len(times) >= 2:
+        ms = sorted(times)
+        m0, m1 = ms[0], ms[-1]
+        a = (times[m1] - times[m0]) / (m1 - m0)  # marginal tick cost
+        c = times[m0] - a * (m0 + pipe - 1)      # fixed overhead
+        print(f"fit: {1e3 * a:7.1f} ms/tick marginal, "
+              f"{1e3 * c:7.1f} ms fixed overhead per step "
+              f"({c / times[m1]:5.1%} of the M={m1} step)")
+
+
+if __name__ == "__main__":
+    main()

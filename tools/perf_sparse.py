@@ -8,7 +8,6 @@ dense-masked XLA path the repo used before the kernel existed.
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

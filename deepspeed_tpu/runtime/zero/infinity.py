@@ -195,7 +195,36 @@ class ZeroInfinityEngine:
             self._gblocks = jax.tree_util.tree_map(
                 lambda a: np.zeros(a.shape, np.float32), self._blocks)
 
-        self._top_dev = jax.device_put(self._top, self._device)
+        # --- vocab-tiled embedding/head (reference TiledLinear,
+        # runtime/zero/tiling.py:27): when the tied table exceeds an
+        # EXPLICIT device staging budget, it stays host-resident and
+        # streams. Opt-in by setting offload_param.buffer_size below the
+        # table bytes — the 100MB default must not silently flip standard
+        # models (GPT-2's 154MB table) onto the slower streamed path.
+        self._tiled = None
+        wte = self._top.get("wte")
+        if (off is not None and wte is not None
+                and "buffer_size" in off.model_fields_set
+                and wte.size * 4 > off.buffer_size):
+            if not getattr(cfgm, "tied_head", True) or getattr(
+                    cfgm, "lm_head_bias", False):
+                raise DeepSpeedConfigError(
+                    "vocab-tiled offload supports the tied, bias-free "
+                    "embedding/head; raise offload_param.buffer_size to "
+                    "keep the table on device")
+            from deepspeed_tpu.runtime.zero.tiled_head import TiledEmbedHead
+
+            V, C = wte.shape
+            self._tiled = TiledEmbedHead(
+                V, C, vocab_tile=max(128, off.buffer_size // (C * 4)),
+                dtype=cfgm.dtype)
+            self._gwte = np.zeros((V, C), np.float32)
+            log_dist(
+                f"[infinity] vocab-tiled head: [{V}, {C}] table stays on "
+                f"host; {self._tiled.n_tiles} tiles of {self._tiled.Vt} "
+                "rows stream per step", ranks=[0])
+
+        self._top_dev = self._commit_top()
         self._gtop = None       # device-accumulated top grads
         self._compiled = {}
         self._last_loss = None
@@ -361,8 +390,37 @@ class ZeroInfinityEngine:
             "top_add": jax.jit(top_add, donate_argnums=(0,)),
             "head_loss": jax.jit(head_loss),
         }
+        if self._tiled is not None:
+            # tiled tier: the wte gather/head matmul live OUTSIDE these
+            # programs (host gather + streamed tiles); the jitted pieces
+            # are everything around them
+            def embed_rest(top, emb):
+                x = emb.astype(cfg.dtype)
+                if cfg.position_embedding == "learned":
+                    x = x + top["wpe"][None, cfg.position_offset:
+                                       cfg.position_offset + T].astype(
+                        cfg.dtype)
+                if cfg.embedding_layernorm:
+                    x = ln("emb_ln", top, x)
+                return x
+
+            def lnf(top, h):
+                return ln("ln_f", top, h)
+
+            fns["embed_rest"] = jax.jit(embed_rest)
+            fns["embed_rest_vjp"] = jax.jit(
+                lambda top, emb, g: jax.vjp(embed_rest, top, emb)[1](g))
+            fns["lnf"] = jax.jit(lnf)
+            fns["lnf_vjp"] = jax.jit(
+                lambda top, h, g: jax.vjp(lnf, top, h)[1](g))
         self._compiled[key] = fns
         return fns
+
+    def _commit_top(self):
+        """Device copy of the top params; a tiled table stays on host."""
+        top = ({k: v for k, v in self._top.items() if k != "wte"}
+               if self._tiled is not None else self._top)
+        return jax.device_put(top, self._device)
 
     def _row(self, l: int):
         """Layer ``l``'s weights as a host tree of contiguous row views —
@@ -399,7 +457,13 @@ class ZeroInfinityEngine:
         L = self.n_layer
 
         # ---- forward stream: prefetch l+1 while l computes ----
-        x = fns["embed"](self._top_dev, jax.device_put(ids, dev))
+        if self._tiled is not None:
+            # host gather: [B, T, C] crosses to the chip, never the table
+            emb_dev = jax.device_put(
+                self._tiled.embed_gather(self._top["wte"], ids), dev)
+            x = fns["embed_rest"](self._top_dev, emb_dev)
+        else:
+            x = fns["embed"](self._top_dev, jax.device_put(ids, dev))
         acts = [x]
         if self._swap is not None:
             self._swap.prefetch_params(0)
@@ -411,7 +475,20 @@ class ZeroInfinityEngine:
             acts.append(x)
 
         labels_d = jax.device_put(labels, dev)
-        loss, dtop, dx = fns["head_vjp"](self._top_dev, acts[-1], labels_d)
+        if self._tiled is not None:
+            from deepspeed_tpu.models.gpt2 import shift_labels
+
+            h = acts[-1]
+            hln = fns["lnf"](self._top_dev, h)
+            # streamed vocab tiles: online-softmax fwd + remat bwd; tile
+            # weight grads accumulate straight into the host table grad
+            loss, dhln = self._tiled.loss_and_grads(
+                hln, self._top["wte"], shift_labels(labels_d),
+                self._gwte, device=dev)
+            dtop, dx = fns["lnf_vjp"](self._top_dev, h, dhln)
+        else:
+            loss, dtop, dx = fns["head_vjp"](self._top_dev, acts[-1],
+                                             labels_d)
 
         # ---- backward stream: reverse prefetch; dparams D2H overlaps the
         # next layer's VJP (async host copy, consumed one step later) ----
@@ -430,7 +507,13 @@ class ZeroInfinityEngine:
             pending = (l, dbp)
         if pending is not None:
             self._accum_block(*pending)
-        dtop_e = fns["embed_vjp"](self._top_dev, jax.device_put(ids, dev), dx)
+        if self._tiled is not None:
+            dtop_e, demb = fns["embed_rest_vjp"](self._top_dev, emb_dev, dx)
+            self._tiled.embed_scatter_grad(self._gwte, ids,
+                                           jax.device_get(demb))
+        else:
+            dtop_e = fns["embed_vjp"](self._top_dev,
+                                      jax.device_put(ids, dev), dx)
         dtop = jax.tree_util.tree_map(lambda a, b: a + b, dtop, dtop_e)
         self._gtop = dtop if self._gtop is None \
             else fns["top_add"](self._gtop, dtop)
@@ -456,7 +539,14 @@ class ZeroInfinityEngine:
             ids = labels = np.asarray(batch)
         B, T = ids.shape
         fns = self._fns(B, T)
-        x = fns["embed"](self._top_dev, jax.device_put(ids, self._device))
+        if self._tiled is not None:
+            emb_dev = jax.device_put(
+                self._tiled.embed_gather(self._top["wte"], ids),
+                self._device)
+            x = fns["embed_rest"](self._top_dev, emb_dev)
+        else:
+            x = fns["embed"](self._top_dev,
+                             jax.device_put(ids, self._device))
         if self._swap is not None:
             self._swap.prefetch_params(0)
         nxt = self._fetch_row(0, prefetch=1)
@@ -464,6 +554,14 @@ class ZeroInfinityEngine:
             cur, nxt = nxt, (self._fetch_row(l + 1, prefetch=l + 2)
                              if l + 1 < self.n_layer else None)
             x = fns["block_fwd"](cur, x)
+        if self._tiled is not None:
+            from deepspeed_tpu.models.gpt2 import shift_labels
+
+            hln = fns["lnf"](self._top_dev, x)
+            return self._tiled.loss_only(
+                hln, self._top["wte"],
+                shift_labels(jax.device_put(labels, self._device)),
+                device=self._device)
         return fns["head_loss"](self._top_dev, x,
                                 jax.device_put(labels, self._device))
 
@@ -494,6 +592,8 @@ class ZeroInfinityEngine:
                     "lr", 1e-3))
             if self._swap is None:
                 grads = dict(jax.device_get(self._gtop))
+                if self._tiled is not None:
+                    grads["wte"] = self._gwte
                 grads["transformer"] = {"h": {"block": self._gblocks}}
                 # mean over micro-steps: apply() already multiplies grads by
                 # 1/loss_scale leaf-by-leaf — no extra full-tree scaling pass
@@ -504,7 +604,9 @@ class ZeroInfinityEngine:
                 # NVMe tier: global clip spans top + blocks, so the norm is
                 # engine-owned; the pipelined swapper then updates the
                 # blocks layer-by-layer while (param, m, v) records stream
-                grads_top = jax.device_get(self._gtop)
+                grads_top = dict(jax.device_get(self._gtop))
+                if self._tiled is not None:
+                    grads_top["wte"] = self._gwte
                 sq = sum(float(np.sum(np.square(
                     np.asarray(g, np.float32), dtype=np.float64)))
                     for g in jax.tree_util.tree_leaves(grads_top))
@@ -521,9 +623,12 @@ class ZeroInfinityEngine:
                                 grad_scale=clip_coef / gas)
                 self._last_grad_norm = grad_norm
             # masters updated in place; only the device-resident top copy
-            # needs a commit (block weights re-stream from masters anyway)
-            self._top_dev = jax.device_put(self._top, self._device)
+            # needs a commit (block weights re-stream from masters anyway;
+            # a tiled table never goes to device at all)
+            self._top_dev = self._commit_top()
             self._gtop = None
+            if self._tiled is not None:
+                self._gwte.fill(0.0)
             for leaf in jax.tree_util.tree_leaves(self._gblocks):
                 leaf.fill(0.0)
             self.global_steps += 1
@@ -611,7 +716,9 @@ class ZeroInfinityEngine:
             self._blocks = self._host_params["transformer"]["h"]["block"]
             self._top = {k: v for k, v in self._host_params.items()
                          if k != "transformer"}
-        self._top_dev = jax.device_put(self._top, self._device)
+        self._top_dev = self._commit_top()
+        if self._tiled is not None:
+            self._gwte.fill(0.0)
         self.global_steps = int(flat["global_steps"])
         self.global_samples = int(flat["global_samples"])
         self.micro_steps = int(flat["micro_steps"])

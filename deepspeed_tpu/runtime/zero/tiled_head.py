@@ -1,0 +1,165 @@
+"""Vocab-tiled embedding/LM-head for layers that exceed the device budget.
+
+Capability parity with the reference ``TiledLinear``
+(``runtime/zero/tiling.py:27``): a single linear too large for device
+memory is computed in slices, trading one resident ``[V, C]`` weight for
+``[Vt, C]`` tiles. The TPU-native shape of the problem is the tied
+embedding/LM-head of huge-vocab models (the 176B-class configs in
+BASELINE.json): here
+
+- the fp32 table stays HOST-resident (the Infinity tier's master copy);
+- embedding forward is a host gather (``wte[ids]``) shipping ``[B, T, C]``
+  to the chip — never the table;
+- the LM-head cross-entropy streams ``[Vt, C]`` weight tiles through a
+  jitted per-tile kernel with an online (running max / sum-exp)
+  softmax — the flash-attention trick applied to the vocab axis — and a
+  second streamed pass for the backward, so peak device memory is
+  ``O(B*T*C + Vt*C)`` regardless of V;
+- weight gradients land tile-by-tile in a host accumulator; the
+  embedding backward scatter-adds into the same accumulator (tied head).
+
+Used by ``ZeroInfinityEngine`` when the table exceeds
+``offload_param.buffer_size`` (the reference knob bounding device staging
+buffers).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TiledEmbedHead:
+    """Host-resident tied embedding/head streamed in vocab tiles."""
+
+    def __init__(self, vocab_size: int, n_embd: int, vocab_tile: int,
+                 dtype=jnp.float32):
+        self.V = int(vocab_size)
+        self.C = int(n_embd)
+        self.Vt = max(128, min(int(vocab_tile), self.V))
+        # wire dtype for H2D traffic: tiles/embeddings cross PCIe at the
+        # model's compute precision (the kernels cast to h.dtype anyway,
+        # so shipping fp32 for a bf16 model would double transfer bytes)
+        self.dtype = np.dtype(dtype) if dtype != jnp.bfloat16 else \
+            jnp.bfloat16.dtype
+        self.n_tiles = -(-self.V // self.Vt)
+        self._jit_pass1 = jax.jit(self._pass1)
+        self._jit_pass2 = jax.jit(self._pass2)
+        self._jit_finish = jax.jit(self._finish)
+
+    # -- embedding ------------------------------------------------------
+    def embed_gather(self, wte_host: np.ndarray, ids: np.ndarray):
+        """Host gather; only [B, T, C] crosses PCIe, never [V, C]."""
+        return np.asarray(wte_host)[np.asarray(ids)].astype(self.dtype)
+
+    def embed_scatter_grad(self, gwte_host: np.ndarray, ids: np.ndarray,
+                           demb: np.ndarray) -> None:
+        """Embedding backward: scatter-add rows into the host accumulator."""
+        flat_ids = np.asarray(ids).reshape(-1)
+        flat_g = np.asarray(demb, np.float32).reshape(-1, self.C)
+        np.add.at(gwte_host, flat_ids, flat_g)
+
+    # -- per-tile kernels (compiled once; tile shape static) ------------
+    @staticmethod
+    def _pass1(h, w, start, labels, m, s, gold):
+        """Online logsumexp + gold-logit accumulation for one tile."""
+        l = jnp.einsum("btc,vc->btv", h, w.astype(h.dtype),
+                       preferred_element_type=jnp.float32)
+        m_j = jnp.max(l, axis=-1)
+        m_new = jnp.maximum(m, m_j)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(l - m_new[..., None]), axis=-1)
+        vt = w.shape[0]
+        in_tile = (labels >= start) & (labels < start + vt)
+        idx = jnp.clip(labels - start, 0, vt - 1)
+        gold = gold + jnp.where(
+            in_tile, jnp.take_along_axis(l, idx[..., None], axis=-1)[..., 0],
+            0.0)
+        return m_new, s, gold
+
+    @staticmethod
+    def _finish(m, s, gold, labels, ignore_index=-100):
+        valid = labels != ignore_index
+        logz = m + jnp.log(s)
+        nll = (logz - gold) * valid
+        n = jnp.maximum(valid.sum(), 1)
+        # coef: d(mean nll)/d(per-token nll), zero on ignored tokens
+        coef = valid.astype(jnp.float32) / n.astype(jnp.float32)
+        return nll.sum() / n, logz, coef
+
+    @staticmethod
+    def _pass2(h, w, start, labels, logz, coef):
+        """Backward for one tile: recompute logits (remat), softmax minus
+        one-hot, emit dh-partial (device) and dw (→ host)."""
+        l = jnp.einsum("btc,vc->btv", h, w.astype(h.dtype),
+                       preferred_element_type=jnp.float32)
+        p = jnp.exp(l - logz[..., None])
+        vt = w.shape[0]
+        in_tile = (labels >= start) & (labels < start + vt)
+        idx = jnp.clip(labels - start, 0, vt - 1)
+        onehot = (jnp.arange(vt)[None, None, :] == idx[..., None]) \
+            & in_tile[..., None]
+        dl = coef[..., None] * (p - onehot.astype(jnp.float32))
+        dh = jnp.einsum("btv,vc->btc", dl, w.astype(jnp.float32))
+        dw = jnp.einsum("btv,btc->vc", dl, h.astype(jnp.float32))
+        return dh, dw  # both fp32; caller accumulates in fp32
+
+    def _stream_tiles(self, wte_host: np.ndarray, device):
+        """Double-buffered tile stream: tile j+1 transfers while the
+        caller's kernel runs on tile j. Shared by both passes and eval."""
+        def put(j):
+            lo = j * self.Vt
+            hi = min(lo + self.Vt, self.V)
+            # the remainder tile keeps its true size — jit compiles one
+            # extra kernel variant instead of padding the partition
+            # function with fake rows
+            return lo, jax.device_put(
+                np.asarray(wte_host[lo:hi]).astype(self.dtype), device)
+
+        nxt = put(0)
+        for j in range(self.n_tiles):
+            cur, nxt = nxt, (put(j + 1) if j + 1 < self.n_tiles else None)
+            yield cur
+
+    def _pass1_all(self, h, wte_host, labels_d, device):
+        B, T = labels_d.shape
+        m = jnp.full((B, T), -jnp.inf, jnp.float32)
+        s = jnp.zeros((B, T), jnp.float32)
+        gold = jnp.zeros((B, T), jnp.float32)
+        for lo, w_dev in self._stream_tiles(wte_host, device):
+            m, s, gold = self._jit_pass1(h, w_dev, jnp.int32(lo),
+                                         labels_d, m, s, gold)
+        return m, s, gold
+
+    # -- streamed loss (forward only: eval path) -----------------------
+    def loss_only(self, h, wte_host: np.ndarray, labels, device=None):
+        device = device or jax.devices()[0]
+        labels_d = jax.device_put(jnp.asarray(labels), device)
+        m, s, gold = self._pass1_all(h, wte_host, labels_d, device)
+        loss, _, _ = self._jit_finish(m, s, gold, labels_d)
+        return loss
+
+    # -- streamed loss fwd+bwd -----------------------------------------
+    def loss_and_grads(self, h, wte_host: np.ndarray, labels,
+                       gwte_host: np.ndarray, device=None):
+        """Streaming cross-entropy over the host table.
+
+        ``h``: device ``[B, T, C]`` (post final-LN); ``labels``: shifted
+        target ids (``-100`` ignored). Returns ``(loss, dh)`` on device;
+        tile weight grads accumulate into ``gwte_host`` in place.
+        """
+        device = device or jax.devices()[0]
+        labels_d = jax.device_put(jnp.asarray(labels), device)
+        # pass 1 (double-buffered stream; peak = 2 tiles)
+        m, s, gold = self._pass1_all(h, wte_host, labels_d, device)
+        loss, logz, coef = self._jit_finish(m, s, gold, labels_d)
+        # pass 2: stream again (remat of the logits), grads to host.
+        # dh accumulates in fp32 - a bf16 running sum over n_tiles would
+        # feed ~n_tiles * 2^-9 relative rounding into the whole backward
+        dh = jnp.zeros(h.shape, jnp.float32)
+        for lo, w_dev in self._stream_tiles(wte_host, device):
+            dh_j, dw = self._jit_pass2(h, w_dev, jnp.int32(lo), labels_d,
+                                       logz, coef)
+            dh = dh + dh_j
+            gwte_host[lo:lo + dw.shape[0]] += np.asarray(
+                jax.device_get(dw), np.float32)
+        return loss, dh.astype(h.dtype)

@@ -143,6 +143,19 @@ class CheckpointConfig(DeepSpeedConfigModel):
     sharded: bool = False
 
 
+class NebulaConfig(DeepSpeedConfigModel):
+    """``nebula`` section (reference ``nebula/config.py``): service-style
+    tiered checkpointing — fast-tier commits + periodic durable mirror
+    with version retention. Served by ``TieredCheckpointEngine``."""
+
+    enabled: bool = False
+    persistent_storage_path: Optional[str] = None
+    persistent_time_interval: float = 100.0
+    num_of_version_in_retention: int = 2
+    enable_nebula_load: bool = True
+    load_path: Optional[str] = None
+
+
 class DataTypesConfig(DeepSpeedConfigModel):
     grad_accum_dtype: Optional[str] = None
 
@@ -241,6 +254,7 @@ class DeepSpeedConfig:
             csv_monitor=d.get("csv_monitor", {}),
         )
         self.checkpoint_config = CheckpointConfig(**d.get(C.CHECKPOINT, {}))
+        self.nebula_config = NebulaConfig(**d.get("nebula", {}))
         self.data_types_config = DataTypesConfig(**d.get(C.DATA_TYPES, {}))
 
         if self.fp16.enabled and self.bf16.enabled:

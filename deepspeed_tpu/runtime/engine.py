@@ -267,7 +267,8 @@ class DeepSpeedEngine:
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
 
-        # --- checkpoint engine (reference _configure_checkpointing :919) ---
+        # --- checkpoint engine (reference _configure_checkpointing :919;
+        # nebula selection engine.py:919-951) ---
         if self._config.checkpoint_config.sharded:
             from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
                 ShardedCheckpointEngine)
@@ -277,6 +278,12 @@ class DeepSpeedEngine:
             self.checkpoint_engine = OrbaxCheckpointEngine()
         else:
             self.checkpoint_engine = ArrayCheckpointEngine()
+        if self._config.nebula_config.enabled:
+            from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+                TieredCheckpointEngine)
+
+            self.checkpoint_engine = TieredCheckpointEngine(
+                self._config.nebula_config, inner=self.checkpoint_engine)
         # host-side aux state (engine counters, offloaded optimizer moments)
         # always travels through the consolidated npz/json format
         self._aux_checkpoint_engine = ArrayCheckpointEngine()
@@ -1286,10 +1293,13 @@ class DeepSpeedEngine:
                 self.checkpoint_engine.save(module_state, os.path.join(ckpt_dir, "module"))
                 self.checkpoint_engine.save(optim_state, os.path.join(ckpt_dir, "optimizer"))
                 self.checkpoint_engine.save(engine_state, os.path.join(ckpt_dir, "engine"))
+        self.checkpoint_engine.commit(tag)
+        # "latest" moves only AFTER the commit publishes the tag — a crash
+        # between the two can never leave latest dangling at a
+        # half-written checkpoint (the tiered engine's atomicity contract)
         if dist.get_rank() == 0 and save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
-        self.checkpoint_engine.commit(tag)
         dist.barrier()
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
         return True

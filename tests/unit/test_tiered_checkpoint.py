@@ -1,0 +1,170 @@
+"""Tiered (Nebula-equivalent) checkpoint engine tests
+(reference ``nebula/`` + ``nebula_checkpoint_engine.py:15``)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+    ArrayCheckpointEngine, TieredCheckpointEngine)
+from deepspeed_tpu.runtime.config import NebulaConfig
+from tests.unit.simple_model import simple_loss_fn, simple_params
+
+
+def _engine_cfg(**nebula):
+    return {"train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "nebula": {"enabled": True, **nebula},
+            "steps_per_print": 10_000}
+
+
+def _mk(tmp_path, **nebula):
+    cfg = NebulaConfig(enabled=True, **nebula)
+    return TieredCheckpointEngine(cfg)
+
+
+class TestStagingAndCommit:
+    def test_save_stages_commit_publishes(self, tmp_path):
+        eng = _mk(tmp_path)
+        eng.create("tagA")
+        path = str(tmp_path / "ckpt" / "tagA" / "module")
+        eng.save({"w": np.ones((4,))}, path)
+        # nothing visible at the final path before commit
+        assert not os.path.exists(path + ".npz")
+        assert os.path.exists(
+            str(tmp_path / "ckpt" / ".staging" / "tagA" / "module.npz"))
+        eng.commit("tagA")
+        assert os.path.exists(path + ".npz")
+        assert not os.path.exists(
+            str(tmp_path / "ckpt" / ".staging" / "tagA"))
+        flat = eng.load(path)
+        np.testing.assert_array_equal(flat["w"], np.ones((4,)))
+
+    def test_uncommitted_staging_rolled_back(self, tmp_path):
+        eng = _mk(tmp_path)
+        eng.create("crash")
+        path = str(tmp_path / "ckpt" / "crash" / "module")
+        eng.save({"w": np.zeros(2)}, path)
+        # no commit: the partial save never becomes visible, and the next
+        # committed round sweeps the abandoned staging
+        eng.create("next")
+        eng.save({"w": np.ones(1)}, str(tmp_path / "ckpt" / "next" / "m"))
+        eng.commit("next")
+        assert not os.path.exists(
+            str(tmp_path / "ckpt" / ".staging" / "crash"))
+        assert not os.path.exists(path + ".npz")
+
+    def test_crashed_process_staging_wiped_on_reuse(self, tmp_path):
+        """Rollback must survive a process crash: a FRESH engine re-saving
+        the same tag must not publish the dead run's leftover files."""
+        stale = tmp_path / "ckpt" / ".staging" / "t" / "leftover.npz"
+        os.makedirs(stale.parent)
+        stale.write_bytes(b"junk")
+        eng = _mk(tmp_path)  # new process: no in-memory knowledge
+        eng.create("t")
+        eng.save({"w": np.ones(2)}, str(tmp_path / "ckpt" / "t" / "module"))
+        eng.commit("t")
+        assert (tmp_path / "ckpt" / "t" / "module.npz").exists()
+        assert not (tmp_path / "ckpt" / "t" / "leftover.npz").exists()
+
+    def test_load_path_preferred_over_persist(self, tmp_path):
+        alt = tmp_path / "alt"
+        os.makedirs(alt / "t0")
+        ArrayCheckpointEngine().save({"w": np.full((2,), 5.0)},
+                                     str(alt / "t0" / "module"))
+        eng = _mk(tmp_path, load_path=str(alt),
+                  persistent_storage_path=str(tmp_path / "durable"))
+        flat = eng.load(str(tmp_path / "ckpt" / "t0" / "module"))
+        np.testing.assert_array_equal(flat["w"], np.full((2,), 5.0))
+
+    def test_supports_sharded_forwarded(self, tmp_path):
+        class _Sharded(ArrayCheckpointEngine):
+            supports_sharded = True
+
+        cfg = NebulaConfig(enabled=True)
+        eng = TieredCheckpointEngine(cfg, inner=_Sharded())
+        assert eng.supports_sharded
+        assert not _mk(tmp_path).supports_sharded
+
+    def test_recommit_replaces_atomically(self, tmp_path):
+        eng = _mk(tmp_path)
+        for val in (1.0, 2.0):
+            eng.create("t")
+            path = str(tmp_path / "ckpt" / "t" / "module")
+            eng.save({"w": np.full((2,), val)}, path)
+            eng.commit("t")
+        flat = eng.load(str(tmp_path / "ckpt" / "t" / "module"))
+        assert flat["w"][0] == 2.0
+        assert not os.path.exists(str(tmp_path / "ckpt" / "t.replaced"))
+
+
+class TestDurableMirror:
+    def test_mirror_and_retention(self, tmp_path):
+        mirror = tmp_path / "durable"
+        eng = _mk(tmp_path, persistent_storage_path=str(mirror),
+                  persistent_time_interval=0.0,
+                  num_of_version_in_retention=2)
+        for i in range(4):
+            tag = f"step{i}"
+            eng.create(tag)
+            eng.save({"w": np.full((2,), float(i))},
+                     str(tmp_path / "ckpt" / tag / "module"))
+            eng.commit(tag)
+        manifest = json.load(open(mirror / ".tiered_manifest.json"))
+        assert manifest == ["step2", "step3"]  # retention pruned 0, 1
+        assert not (mirror / "step0").exists()
+        assert (mirror / "step3" / "module.npz").exists()
+
+    def test_load_falls_back_to_mirror(self, tmp_path):
+        mirror = tmp_path / "durable"
+        eng = _mk(tmp_path, persistent_storage_path=str(mirror),
+                  persistent_time_interval=0.0)
+        eng.create("t0")
+        path = str(tmp_path / "ckpt" / "t0" / "module")
+        eng.save({"w": np.full((3,), 7.0)}, path)
+        eng.commit("t0")
+        # fast tier lost (node-local disk gone)
+        os.remove(path + ".npz")
+        os.remove(path + ".json")
+        flat = eng.load(path)
+        np.testing.assert_array_equal(flat["w"], np.full((3,), 7.0))
+
+    def test_interval_gates_mirroring(self, tmp_path):
+        mirror = tmp_path / "durable"
+        eng = _mk(tmp_path, persistent_storage_path=str(mirror),
+                  persistent_time_interval=10_000.0)
+        for i in range(2):
+            tag = f"s{i}"
+            eng.create(tag)
+            eng.save({"w": np.zeros(1)},
+                     str(tmp_path / "ckpt" / tag / "module"))
+            eng.commit(tag)
+        # first commit mirrors (last_persist=0 -> interval elapsed since
+        # epoch), second stays fast-tier only
+        assert (mirror / "s0").exists()
+        assert not (mirror / "s1").exists()
+
+
+class TestEngineIntegration:
+    def test_training_engine_selects_tiered(self, tmp_path):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_parameters=simple_params(),
+            config=_engine_cfg(
+                persistent_storage_path=str(tmp_path / "durable"),
+                persistent_time_interval=0.0))
+        assert isinstance(engine.checkpoint_engine, TieredCheckpointEngine)
+        x = np.ones((8, 8), np.float32)
+        loss = engine((x, np.zeros((8, 8), np.float32)))
+        engine.backward(loss)
+        engine.step()
+        engine.save_checkpoint(tmp_path / "ck", tag="t1")
+        # published atomically + mirrored + latest points at it
+        assert (tmp_path / "ck" / "t1" / "module.npz").exists()
+        assert not (tmp_path / "ck" / ".staging" / "t1").exists()
+        assert (tmp_path / "durable" / "t1" / "module.npz").exists()
+        assert (tmp_path / "ck" / "latest").read_text() == "t1"
+        tag, _ = engine.load_checkpoint(tmp_path / "ck")
+        assert tag == "t1"

@@ -43,6 +43,13 @@ class GPT2Config:
     # MXU, is the scarce resource
     remat_policy: str = "full"
     use_flash: Optional[bool] = None
+    # "bthd": run flash attention in the projection-natural [B, T, H, D]
+    # layout (ops/flash_attention.py flash_attention_bthd) — no QKV/output
+    # transposes, so XLA inserts no HBM relayout copies around the pallas
+    # custom-call (PERF.md "remaining headroom": ~10-16 ms/step at the
+    # bench config). Falls back to the standard path whenever the fast
+    # path can't serve (mask/bias/window/SP/decode).
+    attn_layout: str = "bhtd"
     # decode mode: attention reads/writes a KV cache (mutable "cache"
     # collection) — the TPU-native form of the reference's inference
     # workspace (csrc/transformer/inference/includes/inference_context.h)
@@ -126,6 +133,27 @@ class GPT2Config:
         kw.setdefault("n_layer", 2)
         kw.setdefault("n_head", 4)
         return GPT2Config(**kw)
+
+
+def _bthd_serves() -> bool:
+    """Whether the strided flash path can run here: a real TPU (or forced
+    interpret mode for tests) with no sequence-parallel axis active (SP
+    has its own dispatch in ops/attention.py)."""
+    from deepspeed_tpu.ops.attention import _on_tpu
+    from deepspeed_tpu.parallel.topology import AXIS_SEQ, get_topology
+
+    topo = get_topology(create_if_missing=False)
+    if topo is not None and topo.axis_size(AXIS_SEQ) > 1:
+        return False
+    if _on_tpu():
+        return True
+    try:  # interpret-mode testing on CPU
+        from jax._src import config as _jax_config
+
+        return (_jax_config.pallas_tpu_interpret_mode_context_manager.value
+                is not None)
+    except Exception:
+        return False
 
 
 def _dense_init(scale=0.02):
@@ -309,32 +337,51 @@ class CausalSelfAttention(nn.Module):
                                   softmax_scale=cfg.attn_scale,
                                   use_flash=False)
                 cached_attn = True
+        y_btc = None  # set by the transpose-free [B, T, H, D] fast path
         if not cached_attn:  # training forward, or decode-mode prefill
             if cfg.decode:  # k4/v4 exist (and carry the rotary rotation)
                 k, v = k4, v4
             else:
                 k = k.reshape(B, T, cfg.n_head, head_dim)
                 v = v.reshape(B, T, cfg.n_head, head_dim)
-            k = k.transpose(0, 2, 1, 3)
-            v = v.transpose(0, 2, 1, 3)
             bias = (_alibi_bias(cfg, jnp.arange(T))
                     if cfg.position_embedding == "alibi" else None)
-            key_valid = (attention_mask[:, None, None, :].astype(bool)
-                         if attention_mask is not None else None)
-            if self.window:
-                # banded causal window (GPT-Neo local attention): query t
-                # sees keys in (t - window, t]
-                t_idx = jnp.arange(T)
-                band = (t_idx[None, :] > t_idx[:, None] - self.window
-                        )[None, None]
-                key_valid = band if key_valid is None else key_valid & band
-            y = attention(q4.transpose(0, 2, 1, 3), k, v, causal=True,
-                          mask=key_valid, bias=bias,
-                          softmax_scale=cfg.attn_scale,
-                          use_flash=cfg.use_flash
-                          if (attention_mask is None and not self.window)
-                          else False)
-        y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
+            if (cfg.attn_layout == "bthd" and bias is None
+                    and attention_mask is None and not self.window
+                    and cfg.use_flash is not False and _bthd_serves()):
+                from deepspeed_tpu.ops.flash_attention import (
+                    flash_attention_bthd)
+
+                try:
+                    y_btc = flash_attention_bthd(
+                        q4, k, v, causal=True,
+                        softmax_scale=cfg.attn_scale).reshape(B, T, C)
+                except ValueError:
+                    # kernel-ineligible shape (seq not divisible by the
+                    # block size): fall through to the standard dispatch,
+                    # which has its own XLA fallback
+                    y_btc = None
+            if y_btc is None:
+                k = k.transpose(0, 2, 1, 3)
+                v = v.transpose(0, 2, 1, 3)
+                key_valid = (attention_mask[:, None, None, :].astype(bool)
+                             if attention_mask is not None else None)
+                if self.window:
+                    # banded causal window (GPT-Neo local attention): query
+                    # t sees keys in (t - window, t]
+                    t_idx = jnp.arange(T)
+                    band = (t_idx[None, :] > t_idx[:, None] - self.window
+                            )[None, None]
+                    key_valid = band if key_valid is None \
+                        else key_valid & band
+                y = attention(q4.transpose(0, 2, 1, 3), k, v, causal=True,
+                              mask=key_valid, bias=bias,
+                              softmax_scale=cfg.attn_scale,
+                              use_flash=cfg.use_flash
+                              if (attention_mask is None and not self.window)
+                              else False)
+        y = y_btc if y_btc is not None \
+            else y.transpose(0, 2, 1, 3).reshape(B, T, C)
         y = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
                      kernel_init=_dense_init(0.02 / (2 * cfg.n_layer) ** 0.5),
                      use_bias=cfg.attn_bias if cfg.attn_out_bias is None

@@ -374,6 +374,228 @@ def _flash_backward(res, g, scale, causal, block_q, block_k):
 
 
 # ----------------------------------------------------------------------
+# strided [B, T, H, D] entry — no HBM relayout
+#
+# The [B, H, T, D] entry forces the model to transpose QKV before and the
+# output after every layer; because the pallas custom-call pins default
+# layouts, XLA materializes those as {2,3,1,0}→{3,2,1,0} HBM copies
+# (~10-16 ms/step on the GPT-2 bench, PERF.md "remaining headroom").
+# These wrappers keep tensors in the projection's natural [B, T, H, D]
+# layout end to end: BlockSpecs fetch (1, bq, g, d) tiles — contiguous
+# (row, heads-group) strips, a strided but DMA-friendly pattern — and a
+# cheap VMEM-local swap presents them to the unchanged kernel bodies as
+# [g, bq, d].
+
+class _SwapRef:
+    """[1, rows, g, d] block ref viewed as the kernels' [g, rows, d]."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def __getitem__(self, idx):
+        return self._ref[...][0].swapaxes(0, 1)
+
+    def __setitem__(self, idx, val):
+        self._ref[...] = val.swapaxes(0, 1)[None]
+
+    @property
+    def dtype(self):
+        return self._ref.dtype
+
+
+class _LseRef:
+    """[1, 1, g, bq] block ref viewed as the kernels' [g, 1, bq]."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def __getitem__(self, idx):
+        return self._ref[...][0].swapaxes(0, 1)  # [g, 1, bq]
+
+    def __setitem__(self, idx, val):
+        self._ref[...] = val.swapaxes(0, 1)[None]
+
+    @property
+    def dtype(self):
+        return self._ref.dtype
+
+
+def _head_group(h: int, bq: int, bk: int, d: int) -> int:
+    """Heads per grid step for the strided layout: identical VMEM budget
+    to the folded layout — the group just can't cross a batch row, so the
+    candidate must divide ``h`` alone."""
+    return _bh_group(h, bq, bk, d)
+
+
+def _fwd_kernel_bthd(q_ref, k_ref, v_ref, o_ref, lse_ref, m, l, acc, **kw):
+    _fwd_kernel(_SwapRef(q_ref), _SwapRef(k_ref), _SwapRef(v_ref),
+                _SwapRef(o_ref), _LseRef(lse_ref), m, l, acc, **kw)
+
+
+def _bwd_dq_kernel_bthd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dq_scr, **kw):
+    _bwd_dq_kernel(_SwapRef(q_ref), _SwapRef(k_ref), _SwapRef(v_ref),
+                   _SwapRef(do_ref), _LseRef(lse_ref), _LseRef(delta_ref),
+                   _SwapRef(dq_ref), dq_scr, **kw)
+
+
+def _bwd_dkv_kernel_bthd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dk_ref, dv_ref, dk_scr, dv_scr, **kw):
+    _bwd_dkv_kernel(_SwapRef(q_ref), _SwapRef(k_ref), _SwapRef(v_ref),
+                    _SwapRef(do_ref), _LseRef(lse_ref), _LseRef(delta_ref),
+                    _SwapRef(dk_ref), _SwapRef(dv_ref), dk_scr, dv_scr, **kw)
+
+
+def _flash_forward_bthd(q, k, v, scale, causal, block_q, block_k):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
+    num_kb = sk // bk
+    g = _head_group(h, bq, bk, d)
+    hpg = h // g
+    grid = (b * hpg, sq // bq, num_kb)
+
+    def qspec(bhi, qi, ki):
+        return (bhi // hpg, qi, bhi % hpg, 0)
+
+    def kspec(bhi, qi, ki):
+        return (bhi // hpg, ki, bhi % hpg, 0)
+
+    qs = pl.BlockSpec((1, bq, g, d), qspec, memory_space=pltpu.VMEM)
+    ks = pl.BlockSpec((1, bk, g, d), kspec, memory_space=pltpu.VMEM)
+    os_ = pl.BlockSpec((1, bq, g, d), qspec, memory_space=pltpu.VMEM)
+    ls = pl.BlockSpec((1, 1, g, bq),
+                      lambda bhi, qi, ki: (bhi // hpg, bhi % hpg, 0, qi),
+                      memory_space=pltpu.VMEM)
+    kernel = functools.partial(_fwd_kernel_bthd, scale=scale, causal=causal,
+                               bq=bq, bk=bk, num_kb=num_kb, off=sk - sq)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qs, ks, ks],
+        out_specs=(os_, ls),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, sq, h, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hpg, g, sq), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, bq, 128), jnp.float32),
+            pltpu.VMEM((g, bq, 128), jnp.float32),
+            pltpu.VMEM((g, bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q, k, v)
+    return o, lse
+
+
+def _flash_backward_bthd(res, dout, scale, causal, block_q, block_k):
+    q, k, v, o, lse = res  # lse: [b, hpg, g, sq]
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
+    num_qb, num_kb = sq // bq, sk // bk
+    g = _head_group(h, bq, bk, d)
+    hpg = h // g
+
+    # D = rowsum(dO * O): [b, sq, h] -> the lse tiling [b, hpg, g, sq]
+    delta = jnp.sum(dout.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)
+    delta = delta.transpose(0, 2, 1).reshape(b, hpg, g, sq)
+
+    def qmap(bhi, qi, ki):
+        return (bhi // hpg, qi, bhi % hpg, 0)
+
+    def kmap(bhi, qi, ki):
+        return (bhi // hpg, ki, bhi % hpg, 0)
+
+    def lmap(bhi, qi, ki):
+        return (bhi // hpg, bhi % hpg, 0, qi)
+
+    qs = pl.BlockSpec((1, bq, g, d), qmap, memory_space=pltpu.VMEM)
+    ks = pl.BlockSpec((1, bk, g, d), kmap, memory_space=pltpu.VMEM)
+    ls = pl.BlockSpec((1, 1, g, bq), lmap, memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_bthd, scale=scale, causal=causal,
+                          bq=bq, bk=bk, num_kb=num_kb, off=sk - sq),
+        grid=(b * hpg, num_qb, num_kb),
+        in_specs=[qs, ks, ks, qs, ls, ls],
+        out_specs=qs,
+        out_shape=jax.ShapeDtypeStruct((b, sq, h, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((g, bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q, k, v, dout, lse, delta)
+
+    def kmap2(bhi, ki, qi):
+        return (bhi // hpg, ki, bhi % hpg, 0)
+
+    def qmap2(bhi, ki, qi):
+        return (bhi // hpg, qi, bhi % hpg, 0)
+
+    def lmap2(bhi, ki, qi):
+        return (bhi // hpg, bhi % hpg, 0, qi)
+
+    qs2 = pl.BlockSpec((1, bq, g, d), qmap2, memory_space=pltpu.VMEM)
+    ks2 = pl.BlockSpec((1, bk, g, d), kmap2, memory_space=pltpu.VMEM)
+    ls2 = pl.BlockSpec((1, 1, g, bq), lmap2, memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_bthd, scale=scale, causal=causal,
+                          bq=bq, bk=bk, num_qb=num_qb, off=sk - sq),
+        grid=(b * hpg, num_kb, num_qb),
+        in_specs=[qs2, ks2, ks2, qs2, ls2, ls2],
+        out_specs=(ks2, ks2),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, sk, h, d), k.dtype),
+            jax.ShapeDtypeStruct((b, sk, h, d), v.dtype),
+        ),
+        scratch_shapes=[pltpu.VMEM((g, bk, d), jnp.float32),
+                        pltpu.VMEM((g, bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q, k, v, dout, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_bthd(q, k, v, causal=True, softmax_scale=None,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Flash attention over the projection-natural layout.
+
+    q, k, v: [batch, seq, heads, head_dim] — the shape a fused QKV
+    projection produces — returning the same layout, so the surrounding
+    program needs no transposes (and XLA inserts no HBM relayout copies
+    around the custom-call).
+    """
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    o, _ = _flash_forward_bthd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _fab_fwd(q, k, v, causal, softmax_scale, block_q, block_k):
+    from jax.ad_checkpoint import checkpoint_name
+
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    q = checkpoint_name(q, "flash_q")
+    k = checkpoint_name(k, "flash_k")
+    v = checkpoint_name(v, "flash_v")
+    o, lse = _flash_forward_bthd(q, k, v, scale, causal, block_q, block_k)
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
+    return o, (q, k, v, o, lse)
+
+
+def _fab_bwd(causal, softmax_scale, block_q, block_k, res, g):
+    q = res[0]
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    return _flash_backward_bthd(res, g, scale, causal, block_q, block_k)
+
+
+flash_attention_bthd.defvjp(_fab_fwd, _fab_bwd)
+
+
+# ----------------------------------------------------------------------
 # public op
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal=True, softmax_scale=None,

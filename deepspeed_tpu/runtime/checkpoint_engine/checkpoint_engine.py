@@ -191,6 +191,27 @@ class TieredCheckpointEngine(CheckpointEngine):
         # engine's (ShardedCheckpointEngine sets it)
         return getattr(self._inner, "supports_sharded", False)
 
+    @property
+    def aux_engine(self):
+        """Consolidated-format engine whose saves STAGE through this
+        tier: the engine's aux files (counters, host optimizer) must ride
+        the same atomic publish — written directly into the final tag dir
+        they would be destroyed when commit replaces it."""
+        outer = self
+
+        class _Aux(CheckpointEngine):
+            def __init__(self):
+                self._arr = ArrayCheckpointEngine()
+
+            def save(self, state_dict, path):
+                outer._stage(state_dict, path, self._arr)
+
+            def load(self, path, map_location=None):
+                return outer._load_with_fallback(path, self._arr,
+                                                 map_location)
+
+        return _Aux()
+
     @staticmethod
     def _split(path):
         """'<save_dir>/<tag>/<name>' -> (save_dir, tag, name)."""
@@ -204,7 +225,7 @@ class TieredCheckpointEngine(CheckpointEngine):
         self._roots = set()
         self._fresh = set()
 
-    def save(self, state_dict, path):
+    def _stage(self, state_dict, path, inner):
         import shutil
 
         save_dir, tag, name = self._split(path)
@@ -217,27 +238,36 @@ class TieredCheckpointEngine(CheckpointEngine):
             shutil.rmtree(staged_dir, ignore_errors=True)
             self._fresh.add((save_dir, tag))
         self._roots.add(save_dir)
-        self._inner.save(state_dict, os.path.join(staged_dir, name))
+        inner.save(state_dict, os.path.join(staged_dir, name))
 
-    def load(self, path, map_location=None):
+    def save(self, state_dict, path):
+        self._stage(state_dict, path, self._inner)
+
+    def _load_with_fallback(self, path, inner, map_location=None):
         try:
-            return self._inner.load(path, map_location=map_location)
+            return inner.load(path, map_location=map_location)
         except (OSError, FileNotFoundError):
             if not self._load_mirror:
                 raise
             save_dir, tag, name = self._split(path)
             last_err = None
-            for base in filter(None, (self._load_path, self._persist_path)):
-                mirror = os.path.join(base, tag, name)
+            # a crash inside a re-publish can strand the previous version
+            # in <tag>.replaced — it is a complete checkpoint, recover it
+            fallbacks = [os.path.join(save_dir, tag + ".replaced", name)]
+            fallbacks += [os.path.join(base, tag, name) for base in
+                          (self._load_path, self._persist_path) if base]
+            for cand in fallbacks:
                 try:
-                    out = self._inner.load(mirror,
-                                           map_location=map_location)
+                    out = inner.load(cand, map_location=map_location)
                     logger.warning(f"[ckpt] fast tier missing {path}; "
-                                   f"restored from mirror {mirror}")
+                                   f"restored from {cand}")
                     return out
                 except (OSError, FileNotFoundError) as e:
                     last_err = e
             raise last_err or FileNotFoundError(path)
+
+    def load(self, path, map_location=None):
+        return self._load_with_fallback(path, self._inner, map_location)
 
     def commit(self, tag):
         import shutil
@@ -253,21 +283,29 @@ class TieredCheckpointEngine(CheckpointEngine):
                 staging_root = os.path.join(root, ".staging")
                 staged = os.path.join(staging_root, tag)
                 final = os.path.join(root, tag)
+                trash = final + ".replaced"
                 if not os.path.isdir(staged):
                     continue
-                # durability before visibility
+                # durability before visibility: file contents first, then
+                # the directory entries the publish renames touch
                 for base, _, files in os.walk(staged):
                     for fn in files:
                         with open(os.path.join(base, fn), "rb") as f:
                             os.fsync(f.fileno())
+                self._fsync_dir(staged)
+                if not os.path.isdir(final) and os.path.isdir(trash):
+                    # a previous commit crashed between its two renames:
+                    # restore the stranded-but-complete old version before
+                    # replacing it (load() also knows to read .replaced)
+                    os.replace(trash, final)
                 if os.path.isdir(final):
-                    trash = final + ".replaced"
                     shutil.rmtree(trash, ignore_errors=True)
                     os.replace(final, trash)
                     os.replace(staged, final)  # atomic publish
                     shutil.rmtree(trash, ignore_errors=True)
                 else:
                     os.replace(staged, final)  # atomic publish
+                self._fsync_dir(root)  # the renames themselves
                 # sweep staging left by abandoned tags (engine-owned dir)
                 for stale in os.listdir(staging_root):
                     shutil.rmtree(os.path.join(staging_root, stale),
@@ -277,6 +315,19 @@ class TieredCheckpointEngine(CheckpointEngine):
         self._roots = set()
         self._fresh = set()
         return True
+
+    @staticmethod
+    def _fsync_dir(path):
+        """Make rename/creation of directory entries durable (fsyncing
+        file contents alone does not persist the dirent on ext4/xfs)."""
+        try:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:  # platform without dir-fsync: best effort
+            pass
 
     # -- durable mirror -------------------------------------------------
     def _manifest(self):
@@ -300,15 +351,25 @@ class TieredCheckpointEngine(CheckpointEngine):
         self._last_persist = now
         published = []
         if os.path.exists(self._manifest()):
-            with open(self._manifest()) as f:
-                published = json.load(f)
+            try:
+                with open(self._manifest()) as f:
+                    published = json.load(f)
+            except (ValueError, OSError):
+                # a crash mid-dump must not brick every later commit;
+                # worst case some old mirror versions escape pruning
+                logger.warning("[ckpt] mirror manifest unreadable; "
+                               "restarting retention tracking")
         published = [t for t in published if t != tag] + [tag]
         # retention: prune only versions this engine published
         while len(published) > max(1, self._retention):
             victim = published.pop(0)
             shutil.rmtree(os.path.join(self._persist_path, victim),
                           ignore_errors=True)
-        with open(self._manifest(), "w") as f:
+        mtmp = self._manifest() + ".tmp"
+        with open(mtmp, "w") as f:
             json.dump(published, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(mtmp, self._manifest())
         log_dist(f"[ckpt] mirrored {tag} to {self._persist_path} "
                  f"(retention {self._retention})", ranks=[0])

@@ -285,8 +285,11 @@ class DeepSpeedEngine:
             self.checkpoint_engine = TieredCheckpointEngine(
                 self._config.nebula_config, inner=self.checkpoint_engine)
         # host-side aux state (engine counters, offloaded optimizer moments)
-        # always travels through the consolidated npz/json format
-        self._aux_checkpoint_engine = ArrayCheckpointEngine()
+        # always travels through the consolidated npz/json format; under the
+        # tiered engine it must stage through the same atomic publish
+        self._aux_checkpoint_engine = getattr(
+            self.checkpoint_engine, "aux_engine", None) \
+            or ArrayCheckpointEngine()
 
         # --- counters & timers ---
         self.micro_steps = 0

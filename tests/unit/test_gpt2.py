@@ -122,3 +122,51 @@ class TestChunkedXent:
                 hidden, wte, labels)
         scans = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "scan"]
         assert scans and scans[0].params["length"] == 2  # ceil(127/64)
+
+
+class TestBthdAttentionLayout:
+    """attn_layout="bthd": transpose-free strided flash path
+    (ops/flash_attention.py flash_attention_bthd; PERF.md layout-copy
+    headroom). Must be numerically identical to the default layout."""
+
+    def test_logits_and_grads_match_default_layout(self):
+        from jax.experimental.pallas import tpu as pltpu
+
+        ids = np.random.default_rng(0).integers(
+            0, 512, (2, 256)).astype(np.int32)
+        outs = {}
+        for layout in ("bhtd", "bthd"):
+            cfg = GPT2Config(vocab_size=512, n_positions=256, n_embd=128,
+                             n_layer=2, n_head=4, dtype=jnp.float32,
+                             scan_layers=True, use_flash=True,
+                             attn_layout=layout)
+            model = GPT2ForTraining(cfg)
+            with pltpu.force_tpu_interpret_mode():
+                params = model.init(jax.random.PRNGKey(0),
+                                    {"input_ids": ids})["params"]
+                loss, grads = jax.value_and_grad(
+                    lambda p: model.loss_fn(p, {"input_ids": ids}))(params)
+            outs[layout] = (float(loss), grads)
+        assert outs["bhtd"][0] == pytest.approx(outs["bthd"][0], rel=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5),
+            outs["bhtd"][1], outs["bthd"][1])
+
+    def test_bthd_falls_back_when_masked(self):
+        # attention_mask forces the standard path; must still run + match
+        from jax.experimental.pallas import tpu as pltpu
+
+        ids = np.random.default_rng(1).integers(
+            0, 512, (2, 64)).astype(np.int32)
+        mask = np.ones((2, 64), np.int32)
+        mask[0, :10] = 0
+        cfg = GPT2Config(vocab_size=512, n_positions=64, n_embd=64,
+                         n_layer=2, n_head=4, dtype=jnp.float32,
+                         attn_layout="bthd")
+        model = GPT2LMHeadModel(cfg)
+        with pltpu.force_tpu_interpret_mode():
+            params = model.init(jax.random.PRNGKey(0), ids)["params"]
+            logits = model.apply({"params": params}, ids,
+                                 attention_mask=jnp.asarray(mask))
+        assert np.isfinite(np.asarray(logits)).all()

@@ -46,4 +46,7 @@ timeout 900 python bench.py
 timeout 900 python bench_decode.py
 timeout 900 python bench_bert.py
 timeout 900 python bench_sparse.py
+
+echo "== 4. attention layout A/B (flip bench.py attn_layout if bthd wins) =="
+timeout 900 python tools/perf_attn_layout.py || true
 echo "== backlog complete: update PERF.md with the four JSON lines =="

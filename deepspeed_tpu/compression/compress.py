@@ -121,11 +121,51 @@ _TECH_FNS = {
 
 
 class Compressor:
-    """Per-parameter technique plan + jit-safe transform."""
+    """Per-parameter technique plan + jit-safe transform.
 
-    def __init__(self, plans: Dict[str, List[Dict]]):
+    ``act_plans`` carries the activation-quantization groups (reference
+    ``basic_layer.py:134``): activations cannot be a param transform, so
+    they are fake-quantized IN-GRAPH via a flax method interceptor
+    (:meth:`activation_quant`) that rewrites every matching ``nn.Dense``
+    input during trace — dynamic per-batch range, STE gradient, gated on
+    the traced global step like the weight techniques."""
+
+    def __init__(self, plans: Dict[str, List[Dict]],
+                 act_plans: Optional[List[Dict]] = None):
         # plans: param path → list of {technique, params, schedule_offset}
         self.plans = plans
+        self.act_plans = list(act_plans or ())
+
+    def activation_quant(self, global_step):
+        """Context manager quantizing matching Dense inputs in-graph.
+        Enter it around the loss evaluation inside the jitted step; it is
+        a no-op context when no activation groups are configured."""
+        import contextlib
+
+        if not self.act_plans:
+            return contextlib.nullcontext()
+        import flax.linen as nn
+
+        act_plans = self.act_plans
+
+        def interceptor(next_fun, args, kwargs, context):
+            if (isinstance(context.module, nn.Dense)
+                    and context.method_name == "__call__" and args):
+                path = "/".join(str(s) for s in context.module.path)
+                for plan in act_plans:
+                    if _match(path, plan["modules"]):
+                        p = plan["params"]
+                        x = args[0]
+                        fq = fake_quantize(
+                            x, num_groups=p.get("groups", 1),
+                            num_bits=p.get("bits", 8),
+                            symmetric=p.get("symmetric", True))
+                        on = global_step >= plan["schedule_offset"]
+                        args = (jnp.where(on, fq, x),) + args[1:]
+                        break
+            return next_fun(*args, **kwargs)
+
+        return nn.intercept_methods(interceptor)
 
     def transform(self, params: Any, global_step) -> Any:
         """Apply scheduled techniques; pure & traceable (``global_step`` may
@@ -145,6 +185,9 @@ class Compressor:
 
     def any_active(self) -> bool:
         return bool(self.plans)
+
+    def any_activation_quant(self) -> bool:
+        return bool(self.act_plans)
 
 
 def get_compression_config(param_dict: Dict) -> Dict:
@@ -182,9 +225,8 @@ def init_compression(params_abstract: Any, deepspeed_config: Dict,
     paths = [p for p, leaf in flat
              if getattr(leaf, "ndim", 0) >= 2]  # matmul weights only
     plans: Dict[str, List[Dict]] = {}
+    act_plans: List[Dict] = []
     for tech in C.TECHNIQUES:
-        if tech == C.ACTIVATION_QUANTIZATION:
-            continue  # activations are handled by model dtype policy on TPU
         shared = cfg[tech][C.SHARED_PARAMETERS]
         if not shared.get("enabled", False):
             continue
@@ -205,16 +247,25 @@ def init_compression(params_abstract: Any, deepspeed_config: Dict,
                 params_norm["ratio"] = 1.0 - float(gp["dense_ratio"])
             offset = int(group.get("schedule_offset",
                                    shared.get("schedule_offset", 0)))
+            if tech == C.ACTIVATION_QUANTIZATION:
+                # in-graph Dense-input fake-quant (reference
+                # basic_layer.py:134); matched against MODULE paths at
+                # trace time, not param paths
+                act_plans.append({"modules": group["modules"],
+                                  "params": params_norm,
+                                  "schedule_offset": offset})
+                continue
             for path in paths:
                 if _match(path, group["modules"]):
                     plans.setdefault(path, []).append({
                         "technique": tech, "params": params_norm,
                         "schedule_offset": offset})
     n = sum(len(v) for v in plans.values())
-    if n:
+    if n or act_plans:
         log_dist(f"[compression] {n} technique applications over "
-                 f"{len(plans)} params", ranks=[0])
-    return Compressor(plans)
+                 f"{len(plans)} params, {len(act_plans)} activation-"
+                 "quantization groups", ranks=[0])
+    return Compressor(plans, act_plans)
 
 
 def redundancy_clean(params: Any, deepspeed_config: Dict) -> Any:

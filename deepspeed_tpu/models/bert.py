@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.attention import attention
+from deepspeed_tpu.models.remat_utils import offload_policy, saved_block_input
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,6 +38,10 @@ class BertConfig:
     scan_layers: bool = True
     remat: bool = False
     remat_policy: str = "full"
+    # host-offloaded / model-axis-partitioned saved activations — see
+    # models/gpt2.py GPT2Config (ref checkpointing.py:485 / :372)
+    cpu_checkpointing: bool = False
+    partition_activations: bool = False
     use_flash: Optional[bool] = None
     # ds-config "sparse_attention" section (mode/block/...): encoder
     # attention runs through the block-sparse layout zoo instead of dense
@@ -170,6 +175,10 @@ class BertLayer(nn.Module):
 def _remat_layer(cfg):
     if not cfg.remat:
         return BertLayer
+    if cfg.cpu_checkpointing:
+        # the outer encoder-level checkpoint owns recompute + host offload
+        # (models/remat_utils.py offload_policy rationale)
+        return BertLayer
     policy = None
     if cfg.remat_policy == "dots":
         policy = jax.checkpoint_policies.checkpoint_dots
@@ -182,6 +191,8 @@ class _ScanBody(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask, deterministic):
+        if self.config.remat:
+            x = saved_block_input(x, self.config)
         x = _remat_layer(self.config)(self.config, name="layer")(
             x, mask, deterministic)
         return x, None
@@ -193,6 +204,7 @@ class BertEncoder(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
         cfg = self.config
+        offload = cfg.remat and cfg.cpu_checkpointing
         if cfg.scan_layers:
             Scanned = nn.scan(
                 _ScanBody,
@@ -202,12 +214,30 @@ class BertEncoder(nn.Module):
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.meta.PARTITION_NAME: "layers"},
             )
+            if offload:
+                # stack-level checkpoint host-offloading the per-layer
+                # "block_in" residuals; deterministic (arg 3 counting self)
+                # is Python-branched → static, passed positionally
+                Scanned = nn.remat(Scanned, prevent_cse=False,
+                                   policy=offload_policy(cfg),
+                                   static_argnums=(3,))
             x, _ = Scanned(cfg, name="layers")(x, mask, deterministic)
             return x
         layer_cls = _remat_layer(cfg)
-        for i in range(cfg.num_hidden_layers):
-            x = layer_cls(cfg, name=f"layer_{i}")(x, mask, deterministic)
-        return x
+
+        def _stack(mdl, h, mask_, det):
+            for i in range(cfg.num_hidden_layers):
+                if cfg.remat:
+                    h = saved_block_input(h, cfg)
+                h = layer_cls(cfg, name=f"layer_{i}", parent=mdl)(h, mask_,
+                                                                  det)
+            return h
+
+        if offload:
+            return nn.remat(_stack, prevent_cse=False,
+                            policy=offload_policy(cfg),
+                            static_argnums=(3,))(self, x, mask, deterministic)
+        return _stack(self, x, mask, deterministic)
 
 
 class BertModel(nn.Module):
@@ -333,11 +363,15 @@ class BertForTraining:
         return self.model.apply(variables, self._input_ids(batch), rngs=rngs)
 
     def with_activation_checkpointing(self, enabled: bool,
-                                      policy: str = "full"):
+                                      policy: str = "full",
+                                      cpu_checkpointing: bool = False,
+                                      partition_activations: bool = False):
         if policy == "none":
             enabled, policy = False, "full"
-        cfg = dataclasses.replace(self.config, remat=enabled,
-                                  remat_policy=policy)
+        cfg = dataclasses.replace(
+            self.config, remat=enabled, remat_policy=policy,
+            cpu_checkpointing=cpu_checkpointing,
+            partition_activations=partition_activations)
         return BertForTraining(cfg)
 
     def with_sparse_attention(self, sparse_config):

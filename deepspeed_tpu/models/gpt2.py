@@ -23,6 +23,7 @@ from deepspeed_tpu.models.decode_utils import (cache_attn_mask,
                                                decode_positions,
                                                pad_lengths, row_positions)
 from deepspeed_tpu.ops.attention import attention
+from deepspeed_tpu.models.remat_utils import offload_policy, saved_block_input
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,6 +43,20 @@ class GPT2Config:
     # (LN/gelu/residual) — the usual best trade on TPU where HBM, not the
     # MXU, is the scarce resource
     remat_policy: str = "full"
+    # reference activation_checkpointing/checkpointing.py:485
+    # (cpu_checkpointing): the saved inter-layer residual-stream tensors
+    # move to HOST memory during forward and stream back for backward
+    # recompute. TPU-native form: one outer jax.checkpoint over the whole
+    # block stack whose policy offloads the named "block_in" values to
+    # pinned_host — everything else recomputes (same profile as the
+    # reference: checkpoints on CPU + full segment recompute)
+    cpu_checkpointing: bool = False
+    # reference checkpointing.py:372 (partition_activations): saved
+    # activations are partitioned across model-parallel ranks instead of
+    # replicated, gathered back at recompute. TPU-native form: a sharding
+    # constraint on the saved "block_in" value spreading the sequence dim
+    # over the model axis — GSPMD stores the shard, all-gathers in backward
+    partition_activations: bool = False
     use_flash: Optional[bool] = None
     # "bthd": run flash attention in the projection-natural [B, T, H, D]
     # layout (ops/flash_attention.py flash_attention_bthd) — no QKV/output
@@ -219,6 +234,11 @@ def apply_rotary(x, positions, rotary_dim: int, theta: float,
 def _remat_block(cfg):
     """Block wrapped per the config's activation-checkpointing policy."""
     if not cfg.remat:
+        return Block
+    if cfg.cpu_checkpointing:
+        # the OUTER stack-level checkpoint (see GPT2LMHeadModel) owns both
+        # the recompute and the host offload; an inner wrap would save the
+        # block inputs on-device, defeating the offload
         return Block
     policy = None
     if cfg.remat_policy == "dots":
@@ -472,6 +492,8 @@ class _ScanBody(nn.Module):
     def __call__(self, x, deterministic, pld_theta, layer_frac,
                  attention_mask):
         cfg = self.config
+        if cfg.remat:
+            x = saved_block_input(x, cfg)
         x = _remat_block(cfg)(cfg, name="block")(
             x, deterministic, pld_theta, layer_frac, attention_mask)
         return x, None
@@ -515,6 +537,8 @@ class LoopBlocks(nn.Module):
         block_cls = _remat_block(cfg)
         windows = cfg.attention_windows or (0,) * cfg.n_layer
         for i in range(cfg.n_layer):
+            if cfg.remat:
+                x = saved_block_input(x, cfg)
             x = block_cls(cfg, window=windows[i], name=f"h_{i}")(
                 x, deterministic, pld_theta, (i + 1) / max(1, cfg.n_layer),
                 attention_mask)
@@ -585,9 +609,22 @@ class GPT2LMHeadModel(nn.Module):
                 "scan_layers=False: the window is a static per-layer "
                 "property, but a scanned stack compiles ONE body")
         blocks = ScanBlocks if cfg.scan_layers else LoopBlocks
-        x = blocks(cfg, name="transformer")(x, deterministic=deterministic,
-                                            pld_theta=pld_theta,
-                                            attention_mask=attention_mask)
+        if cfg.remat and cfg.cpu_checkpointing:
+            # cpu_checkpointing: ONE checkpoint over the whole stack whose
+            # policy host-offloads the per-layer "block_in" residuals (the
+            # values the reference moves to CPU, checkpointing.py:485);
+            # backward streams them back and recomputes each block.
+            # deterministic (arg 2 counting self) is Python-branched inside,
+            # so it is static and must be passed positionally
+            blocks = nn.remat(blocks, prevent_cse=False,
+                              policy=offload_policy(cfg),
+                              static_argnums=(2,))
+            x = blocks(cfg, name="transformer")(x, deterministic, pld_theta,
+                                                attention_mask)
+        else:
+            x = blocks(cfg, name="transformer")(x, deterministic=deterministic,
+                                                pld_theta=pld_theta,
+                                                attention_mask=attention_mask)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
         if cfg.tied_head:
             head_w, head_b = wte, None
@@ -710,15 +747,22 @@ class GPT2ForTraining:
     def apply(self, variables, batch, rngs=None):
         return self.model.apply(variables, self._input_ids(batch), rngs=rngs)
 
-    def with_activation_checkpointing(self, enabled: bool, policy: str = "full"):
+    def with_activation_checkpointing(self, enabled: bool, policy: str = "full",
+                                      cpu_checkpointing: bool = False,
+                                      partition_activations: bool = False):
         """Engine hook: the ds-config ``activation_checkpointing`` section
         overrides the model's remat setting (reference ``configure``,
         runtime/activation_checkpointing/checkpointing.py:830 — there the
-        config drives CheckpointFunction; here it drives jax.checkpoint)."""
+        config drives CheckpointFunction; here it drives jax.checkpoint).
+        ``cpu_checkpointing`` host-offloads the saved inter-layer residuals
+        (ref :485); ``partition_activations`` shards them over the model
+        axis (ref :372)."""
         if policy == "none":
             enabled, policy = False, "full"
-        cfg = dataclasses.replace(self.config, remat=enabled,
-                                  remat_policy=policy)
+        cfg = dataclasses.replace(
+            self.config, remat=enabled, remat_policy=policy,
+            cpu_checkpointing=cpu_checkpointing,
+            partition_activations=partition_activations)
         return GPT2ForTraining(cfg)
 
     def with_progressive_layer_drop(self, enabled: bool = True):

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.models.gpt2 import lm_head_loss, shift_labels
+from deepspeed_tpu.models.remat_utils import offload_policy, saved_block_input
 from deepspeed_tpu.ops.attention import attention
 
 
@@ -37,6 +38,11 @@ class LlamaConfig:
     scan_layers: bool = True
     remat: bool = False
     remat_policy: str = "full"
+    # host-offloaded / model-axis-partitioned saved activations — see
+    # models/gpt2.py GPT2Config for the reference mapping (ref
+    # checkpointing.py:485 / :372)
+    cpu_checkpointing: bool = False
+    partition_activations: bool = False
     use_flash: Optional[bool] = None
     decode: bool = False
     # padded decode: LEFT-padded prompts (attention_mask at prefill);
@@ -248,6 +254,10 @@ def _remat_block(cfg):
     """Same policy surface as models/gpt2.py:_remat_block."""
     if not cfg.remat:
         return LlamaBlock
+    if cfg.cpu_checkpointing:
+        # the outer stack-level checkpoint in LlamaModel owns recompute +
+        # host offload (models/remat_utils.py offload_policy rationale)
+        return LlamaBlock
     policy = None
     if cfg.remat_policy == "dots":
         policy = jax.checkpoint_policies.save_from_both_policies(
@@ -263,6 +273,8 @@ class _ScanBody(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic, attention_mask):
+        if self.config.remat:
+            x = saved_block_input(x, self.config)
         x = _remat_block(self.config)(self.config, name="block")(
             x, deterministic, attention_mask)
         return x, None
@@ -280,6 +292,7 @@ class LlamaModel(nn.Module):
         embed = self.param("embed_tokens", _init(),
                            (cfg.vocab_size, cfg.hidden_size), jnp.float32)
         x = embed[input_ids].astype(cfg.dtype)
+        offload = cfg.remat and cfg.cpu_checkpointing
         if cfg.scan_layers:
             Scanned = nn.scan(
                 _ScanBody,
@@ -288,13 +301,36 @@ class LlamaModel(nn.Module):
                 in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            if offload:
+                # one stack-level checkpoint host-offloading the per-layer
+                # "block_in" residuals (models/remat_utils.py offload_policy);
+                # deterministic (arg 2 counting self) is static → positional
+                Scanned = nn.remat(Scanned, prevent_cse=False,
+                                   policy=offload_policy(cfg),
+                                   static_argnums=(2,))
             x, _ = Scanned(cfg, name="layers")(x, deterministic,
                                                attention_mask)
         else:
             block_cls = _remat_block(cfg)
-            for i in range(cfg.num_hidden_layers):
-                x = block_cls(cfg, name=f"layers_{i}")(x, deterministic,
-                                                       attention_mask)
+
+            def _stack(mdl, h, det, mask):
+                for i in range(cfg.num_hidden_layers):
+                    if cfg.remat:
+                        h = saved_block_input(h, cfg)
+                    h = block_cls(cfg, name=f"layers_{i}", parent=mdl)(
+                        h, det, mask)
+                return h
+
+            if offload:
+                # lifted remat on a (module, ...) function keeps the
+                # layers_{i} param paths unchanged while the one outer
+                # checkpoint host-offloads every block's input residual
+                x = nn.remat(_stack, prevent_cse=False,
+                             policy=offload_policy(cfg),
+                             static_argnums=(2,))(self, x, deterministic,
+                                                  attention_mask)
+            else:
+                x = _stack(self, x, deterministic, attention_mask)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
         if cfg.tie_word_embeddings:
             head = embed
@@ -352,8 +388,12 @@ class LlamaForTraining:
         return self.model.apply(variables, self._input_ids(batch), rngs=rngs)
 
     def with_activation_checkpointing(self, enabled: bool,
-                                      policy: str = "full"):
+                                      policy: str = "full",
+                                      cpu_checkpointing: bool = False,
+                                      partition_activations: bool = False):
         if policy == "none":
             enabled, policy = False, "full"
         return LlamaForTraining(dataclasses.replace(
-            self.config, remat=enabled, remat_policy=policy))
+            self.config, remat=enabled, remat_policy=policy,
+            cpu_checkpointing=cpu_checkpointing,
+            partition_activations=partition_activations))

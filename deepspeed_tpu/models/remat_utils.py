@@ -1,0 +1,57 @@
+"""Shared activation-checkpointing helpers for the model families.
+
+Reference ``runtime/activation_checkpointing/checkpointing.py``:
+- ``:485`` cpu_checkpointing — checkpointed segment inputs move to CPU
+  during forward and stream back for backward recompute;
+- ``:372`` partition_activations — saved activations are partitioned
+  across model-parallel ranks (1/mp stored each, all-gathered at use).
+
+TPU-native mapping: every block's input residual-stream tensor is tagged
+``checkpoint_name(..., "block_in")`` at the block CALL SITE, and a single
+stack-level ``jax.checkpoint`` whose policy host-offloads exactly those
+names replaces per-block remat when cpu_checkpointing is on. The
+partition knob is a GSPMD sharding constraint on the same saved value.
+Any config object with ``partition_activations``/``cpu_checkpointing``
+attributes (GPT2Config / LlamaConfig / BertConfig) can use these.
+"""
+
+import jax
+
+
+def saved_block_input(x, cfg):
+    """Annotate the block input as the checkpoint boundary value.
+
+    Applied at the block CALL SITE — outside any per-block inner remat —
+    so the value it returns is the exact tensor jax.checkpoint saves as
+    the block's residual (applied inside, the saved input would be the
+    pre-annotation value and the constraint would not bind the stored
+    buffer).
+
+    ``checkpoint_name`` tags the inter-layer residual stream so remat
+    policies can address it: the cpu_checkpointing outer policy offloads
+    exactly these values to host; with partition_activations a sharding
+    constraint first spreads the saved copy's sequence dim over the model
+    axis (reference checkpointing.py:372 partitions across MP ranks and
+    all-gathers at recompute — GSPMD inserts the same collectives here)."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    if cfg.partition_activations:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu.parallel.topology import (AXIS_DATA, AXIS_MODEL,
+                                                     get_topology)
+
+        topo = get_topology(create_if_missing=False)
+        if topo is not None and topo.axis_size(AXIS_MODEL) > 1:
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(topo.mesh, P(AXIS_DATA, AXIS_MODEL, None)))
+    return checkpoint_name(x, "block_in")
+
+
+def offload_policy(cfg):
+    """cpu_checkpointing remat policy: host-offload the named inter-layer
+    residuals, recompute everything else (reference checkpointing.py:485)."""
+    return jax.checkpoint_policies.save_and_offload_only_these_names(
+        names_which_can_be_saved=[],
+        names_which_can_be_offloaded=["block_in"],
+        offload_src="device", offload_dst="pinned_host")

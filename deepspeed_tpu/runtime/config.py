@@ -56,12 +56,17 @@ class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     # saves matmul outputs and recomputes only elementwise chains
     enabled: bool = True
     policy: str = "full"
+    # reference checkpointing.py:372 — saved inter-layer residuals get a
+    # sharding constraint spreading seq over the model axis (stored
+    # sharded, all-gathered at recompute)
     partition_activations: bool = False
-    contiguous_memory_optimization: bool = False
+    contiguous_memory_optimization: bool = False  # INERT: engine warns
+    # reference checkpointing.py:485 — saved inter-layer residuals are
+    # host-offloaded via a save_and_offload_only_these_names remat policy
     cpu_checkpointing: bool = False
-    number_checkpoints: Optional[int] = None
-    synchronize_checkpoint_boundary: bool = False
-    profile: bool = False
+    number_checkpoints: Optional[int] = None  # INERT: engine warns
+    synchronize_checkpoint_boundary: bool = False  # INERT: engine warns
+    profile: bool = False  # INERT: engine warns
 
 
 class CommsLoggerConfig(DeepSpeedConfigModel):

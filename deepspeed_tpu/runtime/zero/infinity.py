@@ -224,6 +224,46 @@ class ZeroInfinityEngine:
                 f"host; {self._tiled.n_tiles} tiles of {self._tiled.Vt} "
                 "rows stream per step", ranks=[0])
 
+        # --- tiled-MLP rows (generic TiledLinear, reference
+        # runtime/zero/tiling.py:27): when ONE layer's weights exceed the
+        # staging budget, whole-row staging is impossible — the two MLP
+        # matrices (the bulk of a row) stay host-resident and stream
+        # through out-dim weight tiles (runtime/zero/tiling.py), while the
+        # attention+LN remainder of the row stages as usual. Opt-in the
+        # same way as the vocab-tiled head: an explicit buffer_size below
+        # the row bytes.
+        self._tiled_mlp = None
+        if (off is not None and "buffer_size" in off.model_fields_set
+                and self._blocks is not None):
+            row_bytes = sum(
+                leaf.size // self.n_layer * 4
+                for leaf in jax.tree_util.tree_leaves(self._blocks))
+            if row_bytes > off.buffer_size:
+                if getattr(cfgm, "residual", "sequential") != "sequential":
+                    raise DeepSpeedConfigError(
+                        "tiled-MLP offload supports the sequential-residual "
+                        "decoder family; raise offload_param.buffer_size to "
+                        "stage whole layers")
+                from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+
+                C = cfgm.n_embd
+                Hf = 4 * C
+                itm = 2 if cfgm.dtype == jnp.bfloat16 else 4
+                self._tiled_mlp = (
+                    TiledLinear(C, Hf, out_tile=max(
+                        128, off.buffer_size // (C * itm)), dtype=cfgm.dtype),
+                    TiledLinear(Hf, C, out_tile=max(
+                        128, off.buffer_size // (Hf * itm)), dtype=cfgm.dtype))
+                # grad-accumulator view excluding the tiled matrices (their
+                # grads land tile-by-tile via TiledLinear.grads)
+                self._gblocks_rest = {k: v for k, v in self._gblocks.items()
+                                      if k != "mlp"}
+                log_dist(
+                    f"[infinity] tiled-MLP rows: layer bytes {row_bytes} "
+                    f"exceed budget {off.buffer_size}; c_fc streams "
+                    f"{self._tiled_mlp[0].n_tiles} tiles, c_proj "
+                    f"{self._tiled_mlp[1].n_tiles}", ranks=[0])
+
         self._top_dev = self._commit_top()
         self._gtop = None       # device-accumulated top grads
         self._compiled = {}
@@ -413,8 +453,75 @@ class ZeroInfinityEngine:
             fns["lnf"] = jax.jit(lnf)
             fns["lnf_vjp"] = jax.jit(
                 lambda top, h, g: jax.vjp(lnf, top, h)[1](g))
+        if self._tiled_mlp is not None:
+            # tiled-MLP row programs: the block splits at the MLP matmuls
+            # (those stream host tiles outside jit); pre_mlp covers
+            # ln_1 → attention → residual → ln_2, all deterministic
+            from deepspeed_tpu.models.gpt2 import CausalSelfAttention
+
+            def pre_mlp(bp_rest, x):
+                h1 = ln("ln_1", bp_rest, x)
+                attn_out = CausalSelfAttention(cfg).apply(
+                    {"params": bp_rest["attn"]}, h1, True)
+                x1 = x + attn_out
+                return x1, ln("ln_2", bp_rest, x1)
+
+            def pre_mlp_vjp(bp_rest, x, d_x1, d_h):
+                _, vjp = jax.vjp(pre_mlp, bp_rest, x)
+                return vjp((d_x1, d_h))
+
+            import flax.linen as fnn
+
+            def act_fwd(u):
+                if cfg.activation == "relu":
+                    return fnn.relu(u)
+                return fnn.gelu(u,
+                                approximate=cfg.activation != "gelu_exact")
+
+            fns["pre_mlp"] = jax.jit(pre_mlp)
+            fns["pre_mlp_vjp"] = jax.jit(pre_mlp_vjp)
+            fns["act_fwd"] = jax.jit(act_fwd)
+            fns["act_vjp"] = jax.jit(
+                lambda u, da: jax.vjp(act_fwd, u)[1](da)[0])
+            fns["resid_add"] = jax.jit(lambda x1, y2: x1 + y2)
         self._compiled[key] = fns
         return fns
+
+    # -- tiled-MLP row streaming (generic TiledLinear path) -------------
+    def _block_fwd_tiled(self, l, rest_dev, x, fns):
+        tl1, tl2 = self._tiled_mlp
+        fc = self._blocks["mlp"]["c_fc"]
+        pj = self._blocks["mlp"]["c_proj"]
+        x1, h = fns["pre_mlp"](rest_dev, x)
+        u = tl1.forward(h, fc["kernel"][l], fc["bias"][l],
+                        device=self._device)
+        a = fns["act_fwd"](u)
+        y2 = tl2.forward(a, pj["kernel"][l], pj["bias"][l],
+                         device=self._device)
+        return fns["resid_add"](x1, y2)
+
+    def _block_vjp_tiled(self, l, rest_dev, x, dy, fns):
+        """Backward for one tiled row: recompute x1/h/u/a from the saved
+        block input (weight remat — the big matrices stream again), then
+        chain the streamed VJPs. Tile weight grads land straight in the
+        host accumulators; the returned dbp covers only the staged
+        (attention/LN) part of the row."""
+        tl1, tl2 = self._tiled_mlp
+        fc = self._blocks["mlp"]["c_fc"]
+        pj = self._blocks["mlp"]["c_proj"]
+        gfc = self._gblocks["mlp"]["c_fc"]
+        gpj = self._gblocks["mlp"]["c_proj"]
+        x1, h = fns["pre_mlp"](rest_dev, x)
+        u = tl1.forward(h, fc["kernel"][l], fc["bias"][l],
+                        device=self._device)
+        a = fns["act_fwd"](u)
+        d_a = tl2.grads(a, pj["kernel"][l], dy, gpj["kernel"][l],
+                        gpj["bias"][l], device=self._device)
+        d_u = fns["act_vjp"](u, d_a)
+        d_h = tl1.grads(h, fc["kernel"][l], d_u, gfc["kernel"][l],
+                        gfc["bias"][l], device=self._device)
+        dbp_rest, dx = fns["pre_mlp_vjp"](rest_dev, x, dy, d_h)
+        return dbp_rest, dx
 
     def _commit_top(self):
         """Device copy of the top params; a tiled table stays on host."""
@@ -424,8 +531,12 @@ class ZeroInfinityEngine:
 
     def _row(self, l: int):
         """Layer ``l``'s weights as a host tree of contiguous row views —
-        the unit the H2D stream moves (host-RAM tier)."""
-        return jax.tree_util.tree_map(lambda a: a[l], self._blocks)
+        the unit the H2D stream moves (host-RAM tier). In tiled-MLP mode
+        the staged row excludes the MLP matrices (those stream as weight
+        tiles inside the block programs)."""
+        blocks = (self._blocks if self._tiled_mlp is None else
+                  {k: v for k, v in self._blocks.items() if k != "mlp"})
+        return jax.tree_util.tree_map(lambda a: a[l], blocks)
 
     def _fetch_row(self, l: int, prefetch: int = -1):
         """Layer ``l``'s weights on device; NVMe tier streams through the
@@ -471,7 +582,9 @@ class ZeroInfinityEngine:
         for l in range(L):
             cur, nxt = nxt, (self._fetch_row(l + 1, prefetch=l + 2)
                              if l + 1 < L else None)
-            x = fns["block_fwd"](cur, x)
+            x = (self._block_fwd_tiled(l, cur, x, fns)
+                 if self._tiled_mlp is not None
+                 else fns["block_fwd"](cur, x))
             acts.append(x)
 
         labels_d = jax.device_put(labels, dev)
@@ -499,7 +612,10 @@ class ZeroInfinityEngine:
         for l in range(L - 1, -1, -1):
             cur, nxt = nxt, (self._fetch_row(l - 1, prefetch=l - 2)
                              if l > 0 else None)
-            dbp, dx = fns["block_vjp"](cur, acts[l], dx)
+            if self._tiled_mlp is not None:
+                dbp, dx = self._block_vjp_tiled(l, cur, acts[l], dx, fns)
+            else:
+                dbp, dx = fns["block_vjp"](cur, acts[l], dx)
             for leaf in jax.tree_util.tree_leaves(dbp):
                 leaf.copy_to_host_async()
             if pending is not None:
@@ -524,9 +640,13 @@ class ZeroInfinityEngine:
 
     def _accum_block(self, l: int, dbp):
         host = jax.device_get(dbp)
+        # tiled-MLP rows produce grads only for the staged (non-mlp) part;
+        # the MLP tile grads already landed via TiledLinear.grads
+        target = (self._gblocks if self._tiled_mlp is None
+                  else self._gblocks_rest)
         def add(acc, g):
             acc[l] += np.asarray(g, np.float32)
-        jax.tree_util.tree_map(add, self._gblocks, host)
+        jax.tree_util.tree_map(add, target, host)
 
     def eval_loss(self, batch):
         """Streamed forward only (no gradients) — the inference/eval path."""
@@ -553,7 +673,9 @@ class ZeroInfinityEngine:
         for l in range(self.n_layer):
             cur, nxt = nxt, (self._fetch_row(l + 1, prefetch=l + 2)
                              if l + 1 < self.n_layer else None)
-            x = fns["block_fwd"](cur, x)
+            x = (self._block_fwd_tiled(l, cur, x, fns)
+                 if self._tiled_mlp is not None
+                 else fns["block_fwd"](cur, x))
         if self._tiled is not None:
             from deepspeed_tpu.models.gpt2 import shift_labels
 

@@ -38,6 +38,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # suite split (VERDICT r3 weak #7): `-m "not heavy"` is the fast
+    # development loop; CI / round gates run the full suite. Heavy =
+    # multi-minute compiles or real-text convergence runs.
+    config.addinivalue_line(
+        "markers", "heavy: slow tests (big compiles, convergence gates); "
+        "deselect with -m 'not heavy'")
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _assert_cpu_mesh():
     assert jax.device_count() >= 8, (

@@ -24,6 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.heavy  # convergence-scale runtimes
+
 import deepspeed_tpu
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
 from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology

@@ -102,6 +102,7 @@ class TestProfileModel:
         assert 100_000 < prof.n_params < 300_000
 
 
+@pytest.mark.heavy
 class TestAutotunerEndToEnd:
     @pytest.mark.parametrize("in_process", [True, False])
     def test_tunes_tiny_gpt2(self, tmp_path, in_process):

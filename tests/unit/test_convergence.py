@@ -32,6 +32,7 @@ def _copy_task_batches(vocab, B, T, n, seed=0):
 
 
 @pytest.mark.parametrize("zero_stage", [0, 3])
+@pytest.mark.heavy
 def test_copy_task_convergence(zero_stage):
     vocab, B, T = 64, 32, 32
     model = GPT2ForTraining(GPT2Config(

@@ -66,6 +66,7 @@ def test_garbage_tail_ignored():
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
 
 
+@pytest.mark.heavy
 def test_model_decode_uses_kernel(monkeypatch):
     """End-to-end: GPT-2 decode with the kernel matches the dense path."""
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel

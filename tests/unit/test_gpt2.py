@@ -124,6 +124,7 @@ class TestChunkedXent:
         assert scans and scans[0].params["length"] == 2  # ceil(127/64)
 
 
+@pytest.mark.heavy
 class TestBthdAttentionLayout:
     """attn_layout="bthd": transpose-free strided flash path
     (ops/flash_attention.py flash_attention_bthd; PERF.md layout-copy

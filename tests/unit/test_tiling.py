@@ -1,0 +1,243 @@
+"""Generic tiled linears (VERDICT r3 missing #3).
+
+Reference ``runtime/zero/tiling.py:27`` ``TiledLinear`` splits any linear
+into tiles so the whole weight never materializes at once. Under test:
+
+- ``TiledLinear`` (host-streaming): fp32 weight stays host-resident,
+  streams out-dim tiles through jitted per-tile kernels; forward and
+  streaming-VJP must match the dense computation exactly.
+- ``TiledDense`` (in-graph): ``[tiles, In, Out/tiles]`` kernel applied
+  under ``lax.scan`` + per-tile checkpoint; under ZeRO-3-style sharding
+  the compiled program must gather one tile at a time (memory proof).
+- The ZeRO-Infinity integration: a model whose per-LAYER weights exceed
+  ``offload_param.buffer_size`` — a WEIGHT, not a vocab table — trains
+  with tile-streamed MLP matmuls and matches the untiled trajectory.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+from deepspeed_tpu.parallel.topology import reset_topology
+from deepspeed_tpu.runtime.zero.infinity import ZeroInfinityEngine
+from deepspeed_tpu.runtime.zero.tiling import (TiledDense, TiledLinear,
+                                               tiled_dense)
+
+
+@pytest.fixture(autouse=True)
+def _clean_topology():
+    reset_topology()
+    yield
+    reset_topology()
+
+
+class TestTiledLinear:
+    IN, OUT, OT = 64, 1024, 192  # OT not dividing OUT: remainder tile
+
+    def _data(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(self.IN, self.OUT)).astype(np.float32) * 0.02
+        b = rng.normal(size=(self.OUT,)).astype(np.float32) * 0.01
+        x = jnp.asarray(rng.normal(size=(2, 8, self.IN)).astype(np.float32))
+        return w, b, x
+
+    def test_forward_matches_dense(self):
+        w, b, x = self._data()
+        tl = TiledLinear(self.IN, self.OUT, out_tile=self.OT)
+        assert tl.n_tiles == 6  # ceil(1024/192)
+        np.testing.assert_allclose(
+            np.asarray(tl.forward(x, w, b)), np.asarray(x @ w + b),
+            rtol=1e-5, atol=1e-5)
+
+    def test_streaming_vjp_matches_dense(self):
+        w, b, x = self._data()
+        tl = TiledLinear(self.IN, self.OUT, out_tile=self.OT)
+        rng = np.random.default_rng(1)
+        dy = jnp.asarray(rng.normal(
+            size=(2, 8, self.OUT)).astype(np.float32))
+        gw = np.zeros((self.IN, self.OUT), np.float32)
+        gb = np.zeros((self.OUT,), np.float32)
+        dx = tl.grads(x, w, dy, gw, gb)
+        ref = jax.grad(
+            lambda x_, w_, b_: jnp.sum((x_ @ w_ + b_) * dy),
+            argnums=(0, 1, 2))(x, jnp.asarray(w), jnp.asarray(b))
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(ref[0]),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(gw, np.asarray(ref[1]),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(gb, np.asarray(ref[2]),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_grad_accumulation_adds_in_place(self):
+        w, b, x = self._data()
+        tl = TiledLinear(self.IN, self.OUT, out_tile=self.OT)
+        dy = jnp.ones((2, 8, self.OUT), jnp.float32)
+        gw = np.zeros((self.IN, self.OUT), np.float32)
+        tl.grads(x, w, dy, gw)
+        once = gw.copy()
+        tl.grads(x, w, dy, gw)
+        np.testing.assert_allclose(gw, 2 * once, rtol=1e-6)
+
+    def test_bias_free(self):
+        w, _, x = self._data()
+        tl = TiledLinear(self.IN, self.OUT, out_tile=self.OT,
+                         use_bias=False)
+        np.testing.assert_allclose(
+            np.asarray(tl.forward(x, w)), np.asarray(x @ w),
+            rtol=1e-5, atol=1e-5)
+
+
+class TestTiledDense:
+    def test_matches_untiled_dense(self):
+        td = TiledDense(features=512, tiles=4)
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(2, 8, 64)).astype(np.float32))
+        p = td.init(jax.random.PRNGKey(0), x)
+        y = td.apply(p, x)
+        k = np.asarray(p["params"]["kernel"])       # [tiles, In, Ot]
+        dense_w = k.transpose(1, 0, 2).reshape(64, 512)
+        dense_b = np.asarray(p["params"]["bias"]).reshape(-1)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(x @ dense_w + dense_b),
+            rtol=1e-5, atol=1e-5)
+        # differentiable end to end (per-tile checkpoint in the scan)
+        g = jax.grad(lambda pp: jnp.sum(td.apply(pp, x) ** 2))(p)
+        assert g["params"]["kernel"].shape == (4, 64, 128)
+
+    def test_indivisible_tiles_raise(self):
+        td = TiledDense(features=100, tiles=3)
+        with pytest.raises(ValueError, match="divisible"):
+            td.init(jax.random.PRNGKey(0), jnp.ones((1, 8)))
+
+    def test_zero3_gathers_one_tile_at_a_time(self):
+        """The reference tiles linears so ZeRO-3 never allgathers the
+        whole weight (tiling.py:27 motivation). Under GSPMD a plain
+        sharded matmul often needs no gather at all (XLA partitions the
+        contraction), so the claim under test is the anti-regression
+        bound: with the kernel sharded over its tile axis — a layout a
+        single einsum CANNOT exploit — the scan must still keep peak temp
+        under the full kernel bytes, i.e. it gathers one tile per step
+        rather than materializing the kernel."""
+        n_dev = len(jax.devices())
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()).reshape(n_dev), ("d",))
+        TILES, IN, OUT = 8, 512, 4096
+        x = jnp.ones((2, IN), jnp.float32)
+        kernel = jnp.asarray(np.random.default_rng(0).normal(
+            size=(TILES, IN, OUT // TILES)).astype(np.float32) * 0.02)
+        kernel_bytes = kernel.size * 4
+
+        # ZeRO-3 idiom: shard each tile's inner dims, NOT the scanned
+        # tile axis (scanning over a device-sharded axis would force a
+        # full-array gather — same rule as the engine's scan-over-layers
+        # param layout, zero/partition.py)
+        tiled_sh = NamedSharding(mesh, P(None, "d", None))
+        repl = NamedSharding(mesh, P())
+
+        def loss_tiled(k):
+            return jnp.sum(tiled_dense(x, k, None) ** 2)
+
+        f_t = jax.jit(jax.grad(loss_tiled), in_shardings=(tiled_sh,),
+                      out_shardings=tiled_sh)
+        t_tiled = f_t.lower(kernel).compile().memory_analysis() \
+            .temp_size_in_bytes
+
+        dense_k = jnp.asarray(np.asarray(kernel).transpose(1, 0, 2)
+                              .reshape(IN, OUT))
+        dense_sh = NamedSharding(mesh, P("d", None))
+
+        def loss_dense(k):
+            return jnp.sum((x @ k) ** 2)
+
+        f_d = jax.jit(jax.grad(loss_dense), in_shardings=(dense_sh,),
+                      out_shardings=dense_sh)
+        t_dense = f_d.lower(dense_k).compile().memory_analysis() \
+            .temp_size_in_bytes
+
+        assert t_tiled < kernel_bytes, (
+            f"tiled temp {t_tiled} >= kernel {kernel_bytes}: the scan is "
+            "gathering more than one tile at a time")
+        # and the tiling must not cost order-of-magnitude scratch over the
+        # partitioned dense matmul
+        assert t_tiled < max(8 * t_dense, kernel_bytes // 2)
+        # numerics unchanged by the tiling
+        np.testing.assert_allclose(
+            float(loss_tiled(kernel)), float(loss_dense(dense_k)),
+            rtol=1e-5)
+
+
+class TestInfinityTiledMLP:
+    def _engine(self, buffer_size):
+        return deepspeed_tpu.initialize(
+            model=GPT2ForTraining(GPT2Config(
+                vocab_size=128, n_positions=32, n_embd=64, n_layer=2,
+                n_head=4, dtype=jnp.float32, scan_layers=True)),
+            config={"train_batch_size": 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "gradient_clipping": 1.0,
+                    "zero_optimization": {
+                        "stage": 3,
+                        "offload_param": {"device": "cpu",
+                                          "buffer_size": buffer_size}},
+                    "steps_per_print": 10_000})[0]
+
+    def test_layer_exceeding_budget_trains(self):
+        """A LAYER's weights (not a vocab table) exceed the staging
+        budget: the MLP matrices stream as tiles and training learns."""
+        engine = self._engine(48 * 1024)  # row ~195KB > 48KB
+        assert isinstance(engine, ZeroInfinityEngine)
+        assert engine._tiled_mlp is not None
+        tl1, tl2 = engine._tiled_mlp
+        # every staged piece respects the budget: weight tiles and the
+        # non-MLP row remainder
+        assert tl1.Ot * 64 * 4 <= 48 * 1024
+        rest_bytes = sum(leaf.size // 2 * 4 for leaf in
+                         jax.tree_util.tree_leaves(engine._row(0)))
+        assert rest_bytes <= 48 * 1024
+        ids = np.random.default_rng(0).integers(
+            0, 128, (2, 16)).astype(np.int32)
+        losses = []
+        for _ in range(6):
+            loss = engine({"input_ids": ids})
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] - 0.4, losses
+
+    def test_tiled_mlp_matches_untiled_trajectory(self):
+        e_tiled = self._engine(48 * 1024)
+        e_dense = self._engine(10 ** 9)
+        assert e_tiled._tiled_mlp is not None
+        assert e_dense._tiled_mlp is None
+        ids = np.random.default_rng(0).integers(
+            0, 128, (2, 16)).astype(np.int32)
+        for _ in range(3):
+            l1 = e_tiled({"input_ids": ids})
+            e_tiled.backward(l1)
+            e_tiled.step()
+            l2 = e_dense({"input_ids": ids})
+            e_dense.backward(l2)
+            e_dense.step()
+            np.testing.assert_allclose(float(l1), float(l2),
+                                       rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(
+            float(e_tiled.eval_loss({"input_ids": ids})),
+            float(e_dense.eval_loss({"input_ids": ids})),
+            rtol=2e-4, atol=2e-5)
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        engine = self._engine(48 * 1024)
+        ids = np.random.default_rng(0).integers(
+            0, 128, (2, 16)).astype(np.int32)
+        loss = engine({"input_ids": ids})
+        engine.backward(loss)
+        engine.step()
+        engine.save_checkpoint(str(tmp_path), tag="t1")
+        tag, _ = engine.load_checkpoint(str(tmp_path), tag="t1")
+        assert tag == "t1"
+        l2 = engine({"input_ids": ids})
+        assert np.isfinite(float(l2))

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Continuous TPU-backend probe: poll every ~15 min, append a status line to
+# tools/probe_log_r04.txt.  When the backend answers, write tools/CHIP_UP
+# as a sentinel so the session notices and runs tools/real_chip_backlog.sh.
+cd "$(dirname "$0")/.."
+LOG=tools/probe_log_r04.txt
+while true; do
+  TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  OUT=$(timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = (jnp.ones((128,128)) @ jnp.ones((128,128))).block_until_ready()
+print('UP', d[0].platform, len(d))" 2>/dev/null | grep '^UP' | tail -1)
+  [[ -z "$OUT" ]] && OUT="DOWN (timeout/no-answer)"
+  echo "$TS $OUT" >> "$LOG"
+  if [[ "$OUT" == UP* ]]; then
+    touch tools/CHIP_UP
+    echo "$TS sentinel written" >> "$LOG"
+  fi
+  sleep 900
+done

@@ -1,0 +1,192 @@
+"""Compile-level memory proof for BASELINE.json's big tracked configs.
+
+No weights are materialized: params are ``jax.eval_shape`` abstractions,
+the train/infer step is ``lower().compile()``d against a virtual CPU
+mesh of the target chip count, and XLA's ``memory_analysis()`` reports
+per-device bytes (the same technique as tests/unit/test_zero_memory.py,
+at BASELINE scale). VERDICT r3 next-round #4.
+
+Run directly (prints one JSON line per config):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=64 \
+        python tools/scale_proof.py llama7b_zero3_v5p64
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/scale_proof.py bloom176b_tp8
+
+Caveat: the CPU lowering uses the reference (non-flash) attention, which
+materializes [B, H, T, T] logits — device temp here is an OVERESTIMATE
+of the TPU program (flash kernel streams K/V tiles in VMEM), so a pass
+against the HBM budget is conservative.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V5P_HBM_GIB = 95  # HBM per v5p chip
+
+
+def _mesh(axis_sizes):
+    from deepspeed_tpu.parallel.topology import (MeshTopology,
+                                                 reset_topology,
+                                                 set_topology)
+
+    reset_topology()
+    topo = MeshTopology(axis_sizes=axis_sizes)
+    set_topology(topo)
+    return topo
+
+
+def llama7b_zero3_v5p64():
+    """Llama-2-7B, ZeRO-3 param partition, pure-data v5p-64 mesh
+    (BASELINE.json config #3): full train step (fwd+bwd+AdamW)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForTraining
+    from deepspeed_tpu.runtime.zero.partition import (
+        batch_sharding, build_opt_state_shardings, build_zero_shardings,
+        replicated)
+
+    topo = _mesh({"data": 64})
+    mesh = topo.mesh
+    cfg = LlamaConfig(vocab_size=32000, max_position_embeddings=4096,
+                      hidden_size=4096, intermediate_size=11008,
+                      num_hidden_layers=32, num_attention_heads=32,
+                      remat=True, scan_layers=True)
+    model = LlamaForTraining(cfg)
+    B, T = 64, 4096  # one sequence per chip
+    batch = {"input_ids": jax.ShapeDtypeStruct((B, T), np.int32)}
+    abstract = jax.eval_shape(
+        lambda r: model.init(
+            r, {"input_ids": jnp.zeros((B, T), jnp.int32)})["params"],
+        jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(abstract))
+    psh, _ = build_zero_shardings(abstract, mesh, stage=3,
+                                  persistence_threshold=0)
+    opt = optax.adamw(1e-4)
+    opt_abstract = jax.eval_shape(opt.init, abstract)
+    osh = build_opt_state_shardings(opt_abstract, abstract, mesh, stage=3)
+
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(model.loss_fn)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    ma = jax.jit(
+        train_step,
+        in_shardings=(psh, osh, batch_sharding(mesh)),
+        out_shardings=(psh, osh, replicated(mesh)),
+        donate_argnums=(0, 1),
+    ).lower(abstract, opt_abstract, batch).compile().memory_analysis()
+    return {"config": "llama7b_zero3_v5p64", "n_devices": 64,
+            "params_b": round(n_params / 1e9, 2),
+            "arg_gib": ma.argument_size_in_bytes / 2**30,
+            "temp_gib": ma.temp_size_in_bytes / 2**30,
+            "out_gib": ma.output_size_in_bytes / 2**30,
+            "alias_gib": ma.alias_size_in_bytes / 2**30}
+
+
+def bloom176b_tp8():
+    """BLOOM-176B DeepSpeed-Inference tensor-parallel prefill
+    (BASELINE.json config #4): bf16 weights TP-sharded over 8 chips via
+    the bloom module-inject policy, batch-1 2048-token prefill."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.module_inject import get_tp_policy, specs_from_policy
+    from deepspeed_tpu.runtime.zero.partition import replicated
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    topo = _mesh({"model": 8})
+    mesh = topo.mesh
+    # BLOOM-176B: 70 layers, hidden 14336, 112 heads, ALiBi positions,
+    # embedding layernorm, tied head (HF config; state_dict_factory's
+    # canonical-decoder normalization serves the real weights)
+    cfg = GPT2Config(vocab_size=250880, n_positions=2048, n_embd=14336,
+                     n_layer=70, n_head=112, position_embedding="alibi",
+                     embedding_layernorm=True, tied_head=True,
+                     dtype=jnp.bfloat16, scan_layers=True)
+    model = GPT2LMHeadModel(cfg)
+    B, T = 1, 2048
+    abstract32 = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((B, T), jnp.int32))["params"],
+        jax.random.PRNGKey(0))
+    # inference engine converts weights to bf16 (inference/engine.py)
+    abstract = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, jnp.bfloat16), abstract32)
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(abstract))
+    specs = specs_from_policy(get_tp_policy("gpt2"), abstract, mesh)
+    psh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s if s is not None else P()), specs,
+        is_leaf=lambda x: x is None or isinstance(x, P))
+
+    def prefill(params, ids):
+        return model.apply({"params": params}, ids, deterministic=True)
+
+    ma = jax.jit(
+        prefill,
+        in_shardings=(psh, replicated(mesh)),
+        out_shardings=replicated(mesh),
+    ).lower(abstract,
+            jax.ShapeDtypeStruct((B, T), np.int32)).compile() \
+        .memory_analysis()
+    # XLA:CPU's buffer assignment does not reuse across sequential layer
+    # regions — measured temp grows ~1 GiB/LAYER even unrolled, for an
+    # INFERENCE pass where nothing is carried. So the per-device HBM
+    # claim uses (a) the exact sharded weight bytes (arg) — the part a
+    # TP-spec regression would move — plus (b) an analytic bound on the
+    # genuinely-live activations at the prefill spike: fp32 [T, V]
+    # logits, one layer's TP-sharded [H/tp, T, T] fp32 attention scores
+    # (flash on TPU streams these; dense is the worst case), the
+    # [T, 4C] MLP intermediates, and the [T, C] residual stream.
+    H, C, V, tp = cfg.n_head, cfg.n_embd, cfg.vocab_size, 8
+    working = (T * V * 4                      # head logits fp32
+               + (H // tp) * T * T * 4        # attn scores (one layer)
+               + T * 4 * C * 6                # MLP in/out bf16+fp32
+               + T * C * 8                    # residual stream copies
+               ) / 2**30
+    return {"config": "bloom176b_tp8", "n_devices": 8,
+            "params_b": round(n_params / 1e9, 2),
+            "arg_gib": ma.argument_size_in_bytes / 2**30,
+            "analytic_working_gib": working,
+            "cpu_temp_gib_artifact": ma.temp_size_in_bytes / 2**30,
+            "out_gib": ma.output_size_in_bytes / 2**30,
+            "alias_gib": ma.alias_size_in_bytes / 2**30}
+
+
+CONFIGS = {
+    "llama7b_zero3_v5p64": (llama7b_zero3_v5p64, 64),
+    "bloom176b_tp8": (bloom176b_tp8, 8),
+}
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    for name in sys.argv[1:] or list(CONFIGS):
+        fn, n_dev = CONFIGS[name]
+        assert jax.device_count() >= n_dev, (
+            f"{name} needs {n_dev} virtual devices; set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n_dev}")
+        stats = fn()
+        peak = stats["arg_gib"] + stats.get(
+            "temp_gib", stats.get("analytic_working_gib", 0.0))
+        stats["peak_gib"] = peak
+        stats["budget_gib"] = V5P_HBM_GIB
+        stats["fits"] = peak < V5P_HBM_GIB
+        print(json.dumps({k: (round(v, 2) if isinstance(v, float) else v)
+                          for k, v in stats.items()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,14 @@ def initialize(args=None,
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 
+def default_inference_config():
+    """Default inference config as a plain dict (reference
+    ``deepspeed/__init__.py:226``)."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+    return DeepSpeedInferenceConfig().model_dump()
+
+
 def init_inference(model, config=None, **kwargs):
     """Build an inference engine (reference ``deepspeed/__init__.py:233``)."""
     from deepspeed_tpu.inference.engine import InferenceEngine

@@ -283,6 +283,7 @@ class DeepSpeedEngine:
                            "and a standard optimizer; disabling")
             self._fused_step = False
         self._fused_meta = None  # (overflow, grad_norm) of the last fused step
+        self._last_overflow = None  # was_step_applied() introspection
 
         # --- ZeRO-Offload optimizer tier (reference stage_1_and_2.py cpu
         #     offload + swap_tensor optimizer swappers): masters/moments on
@@ -1052,10 +1053,12 @@ class DeepSpeedEngine:
                 # optimizer already applied inside the fused forward program
                 if self._fused_meta is not None:
                     self._last_grad_norm = self._fused_meta[1]
+                    self._last_overflow = self._fused_meta[0]
             else:
                 self.state, overflow, grad_norm = self._jit_apply(
                     self.state, self._lr_override())
                 self._last_grad_norm = grad_norm
+                self._last_overflow = overflow
             self.global_steps += 1
             self.global_samples += self.train_batch_size()
             if self.lr_scheduler is not None:
@@ -1097,6 +1100,7 @@ class DeepSpeedEngine:
             self.state.grad_acc, lr=lr, loss_scale=scale,
             check_overflow=fp16)
         self._last_grad_norm = grad_norm
+        self._last_overflow = bool(overflow)
         # identical dynamic-loss-scale semantics to the compiled apply_step
         # (growth window, hysteresis, min_scale floor)
         new_scale = update_scale(self._scaler_config, self.state.loss_scale,
@@ -1264,6 +1268,400 @@ class DeepSpeedEngine:
         if self.state is not None:
             return int(self.state.skipped_steps)
         return self.skipped_steps
+
+    def was_step_applied(self) -> bool:
+        """Whether the last ``step()`` updated the weights (False = the
+        fp16 overflow path skipped it; reference ``engine.py:2143``)."""
+        if self._last_overflow is None:
+            return True
+        return not bool(self._last_overflow)
+
+    # -- module state dict / 16-bit export (reference engine.py:2980+) --
+    def module_state_dict(self):
+        """Host copy of the model parameters (reference
+        ``module_state_dict``; here a pytree, since the model is a flax
+        module, not a torch one)."""
+        if self.state is None:
+            raise RuntimeError(
+                "module_state_dict() before any forward(): parameters are "
+                "materialized lazily at the first batch")
+        return jax.device_get(self.state.params)
+
+    def load_module_state_dict(self, state_dict, strict=True):
+        """Replace the live parameters from a host pytree (reference
+        ``load_module_state_dict``): leaves are cast to the existing dtype
+        and placed with the existing shardings. ``strict=False`` merges by
+        parameter path — missing entries keep their current values,
+        unknown entries are ignored (the reference's partial-load
+        semantics)."""
+        if self.state is None:
+            raise RuntimeError("load_module_state_dict() before any "
+                               "forward()")
+        from deepspeed_tpu.utils.pytree import flatten_with_path_strings
+
+        def place(old, new):
+            return jax.device_put(jnp.asarray(new, old.dtype), old.sharding)
+
+        if strict:
+            old_td = jax.tree_util.tree_structure(self.state.params)
+            new_td = jax.tree_util.tree_structure(state_dict)
+            if old_td != new_td:
+                raise ValueError(
+                    f"state_dict structure mismatch: {new_td} vs {old_td}")
+            new_params = jax.tree_util.tree_map(place, self.state.params,
+                                                state_dict)
+        else:
+            incoming = dict(flatten_with_path_strings(state_dict)[0])
+            flat, treedef = flatten_with_path_strings(self.state.params)
+            new_params = jax.tree_util.tree_unflatten(
+                treedef,
+                [place(leaf, incoming[path]) if path in incoming else leaf
+                 for path, leaf in flat])
+        self.state = self.state._replace(params=new_params)
+
+    def save_16bit_model(self, save_dir, save_filename="model_16bit.safetensors",
+                         exclude_frozen_parameters=False):
+        """Consolidated 16-bit weights for deployment (reference
+        ``save_16bit_model`` / ``zero_gather_16bit_weights_on_model_save``,
+        engine.py:3043): params gather to host, cast to the configured
+        16-bit dtype, and write as safetensors (``/`` joined paths) — the
+        format the inference state-dict factory reads back."""
+        del exclude_frozen_parameters  # flax trees carry no frozen split
+        import numpy as np_
+
+        from deepspeed_tpu.utils.pytree import flatten_with_path_strings
+
+        dtype = jnp.float16 if self.fp16_enabled_ else jnp.bfloat16
+        params = self.module_state_dict()
+        flat, _ = flatten_with_path_strings(params)
+        tensors = {path: np_.asarray(jnp.asarray(leaf).astype(dtype))
+                   for path, leaf in flat}
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, save_filename)
+        try:
+            from safetensors.numpy import save_file
+
+            # bf16 numpy arrays round-trip through safetensors' own view
+            save_file(tensors, path)
+        except ImportError:
+            path = os.path.splitext(path)[0] + ".npz"
+            np_.savez(path, **{k: v.view(np_.uint16)
+                               if v.dtype == jnp.bfloat16 else v
+                               for k, v in tensors.items()})
+        log_dist(f"saved 16-bit model to {path}", ranks=[0])
+        return path
+
+    # torch spelling kept for drop-in compatibility
+    save_fp16_model = save_16bit_model
+
+    def set_train_batch_size(self, train_batch_size):
+        """Adjust the global batch between steps by changing ONLY the
+        gradient-accumulation factor (reference ``set_train_batch_size``,
+        engine.py:528: micro-batch and dp world are compiled-in). The
+        micro/fused step programs bake the gas divisor into the compiled
+        loss scaling, so live programs are rebuilt here."""
+        per_step = (self.train_micro_batch_size_per_gpu()
+                    * self.topology.get_data_parallel_world_size())
+        if train_batch_size % per_step != 0:
+            raise DeepSpeedConfigError(
+                f"train_batch_size {train_batch_size} is not divisible by "
+                f"micro_batch x dp_world = {per_step}")
+        self._config.train_batch_size = train_batch_size
+        self._config.gradient_accumulation_steps = train_batch_size // per_step
+        # re-gate the fused path (gas==1 only) and rebuild any live
+        # programs against the new accumulation factor
+        self._fused_step = (bool(self._config.fused_step)
+                            and self._config.gradient_accumulation_steps == 1
+                            and not self._onebit and not self._host_offload)
+        if self.state is not None:
+            self._compile_steps()
+
+    def get_batch_info(self):
+        return (self.train_batch_size(),
+                self.train_micro_batch_size_per_gpu(),
+                self.gradient_accumulation_steps())
+
+    def get_pld_theta(self):
+        if self.progressive_layer_drop is None:
+            return None
+        return self.progressive_layer_drop.get_theta()
+
+    def memory_breakdown(self):
+        """Reference ``memory_breakdown`` getter (config flag); the actual
+        numbers live in :meth:`memory_stats`."""
+        return self._config.memory_breakdown
+
+    def zero_grad(self):
+        """No-op for API compatibility (reference ``zero_grad``): the
+        functional train step rebuilds gradients every micro-step and
+        zeroes the accumulator at each boundary in-graph."""
+
+    def allreduce_gradients(self, bucket_size=None):
+        """No-op for API compatibility (reference ``allreduce_gradients``):
+        GSPMD inserts the gradient psum over the data axis inside the
+        compiled step — there is no separate reduction phase to invoke."""
+        del bucket_size
+
+    def destroy(self):
+        """Release ALL compiled programs and device state (reference
+        ``destroy``): micro/fused/apply, the per-stage 1-bit cache, the
+        eval program, and the offload-commit program."""
+        self._jit_micro = self._jit_fused = None
+        self._jit_apply = None
+        self._jit_onebit = {}
+        self._jit_offload_commit = None
+        if hasattr(self, "_jit_eval"):
+            del self._jit_eval
+        self.state = None
+
+    # -- thin config getters (reference engine.py:502-883 accessor zoo;
+    #    each returns the parsed config value, including knobs that are
+    #    accepted-but-moot under XLA, so ported tooling keeps working) --
+    def amp_enabled(self):
+        return self._config.amp.enabled
+
+    def amp_params(self):
+        return self._config.amp
+
+    def optimizer_name(self):
+        return (self.client_optimizer.__class__.__name__
+                if self.client_optimizer else self._config.optimizer_name)
+
+    def optimizer_params(self):
+        return self._config.optimizer_params
+
+    def optimizer_legacy_fusion(self):
+        return self._config.optimizer_legacy_fusion
+
+    def scheduler_name(self):
+        return self._config.scheduler_name
+
+    def scheduler_params(self):
+        return self._config.scheduler_params
+
+    def dynamic_loss_scale(self):
+        return self._config.fp16.loss_scale == 0
+
+    def initial_dynamic_scale(self):
+        return float(self._initial_loss_scaler.loss_scale)
+
+    def dynamic_loss_scale_args(self):
+        f = self._config.fp16
+        return {"init_scale": 2 ** f.initial_scale_power,
+                "scale_window": f.loss_scale_window,
+                "min_scale": f.min_loss_scale,
+                "delayed_shift": f.hysteresis}
+
+    def fp16_auto_cast(self):
+        return self._config.fp16.auto_cast
+
+    def fp16_master_weights_and_gradients(self):
+        # fp32 masters always (runtime/precision_config.py policy)
+        return False
+
+    def postscale_gradients(self):
+        return not self._config.prescale_gradients
+
+    def gradient_predivide_factor(self):
+        return self._config.gradient_predivide_factor
+
+    def communication_data_type(self):
+        return self._config.communication_data_type
+
+    def sparse_gradients_enabled(self):
+        return self._config.sparse_gradients_enabled
+
+    def dataloader_drop_last(self):
+        return self._config.dataloader_drop_last
+
+    def checkpoint_tag_validation_enabled(self):
+        return self._config.checkpoint_tag_validation_enabled
+
+    def checkpoint_tag_validation_fail(self):
+        return self._config.checkpoint_tag_validation_fail
+
+    def load_universal_checkpoint(self):
+        """Reference getter; mesh-change-tolerant restore needs no special
+        mode here — ``load_checkpoint`` reshapes by construction
+        (tests/unit/test_checkpoint_reshape.py)."""
+        return self._config.load_universal_checkpoint
+
+    def use_node_local_storage(self):
+        return self._config.use_node_local_storage
+
+    def elasticity_enabled(self):
+        return bool(self._config.elasticity_config.get("enabled", False))
+
+    def swap_tensor_config(self):
+        z = self._config.zero_config
+        return {"offload_param": z.offload_param,
+                "offload_optimizer": z.offload_optimizer}
+
+    def aio_config(self):
+        return self._config.aio_config
+
+    def get_data_types(self):
+        return (self._config.precision_dtype, jnp.float32)
+
+    def curriculum_learning_config(self):
+        return self._config.data_efficiency_config.get(
+            "curriculum_learning", self._config.curriculum_params_legacy)
+
+    def curriculum_learning_enabled(self):
+        return (self.curriculum_scheduler is not None
+                or bool(self.curriculum_learning_config().get(
+                    "enabled", False)))
+
+    def data_efficiency_enabled(self):
+        return bool(self._config.data_efficiency_config.get("enabled",
+                                                            False))
+
+    def data_efficiency_config(self):
+        return self._config.data_efficiency_config
+
+    def data_sampling_enabled(self):
+        return bool(self.data_sampling_config().get("enabled", False))
+
+    def data_sampling_config(self):
+        return self._config.data_efficiency_config.get("data_sampling", {})
+
+    def random_ltd_config(self):
+        return self._config.data_efficiency_config.get("data_routing", {}) \
+            .get("random_ltd", {})
+
+    def quantize_training(self):
+        return self._config._param_dict.get("quantize_training", {})
+
+    # eigenvalue getters (reference engine.py:700 region)
+    def eigenvalue_verbose(self):
+        return (self._config.eigenvalue_params or {}).get("verbose", False)
+
+    def eigenvalue_max_iter(self):
+        return (self._config.eigenvalue_params or {}).get("max_iter", 100)
+
+    def eigenvalue_tol(self):
+        return (self._config.eigenvalue_params or {}).get("tol", 1e-2)
+
+    def eigenvalue_stability(self):
+        return (self._config.eigenvalue_params or {}).get("stability", 1e-6)
+
+    def eigenvalue_gas_boundary_resolution(self):
+        return (self._config.eigenvalue_params or {}).get(
+            "gas_boundary_resolution", 1)
+
+    def eigenvalue_layer_name(self):
+        return (self._config.eigenvalue_params or {}).get(
+            "layer_name", "block")
+
+    def eigenvalue_layer_num(self):
+        return (self._config.eigenvalue_params or {}).get("layer_num", 0)
+
+    # flops profiler getters
+    def flops_profiler_enabled(self):
+        return self._config.flops_profiler_config.enabled
+
+    def flops_profiler_profile_step(self):
+        return self._config.flops_profiler_config.profile_step
+
+    def flops_profiler_module_depth(self):
+        return self._config.flops_profiler_config.module_depth
+
+    def flops_profiler_top_modules(self):
+        return self._config.flops_profiler_config.top_modules
+
+    def flops_profiler_detailed(self):
+        return self._config.flops_profiler_config.detailed
+
+    def flops_profiler_output_file(self):
+        return self._config.flops_profiler_config.output_file
+
+    # autotuning getters
+    def autotuning_enabled(self):
+        return bool(self._config.autotuning_config.get("enabled", False))
+
+    def autotuning_start_profile_step(self):
+        return self._config.autotuning_config.get("start_profile_step", 3)
+
+    def autotuning_end_profile_step(self):
+        return self._config.autotuning_config.get("end_profile_step", 5)
+
+    def autotuning_metric(self):
+        return self._config.autotuning_config.get("metric", "throughput")
+
+    # zero_* getters (reference engine.py:760-880; the bucket/overlap knobs
+    # are XLA-scheduled here but the configured values are reported)
+    def zero_allow_untested_optimizer(self):
+        return self._config.zero_allow_untested_optimizer
+
+    def zero_allgather_partitions(self):
+        return self._config.zero_config.allgather_partitions
+
+    def zero_allgather_bucket_size(self):
+        return self._config.zero_config.allgather_bucket_size
+
+    def zero_reduce_scatter(self):
+        return self._config.zero_config.reduce_scatter
+
+    def zero_reduce_bucket_size(self):
+        return self._config.zero_config.reduce_bucket_size
+
+    def zero_overlap_comm(self):
+        return self._config.zero_config.overlap_comm
+
+    def zero_contiguous_gradients(self):
+        return self._config.zero_config.contiguous_gradients
+
+    def zero_sub_group_size(self):
+        return self._config.zero_config.sub_group_size
+
+    def zero_prefetch_bucket_size(self):
+        return self._config.zero_config.prefetch_bucket_size
+
+    def zero_param_persistence_threshold(self):
+        return self._config.zero_config.param_persistence_threshold
+
+    def zero_model_persistence_threshold(self):
+        return self._config.zero_config.model_persistence_threshold
+
+    def zero_max_live_parameters(self):
+        return self._config.zero_config.max_live_parameters
+
+    def zero_max_reuse_distance(self):
+        return self._config.zero_config.max_reuse_distance
+
+    def zero_gather_16bit_weights_on_model_save(self):
+        return self._config.zero_config.gather_16bit_weights_on_model_save
+
+    def zero_ignore_unused_parameters(self):
+        return self._config.zero_config.ignore_unused_parameters
+
+    def zero_legacy_stage1(self):
+        return self._config.zero_config.legacy_stage1
+
+    def zero_round_robin_gradients(self):
+        return self._config.zero_config.round_robin_gradients
+
+    def zero_elastic_checkpoint(self):
+        return self._config.zero_config.elastic_checkpoint
+
+    def zero_load_from_fp32_weights(self):
+        return self._config.zero_config.load_from_fp32_weights
+
+    def zero_cpu_offload(self):
+        off = self._config.zero_config.offload_optimizer
+        return off is not None and str(off.device) == "cpu"
+
+    def zero_offload_param(self):
+        return self._config.zero_config.offload_param
+
+    def zero_offload_optimizer(self):
+        return self._config.zero_config.offload_optimizer
+
+    def zero_optimization_partition_gradients(self):
+        return self.zero_optimization_stage() >= 2
+
+    def zero_optimization_partition_weights(self):
+        return self.zero_optimization_stage() >= 3
 
     def train(self, mode=True):
         self.warn_unscaled_loss = True

@@ -10,8 +10,9 @@ Here the same flow is one call::
     out = engine.generate(ids, max_new_tokens=64)
 
 Supported: GPT-2, OPT, BLOOM (canonical fused decoder), Llama (native
-family) — detected from the checkpoint's weight names; the matching TP
-injection policy is selected automatically.
+family), CLIP (dual-encoder serving engine) — detected from the
+checkpoint's weight names; the matching TP injection policy is selected
+automatically.
 """
 
 from typing import Optional
@@ -73,6 +74,39 @@ def load_pretrained(src, arch: Optional[str] = None, dtype=None,
 
     sd = src if isinstance(src, dict) else SDLoaderFactory.load(src)
     arch = arch or detect_arch(sd)
+    if arch == "clip":
+        # dual-encoder family (reference HFCLIPLayerPolicy): the tower
+        # hyperparameters live in config.json, not the weight names
+        import dataclasses as _dc
+
+        from deepspeed_tpu.models.clip import (CLIPModel,
+                                               clip_config_from_hf,
+                                               clip_params_from_hf)
+        from deepspeed_tpu.runtime.state_dict_factory import \
+            _load_config_json
+
+        cfg_src = loader_kw.pop("hf_config", None)
+        if cfg_src is None:
+            import os
+
+            path = src if isinstance(src, str) else None
+            if path and not os.path.isdir(path):
+                # a weights-FILE path: config.json lives beside it
+                # (same resolution as _sniff_config)
+                path = os.path.dirname(os.path.abspath(path))
+            if path:
+                path = os.path.join(path, "config.json")
+            if not (path and os.path.exists(path)):
+                raise ValueError(
+                    "clip: pass hf_config= (a transformers CLIPConfig or "
+                    "its dict) when loading from a bare state dict — the "
+                    "tower shapes are not derivable from weight names")
+            cfg_src = _load_config_json(path)
+        config = clip_config_from_hf(cfg_src)
+        config = _dc.replace(config, scan_layers=scan_layers,
+                             **({"dtype": dtype} if dtype else {}))
+        params = clip_params_from_hf(sd, config)
+        return CLIPModel(config), params, "clip"
     if arch not in _SNIFF_KW:
         raise ValueError(
             f"unsupported architecture {arch!r}; supported: "
@@ -111,6 +145,54 @@ def load_pretrained(src, arch: Optional[str] = None, dtype=None,
     return model, params, arch
 
 
+class CLIPServingEngine:
+    """TP-sharded CLIP serving: jitted text/image feature extraction and
+    temperature-scaled similarity (the reference serves CLIP through the
+    same init_inference flow — its engine only injects the encoder
+    kernels; generation never applies to a dual encoder)."""
+
+    def __init__(self, model, params, tp_size: int = 1):
+        import jax
+
+        from deepspeed_tpu.module_inject.policies import \
+            shard_params_with_policy
+        from deepspeed_tpu.parallel.topology import (AXIS_MODEL,
+                                                     MeshTopology,
+                                                     get_topology,
+                                                     set_topology)
+
+        self.model = model
+        # same mesh resolution as InferenceEngine (inference/engine.py:76):
+        # reuse an existing topology only when its model axis matches the
+        # requested tp_size; otherwise build the TP mesh — never silently
+        # serve replicated when sharding was asked for
+        existing = get_topology(create_if_missing=False)
+        if existing is not None and existing.axis_size(AXIS_MODEL) == tp_size:
+            topo = existing
+        else:
+            topo = MeshTopology(axis_sizes={AXIS_MODEL: tp_size})
+            set_topology(topo)
+        self.topology = topo
+        if topo.axis_size(AXIS_MODEL) > 1:
+            params, _ = shard_params_with_policy(params, "clip", topo.mesh)
+        self.params = params
+        self._text_fn = jax.jit(lambda p, i: model.apply(
+            {"params": p}, i, method=type(model).get_text_features))
+        self._image_fn = jax.jit(lambda p, px: model.apply(
+            {"params": p}, px, method=type(model).get_image_features))
+        self._sim_fn = jax.jit(lambda p, i, px: model.apply(
+            {"params": p}, i, px))
+
+    def encode_text(self, input_ids):
+        return self._text_fn(self.params, input_ids)
+
+    def encode_image(self, pixel_values):
+        return self._image_fn(self.params, pixel_values)
+
+    def __call__(self, input_ids, pixel_values):
+        return self._sim_fn(self.params, input_ids, pixel_values)
+
+
 def from_pretrained(src, arch: Optional[str] = None, dtype=None,
                     scan_layers: bool = True, loader_kw=None, **engine_kw):
     """One-call serving engine for an HF checkpoint (reference
@@ -120,6 +202,11 @@ def from_pretrained(src, arch: Optional[str] = None, dtype=None,
     model, params, arch = load_pretrained(src, arch=arch, dtype=dtype,
                                           scan_layers=scan_layers,
                                           **(loader_kw or {}))
+    if arch == "clip":
+        tp = engine_kw.get("tensor_parallel", {})
+        tp_size = tp.get("tp_size", 1) if isinstance(tp, dict) else \
+            getattr(tp, "tp_size", 1)
+        return CLIPServingEngine(model, params, tp_size=tp_size)
     engine_kw.setdefault("injection_policy", _POLICY_FOR_ARCH[arch])
     if dtype is not None:
         engine_kw.setdefault("dtype", dtype)

@@ -143,13 +143,10 @@ class InferenceEngine:
             lambda x: x.astype(dtype) if _is_floating(x) else x, params)
 
     def _shard_params(self, params):
-        abstract = jax.eval_shape(lambda p: p, params)
-        specs = specs_from_policy(self.policy, abstract, self.mesh)
-        shardings = jax.tree_util.tree_map(
-            lambda s: NamedSharding(self.mesh, s if s is not None else P()),
-            specs, is_leaf=lambda s: s is None or isinstance(s, P))
-        params = jax.jit(lambda p: p, out_shardings=shardings)(params)
-        return params, shardings
+        from deepspeed_tpu.module_inject.policies import \
+            shard_params_with_policy
+
+        return shard_params_with_policy(params, self.policy, self.mesh)
 
     def _quantize_weights(self, params):
         """Weight-only int8 groupwise quantization (reference

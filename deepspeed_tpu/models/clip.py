@@ -1,0 +1,377 @@
+"""CLIP (text + vision dual encoder), TPU-first.
+
+Closes the last model family in the reference's injection-policy zoo
+(``module_inject/replace_policy.py:236`` ``HFCLIPLayerPolicy``): both CLIP
+towers are stacks of the same pre-LN encoder layer (separate q/k/v
+projections, quick-gelu MLP), which the reference swaps for its fused
+kernel module. Here the towers are native flax modules sharing ONE
+encoder-layer implementation routed through ``deepspeed_tpu.ops.attention``
+(flash kernel on TPU for the unmasked vision tower; causal for text),
+scanned for per-layer ZeRO-3 gathers, with HF-matching module names so the
+``clip`` TP policy (module_inject/policies.py) and the HF weight map apply
+verbatim.
+
+HF semantics matched (``transformers/models/clip/modeling_clip.py``):
+- text tower is CAUSAL; pooled output is the hidden state at each row's
+  highest token id (the EOT token under CLIP's vocab);
+- vision tower: conv patch embed (no bias) + class token + learned
+  positions, ``pre_layrnorm`` (HF's spelling), post-LN on the class token;
+- projections are bias-free; similarity logits scale by exp(logit_scale).
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 8
+    max_position_embeddings: int = 77
+    layer_norm_eps: float = 1e-5
+    eos_token_id: int = 49407
+    hidden_act: str = "quick_gelu"
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPVisionConfig:
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    image_size: int = 224
+    patch_size: int = 32
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-5
+    hidden_act: str = "quick_gelu"
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPConfig:
+    text: CLIPTextConfig = dataclasses.field(default_factory=CLIPTextConfig)
+    vision: CLIPVisionConfig = dataclasses.field(
+        default_factory=CLIPVisionConfig)
+    projection_dim: int = 512
+    logit_scale_init: float = 2.6592
+    dtype: Any = jnp.float32
+    scan_layers: bool = True
+
+    @staticmethod
+    def tiny(**kw):
+        kw.setdefault("text", CLIPTextConfig(
+            vocab_size=99, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=16))
+        kw.setdefault("vision", CLIPVisionConfig(
+            hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, image_size=16, patch_size=8))
+        kw.setdefault("projection_dim", 24)
+        return CLIPConfig(**kw)
+
+
+def quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+_ACTIVATIONS = {
+    # HF activation names (gelu-family CLIP variants: LAION OpenCLIP
+    # conversions use "gelu"; original OpenAI weights "quick_gelu")
+    "quick_gelu": quick_gelu,
+    "gelu": lambda x: nn.gelu(x, approximate=False),
+    "gelu_new": lambda x: nn.gelu(x, approximate=True),
+    "gelu_pytorch_tanh": lambda x: nn.gelu(x, approximate=True),
+}
+
+
+def _activation(name: str):
+    if name not in _ACTIVATIONS:
+        raise ValueError(
+            f"unsupported CLIP hidden_act {name!r}; supported: "
+            f"{sorted(_ACTIVATIONS)}")
+    return _ACTIVATIONS[name]
+
+
+class CLIPEncoderLayer(nn.Module):
+    """Pre-LN block shared by both towers (HF ``CLIPEncoderLayer``)."""
+
+    hidden_size: int
+    intermediate_size: int
+    num_heads: int
+    eps: float
+    causal: bool
+    dtype: Any
+    hidden_act: str = "quick_gelu"
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, C = x.shape
+        H = self.num_heads
+        D = C // H
+        h = nn.LayerNorm(epsilon=self.eps, dtype=self.dtype,
+                         name="layer_norm1")(x)
+        q = nn.Dense(C, dtype=self.dtype, name="q_proj")(h)
+        k = nn.Dense(C, dtype=self.dtype, name="k_proj")(h)
+        v = nn.Dense(C, dtype=self.dtype, name="v_proj")(h)
+        q, k, v = (t.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+                   for t in (q, k, v))
+        y = attention(q, k, v, causal=self.causal)
+        y = y.transpose(0, 2, 1, 3).reshape(B, T, C)
+        y = nn.Dense(C, dtype=self.dtype, name="out_proj")(y)
+        x = x + y
+        h = nn.LayerNorm(epsilon=self.eps, dtype=self.dtype,
+                         name="layer_norm2")(x)
+        h = nn.Dense(self.intermediate_size, dtype=self.dtype,
+                     name="fc1")(h)
+        h = _activation(self.hidden_act)(h)
+        h = nn.Dense(C, dtype=self.dtype, name="fc2")(h)
+        return x + h
+
+
+class _Encoder(nn.Module):
+    """Scanned or unrolled stack of :class:`CLIPEncoderLayer`."""
+
+    hidden_size: int
+    intermediate_size: int
+    num_heads: int
+    num_layers: int
+    eps: float
+    causal: bool
+    dtype: Any
+    scan_layers: bool
+    hidden_act: str = "quick_gelu"
+
+    @nn.compact
+    def __call__(self, x):
+        kw = dict(hidden_size=self.hidden_size,
+                  intermediate_size=self.intermediate_size,
+                  num_heads=self.num_heads, eps=self.eps,
+                  causal=self.causal, dtype=self.dtype,
+                  hidden_act=self.hidden_act)
+        if self.scan_layers:
+            class _Body(nn.Module):
+                @nn.compact
+                def __call__(self, h, _):
+                    return CLIPEncoderLayer(**kw, name="layer")(h), None
+
+            Scanned = nn.scan(
+                _Body, variable_axes={"params": 0},
+                split_rngs={"params": True}, in_axes=(nn.broadcast,),
+                length=self.num_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            x, _ = Scanned(name="layers")(x, None)
+            return x
+        for i in range(self.num_layers):
+            x = CLIPEncoderLayer(**kw, name=f"layers_{i}")(x)
+        return x
+
+
+class CLIPTextTower(nn.Module):
+    config: CLIPConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        t = self.config.text
+        B, T = input_ids.shape
+        tok = self.param("token_embedding", nn.initializers.normal(0.02),
+                         (t.vocab_size, t.hidden_size), jnp.float32)
+        pos = self.param("position_embedding", nn.initializers.normal(0.01),
+                         (t.max_position_embeddings, t.hidden_size),
+                         jnp.float32)
+        x = tok[input_ids].astype(self.config.dtype) \
+            + pos[None, :T].astype(self.config.dtype)
+        x = _Encoder(t.hidden_size, t.intermediate_size,
+                     t.num_attention_heads, t.num_hidden_layers,
+                     t.layer_norm_eps, causal=True, dtype=self.config.dtype,
+                     scan_layers=self.config.scan_layers,
+                     hidden_act=t.hidden_act, name="encoder")(x)
+        x = nn.LayerNorm(epsilon=t.layer_norm_eps, dtype=self.config.dtype,
+                         name="final_layer_norm")(x)
+        # HF pooling: legacy checkpoints (eos_token_id == 2) take the
+        # hidden at each row's HIGHEST token id; otherwise the FIRST
+        # eos_token_id position (argmax of the boolean mask — row 0 when
+        # absent, matching HF)
+        if t.eos_token_id == 2:
+            eot = jnp.argmax(input_ids, axis=-1)
+        else:
+            eot = jnp.argmax(
+                (input_ids == t.eos_token_id).astype(jnp.int32), axis=-1)
+        pooled = x[jnp.arange(B), eot]
+        return x, pooled
+
+
+class CLIPVisionTower(nn.Module):
+    config: CLIPConfig
+
+    @nn.compact
+    def __call__(self, pixel_values):
+        v = self.config.vision
+        B = pixel_values.shape[0]
+        # NCHW input (HF convention) → NHWC for the conv
+        x = jnp.transpose(pixel_values, (0, 2, 3, 1)).astype(
+            self.config.dtype)
+        x = nn.Conv(v.hidden_size, (v.patch_size, v.patch_size),
+                    strides=(v.patch_size, v.patch_size), use_bias=False,
+                    dtype=self.config.dtype, name="patch_embedding")(x)
+        x = x.reshape(B, -1, v.hidden_size)  # [B, n_patches, C]
+        cls = self.param("class_embedding", nn.initializers.normal(0.02),
+                         (v.hidden_size,), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(self.config.dtype),
+                              (B, 1, v.hidden_size)), x], axis=1)
+        n_pos = (v.image_size // v.patch_size) ** 2 + 1
+        pos = self.param("position_embedding", nn.initializers.normal(0.01),
+                         (n_pos, v.hidden_size), jnp.float32)
+        x = x + pos[None].astype(self.config.dtype)
+        x = nn.LayerNorm(epsilon=v.layer_norm_eps, dtype=self.config.dtype,
+                         name="pre_layrnorm")(x)  # HF's spelling
+        x = _Encoder(v.hidden_size, v.intermediate_size,
+                     v.num_attention_heads, v.num_hidden_layers,
+                     v.layer_norm_eps, causal=False,
+                     dtype=self.config.dtype,
+                     scan_layers=self.config.scan_layers,
+                     hidden_act=v.hidden_act, name="encoder")(x)
+        pooled = nn.LayerNorm(epsilon=v.layer_norm_eps,
+                              dtype=self.config.dtype,
+                              name="post_layernorm")(x[:, 0])
+        return x, pooled
+
+
+class CLIPModel(nn.Module):
+    """Dual-encoder with projections and temperature-scaled similarity."""
+
+    config: CLIPConfig
+
+    def setup(self):
+        self.text_model = CLIPTextTower(self.config)
+        self.vision_model = CLIPVisionTower(self.config)
+        self.visual_projection = nn.Dense(self.config.projection_dim,
+                                          use_bias=False,
+                                          dtype=self.config.dtype)
+        self.text_projection = nn.Dense(self.config.projection_dim,
+                                        use_bias=False,
+                                        dtype=self.config.dtype)
+        self.logit_scale = self.param(
+            "logit_scale",
+            lambda rng: jnp.asarray(self.config.logit_scale_init,
+                                    jnp.float32))
+
+    def get_text_features(self, input_ids):
+        _, pooled = self.text_model(input_ids)
+        return self.text_projection(pooled)
+
+    def get_image_features(self, pixel_values):
+        _, pooled = self.vision_model(pixel_values)
+        return self.visual_projection(pooled)
+
+    def __call__(self, input_ids, pixel_values):
+        text_embeds = self.get_text_features(input_ids)
+        image_embeds = self.get_image_features(pixel_values)
+        text_embeds = text_embeds / jnp.linalg.norm(
+            text_embeds, axis=-1, keepdims=True)
+        image_embeds = image_embeds / jnp.linalg.norm(
+            image_embeds, axis=-1, keepdims=True)
+        scale = jnp.exp(self.logit_scale)
+        logits_per_text = scale * text_embeds @ image_embeds.T
+        return {"logits_per_text": logits_per_text,
+                "logits_per_image": logits_per_text.T,
+                "text_embeds": text_embeds,
+                "image_embeds": image_embeds}
+
+
+# ---------------------------------------------------------------------
+# HF weight import
+
+def _layer_tree(sd, prefix, n_layers, scan):
+    """Per-layer HF weights → our encoder tree (stacked if scanned)."""
+    def leaf(i, name, transpose=False):
+        w = np.asarray(sd[f"{prefix}.layers.{i}.{name}"])
+        return w.T if transpose else w
+
+    def one(i):
+        t = {}
+        for proj in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            t[proj] = {"kernel": leaf(i, f"self_attn.{proj}.weight", True),
+                       "bias": leaf(i, f"self_attn.{proj}.bias")}
+        for fc in ("fc1", "fc2"):
+            t[fc] = {"kernel": leaf(i, f"mlp.{fc}.weight", True),
+                     "bias": leaf(i, f"mlp.{fc}.bias")}
+        for ln in ("layer_norm1", "layer_norm2"):
+            t[ln] = {"scale": leaf(i, f"{ln}.weight"),
+                     "bias": leaf(i, f"{ln}.bias")}
+        return t
+
+    rows = [one(i) for i in range(n_layers)]
+    if not scan:
+        return {f"layers_{i}": rows[i] for i in range(n_layers)}
+    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *rows)
+    return {"layers": {"layer": stacked}}
+
+
+def clip_params_from_hf(sd, cfg: CLIPConfig):
+    """Torch ``CLIPModel.state_dict()`` → our param tree (kernels
+    transposed to flax's [in, out])."""
+    sd = {k: np.asarray(v) for k, v in sd.items()}
+    t, v = cfg.text, cfg.vision
+    text = {
+        "token_embedding": sd["text_model.embeddings.token_embedding.weight"],
+        "position_embedding":
+            sd["text_model.embeddings.position_embedding.weight"],
+        "encoder": _layer_tree(sd, "text_model.encoder",
+                               t.num_hidden_layers, cfg.scan_layers),
+        "final_layer_norm": {
+            "scale": sd["text_model.final_layer_norm.weight"],
+            "bias": sd["text_model.final_layer_norm.bias"]},
+    }
+    # conv kernel: torch [out, in, kh, kw] → flax [kh, kw, in, out]
+    patch = sd["vision_model.embeddings.patch_embedding.weight"] \
+        .transpose(2, 3, 1, 0)
+    vision = {
+        "class_embedding": sd["vision_model.embeddings.class_embedding"],
+        "position_embedding":
+            sd["vision_model.embeddings.position_embedding.weight"],
+        "patch_embedding": {"kernel": patch},
+        "pre_layrnorm": {"scale": sd["vision_model.pre_layrnorm.weight"],
+                         "bias": sd["vision_model.pre_layrnorm.bias"]},
+        "encoder": _layer_tree(sd, "vision_model.encoder",
+                               v.num_hidden_layers, cfg.scan_layers),
+        "post_layernorm": {
+            "scale": sd["vision_model.post_layernorm.weight"],
+            "bias": sd["vision_model.post_layernorm.bias"]},
+    }
+    return {
+        "text_model": text,
+        "vision_model": vision,
+        "visual_projection": {"kernel": sd["visual_projection.weight"].T},
+        "text_projection": {"kernel": sd["text_projection.weight"].T},
+        "logit_scale": sd["logit_scale"],
+    }
+
+
+def clip_config_from_hf(hf_config) -> CLIPConfig:
+    """transformers ``CLIPConfig`` (or its dict) → :class:`CLIPConfig`."""
+    if hasattr(hf_config, "to_dict"):
+        hf_config = hf_config.to_dict()
+    tc, vc = hf_config["text_config"], hf_config["vision_config"]
+    pick = lambda d, *names: {n: d[n] for n in names if n in d}
+    return CLIPConfig(
+        text=CLIPTextConfig(**pick(
+            tc, "vocab_size", "hidden_size", "intermediate_size",
+            "num_hidden_layers", "num_attention_heads",
+            "max_position_embeddings", "layer_norm_eps",
+            "eos_token_id", "hidden_act")),
+        vision=CLIPVisionConfig(**pick(
+            vc, "hidden_size", "intermediate_size", "num_hidden_layers",
+            "num_attention_heads", "image_size", "patch_size",
+            "num_channels", "layer_norm_eps", "hidden_act")),
+        projection_dim=hf_config.get("projection_dim", 512),
+        logit_scale_init=hf_config.get("logit_scale_init_value", 2.6592))

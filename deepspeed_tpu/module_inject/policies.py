@@ -154,10 +154,20 @@ BERT_POLICY = TPPolicy(
      ("query", COLUMN), ("key", COLUMN), ("value", COLUMN),
      ("intermediate", COLUMN), ("word_embeddings", VOCAB)])
 
+CLIP_POLICY = TPPolicy(
+    "clip",
+    # both CLIP towers share the pre-LN encoder layer (reference
+    # HFCLIPLayerPolicy, replace_policy.py:236): separate q/k/v + fc1 are
+    # column-parallel, out_proj + fc2 row-parallel; the token table
+    # shards over vocab
+    [("out_proj", ROW), ("fc2", ROW),
+     ("q_proj", COLUMN), ("k_proj", COLUMN), ("v_proj", COLUMN),
+     ("fc1", COLUMN), ("token_embedding", VOCAB)])
+
 _POLICIES: Dict[str, TPPolicy] = {
     "auto": AUTO_POLICY, "gpt2": GPT2_POLICY, "llama": LLAMA_POLICY,
     "opt": OPT_POLICY, "bloom": BLOOM_POLICY, "gptj": GPTJ_POLICY,
-    "gpt-neox": GPT_NEOX_POLICY, "bert": BERT_POLICY,
+    "gpt-neox": GPT_NEOX_POLICY, "bert": BERT_POLICY, "clip": CLIP_POLICY,
 }
 
 
@@ -190,3 +200,22 @@ def specs_from_policy(policy: TPPolicy, params_abstract, mesh,
     specs = [policy.spec_for(path, tuple(leaf.shape), tp_size, axis)
              for path, leaf in flat]
     return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def shard_params_with_policy(params, policy, mesh, axis: str = AXIS_MODEL):
+    """Place a param pytree per the policy's TP specs.
+
+    The one sharding entry point serving engines share (InferenceEngine
+    and CLIPServingEngine): ``(sharded_params, shardings)`` with
+    unmatched leaves replicated. ``policy`` may be a name or a TPPolicy.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    abstract = jax.eval_shape(lambda p: p, params)
+    specs = specs_from_policy(get_tp_policy(policy), abstract, mesh, axis)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s if s is not None else P()),
+        specs, is_leaf=lambda s: s is None or isinstance(s, P))
+    params = jax.jit(lambda p: p, out_shardings=shardings)(params)
+    return params, shardings

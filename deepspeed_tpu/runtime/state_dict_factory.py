@@ -559,6 +559,9 @@ def detect_arch(sd: Dict[str, Any]) -> Optional[str]:
         return "gpt-neox"
     if any("attention.self.query" in k for k in keys):
         return "bert"
+    if any(k.startswith("visual_projection")
+           or "vision_model.encoder" in k for k in keys):
+        return "clip"
     return None
 
 

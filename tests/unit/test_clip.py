@@ -1,0 +1,217 @@
+"""CLIP dual-encoder serving (reference ``HFCLIPLayerPolicy``,
+``module_inject/replace_policy.py:236`` — the last model family in the
+reference's injection-policy zoo).
+
+Parity is proven against a randomly-initialized transformers ``CLIPModel``
+(no network needed): its state dict loads through ``clip_params_from_hf``
+and the logits/embeddings must match; the ``clip`` TP policy must serve
+the same numbers sharded over the model axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.clip import (CLIPConfig, CLIPModel,
+                                       clip_config_from_hf,
+                                       clip_params_from_hf)
+from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology
+
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(autouse=True)
+def _clean_topology():
+    reset_topology()
+    yield
+    reset_topology()
+
+
+def _hf_model():
+    cfg = transformers.CLIPConfig(
+        text_config={"vocab_size": 99, "hidden_size": 32,
+                     "intermediate_size": 64, "num_hidden_layers": 2,
+                     "num_attention_heads": 4,
+                     "max_position_embeddings": 16,
+                     "eos_token_id": 98},
+        vision_config={"hidden_size": 32, "intermediate_size": 64,
+                       "num_hidden_layers": 2, "num_attention_heads": 4,
+                       "image_size": 16, "patch_size": 8},
+        projection_dim=24)
+    return transformers.CLIPModel(cfg).eval(), cfg
+
+
+def _inputs(seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, 98, (3, 12)).astype(np.int32)
+    # EOS (98) mid-sequence at distinct per-row positions: the text
+    # pooling must pick the FIRST eos hidden, not position 0 or argmax
+    for row, pos in enumerate((5, 9, 7)):
+        ids[row, pos] = 98
+    pixels = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    return ids, pixels
+
+
+class TestHFParity:
+    @pytest.mark.parametrize("scan", [True, False])
+    def test_logits_match_hf(self, scan):
+        import torch
+
+        hf, hf_cfg = _hf_model()
+        ids, pixels = _inputs()
+        with torch.no_grad():
+            ref = hf(input_ids=torch.tensor(ids.astype(np.int64)),
+                     pixel_values=torch.tensor(pixels))
+        cfg = clip_config_from_hf(hf_cfg)
+        cfg = __import__("dataclasses").replace(cfg, scan_layers=scan)
+        params = clip_params_from_hf(hf.state_dict(), cfg)
+        model = CLIPModel(cfg)
+        out = model.apply({"params": params}, jnp.asarray(ids),
+                          jnp.asarray(pixels))
+        np.testing.assert_allclose(
+            np.asarray(out["logits_per_image"]),
+            ref.logits_per_image.numpy(), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(out["text_embeds"]),
+            (ref.text_embeds / ref.text_embeds.norm(dim=-1,
+                                                    keepdim=True)).numpy(),
+            rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(out["image_embeds"]),
+            (ref.image_embeds / ref.image_embeds.norm(
+                dim=-1, keepdim=True)).numpy(), rtol=2e-4, atol=2e-4)
+
+    def test_gelu_variant_matches_hf(self):
+        """OpenCLIP-converted checkpoints use hidden_act='gelu' (not the
+        OpenAI quick_gelu); the activation must follow the config."""
+        import torch
+
+        cfg_hf = transformers.CLIPConfig(
+            text_config={"vocab_size": 99, "hidden_size": 32,
+                         "intermediate_size": 64, "num_hidden_layers": 2,
+                         "num_attention_heads": 4,
+                         "max_position_embeddings": 16,
+                         "eos_token_id": 98, "hidden_act": "gelu"},
+            vision_config={"hidden_size": 32, "intermediate_size": 64,
+                           "num_hidden_layers": 2, "num_attention_heads": 4,
+                           "image_size": 16, "patch_size": 8,
+                           "hidden_act": "gelu"},
+            projection_dim=24)
+        hf = transformers.CLIPModel(cfg_hf).eval()
+        ids, pixels = _inputs(4)
+        cfg = clip_config_from_hf(cfg_hf)
+        assert cfg.text.hidden_act == "gelu"
+        params = clip_params_from_hf(hf.state_dict(), cfg)
+        out = CLIPModel(cfg).apply({"params": params}, jnp.asarray(ids),
+                                   jnp.asarray(pixels))
+        with torch.no_grad():
+            ref = hf(input_ids=torch.tensor(ids.astype(np.int64)),
+                     pixel_values=torch.tensor(pixels))
+        np.testing.assert_allclose(np.asarray(out["logits_per_image"]),
+                                   ref.logits_per_image.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_unsupported_activation_raises(self):
+        from deepspeed_tpu.models.clip import (CLIPTextConfig,
+                                               _activation)
+
+        with pytest.raises(ValueError, match="hidden_act"):
+            _activation("swish")
+
+    def test_feature_extractors(self):
+        import torch
+
+        hf, hf_cfg = _hf_model()
+        ids, pixels = _inputs(1)
+        cfg = clip_config_from_hf(hf_cfg)
+        params = clip_params_from_hf(hf.state_dict(), cfg)
+        model = CLIPModel(cfg)
+        with torch.no_grad():
+            t_ref = hf.get_text_features(
+                torch.tensor(ids.astype(np.int64))).numpy()
+            i_ref = hf.get_image_features(torch.tensor(pixels)).numpy()
+        t = model.apply({"params": params}, jnp.asarray(ids),
+                        method=CLIPModel.get_text_features)
+        i = model.apply({"params": params}, jnp.asarray(pixels),
+                        method=CLIPModel.get_image_features)
+        np.testing.assert_allclose(np.asarray(t), t_ref, rtol=2e-4,
+                                   atol=2e-4)
+        np.testing.assert_allclose(np.asarray(i), i_ref, rtol=2e-4,
+                                   atol=2e-4)
+
+
+class TestTPServing:
+    def test_tp_sharded_matches_replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu.module_inject import (get_tp_policy,
+                                                 specs_from_policy)
+
+        hf, hf_cfg = _hf_model()
+        ids, pixels = _inputs(2)
+        cfg = clip_config_from_hf(hf_cfg)
+        params = clip_params_from_hf(hf.state_dict(), cfg)
+        model = CLIPModel(cfg)
+        ref = model.apply({"params": params}, jnp.asarray(ids),
+                          jnp.asarray(pixels))
+
+        topo = MeshTopology(axis_sizes={"model": 4},
+                            devices=jax.devices()[:4])
+        mesh = topo.mesh
+        abstract = jax.eval_shape(lambda: params)
+        specs = specs_from_policy(get_tp_policy("clip"), abstract, mesh)
+        sharded = jax.tree_util.tree_map(
+            lambda leaf, s: jax.device_put(
+                leaf, NamedSharding(mesh, s if s is not None else P())),
+            params, specs,
+            is_leaf=lambda x: not isinstance(x, dict))
+        n_sharded = sum(
+            1 for s in jax.tree_util.tree_leaves(
+                specs, is_leaf=lambda x: isinstance(x, P))
+            if isinstance(s, P) and any(e is not None for e in s))
+        assert n_sharded >= 20  # q/k/v/out/fc1/fc2 across both towers
+
+        out = jax.jit(lambda p, i, px: model.apply({"params": p}, i, px))(
+            sharded, jnp.asarray(ids), jnp.asarray(pixels))
+        np.testing.assert_allclose(np.asarray(out["logits_per_image"]),
+                                   np.asarray(ref["logits_per_image"]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestFromPretrained:
+    def test_auto_detect_and_serve(self):
+        """Reference init_inference flow for CLIP: arch auto-detected
+        from the weight names, tower shapes from the config, TP sharding
+        from the clip policy, jitted serving methods."""
+        import torch
+
+        from deepspeed_tpu.inference.auto import from_pretrained
+        from deepspeed_tpu.runtime.state_dict_factory import detect_arch
+
+        hf, hf_cfg = _hf_model()
+        ids, pixels = _inputs(3)
+        sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+        assert detect_arch(sd) == "clip"
+        engine = from_pretrained(
+            sd, loader_kw={"hf_config": hf_cfg.to_dict()},
+            tensor_parallel={"tp_size": 4})
+        assert engine.topology.axis_size("model") == 4
+        out = engine(jnp.asarray(ids), jnp.asarray(pixels))
+        with torch.no_grad():
+            ref = hf(input_ids=torch.tensor(ids.astype(np.int64)),
+                     pixel_values=torch.tensor(pixels))
+        np.testing.assert_allclose(np.asarray(out["logits_per_image"]),
+                                   ref.logits_per_image.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+        t = engine.encode_text(jnp.asarray(ids))
+        i = engine.encode_image(jnp.asarray(pixels))
+        assert t.shape == (3, 24) and i.shape == (2, 24)
+
+    def test_bare_state_dict_requires_config(self):
+        from deepspeed_tpu.inference.auto import load_pretrained
+
+        hf, _ = _hf_model()
+        sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+        with pytest.raises(ValueError, match="hf_config"):
+            load_pretrained(sd)

@@ -157,21 +157,10 @@ class CLIPServingEngine:
         from deepspeed_tpu.module_inject.policies import \
             shard_params_with_policy
         from deepspeed_tpu.parallel.topology import (AXIS_MODEL,
-                                                     MeshTopology,
-                                                     get_topology,
-                                                     set_topology)
+                                                     resolve_tp_topology)
 
         self.model = model
-        # same mesh resolution as InferenceEngine (inference/engine.py:76):
-        # reuse an existing topology only when its model axis matches the
-        # requested tp_size; otherwise build the TP mesh — never silently
-        # serve replicated when sharding was asked for
-        existing = get_topology(create_if_missing=False)
-        if existing is not None and existing.axis_size(AXIS_MODEL) == tp_size:
-            topo = existing
-        else:
-            topo = MeshTopology(axis_sizes={AXIS_MODEL: tp_size})
-            set_topology(topo)
+        topo = resolve_tp_topology(tp_size)
         self.topology = topo
         if topo.axis_size(AXIS_MODEL) > 1:
             params, _ = shard_params_with_policy(params, "clip", topo.mesh)
@@ -203,9 +192,14 @@ def from_pretrained(src, arch: Optional[str] = None, dtype=None,
                                           scan_layers=scan_layers,
                                           **(loader_kw or {}))
     if arch == "clip":
-        tp = engine_kw.get("tensor_parallel", {})
-        tp_size = tp.get("tp_size", 1) if isinstance(tp, dict) else \
-            getattr(tp, "tp_size", 1)
+        # parse tp through the inference config so every reference
+        # spelling works (tensor_parallel / tp alias / deprecated mp_size)
+        from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+        known = {k: v for k, v in engine_kw.items()
+                 if k in ("tensor_parallel", "tp", "mp_size")}
+        tp_size = int(DeepSpeedInferenceConfig(
+            **known).tensor_parallel.tp_size)
         return CLIPServingEngine(model, params, tp_size=tp_size)
     engine_kw.setdefault("injection_policy", _POLICY_FOR_ARCH[arch])
     if dtype is not None:

@@ -27,7 +27,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
-from deepspeed_tpu.module_inject.policies import get_tp_policy, specs_from_policy
+from deepspeed_tpu.module_inject.policies import get_tp_policy
 from deepspeed_tpu.parallel.topology import (AXIS_DATA, AXIS_MODEL,
                                              MeshTopology, get_topology,
                                              set_topology)
@@ -76,12 +76,9 @@ class InferenceEngine:
         if mesh is not None:
             self.topo = mesh if isinstance(mesh, MeshTopology) else MeshTopology(mesh=mesh)
         else:
-            existing = get_topology(create_if_missing=False)
-            if existing is not None and existing.axis_size(AXIS_MODEL) == tp:
-                self.topo = existing
-            else:
-                self.topo = MeshTopology(axis_sizes={AXIS_MODEL: tp})
-                set_topology(self.topo)
+            from deepspeed_tpu.parallel.topology import resolve_tp_topology
+
+            self.topo = resolve_tp_topology(tp)
         self.mesh = self.topo.mesh
         self.mp_world_size = self.topo.get_model_parallel_world_size()
 

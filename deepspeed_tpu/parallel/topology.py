@@ -358,3 +358,18 @@ def get_topology(create_if_missing: bool = True) -> Optional[MeshTopology]:
 def reset_topology():
     global _WORLD_TOPOLOGY
     _WORLD_TOPOLOGY = None
+
+
+def resolve_tp_topology(tp_size: int) -> MeshTopology:
+    """The serving engines' mesh resolution (reference
+    ``_create_model_parallel_group``): reuse the existing global topology
+    only when its model axis already matches ``tp_size``; otherwise build
+    a model-axis mesh and make it the global one. Shared by
+    InferenceEngine and CLIPServingEngine so the reuse condition can
+    never diverge between serving paths."""
+    existing = get_topology(create_if_missing=False)
+    if existing is not None and existing.axis_size(AXIS_MODEL) == tp_size:
+        return existing
+    topo = MeshTopology(axis_sizes={AXIS_MODEL: tp_size})
+    set_topology(topo)
+    return topo

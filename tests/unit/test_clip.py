@@ -208,6 +208,17 @@ class TestFromPretrained:
         i = engine.encode_image(jnp.asarray(pixels))
         assert t.shape == (3, 24) and i.shape == (2, 24)
 
+    def test_deprecated_mp_size_spelling_shards(self):
+        """Every reference tp spelling must reach the CLIP engine —
+        mp_size=4 silently serving replicated would be a policy bug."""
+        from deepspeed_tpu.inference.auto import from_pretrained
+
+        hf, hf_cfg = _hf_model()
+        sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+        engine = from_pretrained(
+            sd, loader_kw={"hf_config": hf_cfg.to_dict()}, mp_size=4)
+        assert engine.topology.axis_size("model") == 4
+
     def test_bare_state_dict_requires_config(self):
         from deepspeed_tpu.inference.auto import load_pretrained
 

@@ -8,6 +8,7 @@ capability surface of the reference DeepSpeed (``deepspeed/__init__.py``):
 from deepspeed_tpu.version import __version__, __version_info__
 
 from deepspeed_tpu.accelerator import get_accelerator, set_accelerator
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
 from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
 
 

@@ -1,0 +1,253 @@
+"""User-facing activation-checkpointing API.
+
+Capability parity with the reference ``deepspeed.checkpointing``
+(``runtime/activation_checkpointing/checkpointing.py``): Megatron-style
+integrations call ``configure(...)`` once and then wrap segment forwards
+in ``checkpoint(fn, *args)``. Here the primitives are TPU-native:
+
+- ``checkpoint`` is ``jax.checkpoint`` (full recompute — the reference's
+  CheckpointFunction semantics, ``:498``);
+- ``partition_activations`` (ref ``:372``) becomes a GSPMD sharding
+  constraint on the tensor args at the checkpoint boundary, so the saved
+  copies live model-axis-sharded and gather back at recompute;
+- ``checkpoint_in_cpu`` (ref ``:485``): args transfer to HOST memory
+  space *before* the remat region and reload to device *inside* it — the
+  region's saved residuals are therefore the host-resident copies
+  (``jax.checkpoint`` saves its inputs), and backward re-reads host
+  memory. XLA's CPU backend cannot execute cross-space placements under
+  a mesh (same limitation as the engine's cpu_checkpointing gate) —
+  there the flag warns once and is skipped.
+
+This generic-args API intentionally does NOT reuse
+``models/remat_utils.py``'s named-value offload policy: that mechanism
+addresses values *inside* a model's remat region by ``checkpoint_name``
+tags the model code plants; user segments are opaque callables whose
+only addressable residuals are their arguments.
+
+The RNG tracker surface (``get_cuda_rng_tracker`` /
+``model_parallel_cuda_manual_seed``, ref ``:122-241``) is served with JAX
+semantics: explicit fold-in keys per model-parallel rank instead of
+mutable device RNG state — counter-based keys replay identically at
+recompute by construction.
+"""
+
+import contextlib
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+# ---------------------------------------------------------------------
+# module configuration (reference module globals, checkpointing.py:830)
+
+_CONFIG: Dict[str, Any] = {
+    "partition_activations": False,
+    "contiguous_checkpointing": False,
+    "checkpoint_in_cpu": False,
+    "num_checkpoints": None,
+    "synchronize": False,
+    "profile": False,
+    "configured": False,
+}
+# knobs XLA makes moot (allocation/scheduling/segment sizing are the
+# compiler's): accepted for config parity, warned per configure()
+_INERT_KEYS = ("contiguous_checkpointing", "num_checkpoints",
+               "synchronize", "profile")
+_warned_cpu_backend = False
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Reference ``configure`` (checkpointing.py:830): explicit kwargs win
+    over the ds-config's ``activation_checkpointing`` section. ``mpu_`` is
+    accepted for signature parity; the model axis comes from the global
+    mesh topology here."""
+    del mpu_
+    section = {}
+    if deepspeed_config is not None:
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        cfg = (deepspeed_config
+               if isinstance(deepspeed_config, DeepSpeedConfig)
+               else DeepSpeedConfig(deepspeed_config))
+        ac = cfg.activation_checkpointing_config
+        section = {"partition_activations": ac.partition_activations,
+                   "contiguous_checkpointing":
+                       ac.contiguous_memory_optimization,
+                   "checkpoint_in_cpu": ac.cpu_checkpointing,
+                   "num_checkpoints": ac.number_checkpoints,
+                   "synchronize": ac.synchronize_checkpoint_boundary,
+                   "profile": ac.profile}
+    for key, val in (("partition_activations", partition_activations),
+                     ("contiguous_checkpointing", contiguous_checkpointing),
+                     ("checkpoint_in_cpu", checkpoint_in_cpu),
+                     ("num_checkpoints", num_checkpoints),
+                     ("synchronize", synchronize),
+                     ("profile", profile)):
+        if val is not None:
+            section[key] = val
+    _CONFIG.update(section)
+    _CONFIG["configured"] = True
+    for key in _INERT_KEYS:
+        if _CONFIG[key]:
+            logger.warning(
+                f"deepspeed_tpu.checkpointing: {key} is accepted but INERT "
+                "on TPU (XLA owns allocation/scheduling/segment sizing; "
+                "use jax.profiler for profiling)")
+
+
+def is_configured() -> bool:
+    return _CONFIG["configured"]
+
+
+def reset():
+    """Reference ``reset`` (checkpointing.py:773)."""
+    global _warned_cpu_backend
+    _CONFIG.update(partition_activations=False,
+                   contiguous_checkpointing=False, checkpoint_in_cpu=False,
+                   num_checkpoints=None, synchronize=False, profile=False,
+                   configured=False)
+    _warned_cpu_backend = False
+
+
+def partition_activations_in_checkpoint(partition_activation):
+    """Reference toggle (checkpointing.py:760)."""
+    _CONFIG["partition_activations"] = bool(partition_activation)
+
+
+def set_num_layers(nlayers):
+    """Reference ``set_num_layers`` (checkpointing.py:768) — sized the
+    contiguous checkpoint buffers there; INERT here (XLA allocates), kept
+    for signature parity and introspection."""
+    _CONFIG["num_checkpoints"] = nlayers
+
+
+# ---------------------------------------------------------------------
+# the checkpoint wrapper
+
+def _is_array(x) -> bool:
+    return hasattr(x, "dtype") and hasattr(x, "shape") \
+        and getattr(x, "ndim", 0) > 0
+
+
+def _partition_arg(x):
+    """Model-axis sharding constraint on a saved arg (the TPU form of the
+    reference's partition_activations scatter, checkpointing.py:372).
+    Dim choice follows ``models/remat_utils.saved_block_input``: prefer a
+    non-leading divisible dim (dim 0 is conventionally the data-sharded
+    batch axis — constraining it to the model axis would fight the DP
+    layout); fall back to dim 0 only when nothing else divides."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.parallel.topology import AXIS_MODEL, get_topology
+
+    topo = get_topology(create_if_missing=False)
+    if topo is None or topo.axis_size(AXIS_MODEL) <= 1 or not _is_array(x):
+        return x
+    mp = topo.axis_size(AXIS_MODEL)
+    for dim in (*range(1, x.ndim), 0):
+        if x.shape[dim] % mp == 0:
+            spec = [None] * x.ndim
+            spec[dim] = AXIS_MODEL
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(topo.mesh, P(*spec)))
+    return x
+
+
+def checkpoint(function, *args):
+    """Reference ``checkpoint(function, *args)`` (checkpointing.py:748):
+    run ``function`` under rematerialization — nothing internal is saved;
+    the (optionally partitioned / host-resident) args are the segment's
+    residuals."""
+    global _warned_cpu_backend
+    checkpoint_in_cpu = _CONFIG["checkpoint_in_cpu"]
+    if checkpoint_in_cpu and jax.default_backend() == "cpu":
+        if not _warned_cpu_backend:
+            logger.warning(
+                "deepspeed_tpu.checkpointing: checkpoint_in_cpu is "
+                "unsupported on the CPU backend (no Host placement "
+                "execution) — saved activations stay on-device")
+            _warned_cpu_backend = True
+        checkpoint_in_cpu = False
+    if _CONFIG["partition_activations"]:
+        args = tuple(_partition_arg(a) for a in args)
+    if not checkpoint_in_cpu:
+        return jax.checkpoint(function)(*args)
+    # host residuals: transfer OUT here (so the region's saved inputs are
+    # the host copies), reload INSIDE the region (re-run in both forward
+    # and the backward recompute). jax.memory.Space is the public
+    # memory-placement API.
+    is_arr = [_is_array(a) for a in args]
+    host_args = tuple(
+        jax.device_put(a, jax.memory.Space.Host) if arr else a
+        for a, arr in zip(args, is_arr))
+
+    def reload_and_run(*hargs):
+        dargs = tuple(
+            jax.device_put(a, jax.memory.Space.Device) if arr else a
+            for a, arr in zip(hargs, is_arr))
+        return function(*dargs)
+
+    return jax.checkpoint(reload_and_run)(*host_args)
+
+
+# ---------------------------------------------------------------------
+# RNG tracker surface (reference CudaRNGStatesTracker, checkpointing.py:122
+# — JAX form: derived keys, no mutable device generator)
+
+class RNGStatesTracker:
+    """Named seeds → fold-in derived ``jax.random`` keys.
+
+    The reference swaps CUDA RNG state so each model-parallel rank's
+    dropout differs inside checkpointed segments and REPLAYS identically
+    at recompute. JAX's counter-based keys give replay for free (the same
+    key always produces the same draw); per-rank decorrelation comes from
+    folding the mesh-axis index into the key inside sharded code."""
+
+    def __init__(self):
+        self._seeds: Dict[str, int] = {}
+
+    def reset(self):
+        self._seeds.clear()
+
+    def get_states(self):
+        return dict(self._seeds)
+
+    def set_states(self, states):
+        self._seeds = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self._seeds:
+            raise ValueError(f"rng state {name!r} already added")
+        self._seeds[name] = int(seed)
+
+    @contextlib.contextmanager
+    def fork(self, name: str = "model-parallel-rng", fold: int = 0):
+        """Yield the derived key for ``name`` (folded by ``fold``, e.g. a
+        traced model-parallel rank index). Context-manager form keeps the
+        reference's ``with get_cuda_rng_tracker().fork():`` call shape."""
+        if name not in self._seeds:
+            raise KeyError(f"rng state {name!r} was never add()ed")
+        yield jax.random.fold_in(jax.random.PRNGKey(self._seeds[name]),
+                                 fold)
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_cuda_rng_tracker() -> RNGStatesTracker:
+    """Reference name kept for drop-in imports (checkpointing.py:193)."""
+    return _RNG_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    """Reference ``model_parallel_cuda_manual_seed`` (checkpointing.py:198):
+    registers the data-parallel ('default') and model-parallel seeds. The
+    model-parallel seed is offset exactly as the reference does (2718 +
+    seed); per-rank decorrelation happens at fold time."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("default", seed)
+    _RNG_TRACKER.add("model-parallel-rng", 2718 + int(seed))

@@ -1,0 +1,191 @@
+"""User-facing ``deepspeed_tpu.checkpointing`` API (reference
+``deepspeed.checkpointing`` — ``runtime/activation_checkpointing/
+checkpointing.py:748 checkpoint / :830 configure / :122 RNG tracker``):
+the surface Megatron-style integrations import directly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.topology import (MeshTopology, reset_topology,
+                                             set_topology)
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    checkpointing.reset()
+    reset_topology()
+    yield
+    checkpointing.reset()
+    reset_topology()
+
+
+def _segment(x, w):
+    return jnp.tanh(x @ w) @ w.T
+
+
+class TestCheckpointFunction:
+    def test_grad_parity_with_uncheckpointed(self):
+        w = jnp.asarray(np.random.default_rng(0).normal(
+            size=(64, 64)).astype(np.float32) * 0.1)
+        x = jnp.ones((8, 64))
+
+        def loss_plain(w):
+            return jnp.sum(_segment(_segment(x, w), w) ** 2)
+
+        def loss_ckpt(w):
+            h = checkpointing.checkpoint(_segment, x, w)
+            return jnp.sum(checkpointing.checkpoint(_segment, h, w) ** 2)
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(loss_ckpt)(w)),
+            np.asarray(jax.grad(loss_plain)(w)), rtol=1e-5, atol=1e-6)
+
+    def test_recompute_drops_internal_residuals(self):
+        """The checkpointed segment must not save its internals: the AD
+        residual set shrinks to the segment inputs (structural proof —
+        XLA:CPU's buffer accounting hides remat savings in temp bytes)."""
+        import contextlib
+        import io
+
+        from jax.ad_checkpoint import print_saved_residuals
+
+        w = jnp.ones((256, 256))
+        x = jnp.ones((64, 256))
+
+        def chain(x, w):
+            for _ in range(6):
+                x = jnp.tanh(x @ w)
+            return x
+
+        def loss_plain(w):
+            return jnp.sum(chain(x, w) ** 2)
+
+        def loss_ckpt(w):
+            return jnp.sum(checkpointing.checkpoint(chain, x, w) ** 2)
+
+        def n_resid(fn):
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                print_saved_residuals(fn, w)
+            return len([l for l in buf.getvalue().splitlines()
+                        if "f32" in l])
+
+        assert n_resid(loss_ckpt) < n_resid(loss_plain)
+
+    def test_configure_from_ds_config_and_flags(self):
+        assert not checkpointing.is_configured()
+        checkpointing.configure(
+            deepspeed_config={"train_batch_size": 1,
+                              "activation_checkpointing": {
+                                  "enabled": True,
+                                  "partition_activations": True}},
+            checkpoint_in_cpu=False)
+        assert checkpointing.is_configured()
+        assert checkpointing._CONFIG["partition_activations"]
+        checkpointing.partition_activations_in_checkpoint(False)
+        assert not checkpointing._CONFIG["partition_activations"]
+        checkpointing.reset()
+        assert not checkpointing.is_configured()
+
+    def test_partition_activations_shards_saved_args(self):
+        """With the flag on and a model axis present, the compiled grad
+        keeps less temp live (saved args stored sharded)."""
+        set_topology(MeshTopology(axis_sizes={"data": 2, "model": 4},
+                                  devices=jax.devices()[:8]))
+        w = jnp.ones((512, 512))
+        x = jnp.ones((64, 512))
+
+        def chain(x, w):
+            for _ in range(4):
+                x = jnp.tanh(x @ w)
+            return x
+
+        def loss(w):
+            h = checkpointing.checkpoint(chain, x, w)
+            return jnp.sum(checkpointing.checkpoint(chain, h, w) ** 2)
+
+        t_plain = jax.jit(jax.grad(loss)).lower(w).compile() \
+            .memory_analysis().temp_size_in_bytes
+        checkpointing.configure(partition_activations=True)
+        t_part = jax.jit(jax.grad(loss)).lower(w).compile() \
+            .memory_analysis().temp_size_in_bytes
+        g_plain = jax.grad(loss)(w)
+        assert t_part < t_plain, (t_part, t_plain)
+        # numerics unchanged
+        checkpointing.reset()
+        np.testing.assert_allclose(np.asarray(jax.grad(loss)(w)),
+                                   np.asarray(g_plain), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_checkpoint_in_cpu_warns_and_works_on_cpu_backend(self):
+        checkpointing.configure(checkpoint_in_cpu=True)
+        w = jnp.ones((32, 32))
+        x = jnp.ones((4, 32))
+
+        def loss(w):
+            return jnp.sum(checkpointing.checkpoint(_segment, x, w) ** 2)
+
+        g = jax.grad(loss)(w)  # must not crash: flag skipped with warning
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_checkpoint_in_cpu_saves_host_residuals(self, monkeypatch):
+        """With the offload path active (backend check bypassed — residual
+        analysis only TRACES, nothing executes), the segment's saved
+        residuals must live in host memory space: jax.checkpoint saves
+        its inputs, and the inputs are the pre-region host transfers."""
+        import contextlib
+        import io
+
+        from jax.ad_checkpoint import print_saved_residuals
+
+        monkeypatch.setattr(
+            checkpointing.jax, "default_backend", lambda: "tpu")
+        checkpointing.configure(checkpoint_in_cpu=True)
+        w = jnp.ones((32, 32))
+        x = jnp.ones((4, 32))
+
+        def loss(w):
+            return jnp.sum(checkpointing.checkpoint(_segment, x, w) ** 2)
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            print_saved_residuals(loss, w)
+        assert "<host>" in buf.getvalue(), buf.getvalue()
+
+
+class TestRNGTracker:
+    def test_reference_import_surface(self):
+        # the names Megatron integrations import
+        assert deepspeed_tpu.checkpointing.checkpoint is \
+            checkpointing.checkpoint
+        checkpointing.model_parallel_cuda_manual_seed(1234)
+        tracker = checkpointing.get_cuda_rng_tracker()
+        states = tracker.get_states()
+        assert states == {"default": 1234, "model-parallel-rng": 2718 + 1234}
+
+    def test_fork_yields_reproducible_decorrelated_keys(self):
+        checkpointing.model_parallel_cuda_manual_seed(7)
+        tracker = checkpointing.get_cuda_rng_tracker()
+        with tracker.fork() as k1:
+            a1 = jax.random.normal(k1, (4,))
+        with tracker.fork() as k2:
+            a2 = jax.random.normal(k2, (4,))
+        # replay-identical (the property recompute relies on)
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+        # per-rank fold decorrelates
+        with tracker.fork(fold=1) as k3:
+            b = jax.random.normal(k3, (4,))
+        assert not np.array_equal(np.asarray(a1), np.asarray(b))
+
+    def test_duplicate_add_raises(self):
+        tracker = checkpointing.RNGStatesTracker()
+        tracker.add("x", 1)
+        with pytest.raises(ValueError):
+            tracker.add("x", 2)
+        with pytest.raises(KeyError):
+            with tracker.fork("never-added"):
+                pass

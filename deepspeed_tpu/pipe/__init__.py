@@ -1,0 +1,7 @@
+"""Alias package (reference ``deepspeed/pipe/__init__.py``): user code
+imports the pipeline building blocks from ``deepspeed.pipe``."""
+
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec)
+
+__all__ = ["PipelineModule", "LayerSpec", "TiedLayerSpec"]

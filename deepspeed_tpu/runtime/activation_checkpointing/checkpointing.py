@@ -32,10 +32,9 @@ recompute by construction.
 """
 
 import contextlib
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
-import jax.numpy as jnp
 
 from deepspeed_tpu.utils.logging import logger
 

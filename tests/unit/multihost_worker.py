@@ -30,7 +30,9 @@ def main():
     rank = jax.process_index()
     assert rank == int(os.environ["OMPI_COMM_WORLD_RANK"]), (
         "mpi_discovery must map the scheduler rank onto the JAX process id")
-    assert dist.get_world_size() == 2
+    # world_size counts DEVICES (SPMD ranks): 2 processes x 4 virtual
+    # CPU devices each
+    assert dist.get_world_size() == jax.device_count() == 8
 
     # --- host-side collectives (outside jit) --------------------------
     dist.barrier()
@@ -42,7 +44,12 @@ def main():
     # --- in-jit collective over the global 2-process mesh -------------
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+    # one device per PROCESS (jax.devices() is process-major): the mesh
+    # must span both processes or make_array_from_process_local_data has
+    # no addressable shard on rank 1
+    per_proc = [d for d in jax.devices()
+                if d.id % jax.local_device_count() == 0]
+    mesh = Mesh(np.asarray(per_proc), ("data",))
     sharding = NamedSharding(mesh, P("data"))
     local = np.full((1, 4), rank + 1, np.float32)
     garr = jax.make_array_from_process_local_data(sharding, local, (2, 4))
@@ -68,6 +75,48 @@ def main():
     stopped = agent.step_boundary()
     assert stopped, "both hosts must agree to checkpoint"
     assert engine.saved and engine.saved[0][1] is not None
+
+    dist.barrier()
+
+    # --- full ENGINE training across the 2-process global mesh --------
+    # (each process contributes its local virtual CPU devices; the global
+    # data axis spans both). Host batches are generated identically on
+    # every process — jax.device_put with a multi-process sharding places
+    # each process's addressable shards from the same global array, the
+    # documented multihost ingestion contract the engine's _shard_batch
+    # relies on.
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+    from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology
+
+    n_global = jax.device_count()
+    assert n_global == jax.local_device_count() * 2
+    reset_topology()
+    topo = MeshTopology(axis_sizes={"data": n_global})
+    engine2, *_ = deepspeed_tpu.initialize(
+        model=GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32)),
+        mesh=topo,
+        config={"train_batch_size": n_global,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 10_000})
+    ids = np.random.default_rng(0).integers(
+        0, 256, (n_global, 32)).astype(np.int32)  # same on every process
+    losses = []
+    for _ in range(3):
+        loss = engine2({"input_ids": ids})
+        engine2.backward(loss)
+        engine2.step()
+        losses.append(float(jax.device_get(loss)))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    # every process must hold the identical replicated loss trajectory
+    all_losses = np.asarray(dist.all_gather(
+        np.asarray(losses, np.float32))).reshape(2, -1)
+    assert np.allclose(all_losses[0], all_losses[1]), all_losses
+    print(f"MULTIHOST-TRAIN-OK rank={rank} losses={losses}", flush=True)
 
     dist.barrier()
     print(f"MULTIHOST-OK rank={rank}", flush=True)

@@ -31,12 +31,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 WORKER = os.path.join(REPO, "tests", "unit", "multihost_worker.py")
 
 
+@pytest.mark.heavy
 def test_two_process_rendezvous_and_collectives():
     port = _free_port()
     env_base = dict(os.environ)
-    # children build their own 1-device CPU backends; drop the parent
-    # suite's 8-device virtual-mesh flag and let the worker pin cpu
-    env_base.pop("XLA_FLAGS", None)
+    # children build their own CPU backends: 4 virtual devices each, so
+    # the 2-process global mesh has 8 — the engine-training section
+    # exercises a REAL multi-process data axis, not 1 device per host
+    env_base["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env_base.pop("RANK", None)
     env_base.pop("WORLD_SIZE", None)
     pypath = env_base.get("PYTHONPATH", "")
@@ -65,4 +67,5 @@ def test_two_process_rendezvous_and_collectives():
             p.stdout.read() if p.stdout else "" for p in procs))
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"MULTIHOST-TRAIN-OK rank={rank}" in out, out
         assert f"MULTIHOST-OK rank={rank}" in out, out

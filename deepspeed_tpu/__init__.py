@@ -7,6 +7,7 @@ capability surface of the reference DeepSpeed (``deepspeed/__init__.py``):
 
 from deepspeed_tpu.version import __version__, __version_info__
 
+from deepspeed_tpu import zero
 from deepspeed_tpu.accelerator import get_accelerator, set_accelerator
 from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
 from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
@@ -47,6 +48,20 @@ def initialize(args=None,
 
     from deepspeed_tpu.runtime.zero.infinity import (ZeroInfinityEngine,
                                                      wants_param_offload)
+
+    if isinstance(model_parameters, dict) and "params" in model_parameters:
+        # flax variables-dict form (model.init output) — unwrap here so
+        # EVERY engine class sees the bare param tree (the inference
+        # engine applies the same leniency); extra collections (e.g.
+        # batch_stats) have no TrainState slot and are dropped loudly
+        extra = sorted(set(model_parameters) - {"params"})
+        if extra:
+            log_dist(
+                f"initialize: model_parameters carries non-'params' flax "
+                f"collections {extra} — the training engines track "
+                "parameters only; those collections are DROPPED",
+                ranks=[0])
+        model_parameters = model_parameters["params"]
 
     if isinstance(model, PipelineModule):
         engine_cls = PipelineEngine

@@ -1,0 +1,161 @@
+"""User-facing ``deepspeed_tpu.zero`` namespace.
+
+Capability parity with ``deepspeed.zero`` (reference
+``runtime/zero/__init__.py`` → ``partition_parameters.py``):
+
+- :class:`Init` (ref ``:537``) — construct parameters DIRECTLY sharded so
+  the full model never exists replicated on any chip. The reference hooks
+  ``nn.Module.__init__`` to partition tensors as torch creates them; the
+  TPU-native form jit-compiles the model's init function with ZeRO⊕TP
+  ``out_shardings``, which is strictly stronger: XLA materializes each
+  parameter shard in place, on device, with no transient full copy.
+- :class:`GatheredParameters` (ref ``:1511``) — temporarily assemble
+  partitioned parameters for host-side inspection/modification, writing
+  modifications back to the sharded copies on exit.
+- :func:`register_external_parameter` (ref ``:245``) — a documented no-op:
+  it exists to keep the reference's forward hooks working when a module
+  consumes another module's parameter; GSPMD has no hook machinery to
+  break, cross-module reads just work.
+"""
+
+import contextlib
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Init:
+    """Sharded parameter materialization (reference ``zero.Init``).
+
+    Reference call shape::
+
+        with deepspeed.zero.Init(config_dict_or_path=ds_config):
+            model = MyModel()          # torch: tensors partitioned on creation
+
+    TPU-native call shape (flax init is a function, not a side effect —
+    there is nothing to intercept, so the materializer is explicit)::
+
+        init = deepspeed_tpu.zero.Init(config_dict_or_path=ds_config)
+        params = init.materialize(model, sample_batch)   # sharded jax.Arrays
+
+    Inside ``deepspeed_tpu.initialize`` this already happens by default
+    (engine ``_init_params``); the class exists for the reference's
+    standalone uses — materializing a partitioned tree before or without
+    an engine. The context-manager form is kept so reference-shaped code
+    runs: entering is a no-op beyond recording the config.
+    """
+
+    def __init__(self, module=None, config_dict_or_path=None, mesh=None,
+                 stage: int = 3, config=None, **unused):
+        if config is not None and config_dict_or_path is None:
+            config_dict_or_path = config  # reference's deprecated spelling
+        self.config = config_dict_or_path
+        self.stage = stage
+        self._topo = mesh
+        if unused:
+            logger.warning(
+                f"zero.Init: ignoring torch-runtime kwargs {sorted(unused)} "
+                "(no meaning under XLA)")
+        if module is not None:
+            logger.warning(
+                "zero.Init(module=...): post-hoc partitioning of a built "
+                "module is the engine's job here — pass the model to "
+                "deepspeed_tpu.initialize, or use materialize()")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _topology(self):
+        from deepspeed_tpu.parallel.topology import get_topology
+
+        return self._topo if self._topo is not None else get_topology()
+
+    def materialize(self, model, sample_batch, rng=None, param_specs=None):
+        """Init ``model``'s params with every leaf created ALREADY sharded
+        (ZeRO stage-3 over the data axis layered on any TP base specs) —
+        the jitted init's ``out_shardings`` place each shard on its
+        device; no replicated copy ever exists (the property the
+        reference's ``Init`` buys with creation-time partitioning)."""
+        from deepspeed_tpu.runtime.zero.partition import build_zero_shardings
+
+        topo = self._topology()
+        rng = rng if rng is not None else jax.random.PRNGKey(42)
+        abstract = jax.eval_shape(
+            lambda r: model.init(r, sample_batch)["params"], rng)
+        shardings, _ = build_zero_shardings(
+            abstract, topo.mesh, stage=self.stage, param_specs=param_specs,
+            persistence_threshold=0)
+        init_fn = jax.jit(lambda r: model.init(r, sample_batch)["params"],
+                          out_shardings=shardings)
+        with topo.mesh:
+            return init_fn(rng)
+
+
+class GatheredParameters:
+    """Temporarily assemble partitioned parameters (reference
+    ``zero.GatheredParameters``, partition_parameters.py:1511)::
+
+        with deepspeed_tpu.zero.GatheredParameters(params) as full:
+            full["wte"][0] = 0.0          # host numpy, fully assembled
+        # exit: modifications re-shard back onto the original placements
+
+    ``params`` is any pytree of (possibly sharded) ``jax.Array`` leaves.
+    The gathered form is a pytree of host numpy arrays. With
+    ``modifier_rank=None`` (read-only, the reference's default meaning
+    "nobody writes"), exit skips the write-back. Access the re-sharded
+    tree as ``.params`` after exit."""
+
+    def __init__(self, params, modifier_rank: Optional[int] = 0,
+                 fwd_module=None, enabled: bool = True):
+        del fwd_module  # reference registers external params; no-op here
+        self._orig = params
+        self._writeback = enabled and modifier_rank is not None
+        self._enabled = enabled
+        self._gathered = None
+        self.params = params
+
+    def __enter__(self):
+        if not self._enabled:
+            return self._orig
+        self._gathered = jax.tree_util.tree_map(
+            lambda leaf: np.array(jax.device_get(leaf)), self._orig)
+        return self._gathered
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None or not self._enabled:
+            return False
+        if self._writeback:
+            self.params = jax.tree_util.tree_map(
+                lambda old, new: jax.device_put(
+                    np.asarray(new, dtype=old.dtype), old.sharding),
+                self._orig, self._gathered)
+        return False
+
+
+def register_external_parameter(module, parameter) -> None:
+    """Reference ``register_external_parameter``
+    (partition_parameters.py:245): tells the ZeRO-3 hook machinery that
+    ``module``'s forward consumes a parameter owned elsewhere. Under
+    GSPMD there are no gather hooks — any traced read of any sharded
+    parameter compiles to the right collectives — so this is a no-op
+    kept for import compatibility."""
+    del module, parameter
+
+
+# enum-shaped import compatibility (reference ZeroParamType/ZeroParamStatus)
+class ZeroParamType:
+    NORMAL = 1
+    PARTITIONED = 2
+    REMOTE = 3
+
+
+class ZeroParamStatus:
+    NOT_AVAILABLE = 1
+    INFLIGHT = 2
+    AVAILABLE = 3

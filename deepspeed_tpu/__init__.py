@@ -49,19 +49,11 @@ def initialize(args=None,
     from deepspeed_tpu.runtime.zero.infinity import (ZeroInfinityEngine,
                                                      wants_param_offload)
 
-    if isinstance(model_parameters, dict) and "params" in model_parameters:
-        # flax variables-dict form (model.init output) — unwrap here so
-        # EVERY engine class sees the bare param tree (the inference
-        # engine applies the same leniency); extra collections (e.g.
-        # batch_stats) have no TrainState slot and are dropped loudly
-        extra = sorted(set(model_parameters) - {"params"})
-        if extra:
-            log_dist(
-                f"initialize: model_parameters carries non-'params' flax "
-                f"collections {extra} — the training engines track "
-                "parameters only; those collections are DROPPED",
-                ranks=[0])
-        model_parameters = model_parameters["params"]
+    from deepspeed_tpu.utils.pytree import unwrap_variables_dict
+
+    # flax variables-dict form (model.init output) — one shared unwrap so
+    # EVERY engine class sees the bare param tree
+    model_parameters = unwrap_variables_dict(model_parameters)
 
     if isinstance(model, PipelineModule):
         engine_cls = PipelineEngine

@@ -88,8 +88,9 @@ class InferenceEngine:
             if example_input is None:
                 example_input = jnp.zeros((1, 8), jnp.int32)
             params = model.init(self._rng, example_input)
-        if isinstance(params, dict) and "params" in params:
-            params = params["params"]  # unwrap flax variables dict
+        from deepspeed_tpu.utils.pytree import unwrap_variables_dict
+
+        params = unwrap_variables_dict(params)
         self.policy = self._resolve_policy(config.injection_policy)
         params = self._convert_dtype(params)
         self.params, self.param_shardings = self._shard_params(params)

@@ -1,0 +1,5 @@
+"""Alias package (reference ``deepspeed/ops/adagrad``)."""
+
+from deepspeed_tpu.ops.cpu_adagrad import DeepSpeedCPUAdagrad
+
+__all__ = ["DeepSpeedCPUAdagrad"]

@@ -1,0 +1,7 @@
+"""Alias package (reference ``deepspeed/ops/adam``): user code imports
+``from deepspeed.ops.adam import FusedAdam, DeepSpeedCPUAdam``."""
+
+from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.ops.optimizer import FusedAdam
+
+__all__ = ["FusedAdam", "DeepSpeedCPUAdam"]

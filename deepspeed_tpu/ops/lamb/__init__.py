@@ -1,0 +1,5 @@
+"""Alias package (reference ``deepspeed/ops/lamb``)."""
+
+from deepspeed_tpu.ops.optimizer import FusedLamb
+
+__all__ = ["FusedLamb"]

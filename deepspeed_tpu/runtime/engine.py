@@ -485,13 +485,11 @@ class DeepSpeedEngine:
         self._jit_apply = None
         self._param_treedef = None
         if model_parameters is not None:
-            if (isinstance(model_parameters, dict)
-                    and "params" in model_parameters):
-                # flax variables-dict form — initialize() unwraps for all
-                # engines; kept here too for direct DeepSpeedEngine(...)
-                # construction
-                model_parameters = model_parameters["params"]
-            self._build_state(model_parameters)
+            from deepspeed_tpu.utils.pytree import unwrap_variables_dict
+
+            # shared leniency for direct DeepSpeedEngine(...) construction
+            # (initialize() already unwraps for all engine classes)
+            self._build_state(unwrap_variables_dict(model_parameters))
 
         log_dist(f"DeepSpeedEngine configured: zero_stage={self.zero_optimization_stage()} "
                  f"mesh={self.topology} micro_batch={self.train_micro_batch_size_per_gpu()} "

@@ -1,3 +1,7 @@
 from deepspeed_tpu.utils.logging import logger, log_dist, print_rank_0
 from deepspeed_tpu.utils.memory import memory_stats, see_memory_usage
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+# reference deepspeed/utils/__init__.py import surface
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.instrumentation import OnDevice, instrument_w_nvtx
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader

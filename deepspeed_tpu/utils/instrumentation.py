@@ -1,0 +1,56 @@
+"""Instrumentation + construction-context shims from the reference
+``deepspeed.utils`` import surface.
+
+- :func:`instrument_w_nvtx` (reference ``utils/nvtx.py``): wraps a
+  function in a profiler range. NVTX is CUDA-only; the TPU-native range
+  marker is ``jax.profiler.TraceAnnotation``, which shows up in the
+  XPlane traces ``jax.profiler.start_trace`` captures.
+- :class:`OnDevice` (reference ``utils/init_on_device.py``): torch needs
+  a context manager to construct modules on meta/target devices without
+  materializing weights. Flax modules are dataclasses — construction
+  allocates nothing and ``jax.eval_shape``/``zero.Init`` cover the
+  deferred/ sharded materialization — so the context is a documented
+  no-op that validates its arguments and keeps reference code running.
+"""
+
+import contextlib
+import functools
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def instrument_w_nvtx(func):
+    """Profiler-range decorator (reference ``instrument_w_nvtx``): each
+    call shows as a named range in ``jax.profiler`` traces."""
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(func.__qualname__):
+            return func(*args, **kwargs)
+
+    return wrapped
+
+
+class OnDevice:
+    """Reference ``OnDevice`` (utils/init_on_device.py): construct a
+    model under a device/dtype context. Flax module CONSTRUCTION never
+    allocates parameters (init does), so nothing needs deferring —
+    entering records the intent and points users at the native
+    materializers."""
+
+    def __init__(self, dtype=None, device=None, enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        if enabled and device in ("meta",):
+            logger.info(
+                "OnDevice(device='meta'): flax construction is already "
+                "weight-free; use jax.eval_shape for abstract params or "
+                "deepspeed_tpu.zero.Init().materialize for sharded ones")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -15,3 +15,21 @@ def flatten_with_path_strings(tree: Any) -> Tuple[List[Tuple[str, Any]], Any]:
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     return [("/".join(key_entry_str(k) for k in key_path), leaf)
             for key_path, leaf in flat], treedef
+
+
+def unwrap_variables_dict(tree):
+    """Flax variables-dict leniency shared by every engine entry point:
+    ``model.init`` returns ``{"params": ..., <other collections>...}`` —
+    engines track parameters only, so unwrap and WARN when any other
+    collection (e.g. batch_stats) is being dropped."""
+    if not (isinstance(tree, dict) and "params" in tree):
+        return tree
+    extra = sorted(set(tree) - {"params"})
+    if extra:
+        from deepspeed_tpu.utils.logging import log_dist
+
+        log_dist(
+            f"model_parameters carries non-'params' flax collections "
+            f"{extra} — engines track parameters only; those collections "
+            "are DROPPED", ranks=[0])
+    return tree["params"]

@@ -106,12 +106,13 @@ class GatheredParameters:
         # exit: modifications re-shard back onto the original placements
 
     ``params`` is any pytree of (possibly sharded) ``jax.Array`` leaves.
-    The gathered form is a pytree of host numpy arrays. With
-    ``modifier_rank=None`` (read-only, the reference's default meaning
-    "nobody writes"), exit skips the write-back. Access the re-sharded
-    tree as ``.params`` after exit."""
+    The gathered form is a pytree of host numpy arrays. The default
+    ``modifier_rank=None`` is read-only (the reference's default —
+    "nobody writes"), so exit skips the write-back; pass
+    ``modifier_rank=0`` to re-shard modifications and read them from
+    ``.params`` after exit."""
 
-    def __init__(self, params, modifier_rank: Optional[int] = 0,
+    def __init__(self, params, modifier_rank: Optional[int] = None,
                  fwd_module=None, enabled: bool = True):
         del fwd_module  # reference registers external params; no-op here
         self._orig = params

@@ -98,3 +98,37 @@ def test_factory():
     assert isinstance(build_basic_optimizer("sgd", {"lr": 1e-3}), FusedSGD)
     with pytest.raises(ValueError):
         build_basic_optimizer("nope", {})
+
+
+class TestReferenceImportPaths:
+    def test_ops_alias_packages(self):
+        """The reference's optimizer import sites must resolve:
+        ``from deepspeed.ops.adam import FusedAdam, DeepSpeedCPUAdam``."""
+        from deepspeed_tpu.ops.adagrad import DeepSpeedCPUAdagrad
+        from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam, FusedAdam
+        from deepspeed_tpu.ops.lamb import FusedLamb
+        from deepspeed_tpu.ops.cpu_adam import \
+            DeepSpeedCPUAdam as DirectCPUAdam
+
+        assert DeepSpeedCPUAdam is DirectCPUAdam
+        assert FusedAdam.__name__ == "FusedAdam"
+        assert FusedLamb.__name__ == "FusedLamb"
+        assert DeepSpeedCPUAdagrad.__name__ == "DeepSpeedCPUAdagrad"
+
+    def test_utils_surface(self):
+        """Reference ``deepspeed.utils`` import names."""
+        from deepspeed_tpu.utils import (OnDevice, RepeatingLoader, groups,
+                                         instrument_w_nvtx, log_dist,
+                                         logger)
+
+        @instrument_w_nvtx
+        def f(x):
+            return x * 2
+
+        assert f(3) == 6
+        with OnDevice(device="meta"):
+            pass
+        assert callable(groups.get_data_parallel_world_size)
+        loader = RepeatingLoader([1, 2])
+        it = iter(loader)
+        assert [next(it) for _ in range(4)] == [1, 2, 1, 2]

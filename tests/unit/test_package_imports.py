@@ -1,0 +1,25 @@
+"""Every submodule of the package must import cleanly — the cheapest
+whole-surface gate there is (reference analog: its CI import smoke).
+Catches dangling imports, circular imports, and alias packages whose
+targets moved."""
+
+import importlib
+import pkgutil
+
+
+def test_every_submodule_imports():
+    import deepspeed_tpu
+
+    failures = []
+    # onerror: walk_packages internally imports packages to recurse into
+    # them — without a handler a raising __init__ would abort the walk
+    # with a raw traceback instead of landing in the failure report
+    for m in pkgutil.walk_packages(deepspeed_tpu.__path__,
+                                   "deepspeed_tpu.",
+                                   onerror=lambda name: failures.append(
+                                       f"{name}: walk error")):
+        try:
+            importlib.import_module(m.name)
+        except Exception as e:  # noqa: BLE001 — report all breakage
+            failures.append(f"{m.name}: {type(e).__name__}: {e}")
+    assert not failures, "\n".join(failures)

@@ -198,6 +198,12 @@ def from_pretrained(src, arch: Optional[str] = None, dtype=None,
 
         known = {k: v for k, v in engine_kw.items()
                  if k in ("tensor_parallel", "tp", "mp_size")}
+        unused = sorted(set(engine_kw) - set(known))
+        if unused:
+            logger.warning(
+                "clip serving consumes only tensor_parallel/tp/mp_size; "
+                f"ignoring engine options {unused} (the dual-encoder path "
+                "has no decode cache, kernel injection, or quant convert)")
         tp_size = int(DeepSpeedInferenceConfig(
             **known).tensor_parallel.tp_size)
         return CLIPServingEngine(model, params, tp_size=tp_size)

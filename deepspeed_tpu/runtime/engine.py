@@ -1349,9 +1349,14 @@ class DeepSpeedEngine:
             save_file(tensors, path)
         except ImportError:
             path = os.path.splitext(path)[0] + ".npz"
-            np_.savez(path, **{k: v.view(np_.uint16)
-                               if v.dtype == jnp.bfloat16 else v
-                               for k, v in tensors.items()})
+            # npz can't hold bf16 natively: store uint16 views plus a
+            # sidecar key listing which entries to re-view on load (the
+            # SDLoaderFactory npz reader honors it)
+            bf16_keys = [k for k, v in tensors.items()
+                         if v.dtype == jnp.bfloat16]
+            np_.savez(path, __bf16_keys__=np_.asarray(bf16_keys),
+                      **{k: v.view(np_.uint16) if v.dtype == jnp.bfloat16
+                         else v for k, v in tensors.items()})
         log_dist(f"saved 16-bit model to {path}", ranks=[0])
         return path
 

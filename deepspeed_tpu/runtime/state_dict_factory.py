@@ -135,7 +135,13 @@ class SDLoaderFactory:
                     f"no checkpoint file found under {path!r}")
         if path.endswith(".npz"):
             with np.load(path) as z:
-                return {k: z[k] for k in z.files}
+                # engine.save_16bit_model's no-safetensors fallback stores
+                # bf16 tensors as uint16 views plus this sidecar key
+                bf16 = set(np.atleast_1d(z["__bf16_keys__"]).tolist()) \
+                    if "__bf16_keys__" in z.files else set()
+                import jax.numpy as jnp
+                return {k: z[k].view(jnp.bfloat16) if k in bf16 else z[k]
+                        for k in z.files if k != "__bf16_keys__"}
         if path.endswith(".safetensors"):
             from safetensors.numpy import load_file
 

@@ -164,6 +164,22 @@ class ZeroInfinityEngine:
                 PipelinedOptimizerSwapper)
 
             blocks_init = host_params["transformer"]["h"]["block"]
+            # validate the staging budget BEFORE the swapper constructor
+            # write_all()s ~3x model bytes of stride files to disk — a
+            # refusal must not leave orphaned multi-GB .bin files behind
+            if off is not None and "buffer_size" in off.model_fields_set:
+                n_layer = int(jax.tree_util.tree_leaves(
+                    blocks_init)[0].shape[0])
+                row_bytes = sum(
+                    leaf.size // n_layer * 4
+                    for leaf in jax.tree_util.tree_leaves(blocks_init))
+                if row_bytes > off.buffer_size:
+                    raise DeepSpeedConfigError(
+                        f"offload_param.buffer_size={off.buffer_size} is "
+                        f"below one layer's weights ({row_bytes} bytes) and "
+                        "tiled-MLP streaming is unavailable on the NVMe "
+                        "tier; raise buffer_size to at least one layer, or "
+                        "use device='cpu' for tiled streaming")
             top_init = {k: v for k, v in host_params.items()
                         if k != "transformer"}
             self._host_opt.clip = 0.0  # global clip spans top+blocks: engine-owned

@@ -89,6 +89,15 @@ def probe(timeout_s: float = 90.0):
     return None, " | ".join(t.strip() for t in tail)
 
 
+def _round_key(path: str):
+    """Order round artifacts by their parsed round number (``r2`` < ``r10``
+    < ``r100``) — lexicographic path sort breaks once zero-padding slips."""
+    import re
+
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return (int(m.group(1)) if m else -1, path)
+
+
 def _last_known_good(metric: str):
     """Latest driver-captured green result for ``metric`` from the
     ``BENCH_r*.json`` artifacts, with provenance — the partial-credit
@@ -99,7 +108,8 @@ def _last_known_good(metric: str):
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     best = None
-    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")),
+                       key=_round_key):
         try:
             with open(path) as f:
                 rec = json.load(f)
@@ -123,7 +133,8 @@ def _probe_log_tail(lines: int = 5):
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     logs = sorted(glob.glob(os.path.join(repo, "tools",
-                                         "probe_log_r*.txt")))
+                                         "probe_log_r*.txt")),
+                  key=_round_key)
     if not logs:
         return None
     try:

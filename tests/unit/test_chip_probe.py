@@ -1,0 +1,21 @@
+"""Bench-artifact provenance helpers (utils/chip_probe.py): round
+artifacts must be ordered by parsed round number, not path string —
+``BENCH_r10`` sorts after ``BENCH_r2`` (ADVICE r4)."""
+
+from deepspeed_tpu.utils.chip_probe import _round_key
+
+
+def test_round_key_orders_numerically():
+    paths = ["BENCH_r10.json", "BENCH_r2.json", "BENCH_r100.json",
+             "BENCH_r04.json"]
+    assert sorted(paths, key=_round_key) == [
+        "BENCH_r2.json", "BENCH_r04.json", "BENCH_r10.json",
+        "BENCH_r100.json"]
+
+
+def test_round_key_handles_probe_logs_and_unmatched():
+    paths = ["tools/probe_log_r20.txt", "tools/probe_log_r100.txt",
+             "tools/probe_log_r3.txt"]
+    assert sorted(paths, key=_round_key)[-1] == "tools/probe_log_r100.txt"
+    # unmatched names sort first rather than raising
+    assert _round_key("BENCH.json")[0] == -1

@@ -352,6 +352,33 @@ class TestReferenceAccessorSurface:
                 for l in jax.tree_util.tree_leaves(sd))
         assert _os.path.getsize(path) < 0.75 * n
 
+    def test_16bit_npz_fallback_roundtrip(self, tmp_path, monkeypatch):
+        """Without safetensors the writer falls back to npz with uint16
+        views; the sidecar key must re-view them as bf16 on load — no
+        silent dtype corruption through SDLoaderFactory (ADVICE r4)."""
+        import sys
+
+        e = self._engine()
+        ids = np.random.default_rng(0).integers(
+            0, 256, (8, 32)).astype(np.int32)
+        e({"input_ids": ids})  # materialize params
+        sd = e.module_state_dict()
+        monkeypatch.setitem(sys.modules, "safetensors.numpy", None)
+        path = e.save_16bit_model(str(tmp_path))
+        assert path.endswith(".npz")
+        from deepspeed_tpu.runtime.state_dict_factory import SDLoaderFactory
+        from deepspeed_tpu.utils.pytree import flatten_with_path_strings
+
+        loaded = SDLoaderFactory.load(path)
+        assert "__bf16_keys__" not in loaded
+        flat, _ = flatten_with_path_strings(sd)
+        src = dict(flat)
+        assert set(loaded) == set(src)
+        for k, v in loaded.items():
+            assert v.dtype == jnp.bfloat16, k
+            np.testing.assert_array_equal(
+                v, np.asarray(jnp.asarray(src[k]).astype(jnp.bfloat16)))
+
     def test_set_train_batch_size(self):
         e = self._engine()
         assert e.gradient_accumulation_steps() == 2

@@ -148,6 +148,24 @@ class TestInfinityEngine:
             losses.append(float(l))
         assert losses[-1] < losses[0]
 
+    def test_nvme_budget_below_row_raises(self, tmp_path):
+        """NVMe rows stream from disk as whole units — a buffer_size below
+        one layer's weights cannot be honored there (no host master to
+        tile from), so construction must refuse loudly rather than
+        silently staging over budget (ADVICE r4)."""
+        from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+
+        model = GPT2ForTraining(_cfg())
+        with pytest.raises(DeepSpeedConfigError, match="NVMe tier"):
+            ZeroInfinityEngine(
+                model=model, model_parameters=_init_params(model),
+                config=_ds_config(extra_zero={"offload_param": {
+                    "device": "nvme", "nvme_path": str(tmp_path),
+                    "buffer_size": 1024}}))
+        # the refusal fires BEFORE the swapper writes its stride files —
+        # no orphaned .bin stores left on disk
+        assert not any(p.suffix == ".bin" for p in tmp_path.iterdir())
+
     def test_checkpoint_roundtrip(self, tmp_path):
         model = GPT2ForTraining(_cfg())
         params = _init_params(model)

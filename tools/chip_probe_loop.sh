@@ -1,9 +1,9 @@
 #!/usr/bin/env bash
 # Continuous TPU-backend probe: poll every ~15 min, append a status line to
-# tools/probe_log_r04.txt.  When the backend answers, write tools/CHIP_UP
+# tools/probe_log_r05.txt.  When the backend answers, write tools/CHIP_UP
 # as a sentinel so the session notices and runs tools/real_chip_backlog.sh.
 cd "$(dirname "$0")/.."
-LOG=tools/probe_log_r04.txt
+LOG=tools/probe_log_r05.txt
 while true; do
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   OUT=$(timeout 90 python -c "

@@ -1,0 +1,88 @@
+"""ZeRO-Inference benchmark: offload-streamed decode throughput.
+
+The reference's ZeRO-Inference headline is tokens/s serving a model from
+CPU offload (OPT-30B at 43 tok/s, ``docs/_posts/2022-09-10-zero-inference
+.md:52``) — the regime is H2D-bandwidth-bound (one full model transfer per
+decode step), so batch size and at-rest dtype set the rate. Prints ONE
+JSON line::
+
+    {"metric": "gpt2_zero_inference", "decode_tokens_per_sec": ...,
+     "int8_tokens_per_sec": ..., "model_mb": ...}
+
+On TPU: GPT-2 medium-ish config streamed bf16 and int8 from host RAM.
+On CPU a tiny proxy keeps the script runnable anywhere.
+"""
+
+import json
+import time
+
+import numpy as np
+
+from deepspeed_tpu.utils.chip_probe import (assert_platform, is_tpu,
+                                            require_backend, resolve_metric,
+                                            run_guarded)
+
+METRIC = resolve_metric("gpt2_zero_inference", "gpt2_zero_inference_cpu_smoke")
+
+
+def main():
+    platform = require_backend(METRIC)
+
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.zero_inference import ZeroInferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    assert_platform(METRIC, platform)
+    on_tpu = is_tpu(platform)
+    if on_tpu:
+        # big enough that streaming dominates; batch amortizes each transfer
+        cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=1024,
+                         n_layer=24, n_head=16, dtype=jnp.bfloat16,
+                         scan_layers=True)
+        batch, prompt, new_tokens, reps = 32, 64, 64, 3
+    else:
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        batch, prompt, new_tokens, reps = 2, 8, 8, 2
+
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
+    zero = {"stage": 3, "offload_param": {"device": "cpu"}}
+
+    def rate(dtype):
+        eng = deepspeed_tpu.init_inference(
+            model, dtype=dtype, zero=zero, max_out_tokens=cfg.n_positions)
+        assert isinstance(eng, ZeroInferenceEngine)
+
+        # marginal decode cost between two generation lengths cancels
+        # prefill + dispatch overhead (same methodology as bench_decode.py)
+        def gen_time(n):
+            eng.generate(ids, max_new_tokens=n)  # warm/compile
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                eng.generate(ids, max_new_tokens=n)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t1 = gen_time(new_tokens)
+        t2 = gen_time(2 * new_tokens)
+        per_token_s = max(1e-9, (t2 - t1) / new_tokens)
+        return batch / per_token_s, eng.total_param_bytes
+
+    bf16_rate, model_bytes = rate("bf16" if on_tpu else "fp32")
+    int8_rate, _ = rate("int8")
+
+    print(json.dumps({
+        "metric": METRIC,
+        "decode_tokens_per_sec": round(bf16_rate, 1),
+        "int8_tokens_per_sec": round(int8_rate, 1),
+        "model_mb": round(model_bytes / 1e6, 1),
+        "batch": batch, "prompt": prompt, "new_tokens": new_tokens,
+    }))
+
+
+if __name__ == "__main__":
+    run_guarded(METRIC, main)

@@ -86,10 +86,26 @@ def default_inference_config():
 
 
 def init_inference(model, config=None, **kwargs):
-    """Build an inference engine (reference ``deepspeed/__init__.py:233``)."""
-    from deepspeed_tpu.inference.engine import InferenceEngine
+    """Build an inference engine (reference ``deepspeed/__init__.py:233``).
 
-    # config coercion (None/dict/instance + kwargs merge) lives in the engine
+    A ``zero`` section selecting stage-3 parameter offload (``{"stage": 3,
+    "offload_param": {"device": "cpu"|"nvme", ...}}``) returns the
+    ZeRO-Inference tier: parameters stay host/NVMe-resident and stream
+    through the device per layer, serving models larger than device memory
+    (reference ``docs/_posts/2022-09-10-zero-inference.md``)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.zero_inference import (ZeroInferenceEngine,
+                                                        wants_zero_inference)
+
+    # probe ONLY the zero section ahead of engine construction (full
+    # coercion — None/dict/instance + kwargs merge — lives in the engines;
+    # duck-typed config objects must pass through untouched)
+    zero = kwargs.get("zero")
+    if zero is None:
+        zero = (config.get("zero") if isinstance(config, dict)
+                else getattr(config, "zero", None))
+    if wants_zero_inference(zero):
+        return ZeroInferenceEngine(model, config=config, **kwargs)
     return InferenceEngine(model, config=config, **kwargs)
 
 

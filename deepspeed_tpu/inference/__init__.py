@@ -3,6 +3,7 @@
 from deepspeed_tpu.inference.auto import from_pretrained, load_pretrained
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.zero_inference import ZeroInferenceEngine
 
-__all__ = ["DeepSpeedInferenceConfig", "InferenceEngine", "from_pretrained",
-           "load_pretrained"]
+__all__ = ["DeepSpeedInferenceConfig", "InferenceEngine",
+           "ZeroInferenceEngine", "from_pretrained", "load_pretrained"]

@@ -39,6 +39,33 @@ def _is_floating(x) -> bool:
     return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
 
 
+def sample_logits(logits, rng, temperature, do_sample: bool, top_k: int,
+                  top_p: float):
+    """Greedy/temperature/top-k/top-p (nucleus) next-token sampling —
+    shared by the device engine and the ZeRO-Inference tier so the two
+    cannot drift. ``do_sample``/``top_k``/``top_p`` must be Python-static
+    (they select the traced program); ``temperature`` may be traced.
+    Nucleus keeps the smallest prefix of the sorted distribution whose
+    mass reaches ``top_p`` (the first token past the threshold stays,
+    HF-style)."""
+    logits = logits.astype(jnp.float32)
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p > 0.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
 class InferenceEngine:
     """Wraps a flax LM for sharded, jitted generation.
 
@@ -274,27 +301,8 @@ class InferenceEngine:
             cache = vars_["cache"]
 
             def sample(logits, rng):
-                logits = logits.astype(jnp.float32)
-                if do_sample:
-                    logits = logits / jnp.maximum(temperature, 1e-6)
-                    if top_k > 0:
-                        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-                        logits = jnp.where(logits < kth, -jnp.inf, logits)
-                    if top_p > 0.0:
-                        # nucleus: keep the smallest prefix of the sorted
-                        # distribution whose mass reaches top_p (the first
-                        # token past the threshold stays, HF-style)
-                        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-                        cum = jnp.cumsum(
-                            jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
-                        keep = cum - jax.nn.softmax(sorted_logits,
-                                                    axis=-1) < top_p
-                        cutoff = jnp.min(
-                            jnp.where(keep, sorted_logits, jnp.inf),
-                            axis=-1, keepdims=True)
-                        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-                    return jax.random.categorical(rng, logits, axis=-1)
-                return jnp.argmax(logits, axis=-1)
+                return sample_logits(logits, rng, temperature, do_sample,
+                                     top_k, top_p)
 
             rng, sub = jax.random.split(rng)
             first = sample(logits[:, -1], sub)

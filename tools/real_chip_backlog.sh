@@ -47,6 +47,9 @@ timeout 900 python bench_decode.py
 timeout 900 python bench_bert.py
 timeout 900 python bench_sparse.py
 
+echo "== 3b. round-5: ZeRO-Inference offload-streamed serving tok/s =="
+timeout 900 python bench_zero_infer.py
+
 echo "== 4. attention layout A/B (flip bench.py attn_layout if bthd wins) =="
 timeout 900 python tools/perf_attn_layout.py || true
 echo "== backlog complete: update PERF.md with the four JSON lines =="

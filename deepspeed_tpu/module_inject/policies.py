@@ -202,6 +202,37 @@ def specs_from_policy(policy: TPPolicy, params_abstract, mesh,
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 
+def decode_cache_specs(cache_abstract, mesh, axis: str = AXIS_MODEL):
+    """PartitionSpecs for a decode KV cache under tensor parallelism.
+
+    The cache is the decode working set the TP layout must keep sharded:
+    ``cached_key``/``cached_value`` leaves carry the layout
+    ``[..., positions, heads, head_dim]`` (models/gpt2.py decode cache,
+    optionally with a leading stacked-layer axis), and the HEAD axis
+    follows the attention heads the QKV column-split distributed — so it
+    shards over ``axis`` exactly like the reference splits its inference
+    KV workspace per TP rank (``inference_context.h`` workspace carved
+    per ``mp_size``). Scalars/per-row bookkeeping (``cache_index``,
+    ``position``, ``pad_len``) replicate.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.utils.pytree import flatten_with_path_strings
+
+    flat, treedef = flatten_with_path_strings(cache_abstract)
+
+    def spec(path, leaf):
+        if path.rsplit("/", 1)[-1] in ("cached_key", "cached_value"):
+            parts = [None] * len(leaf.shape)
+            parts[-2] = axis  # heads
+            return P(*parts)
+        return P()
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [NamedSharding(mesh, spec(p, l)) for p, l in flat])
+
+
 def shard_params_with_policy(params, policy, mesh, axis: str = AXIS_MODEL):
     """Place a param pytree per the policy's TP specs.
 

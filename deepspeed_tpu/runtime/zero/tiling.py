@@ -47,6 +47,9 @@ class TiledLinear:
         self.n_tiles = -(-self.Out // self.Ot)
         self._jit_fwd = jax.jit(self._fwd_tile, donate_argnums=(3,))
         self._jit_bwd = jax.jit(self._bwd_tile)
+        self._jit_bwd_dx = jax.jit(
+            lambda w, dyt: jnp.einsum("...o,io->...i", dyt,
+                                      w.astype(jnp.float32)))
 
     # -- per-tile kernels (tile shape static; remainder tile compiles its
     #    own variant instead of padding) --------------------------------
@@ -111,10 +114,16 @@ class TiledLinear:
         # D2H overlap: tile j's dW/db copy to host asynchronously while
         # tile j+1's matmul runs; the host accumulate is deferred one
         # iteration (same pattern as the infinity backward stream)
+        # frozen-weight path (both accumulators omitted): only dx is
+        # needed — skip the dW einsum and its full-matrix D2H entirely
+        frozen = gw_host is None and gb_host is None
         pending = None
         for lo, w_dev in self._stream_tiles(w_host, device):
             hi = lo + w_dev.shape[1]
             dyt = jax.lax.dynamic_slice_in_dim(dy, lo, hi - lo, axis=-1)
+            if frozen:
+                dx = dx + self._jit_bwd_dx(w_dev, dyt)
+                continue
             dx_j, dw, db = self._jit_bwd(x, w_dev, dyt)
             dx = dx + dx_j
             dw.copy_to_host_async()
@@ -129,9 +138,59 @@ class TiledLinear:
     @staticmethod
     def _accum_tile(p, gw_host, gb_host):
         lo, hi, dw, db = p
-        gw_host[:, lo:hi] += np.asarray(jax.device_get(dw), np.float32)
+        if gw_host is not None:
+            gw_host[:, lo:hi] += np.asarray(jax.device_get(dw), np.float32)
         if gb_host is not None:
             gb_host[lo:hi] += np.asarray(jax.device_get(db), np.float32)
+
+    # -- autodiff entry -------------------------------------------------
+    def __call__(self, x, w_host, b_host=None, *, gw_host=None,
+                 gb_host=None, device=None):
+        """Differentiable application: ``jax.grad`` flows ``dx`` through
+        the streamed linear via ``jax.custom_vjp``.
+
+        Host-accumulator contract: the WEIGHT gradient never exists as a
+        device value — during backward each tile's ``dW``/``db`` adds into
+        the caller's host fp32 buffers ``gw_host``/``gb_host`` in place
+        (omit them to discard weight grads, e.g. frozen weights). This is
+        the same side-channel the Infinity tier consumes via
+        :meth:`grads`.
+
+        Eager-only by design: under ``jit``/``grad``-of-``jit`` tracing
+        there is no host to stream from — every tile would bake into the
+        compiled program as a constant, materializing exactly the full
+        weight this class exists to avoid — so a traced ``x`` is refused.
+        The engine's host-orchestrated regime (the only place a
+        host-resident weight makes sense) runs its layer loop outside jit
+        anyway.
+        """
+        def _refuse_traced(x):
+            # custom_vjp delivers CONCRETE arrays here under eager
+            # jax.grad; only jit tracing leaks a tracer through
+            if isinstance(x, jax.core.Tracer):
+                raise TypeError(
+                    "TiledLinear streams a HOST-resident weight and cannot "
+                    "run under jit tracing (each tile would bake into the "
+                    "compiled program as a constant — the full-weight "
+                    "materialization tiling prevents). Call it outside "
+                    "jit; jax.grad works eagerly.")
+
+        @jax.custom_vjp
+        def apply(x):
+            _refuse_traced(x)
+            return self.forward(x, w_host, b_host, device=device)
+
+        def fwd(x):
+            _refuse_traced(x)
+            return self.forward(x, w_host, b_host, device=device), x
+
+        def bwd(x_res, dy):
+            return (self.grads(x_res, w_host, dy, gw_host,
+                               gb_host if self.use_bias else None,
+                               device=device),)
+
+        apply.defvjp(fwd, bwd)
+        return apply(x)
 
 
 def tiled_dense(x, kernel, bias=None, *, precision=None):

@@ -66,6 +66,28 @@ def sample_logits(logits, rng, temperature, do_sample: bool, top_k: int,
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def load_module_params(load_dir, tag=None):
+    """Raw module param tree from a training checkpoint dir — the shared
+    tag-resolution ('latest' file, ``global_step0`` fallback) and layout
+    parsing both serving tiers load through (reference ``engine.py:269``)."""
+    import os
+
+    from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+        ArrayCheckpointEngine)
+
+    eng = ArrayCheckpointEngine()
+    if tag is None:
+        latest = os.path.join(load_dir, "latest")
+        tag = (open(latest).read().strip() if os.path.exists(latest)
+               else "global_step0")
+    state = eng.load(os.path.join(load_dir, str(tag), "module"))
+    if isinstance(state, dict) and any("/" in k for k in state):
+        from deepspeed_tpu.runtime.engine import _unflatten_by_paths
+
+        return _unflatten_by_paths(state, "params/")
+    return state["params"] if "params" in state else state
+
+
 class InferenceEngine:
     """Wraps a flax LM for sharded, jitted generation.
 
@@ -413,21 +435,7 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # reference checkpoint surface (engine.py:269,369)
     def load_checkpoint(self, load_dir, tag=None):
-        from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
-            ArrayCheckpointEngine)
-        import os
-
-        eng = ArrayCheckpointEngine()
-        if tag is None:
-            latest = os.path.join(load_dir, "latest")
-            tag = open(latest).read().strip() if os.path.exists(latest) else "global_step0"
-        state = eng.load(os.path.join(load_dir, str(tag), "module"))
-        if isinstance(state, dict) and any("/" in k for k in state):
-            from deepspeed_tpu.runtime.engine import _unflatten_by_paths
-
-            params = _unflatten_by_paths(state, "params/")
-        else:
-            params = state["params"] if "params" in state else state
+        params = load_module_params(load_dir, tag)
         params = self._convert_dtype(params)
         self.params, self.param_shardings = self._shard_params(params)
         if self._quantized:

@@ -148,8 +148,22 @@ class ZeroInferenceEngine:
         if params is None:
             params = model.init(jax.random.PRNGKey(seed),
                                 jnp.zeros((1, 8), jnp.int32))
+        self._off = off
+        self._install_params(params)
+        log_dist(
+            f"ZeroInferenceEngine: {self.n_layer} streamed layers "
+            f"({'nvme' if self._nvme else 'host'}-resident, "
+            f"{'int8' if self._int8 else np.dtype(self._dtype).name} at "
+            f"rest, {self._row_bytes / 1e6:.2f} MB/layer); device keeps "
+            f"embeddings/head + 2 layer buffers + KV cache", ranks=[0])
+
+    def _install_params(self, params):
+        """(Re)build the at-rest stores from a raw param tree: canonical
+        split, serving-dtype cast, optional int8 quantize, budget check,
+        optional NVMe memmap, device-resident top."""
         from deepspeed_tpu.utils.pytree import unwrap_variables_dict
 
+        off = self._off
         params = jax.device_get(unwrap_variables_dict(params))
         try:
             blocks = params["transformer"]["h"]["block"]
@@ -192,19 +206,30 @@ class ZeroInferenceEngine:
                 "it to at least one layer (the device stages two)")
 
         if self._nvme:
-            blocks = self._memmap_blocks(blocks, off["nvme_path"])
+            blocks, store = self._memmap_blocks(blocks, off["nvme_path"])
+            # a reload supersedes the previous on-disk store: unlink it
+            # now (POSIX keeps the old maps' pages alive until the numpy
+            # memmaps above are garbage-collected with self._blocks) —
+            # otherwise every load_checkpoint leaks a full model copy
+            if getattr(self, "_nvme_store", None):
+                import shutil
+
+                shutil.rmtree(self._nvme_store, ignore_errors=True)
+            self._nvme_store = store
         self._blocks = blocks
         # top (embeddings/head/final-LN — O(vocab), not O(depth)) is the
         # persistent device-resident set, already in the serving dtype
         self._top_dev = jax.device_put(top, self._device)
-
         self._compiled: Dict[Any, Any] = {}
-        log_dist(
-            f"ZeroInferenceEngine: {self.n_layer} streamed layers "
-            f"({'nvme' if self._nvme else 'host'}-resident, "
-            f"{'int8' if self._int8 else np.dtype(self._dtype).name} at "
-            f"rest, {self._row_bytes / 1e6:.2f} MB/layer); device keeps "
-            f"embeddings/head + 2 layer buffers + KV cache", ranks=[0])
+
+    def load_checkpoint(self, load_dir, tag=None):
+        """Reload at-rest parameters from a training checkpoint (same
+        surface as ``InferenceEngine.load_checkpoint``, reference
+        ``engine.py:269``): the module state re-enters the host/NVMe
+        pipeline; compiled per-layer programs are rebuilt."""
+        from deepspeed_tpu.inference.engine import load_module_params
+
+        self._install_params(load_module_params(load_dir, tag))
 
     # ------------------------------------------------------------------
     def _quantize_blocks(self, blocks):
@@ -250,7 +275,7 @@ class ZeroInferenceEngine:
             np.save(fname, a)
             return np.load(fname, mmap_mode="r")
 
-        return jax.tree_util.tree_map_with_path(mm, blocks)
+        return jax.tree_util.tree_map_with_path(mm, blocks), store
 
     # ------------------------------------------------------------------
     def _row(self, l: int):

@@ -61,6 +61,30 @@ def test_from_pretrained_generates(arch):
     assert (out[:, :3] == ids).all()
 
 
+@pytest.mark.parametrize("arch", ["gpt2", "opt", "bloom"])
+def test_from_pretrained_zero_inference(arch):
+    """HF checkpoint → canonical normalize → ZeRO-Inference streamed
+    serving, composed through the one-call entry: a zero section in the
+    engine kwargs must route the loaded model onto the offload tier and
+    still produce HF's greedy first token."""
+    hf, kw = _hf_state_dict(arch)
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference import ZeroInferenceEngine
+
+    engine = from_pretrained(
+        hf.state_dict(), dtype=jnp.float32, loader_kw=kw,
+        max_out_tokens=32,
+        zero={"stage": 3, "offload_param": {"device": "cpu"}})
+    assert isinstance(engine, ZeroInferenceEngine)
+    ids = np.array([[3, 17, 42, 9]], np.int32)
+    out = engine.generate(ids, max_new_tokens=1, do_sample=False)
+    with torch.no_grad():
+        hf_next = hf(torch.tensor(ids, dtype=torch.long)).logits[
+            :, -1].argmax(-1).numpy()
+    assert out[0, -1] == hf_next[0]
+
+
 @pytest.mark.parametrize("arch", ["gpt2", "opt", "bloom", "llama"])
 def test_greedy_first_token_matches_hf(arch):
     """The engine's prefill logits drive the same greedy first token HF

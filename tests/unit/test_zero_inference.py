@@ -201,6 +201,39 @@ class TestQuantized:
         assert err < 0.05 * scale, (err, scale)
 
 
+class TestCheckpointReload:
+    def test_load_checkpoint_matches_device_engine(self, tmp_path):
+        """A training checkpoint reloads into the streamed tier through
+        the same surface the device engine exposes (reference
+        ``engine.py:269``): both engines loaded from the same dir must
+        produce the same logits."""
+        import deepspeed_tpu
+
+        from deepspeed_tpu.models.gpt2 import GPT2ForTraining
+
+        train = GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32))
+        model = train.model
+        engine, *_ = deepspeed_tpu.initialize(
+            model=train,
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "steps_per_print": 10_000})
+        engine({"input_ids": _ids(8, 16)})  # materialize
+        engine.save_checkpoint(tmp_path)
+
+        fresh = _model_and_params(seed=9)[1]  # different weights
+        zinf = ZeroInferenceEngine(model, params=fresh, dtype="fp32",
+                                   zero=_zero())
+        ref = InferenceEngine(model, params={"params": fresh},
+                              dtype="fp32")
+        zinf.load_checkpoint(str(tmp_path))
+        ref.load_checkpoint(str(tmp_path))
+        ids = _ids(2, 10, seed=8)
+        np.testing.assert_allclose(
+            np.asarray(zinf.forward(ids)), np.asarray(ref.forward(ids)),
+            rtol=2e-5, atol=2e-5)
+
+
 class TestNvmeTier:
     def test_memmap_files_and_parity(self, tmp_path):
         model, params = _model_and_params()
@@ -216,6 +249,14 @@ class TestNvmeTier:
         zc = ZeroInferenceEngine(model, params=params, dtype="fp32",
                                  zero=_zero())
         ids = _ids(B=2, T=8, seed=7)
+        np.testing.assert_allclose(
+            np.asarray(zn.forward(ids)), np.asarray(zc.forward(ids)),
+            rtol=1e-6, atol=1e-6)
+        # re-installing params (the load_checkpoint path) must supersede
+        # the on-disk store, not leak a second full model copy
+        zn._install_params(params)
+        stores = [f for f in os.listdir(tmp_path) if f.startswith("zinf_")]
+        assert len(stores) == 1, stores
         np.testing.assert_allclose(
             np.asarray(zn.forward(ids)), np.asarray(zc.forward(ids)),
             rtol=1e-6, atol=1e-6)

@@ -194,6 +194,7 @@ def bloom176b_tp8_decode():
 
     cfg, dmodel, mesh, abstract, n_params, psh = _bloom176b_setup(
         decode=True)
+    tp = int(mesh.shape["model"])  # single-sourced from the setup's mesh
     B, T = 1, 2048
     # cache abstractions come from the prefill program itself (the same
     # flax variables the engine's generate creates)
@@ -204,7 +205,7 @@ def bloom176b_tp8_decode():
     csh = decode_cache_specs(cache_abs, mesh)
     cache_gib = sum(
         int(np.prod(l.shape)) * l.dtype.itemsize
-        for l in jax.tree_util.tree_leaves(cache_abs)) / 8 / 2**30
+        for l in jax.tree_util.tree_leaves(cache_abs)) / tp / 2**30
 
     def decode_step(params, cache, token):
         out, vars_ = dmodel.apply({"params": params, "cache": cache},
@@ -227,7 +228,7 @@ def bloom176b_tp8_decode():
     # as-is; the genuinely-live T=1 working set beyond the upcast is the
     # per-layer [H/tp, 1, S] scores + [1, 1, V] fp32 logits, analytically
     # < 0.1 GiB.
-    H, V, tp = cfg.n_head, cfg.vocab_size, 8
+    H, V = cfg.n_head, cfg.vocab_size
     working = ((H // tp) * T * 4 * cfg.n_layer + V * 4) / 2**30
     return {"config": "bloom176b_tp8_decode", "n_devices": 8,
             "params_b": round(n_params / 1e9, 2),

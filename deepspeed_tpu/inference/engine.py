@@ -385,36 +385,16 @@ class InferenceEngine:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
 
+        if attention_mask is not None:
+            # shared contract (decode_utils): left-padded, shape-matched,
+            # all-real collapses to the unpadded fast path (Pallas decode
+            # kernel + flash prefill)
+            from deepspeed_tpu.models.decode_utils import (
+                validate_left_padded_mask)
+
+            attention_mask = validate_left_padded_mask(input_ids,
+                                                       attention_mask)
         padded = attention_mask is not None
-        if padded:
-            attention_mask = jnp.asarray(attention_mask, jnp.int32)
-            if attention_mask.ndim == 1:
-                attention_mask = attention_mask[None]
-            if attention_mask.shape != input_ids.shape:
-                # a mis-shaped mask broadcasts through every position/
-                # validity computation and generates garbage with no error
-                raise ValueError(
-                    f"attention_mask shape {attention_mask.shape} must "
-                    f"match input_ids shape {tuple(input_ids.shape)}")
-            host_mask = np.asarray(attention_mask)
-            if not (np.diff(host_mask, axis=1) >= 0).all():
-                # right padding would mask REAL cache slots and sample from
-                # a pad position — wrong output, no error
-                raise ValueError(
-                    "attention_mask must be LEFT-padded (non-decreasing "
-                    "along the sequence): pad tokens go before the prompt")
-            if not host_mask[:, -1].all():
-                # an all-pad row softmaxes over nothing (NaN logits) and
-                # the first token samples from the masked last position
-                raise ValueError(
-                    "attention_mask has a row whose final position is "
-                    "padding — every prompt needs at least one real token, "
-                    "and left padding puts it last")
-            if host_mask.all():
-                # the ubiquitous generate(**tokenizer(...)) pattern with an
-                # equal-length batch: keep the unpadded fast path (Pallas
-                # decode kernel + flash prefill)
-                padded, attention_mask = False, None
         key = (T, int(max_new_tokens), bool(do_sample), int(top_k),
                float(top_p), padded)
         if key not in self._generate_cache:

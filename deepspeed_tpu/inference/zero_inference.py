@@ -160,7 +160,12 @@ class ZeroInferenceEngine:
     def _install_params(self, params):
         """(Re)build the at-rest stores from a raw param tree: canonical
         split, serving-dtype cast, optional int8 quantize, budget check,
-        optional NVMe memmap, device-resident top."""
+        optional NVMe memmap, device-resident top.
+
+        Every validation runs on LOCALS before any ``self`` state is
+        touched — a refused reload (bad layout, over-budget checkpoint)
+        must leave a live engine serving its previous model, not a
+        half-installed hybrid."""
         from deepspeed_tpu.utils.pytree import unwrap_variables_dict
 
         off = self._off
@@ -172,7 +177,7 @@ class ZeroInferenceEngine:
                 "params do not carry the scanned canonical layout "
                 "transformer/h/block — load them through the state-dict "
                 "factory or model.init with scan_layers=True")
-        self.n_layer = int(jax.tree_util.tree_leaves(blocks)[0].shape[0])
+        n_layer = int(jax.tree_util.tree_leaves(blocks)[0].shape[0])
         top = {k: v for k, v in params.items() if k != "transformer"}
 
         def to_rest(a):
@@ -183,30 +188,38 @@ class ZeroInferenceEngine:
 
         blocks = jax.tree_util.tree_map(to_rest, blocks)
         top = jax.tree_util.tree_map(to_rest, top)
-        self._row_bytes = sum(
-            leaf.nbytes // self.n_layer
-            for leaf in jax.tree_util.tree_leaves(blocks))
         # both halves counted at the serving (at-rest) dtype
-        self.total_param_bytes = sum(
+        total_bytes = sum(
             l.nbytes for l in jax.tree_util.tree_leaves(blocks)) + sum(
             l.nbytes for l in jax.tree_util.tree_leaves(top))
 
+        q_group_of = None
         if self._int8:
-            blocks = self._quantize_blocks(blocks)
-            self._row_bytes = sum(
-                leaf.nbytes // self.n_layer
-                for leaf in jax.tree_util.tree_leaves(blocks))
+            blocks, q_group_of = self._quantize_blocks(blocks)
+        row_bytes = sum(
+            leaf.nbytes // n_layer
+            for leaf in jax.tree_util.tree_leaves(blocks))
 
         # ---- enforced staging budget ----
-        self._budget = off.get("buffer_size")
-        if self._budget is not None and self._row_bytes > int(self._budget):
+        budget = off.get("buffer_size")
+        if budget is not None and row_bytes > int(budget):
             raise DeepSpeedConfigError(
-                f"offload_param.buffer_size={self._budget} is below one "
-                f"layer's serving weights ({self._row_bytes} bytes); raise "
+                f"offload_param.buffer_size={budget} is below one "
+                f"layer's serving weights ({row_bytes} bytes); raise "
                 "it to at least one layer (the device stages two)")
 
+        store = None
         if self._nvme:
             blocks, store = self._memmap_blocks(blocks, off["nvme_path"])
+
+        # ---- commit point: all validation passed ----
+        self.n_layer = n_layer
+        self._row_bytes = row_bytes
+        self.total_param_bytes = total_bytes
+        self._budget = budget
+        if q_group_of is not None:
+            self._q_group_of = q_group_of
+        if self._nvme:
             # a reload supersedes the previous on-disk store: unlink it
             # now (POSIX keeps the old maps' pages alive until the numpy
             # memmaps above are garbage-collected with self._blocks) —
@@ -234,8 +247,10 @@ class ZeroInferenceEngine:
     # ------------------------------------------------------------------
     def _quantize_blocks(self, blocks):
         """Weight-only int8 at rest: matmul leaves (ndim>=3 stacked) become
-        ``{"q", "scale"}``; vectors (LN/bias) stay in the serving dtype."""
-        self._q_group_of = {}
+        ``{"q", "scale"}``; vectors (LN/bias) stay in the serving dtype.
+        Pure — returns ``(blocks, group_map)`` so a failed install never
+        half-updates the engine."""
+        group_of = {}
 
         def q(path, leaf):
             a = np.asarray(leaf)
@@ -244,11 +259,11 @@ class ZeroInferenceEngine:
                 qv, scale, g = _np_quantize_rows(
                     np.asarray(jnp.asarray(a).astype(jnp.float32)),
                     self._q_groups)
-                self._q_group_of[jax.tree_util.keystr(path)] = g
+                group_of[jax.tree_util.keystr(path)] = g
                 return {"q": qv, "scale": scale}
             return a
 
-        return jax.tree_util.tree_map_with_path(q, blocks)
+        return jax.tree_util.tree_map_with_path(q, blocks), group_of
 
     @staticmethod
     def _memmap_blocks(blocks, nvme_path):
@@ -300,19 +315,23 @@ class ZeroInferenceEngine:
         return top + 2 * self._row_bytes
 
     # ------------------------------------------------------------------
-    def _fns(self, B: int, T: int):
+    def _fns(self, B: int, T: int, padded: bool = False):
         """Per-layer compiled programs, shared by all layers (one compile
-        per (batch, seq) shape)."""
-        key = (B, T)
+        per (batch, seq, padded) shape). ``padded`` variants thread the
+        LEFT-padding attention mask through prefill (the Block's padded
+        decode cache tracks each row's pad prefix from there on) and give
+        the embedding per-row positions."""
+        key = (B, T, padded)
         if key in self._compiled:
             return self._compiled[key]
         import flax.linen as nn
 
+        from deepspeed_tpu.models.decode_utils import row_positions
         from deepspeed_tpu.models.gpt2 import Block
 
         cfg = self.model_config
         cfg_fwd = dataclasses.replace(cfg, dropout=0.0, dtype=self._dtype)
-        dcfg = cfg.for_decode()
+        dcfg = cfg.for_decode(padded=padded)
         dcfg = dataclasses.replace(dcfg, dtype=self._dtype)
         block_fwd = Block(cfg_fwd)
         block_dec = Block(dcfg)
@@ -326,6 +345,20 @@ class ZeroInferenceEngine:
                     top["wpe"], (pos0 + cfg.position_offset, 0),
                     (T, cfg.n_embd))
                 x = x + pos[None].astype(self._dtype)
+            if cfg.embedding_layernorm:
+                x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                                 dtype=self._dtype).apply(
+                    {"params": top["emb_ln"]}, x)
+            return x
+
+        def embed_rows(top, ids, pos_ids):
+            """Per-row positions ([B, T], 0 at each row's first real
+            token) — the padded prefill/decode embedding."""
+            x = jnp.take(top["wte"], ids, axis=0).astype(self._dtype)
+            if cfg.position_embedding == "learned":
+                pos = jnp.take(top["wpe"],
+                               pos_ids + cfg.position_offset, axis=0)
+                x = x + pos.astype(self._dtype)
             if cfg.embedding_layernorm:
                 x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
                                  dtype=self._dtype).apply(
@@ -348,9 +381,10 @@ class ZeroInferenceEngine:
         def logits_last(top, h):
             return logits_all(top, h[:, -1:, :])[:, 0, :]
 
-        def prefill_block(bp, x):
+        def prefill_block(bp, x, mask):
+            kw = {"attention_mask": mask} if padded else {}
             y, vars_ = block_dec.apply({"params": dq(bp)}, x, True,
-                                       mutable=["cache"])
+                                       mutable=["cache"], **kw)
             return y, vars_["cache"]
 
         def decode_block(bp, cache, x):
@@ -363,6 +397,8 @@ class ZeroInferenceEngine:
 
         fns = {
             "embed": jax.jit(embed),
+            "embed_rows": jax.jit(embed_rows),
+            "row_positions": jax.jit(row_positions),
             "logits_all": jax.jit(logits_all),
             "logits_last": jax.jit(logits_last),
             "prefill_block": jax.jit(prefill_block),
@@ -436,17 +472,22 @@ class ZeroInferenceEngine:
         """Streamed autoregressive generation: each decode step moves every
         layer's at-rest weights across H2D once — tokens/s is bounded by
         ``bandwidth / model_bytes``, which is why the at-rest dtype (bf16 /
-        int8) is the headline knob. Returns prompt + new tokens, HF-style."""
-        if attention_mask is not None:
-            m = np.asarray(attention_mask)
-            if not m.all():
-                raise DeepSpeedConfigError(
-                    "ZeRO-Inference v1 serves equal-length (unpadded) "
-                    "batches; left-padded prompts use the device engine")
+        int8) is the headline knob. ``attention_mask`` ([B, T], 0 = LEFT
+        padding) batches prompts of unequal length, same contract as the
+        device engine. Returns prompt + new tokens, HF-style."""
         ids = jnp.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None]
         B, T = ids.shape
+        if attention_mask is not None:
+            from deepspeed_tpu.models.decode_utils import (
+                pad_lengths, validate_left_padded_mask)
+
+            attention_mask = validate_left_padded_mask(ids, attention_mask)
+        padded = attention_mask is not None
+        if padded:
+            # per-row padded-prefix lengths drive the decode positions
+            pad_lens = pad_lengths(attention_mask, T)
         cfg = self.model_config
         limit = cfg.n_positions
         if max_new_tokens is None:
@@ -464,16 +505,23 @@ class ZeroInferenceEngine:
 
         t = self._timer("generate")
         t.start()
-        pfns = self._fns(B, T)
-        dfns = self._fns(B, 1)
+        pfns = self._fns(B, T, padded)
+        dfns = self._fns(B, 1, padded)
         caches = [None] * self.n_layer
+        ids_dev = jax.device_put(ids, self._device)
+        mask_dev = (jax.device_put(attention_mask, self._device)
+                    if padded else None)
 
         def prefill(l, row, h):
-            h, caches[l] = pfns["prefill_block"](row, h)
+            h, caches[l] = pfns["prefill_block"](row, h, mask_dev)
             return h
 
-        x = pfns["embed"](self._top_dev, jax.device_put(ids, self._device),
-                          jnp.zeros((), jnp.int32))
+        if padded:
+            x = pfns["embed_rows"](self._top_dev, ids_dev,
+                                   pfns["row_positions"](mask_dev))
+        else:
+            x = pfns["embed"](self._top_dev, ids_dev,
+                              jnp.zeros((), jnp.int32))
         x = self._stream(x, prefill)
         rng, sub = jax.random.split(rng)
         token = sample(pfns["logits_last"](self._top_dev, x), sub, temp)
@@ -488,8 +536,17 @@ class ZeroInferenceEngine:
             if done.all():
                 tokens.append(np.full((B,), eos_token_id, tokens[0].dtype))
                 continue
-            x = dfns["embed"](self._top_dev, token[:, None],
-                              jnp.asarray(T + step, jnp.int32))
+            if padded:
+                from deepspeed_tpu.models.decode_utils import (
+                    decode_positions)
+
+                # row r's absolute position is (T + step) minus its pad
+                pos_ids = decode_positions(T + step, 1, pad_lens)
+                x = dfns["embed_rows"](self._top_dev, token[:, None],
+                                       pos_ids)
+            else:
+                x = dfns["embed"](self._top_dev, token[:, None],
+                                  jnp.asarray(T + step, jnp.int32))
             x = self._stream(x, dec)
             rng, sub = jax.random.split(rng)
             token = sample(dfns["logits_last"](self._top_dev, x), sub, temp)

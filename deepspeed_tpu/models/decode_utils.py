@@ -12,6 +12,45 @@ desynchronizing any one of them produces wrong positions with no error.
 import jax.numpy as jnp
 
 
+def validate_left_padded_mask(input_ids, attention_mask):
+    """The user-facing mask contract, shared by every serving tier
+    (``InferenceEngine.generate`` and the ZeRO-Inference engine): promote
+    1-D, require the same shape as ``input_ids``, require LEFT padding
+    (non-decreasing rows) with at least one real token per row, and
+    collapse an all-real mask to ``None`` (the unpadded fast path).
+    Returns the validated ``[B, T]`` int32 mask, or ``None``."""
+    import numpy as np
+
+    attention_mask = jnp.asarray(attention_mask, jnp.int32)
+    if attention_mask.ndim == 1:
+        attention_mask = attention_mask[None]
+    if attention_mask.shape != tuple(input_ids.shape):
+        # a mis-shaped mask broadcasts through every position/validity
+        # computation and generates garbage with no error
+        raise ValueError(
+            f"attention_mask shape {attention_mask.shape} must "
+            f"match input_ids shape {tuple(input_ids.shape)}")
+    host_mask = np.asarray(attention_mask)
+    if not (np.diff(host_mask, axis=1) >= 0).all():
+        # right padding would mask REAL cache slots and sample from a
+        # pad position — wrong output, no error
+        raise ValueError(
+            "attention_mask must be LEFT-padded (non-decreasing "
+            "along the sequence): pad tokens go before the prompt")
+    if not host_mask[:, -1].all():
+        # an all-pad row softmaxes over nothing (NaN logits) and the
+        # first token samples from the masked last position
+        raise ValueError(
+            "attention_mask has a row whose final position is "
+            "padding — every prompt needs at least one real token, "
+            "and left padding puts it last")
+    if host_mask.all():
+        # the ubiquitous generate(**tokenizer(...)) pattern with an
+        # equal-length batch: keep the unpadded fast path
+        return None
+    return attention_mask
+
+
 def row_positions(attention_mask):
     """[B, T] per-row positions for LEFT-padded prompts: 0 at each row's
     first real token (pads clip to 0; their outputs are masked anyway)."""

@@ -145,6 +145,70 @@ class TestParity:
         assert (out[:, 6:] >= 0).all() and (out[:, 6:] < 256).all()
 
 
+class TestPaddedBatches:
+    """Left-padded (unequal-length) prompt batches through the streamed
+    tier — same contract and same tokens as the device engine's padded
+    path (test_padded_generate.py)."""
+
+    def _mask_batch(self, T=10):
+        rng = np.random.default_rng(11)
+        ids = rng.integers(1, 256, (3, T)).astype(np.int32)
+        mask = np.ones((3, T), np.int32)
+        mask[0, :4] = 0   # row 0: 4 pads
+        mask[2, :7] = 0   # row 2: 7 pads
+        ids = np.where(mask == 0, 0, ids).astype(np.int32)
+        return ids, mask
+
+    def test_padded_generate_matches_device_engine(self):
+        model, params = _model_and_params()
+        ref = InferenceEngine(model, params={"params": params},
+                              dtype="fp32")
+        zinf = ZeroInferenceEngine(model, params=params, dtype="fp32",
+                                   zero=_zero())
+        ids, mask = self._mask_batch()
+        out_ref = ref.generate(ids, attention_mask=mask, max_new_tokens=8)
+        out_z = zinf.generate(ids, attention_mask=mask, max_new_tokens=8)
+        np.testing.assert_array_equal(out_z, out_ref)
+
+    def test_padded_generate_rotary_family(self):
+        model, params = _model_and_params(position_embedding="rotary",
+                                          rotary_dim=8)
+        ref = InferenceEngine(model, params={"params": params},
+                              dtype="fp32")
+        zinf = ZeroInferenceEngine(model, params=params, dtype="fp32",
+                                   zero=_zero())
+        ids, mask = self._mask_batch(T=8)
+        np.testing.assert_array_equal(
+            zinf.generate(ids, attention_mask=mask, max_new_tokens=6),
+            ref.generate(ids, attention_mask=mask, max_new_tokens=6))
+
+    def test_all_real_mask_takes_fast_path(self):
+        model, params = _model_and_params()
+        zinf = ZeroInferenceEngine(model, params=params, dtype="fp32",
+                                   zero=_zero())
+        ids = _ids(B=2, T=6)
+        np.testing.assert_array_equal(
+            zinf.generate(ids, attention_mask=np.ones_like(ids),
+                          max_new_tokens=4),
+            zinf.generate(ids, max_new_tokens=4))
+
+    def test_invalid_masks_raise(self):
+        model, params = _model_and_params()
+        zinf = ZeroInferenceEngine(model, params=params, dtype="fp32",
+                                   zero=_zero())
+        ids = _ids(B=2, T=6)
+        right_pad = np.array([[1, 1, 1, 1, 0, 0]] * 2, np.int32)
+        with pytest.raises(ValueError, match="LEFT-padded"):
+            zinf.generate(ids, attention_mask=right_pad, max_new_tokens=2)
+        all_pad_row = np.array([[1] * 6, [0] * 6], np.int32)
+        with pytest.raises(ValueError, match="final position"):
+            zinf.generate(ids, attention_mask=all_pad_row,
+                          max_new_tokens=2)
+        with pytest.raises(ValueError, match="must\nmatch|must match"):
+            zinf.generate(ids, attention_mask=np.ones((2, 5), np.int32),
+                          max_new_tokens=2)
+
+
 class TestBudget:
     """Parameters exceed the enforced device budget; the engine serves
     anyway, holding only top + 2 staged rows on device."""
@@ -232,6 +296,31 @@ class TestCheckpointReload:
         np.testing.assert_allclose(
             np.asarray(zinf.forward(ids)), np.asarray(ref.forward(ids)),
             rtol=2e-5, atol=2e-5)
+
+
+class TestFailedReloadAtomicity:
+    def test_refused_install_leaves_engine_serving(self):
+        """A refused re-install (e.g. a checkpoint whose layers exceed the
+        staging budget) must leave the live engine serving its previous
+        model — no half-installed n_layer/_row_bytes hybrid."""
+        model, params = _model_and_params()
+        blk = sum(np.asarray(l).nbytes for l in jax.tree_util.tree_leaves(
+            params["transformer"]["h"]["block"]))
+        budget = int(blk / 2 * 1.5)  # fits the 2-layer model's rows
+        zinf = ZeroInferenceEngine(
+            model, params=params, dtype="fp32",
+            zero=_zero({"buffer_size": budget}))
+        before = np.asarray(zinf.forward(_ids(2, 8)))
+        n_layer, row_bytes = zinf.n_layer, zinf._row_bytes
+
+        # a "checkpoint" from a 4x wider model: rows exceed the budget
+        big_model, big_params = _model_and_params(n_embd=256)
+        with pytest.raises(DeepSpeedConfigError, match="buffer_size"):
+            zinf._install_params(big_params)
+        assert zinf.n_layer == n_layer
+        assert zinf._row_bytes == row_bytes
+        np.testing.assert_array_equal(
+            np.asarray(zinf.forward(_ids(2, 8))), before)
 
 
 class TestNvmeTier:

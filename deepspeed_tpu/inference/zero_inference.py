@@ -34,6 +34,7 @@ GPT-J/NeoX weights through ``GPT2LMHeadModel`` with ``scan_layers=True``)
 """
 
 import dataclasses
+from collections import deque
 from typing import Any, Dict, Optional
 
 import jax
@@ -84,9 +85,11 @@ class ZeroInferenceEngine:
     """Offload-streamed serving engine (reference ZeRO-Inference).
 
     ``offload_param.buffer_size`` (when set) is the enforced device
-    staging budget: one layer's weights must fit in it, and the engine
-    refuses configurations where they do not — the device never holds
-    more than ``2 * buffer_size`` of block parameters.
+    staging budget for block parameters: one layer's weights must fit in
+    it (the engine refuses configurations where they do not), and a
+    budget affording k rows prefetches k layers ahead — in-flight rows
+    never exceed ``buffer_size // row_bytes`` (floor 2, cap ``n_layer``),
+    so device block-param residency stays within the declared budget.
     """
 
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
@@ -155,7 +158,8 @@ class ZeroInferenceEngine:
             f"({'nvme' if self._nvme else 'host'}-resident, "
             f"{'int8' if self._int8 else np.dtype(self._dtype).name} at "
             f"rest, {self._row_bytes / 1e6:.2f} MB/layer); device keeps "
-            f"embeddings/head + 2 layer buffers + KV cache", ranks=[0])
+            f"embeddings/head + {self._prefetch_depth()} layer buffers + "
+            "KV cache", ranks=[0])
 
     def _install_params(self, params):
         """(Re)build the at-rest stores from a raw param tree: canonical
@@ -205,23 +209,33 @@ class ZeroInferenceEngine:
         if budget is not None and row_bytes > int(budget):
             raise DeepSpeedConfigError(
                 f"offload_param.buffer_size={budget} is below one "
-                f"layer's serving weights ({row_bytes} bytes); raise "
-                "it to at least one layer (the device stages two)")
+                f"layer's serving weights ({row_bytes} bytes); raise it "
+                "to at least one layer (the budget is the in-flight "
+                "staging pool: k affordable rows prefetch k layers ahead)")
 
         store = None
         if self._nvme:
             blocks, store = self._memmap_blocks(blocks, off["nvme_path"])
+        # top (embeddings/head/final-LN — O(vocab), not O(depth)) is the
+        # persistent device-resident set, already in the serving dtype.
+        # Placed BEFORE the commit: a device OOM here (e.g. a reloaded
+        # checkpoint with a much larger vocab table) must not leave a
+        # half-installed hybrid
+        top_dev = jax.device_put(top, self._device)
 
-        # ---- commit point: all validation passed ----
+        # ---- commit point: every fallible operation succeeded ----
         self.n_layer = n_layer
         self._row_bytes = row_bytes
         self.total_param_bytes = total_bytes
         self._budget = budget
         if q_group_of is not None:
             self._q_group_of = q_group_of
+        self._blocks = blocks
+        self._top_dev = top_dev
+        self._compiled: Dict[Any, Any] = {}
         if self._nvme:
             # a reload supersedes the previous on-disk store: unlink it
-            # now (POSIX keeps the old maps' pages alive until the numpy
+            # last (POSIX keeps the old maps' pages alive until the numpy
             # memmaps above are garbage-collected with self._blocks) —
             # otherwise every load_checkpoint leaks a full model copy
             if getattr(self, "_nvme_store", None):
@@ -229,11 +243,6 @@ class ZeroInferenceEngine:
 
                 shutil.rmtree(self._nvme_store, ignore_errors=True)
             self._nvme_store = store
-        self._blocks = blocks
-        # top (embeddings/head/final-LN — O(vocab), not O(depth)) is the
-        # persistent device-resident set, already in the serving dtype
-        self._top_dev = jax.device_put(top, self._device)
-        self._compiled: Dict[Any, Any] = {}
 
     def load_checkpoint(self, load_dir, tag=None):
         """Reload at-rest parameters from a training checkpoint (same
@@ -308,11 +317,11 @@ class ZeroInferenceEngine:
 
     def device_param_bytes(self) -> int:
         """Bytes of parameters the device holds at steady state: the
-        persistent top tree + two staged layer rows (the budget proof the
-        serving tests pin against ``total_param_bytes``)."""
+        persistent top tree + the in-flight staged rows (the budget proof
+        the serving tests pin against ``total_param_bytes``)."""
         top = sum(l.nbytes
                   for l in jax.tree_util.tree_leaves(self._top_dev))
-        return top + 2 * self._row_bytes
+        return top + self._prefetch_depth() * self._row_bytes
 
     # ------------------------------------------------------------------
     def _fns(self, B: int, T: int, padded: bool = False):
@@ -436,13 +445,34 @@ class ZeroInferenceEngine:
         return fn
 
     # ------------------------------------------------------------------
+    def _prefetch_depth(self) -> int:
+        """Rows in flight at once. Two (double buffering) is the floor;
+        when ``buffer_size`` affords more, a deeper pipeline absorbs
+        host-side fetch jitter (NVMe page faults, allocator stalls) that
+        a 2-deep pipeline surfaces as device idle time. Capped at the
+        layer count — deeper would just be the whole model resident."""
+        if self._budget is None:
+            return 2
+        return max(2, min(self.n_layer, int(self._budget) // max(
+            1, self._row_bytes)))
+
     def _stream(self, x, fn_of_layer):
-        """Run ``x`` through all layers, double-buffering row fetches."""
+        """Run ``x`` through all layers; row fetches are issued ahead so
+        queued H2D copies ride under the running layer programs
+        (``jax.device_put`` is async). In-flight rows — the popped ``cur``
+        plus the fifo — never exceed ``_prefetch_depth``, so device
+        residency matches ``device_param_bytes()``'s accounting."""
         L = self.n_layer
-        nxt = self._fetch_row(0)
+        depth = self._prefetch_depth()
+        next_fetch = min(depth - 1, L)
+        fifo = deque(self._fetch_row(l) for l in range(next_fetch))
         for l in range(L):
-            cur, nxt = nxt, (self._fetch_row(l + 1) if l + 1 < L else None)
+            cur = fifo.popleft()  # row l (the fifo is never empty here:
+            # it is seeded with depth-1 >= 1 rows and refilled each step)
             x = fn_of_layer(l, cur, x)
+            if next_fetch < L:
+                fifo.append(self._fetch_row(next_fetch))
+                next_fetch += 1
         return x
 
     def forward(self, input_ids, **kwargs):
@@ -481,7 +511,7 @@ class ZeroInferenceEngine:
         B, T = ids.shape
         if attention_mask is not None:
             from deepspeed_tpu.models.decode_utils import (
-                pad_lengths, validate_left_padded_mask)
+                decode_positions, pad_lengths, validate_left_padded_mask)
 
             attention_mask = validate_left_padded_mask(ids, attention_mask)
         padded = attention_mask is not None
@@ -537,9 +567,6 @@ class ZeroInferenceEngine:
                 tokens.append(np.full((B,), eos_token_id, tokens[0].dtype))
                 continue
             if padded:
-                from deepspeed_tpu.models.decode_utils import (
-                    decode_positions)
-
                 # row r's absolute position is (T + step) minus its pad
                 pos_ids = decode_positions(T + step, 1, pad_lens)
                 x = dfns["embed_rows"](self._top_dev, token[:, None],

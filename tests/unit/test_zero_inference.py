@@ -225,7 +225,9 @@ class TestBudget:
             zero=_zero({"buffer_size": budget}))
         assert total_block > budget
         assert zinf.total_param_bytes > budget
-        # device steady state: top + two staged rows, under 2x budget + top
+        # 1.5-row budget -> the floor depth of 2 staged rows; device
+        # steady state = top + in-flight rows, far under the full stack
+        assert zinf._prefetch_depth() == 2
         assert zinf.device_param_bytes() < zinf.total_param_bytes
         assert zinf.device_param_bytes() - 2 * zinf._row_bytes \
             == zinf.total_param_bytes - total_block
@@ -235,6 +237,29 @@ class TestBudget:
         np.testing.assert_array_equal(
             zinf.generate(ids, max_new_tokens=6),
             ref.generate(ids, max_new_tokens=6))
+
+    def test_prefetch_depth_scales_with_budget(self):
+        """A budget affording k rows pipelines k fetches (bounded by the
+        layer count); logits stay identical — depth only changes WHEN
+        copies are issued, never the math."""
+        model, params = _model_and_params(n_layer=6)
+        row = sum(np.asarray(l).nbytes for l in jax.tree_util.tree_leaves(
+            params["transformer"]["h"]["block"])) // 6
+        deep = ZeroInferenceEngine(
+            model, params=params, dtype="fp32",
+            zero=_zero({"buffer_size": int(row * 4.5)}))
+        assert deep._prefetch_depth() == 4
+        wide = ZeroInferenceEngine(
+            model, params=params, dtype="fp32",
+            zero=_zero({"buffer_size": int(row * 100)}))
+        assert wide._prefetch_depth() == 6  # capped at n_layer
+        base = ZeroInferenceEngine(model, params=params, dtype="fp32",
+                                   zero=_zero())
+        assert base._prefetch_depth() == 2  # no budget: double buffering
+        ids = _ids(2, 8, seed=12)
+        np.testing.assert_allclose(np.asarray(deep.forward(ids)),
+                                   np.asarray(base.forward(ids)),
+                                   rtol=1e-6, atol=1e-6)
 
     def test_budget_below_row_refused(self):
         model, params = _model_and_params()

@@ -185,9 +185,13 @@ class ZeroInferenceEngine:
         top = {k: v for k, v in params.items() if k != "transformer"}
 
         def to_rest(a):
+            # pure host cast: jnp dtypes (incl. bfloat16) are ml_dtypes
+            # numpy scalar types, so no device round trip is needed — a
+            # jnp.asarray here would stream every leaf through the
+            # accelerator just to change its dtype
             a = np.asarray(a)
             if np.issubdtype(a.dtype, np.floating) or a.dtype == jnp.bfloat16:
-                return np.asarray(jnp.asarray(a).astype(self._dtype))
+                return np.ascontiguousarray(a.astype(self._dtype))
             return a
 
         blocks = jax.tree_util.tree_map(to_rest, blocks)
@@ -266,8 +270,7 @@ class ZeroInferenceEngine:
             if a.ndim >= 3 and (a.dtype == jnp.bfloat16
                                 or np.issubdtype(a.dtype, np.floating)):
                 qv, scale, g = _np_quantize_rows(
-                    np.asarray(jnp.asarray(a).astype(jnp.float32)),
-                    self._q_groups)
+                    a.astype(np.float32), self._q_groups)
                 group_of[jax.tree_util.keystr(path)] = g
                 return {"q": qv, "scale": scale}
             return a

@@ -48,10 +48,13 @@ def saved_block_input(x, cfg):
     return checkpoint_name(x, "block_in")
 
 
-def offload_policy(cfg):
-    """cpu_checkpointing remat policy: host-offload the named inter-layer
-    residuals, recompute everything else (reference checkpointing.py:485)."""
+def offload_policy(cfg=None, names=("block_in",)):
+    """cpu_checkpointing remat policy: host-offload the named residuals,
+    recompute everything else (reference checkpointing.py:485). The
+    user-facing ``deepspeed_tpu.checkpointing`` API reuses this with its
+    own residual name."""
+    del cfg
     return jax.checkpoint_policies.save_and_offload_only_these_names(
         names_which_can_be_saved=[],
-        names_which_can_be_offloaded=["block_in"],
+        names_which_can_be_offloaded=list(names),
         offload_src="device", offload_dst="pinned_host")

@@ -156,6 +156,23 @@ def _partition_arg(x):
     return x
 
 
+# residual-offload policy for checkpoint_in_cpu: the segment's tensor
+# args are tagged with this name inside the region; the policy offloads
+# exactly the tagged values to pinned host memory and saves nothing
+# else. Same mechanism as the engine's cpu_checkpointing offload policy
+# (models/remat_utils.py) — the explicit-device_put formulation was
+# rejected by XLA's host offloader on hardware (round 5: it either
+# refused the program outright or sank the backward's grad matmul onto
+# the host thread as a HostExecute call, with host-CPU numerics).
+_CKPT_IN_CPU_NAME = "ds_user_ckpt_in_cpu"
+
+
+def _ckpt_in_cpu_policy():
+    from deepspeed_tpu.models.remat_utils import offload_policy
+
+    return offload_policy(names=(_CKPT_IN_CPU_NAME,))
+
+
 def checkpoint(function, *args):
     """Reference ``checkpoint(function, *args)`` (checkpointing.py:748):
     run ``function`` under rematerialization — nothing internal is saved;
@@ -175,22 +192,20 @@ def checkpoint(function, *args):
         args = tuple(_partition_arg(a) for a in args)
     if not checkpoint_in_cpu:
         return jax.checkpoint(function)(*args)
-    # host residuals: transfer OUT here (so the region's saved inputs are
-    # the host copies), reload INSIDE the region (re-run in both forward
-    # and the backward recompute). jax.memory.Space is the public
-    # memory-placement API.
+    # host residuals: the tensor args re-enter the region through a
+    # checkpoint_name tag, and the offload policy stores exactly those
+    # tagged values in pinned host memory for the backward — grads are
+    # bit-identical to the on-device remat (verified on hardware)
+    from jax.ad_checkpoint import checkpoint_name
+
     is_arr = [_is_array(a) for a in args]
-    host_args = tuple(
-        jax.device_put(a, jax.memory.Space.Host) if arr else a
-        for a, arr in zip(args, is_arr))
 
-    def reload_and_run(*hargs):
-        dargs = tuple(
-            jax.device_put(a, jax.memory.Space.Device) if arr else a
-            for a, arr in zip(hargs, is_arr))
-        return function(*dargs)
+    def tagged(*as_):
+        return function(*(
+            checkpoint_name(a, _CKPT_IN_CPU_NAME) if arr else a
+            for a, arr in zip(as_, is_arr)))
 
-    return jax.checkpoint(reload_and_run)(*host_args)
+    return jax.checkpoint(tagged, policy=_ckpt_in_cpu_policy())(*args)
 
 
 # ---------------------------------------------------------------------

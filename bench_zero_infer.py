@@ -73,10 +73,12 @@ def main():
         t1 = gen_time(new_tokens)
         t2 = gen_time(2 * new_tokens)
         per_token_s = max(1e-9, (t2 - t1) / new_tokens)
-        return batch / per_token_s, eng.total_param_bytes
+        return (batch / per_token_s, eng.total_param_bytes,
+                eng.streamed_param_bytes)
 
-    bf16_rate, model_bytes = rate("bf16" if on_tpu else "fp32")
-    int8_rate, _ = rate("int8")
+    bf16_rate, model_bytes, streamed_bytes = rate(
+        "bf16" if on_tpu else "fp32")
+    int8_rate, _, _ = rate("int8")
 
     out = {
         "metric": METRIC,
@@ -106,10 +108,14 @@ def main():
         h2d_mbps = probe.nbytes / 1e6 / (time.perf_counter() - t0)
         out["h2d_mbps"] = round(h2d_mbps, 1)
         # normalize out the host link: the reference's regime assumes a
-        # local PCIe-class link (~16 GB/s gen3 x16); through the tunnel
-        # the same engine is bound by the tunnel's wire rate instead
+        # local PCIe-class link (~16 GB/s gen3 x16). Computed from the
+        # regime identity tokens/s = batch * bw / streamed_bytes using
+        # the bytes each decode step actually streams — NOT the probe
+        # above, which samples the (fluctuating) tunnel rate at a
+        # different moment than the decode measurement did
+        out["streamed_mb_per_step"] = round(streamed_bytes / 1e6, 1)
         out["projected_tokens_per_sec_at_16GBps_pcie3"] = round(
-            bf16_rate * 16000.0 / h2d_mbps, 1)
+            batch * 16e9 / streamed_bytes, 1)
     print(json.dumps(out))
 
 

@@ -269,8 +269,7 @@ class ZeroInferenceEngine:
             a = np.asarray(leaf)
             if a.ndim >= 3 and (a.dtype == jnp.bfloat16
                                 or np.issubdtype(a.dtype, np.floating)):
-                qv, scale, g = _np_quantize_rows(
-                    a.astype(np.float32), self._q_groups)
+                qv, scale, g = _np_quantize_rows(a, self._q_groups)
                 group_of[jax.tree_util.keystr(path)] = g
                 return {"q": qv, "scale": scale}
             return a
@@ -317,6 +316,13 @@ class ZeroInferenceEngine:
             np.ascontiguousarray if self._nvme else (lambda a: a),
             self._row(l))
         return jax.device_put(row, self._device)
+
+    @property
+    def streamed_param_bytes(self) -> int:
+        """Bytes crossing H2D per full layer sweep (one decode step /
+        prefill): the at-rest block rows; the device-resident top never
+        re-transfers."""
+        return self._row_bytes * self.n_layer
 
     def device_param_bytes(self) -> int:
         """Bytes of parameters the device holds at steady state: the

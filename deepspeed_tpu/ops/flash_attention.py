@@ -421,10 +421,53 @@ class _LseRef:
 
 
 def _head_group(h: int, bq: int, bk: int, d: int) -> int:
-    """Heads per grid step for the strided layout: identical VMEM budget
-    to the folded layout — the group just can't cross a batch row, so the
-    candidate must divide ``h`` alone."""
-    return _bh_group(h, bq, bk, d)
+    """Heads per grid step for the strided layout: same VMEM budget as the
+    folded layout, but the group is the block's second-to-last dim, so
+    Pallas additionally requires it be a multiple of 8 OR the full head
+    count (the folded layout has no such constraint — its head dim is the
+    leading block dim). Returns 0 when no legal group fits the budget —
+    ``_bthd_tiles`` then shrinks the seq tiles and retries, raising
+    ValueError when nothing legal exists (``models/gpt2.py`` catches that
+    and dispatches the folded kernel instead)."""
+    per_row = (
+        bq * bk * 4
+        + 2 * bq * 128 * 4
+        + 3 * bq * d * 4
+        + 3 * (bq + bk) * d * 2
+    )
+    # measured on v5e: the strided backward's true VMEM stack is ~2x this
+    # estimate (extra score/ds transients + double-buffered 4D io blocks),
+    # so its budget is half the folded kernel's 10 MiB
+    budget = 5 * 1024 * 1024
+    for g in (h, 16, 8):
+        if g % 8 == 0 or g == h:
+            if h % g == 0 and g * per_row <= budget:
+                return g
+    return 0
+
+
+def _bthd_tiles(sq, sk, h, d, block_q, block_k):
+    """(bq, bk, g) for the strided layout: shrink the seq tiles (floor
+    128) until a Pallas-legal head group — a multiple of 8, or all ``h``
+    heads — fits the VMEM budget. Deterministic in its static args, so
+    the fwd and bwd drivers always agree."""
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
+    while True:
+        g = _head_group(h, bq, bk, d)
+        if g:
+            return bq, bk, g
+        if bk >= bq and bk // 2 >= 128 and sk % (bk // 2) == 0:
+            bk //= 2
+        elif bq // 2 >= 128 and sq % (bq // 2) == 0:
+            bq //= 2
+        elif bk // 2 >= 128 and sk % (bk // 2) == 0:
+            bk //= 2
+        else:
+            raise ValueError(
+                f"flash_attention_bthd: no legal head group for {h} "
+                f"heads at any tile size (needs a group that is a "
+                "multiple of 8, or all heads, within the VMEM budget) — "
+                "use the folded [B, H, T, D] kernel for this shape")
 
 
 def _fwd_kernel_bthd(q_ref, k_ref, v_ref, o_ref, lse_ref, m, l, acc, **kw):
@@ -449,9 +492,8 @@ def _bwd_dkv_kernel_bthd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_forward_bthd(q, k, v, scale, causal, block_q, block_k):
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    bq, bk = _block_sizes(sq, sk, block_q, block_k)
+    bq, bk, g = _bthd_tiles(sq, sk, h, d, block_q, block_k)
     num_kb = sk // bk
-    g = _head_group(h, bq, bk, d)
     hpg = h // g
     grid = (b * hpg, sq // bq, num_kb)
 
@@ -493,9 +535,8 @@ def _flash_backward_bthd(res, dout, scale, causal, block_q, block_k):
     q, k, v, o, lse = res  # lse: [b, hpg, g, sq]
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    bq, bk = _block_sizes(sq, sk, block_q, block_k)
+    bq, bk, g = _bthd_tiles(sq, sk, h, d, block_q, block_k)
     num_qb, num_kb = sq // bq, sk // bk
-    g = _head_group(h, bq, bk, d)
     hpg = h // g
 
     # D = rowsum(dO * O): [b, sq, h] -> the lse tiling [b, hpg, g, sq]

@@ -14,6 +14,7 @@ On CPU a tiny proxy keeps the script runnable anywhere.
 """
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -39,10 +40,14 @@ def main():
     if on_tpu:
         # big enough that streaming dominates; batch amortizes each transfer.
         # The regime is H2D-bound (~seconds per decode step through the
-        # axon tunnel's ~40 MB/s host link), so the marginal window is
-        # kept small — the per-step cost is huge and steady, not noisy
-        cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=1024,
-                         n_layer=24, n_head=16, dtype=jnp.bfloat16,
+        # axon tunnel's ~35-65 MB/s host link), so both the config and the
+        # marginal window are sized to finish inside the backlog's 900s
+        # budget (the 24x1024 first cut streamed 605 MB/step and timed
+        # out); throughput is reported both raw and normalized to a
+        # PCIe3-class link via the regime identity, so the smaller stack
+        # loses no information
+        cfg = GPT2Config(vocab_size=50257, n_positions=512, n_embd=768,
+                         n_layer=12, n_head=12, dtype=jnp.bfloat16,
                          scan_layers=True)
         batch, prompt, new_tokens, reps = 32, 64, 2, 1
     else:
@@ -54,20 +59,40 @@ def main():
     ids = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
     zero = {"stage": 3, "offload_param": {"device": "cpu"}}
 
+    # init ONCE on the host backend and share across both at-rest dtypes:
+    # every decode step already streams the whole model, so two device
+    # inits + pull-backs through a ~40 MB/s tunnel would cost more than
+    # the measurement itself
+    from deepspeed_tpu.inference.zero_inference import host_init_params
+
+    params = host_init_params(model)
+    print("# params initialized on host backend", file=sys.stderr,
+          flush=True)
+
     def rate(dtype):
+        t0 = time.perf_counter()
         eng = deepspeed_tpu.init_inference(
-            model, dtype=dtype, zero=zero, max_out_tokens=cfg.n_positions)
+            model, dtype=dtype, zero=zero, params=params,
+            max_out_tokens=cfg.n_positions)
         assert isinstance(eng, ZeroInferenceEngine)
+        print(f"# {dtype} engine up in {time.perf_counter()-t0:.1f}s",
+              file=sys.stderr, flush=True)
 
         # marginal decode cost between two generation lengths cancels
-        # prefill + dispatch overhead (same methodology as bench_decode.py)
+        # prefill + dispatch overhead (same methodology as
+        # bench_decode.py). One warm generate at the LONGER length
+        # compiles every program both timed lengths need (the KV cache is
+        # sized by max_out_tokens, not by max_new_tokens)
+        eng.generate(ids, max_new_tokens=2 * new_tokens)
+
         def gen_time(n):
-            eng.generate(ids, max_new_tokens=n)  # warm/compile
             best = float("inf")
             for _ in range(reps):
                 t0 = time.perf_counter()
                 eng.generate(ids, max_new_tokens=n)
                 best = min(best, time.perf_counter() - t0)
+            print(f"# {dtype} gen({n}): {best:.2f}s", file=sys.stderr,
+                  flush=True)
             return best
 
         t1 = gen_time(new_tokens)

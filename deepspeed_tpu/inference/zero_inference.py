@@ -63,6 +63,25 @@ def wants_zero_inference(config) -> bool:
     return str(off.get("device", "none")) in ("cpu", "nvme")
 
 
+def host_init_params(model, seed: int = 0):
+    """``model.init`` on the HOST backend. The whole premise of this tier
+    is that the model does not fit (or barely fits) on the device, so
+    materializing a full replica there — and paying the host link twice to
+    bring it back at rest — is both an OOM hazard and minutes of wasted
+    transfer on a tunneled chip. Falls back to the default device when no
+    CPU backend is registered."""
+    import contextlib
+
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        cpu = None
+    with (jax.default_device(cpu) if cpu is not None
+          else contextlib.nullcontext()):
+        return model.init(jax.random.PRNGKey(seed),
+                          jnp.zeros((1, 8), jnp.int32))
+
+
 def _np_quantize_rows(stack: np.ndarray, groups: int):
     """Symmetric grouped int8 over each layer row of a stacked ``[L, ...]``
     leaf (numpy mirror of :func:`ops.quantizer.quantize` semantics, applied
@@ -149,8 +168,7 @@ class ZeroInferenceEngine:
 
         # ---- host-resident parameter tree (canonical layout) ----
         if params is None:
-            params = model.init(jax.random.PRNGKey(seed),
-                                jnp.zeros((1, 8), jnp.int32))
+            params = host_init_params(model, seed)
         self._off = off
         self._install_params(params)
         log_dist(

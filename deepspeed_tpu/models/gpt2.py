@@ -377,8 +377,10 @@ class CausalSelfAttention(nn.Module):
                         q4, k, v, causal=True,
                         softmax_scale=cfg.attn_scale).reshape(B, T, C)
                 except ValueError:
-                    # kernel-ineligible shape (seq not divisible by the
-                    # block size): fall through to the standard dispatch,
+                    # kernel-ineligible shape — seq not divisible by the
+                    # block size, or no Pallas-legal head group (multiple
+                    # of 8 / all heads) fits the strided kernel's VMEM
+                    # budget: fall through to the standard dispatch,
                     # which has its own XLA fallback
                     y_btc = None
             if y_btc is None:

@@ -46,16 +46,23 @@ def _block_sizes(seq_q, seq_k, block_q, block_k):
     return bq, bk
 
 
-def _bh_group(bh: int, bq: int, bk: int, d: int) -> int:
-    """Rows of the folded batch*heads dim processed per grid step, bounded
-    so per-step VMEM (scores + softmax state + accumulators + io blocks)
-    stays under the ~16 MiB scoped-vmem stack limit."""
-    per_row = (
+def _row_vmem_bytes(bq: int, bk: int, d: int) -> int:
+    """Per-(batch*head)-row VMEM for one grid step: scores + softmax state
+    + accumulators + io blocks. Single source for both kernel families —
+    the folded and strided drivers must size tiles from the same model."""
+    return (
         bq * bk * 4            # scores / p / ds transient
         + 2 * bq * 128 * 4     # m, l scratch (lanes padded to 128)
         + 3 * bq * d * 4       # fp32 accumulators (acc / dk+dv)
         + 3 * (bq + bk) * d * 2  # in/out blocks incl. double buffering
     )
+
+
+def _bh_group(bh: int, bq: int, bk: int, d: int) -> int:
+    """Rows of the folded batch*heads dim processed per grid step, bounded
+    so per-step VMEM (scores + softmax state + accumulators + io blocks)
+    stays under the ~16 MiB scoped-vmem stack limit."""
+    per_row = _row_vmem_bytes(bq, bk, d)
     budget = 10 * 1024 * 1024
     for g in (16, 8, 4, 2):
         if bh % g == 0 and g * per_row <= budget:
@@ -429,12 +436,7 @@ def _head_group(h: int, bq: int, bk: int, d: int) -> int:
     ``_bthd_tiles`` then shrinks the seq tiles and retries, raising
     ValueError when nothing legal exists (``models/gpt2.py`` catches that
     and dispatches the folded kernel instead)."""
-    per_row = (
-        bq * bk * 4
-        + 2 * bq * 128 * 4
-        + 3 * bq * d * 4
-        + 3 * (bq + bk) * d * 2
-    )
+    per_row = _row_vmem_bytes(bq, bk, d)
     # measured on v5e: the strided backward's true VMEM stack is ~2x this
     # estimate (extra score/ds transients + double-buffered 4D io blocks),
     # so its budget is half the folded kernel's 10 MiB
@@ -446,22 +448,38 @@ def _head_group(h: int, bq: int, bk: int, d: int) -> int:
     return 0
 
 
+def _tile_divisors(s: int, cap: int):
+    """Divisors of ``s`` in [128, cap], descending — every legal tile
+    size, not just the halving chain (seq 384 must be able to reach 128
+    even though 384 -> 192 -> 96 skips it)."""
+    return [t for t in range(min(cap, s), 127, -1) if s % t == 0]
+
+
 def _bthd_tiles(sq, sk, h, d, block_q, block_k):
     """(bq, bk, g) for the strided layout: shrink the seq tiles (floor
     128) until a Pallas-legal head group — a multiple of 8, or all ``h``
-    heads — fits the VMEM budget. Deterministic in its static args, so
-    the fwd and bwd drivers always agree."""
-    bq, bk = _block_sizes(sq, sk, block_q, block_k)
+    heads — fits the VMEM budget. Walks the full divisor lattice,
+    largest tiles first, shrinking the larger of the two (keeps tiles
+    squarish). Deterministic in its static args, so the fwd and bwd
+    drivers always agree."""
+    # do NOT route through _block_sizes here: its divisibility raise would
+    # reject sq=768 at the default 512 block even though the divisor walk
+    # below holds legal tiles (384/256/192/128). The walk owns
+    # divisibility; the full-seq tile is the always-legal fallback.
+    bq0, bk0 = min(block_q, sq), min(block_k, sk)
+    qd = _tile_divisors(sq, bq0) or [sq]
+    kd = _tile_divisors(sk, bk0) or [sk]
+    i = j = 0
     while True:
-        g = _head_group(h, bq, bk, d)
+        g = _head_group(h, qd[i], kd[j], d)
         if g:
-            return bq, bk, g
-        if bk >= bq and bk // 2 >= 128 and sk % (bk // 2) == 0:
-            bk //= 2
-        elif bq // 2 >= 128 and sq % (bq // 2) == 0:
-            bq //= 2
-        elif bk // 2 >= 128 and sk % (bk // 2) == 0:
-            bk //= 2
+            return qd[i], kd[j], g
+        if kd[j] >= qd[i] and j + 1 < len(kd):
+            j += 1
+        elif i + 1 < len(qd):
+            i += 1
+        elif j + 1 < len(kd):
+            j += 1
         else:
             raise ValueError(
                 f"flash_attention_bthd: no legal head group for {h} "

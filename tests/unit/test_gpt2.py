@@ -171,3 +171,31 @@ class TestBthdAttentionLayout:
             logits = model.apply({"params": params}, ids,
                                  attention_mask=jnp.asarray(mask))
         assert np.isfinite(np.asarray(logits)).all()
+
+
+class TestBthdTileSelection:
+    """Pure tile-selection logic for the strided kernel (no Pallas run)."""
+
+    def test_non_power_of_two_seq_reaches_128(self):
+        # seq 384: the halving chain 384 -> 192 -> 96 skips 128; the
+        # divisor walk must still reach the 128-tile floor when larger
+        # tiles exhaust the head-group VMEM budget
+        from deepspeed_tpu.ops.flash_attention import _tile_divisors
+
+        assert _tile_divisors(384, 512) == [384, 192, 128]
+        assert _tile_divisors(1024, 512) == [512, 256, 128]
+        assert _tile_divisors(64, 512) == []  # below floor -> caller keeps bq0
+
+    def test_tiles_deterministic_and_legal(self):
+        from deepspeed_tpu.ops.flash_attention import _bthd_tiles
+
+        # 768 is the shape the old _block_sizes gate rejected outright
+        # (768 % 512 != 0) despite legal 384/256/192/128 divisor tiles
+        for sq, h, d in ((384, 12, 64), (768, 12, 64), (1024, 12, 64),
+                         (256, 4, 128), (512, 16, 64)):
+            bq, bk, g = _bthd_tiles(sq, sq, h, d, 512, 512)
+            assert sq % bq == 0 and sq % bk == 0
+            assert g % 8 == 0 or g == h
+            assert h % g == 0
+            # static args -> same answer every call (fwd/bwd agreement)
+            assert (bq, bk, g) == _bthd_tiles(sq, sq, h, d, 512, 512)

@@ -13,6 +13,16 @@ from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
 from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
 
 
+def init_distributed(dist_backend="xla", **kwargs):
+    """Initialize the distributed runtime (reference
+    ``deepspeed/__init__.py:578`` exposes this at top level; the
+    implementation lives in :mod:`deepspeed_tpu.comm.comm`). Idempotent;
+    single-process runs need no initialization."""
+    from deepspeed_tpu.comm.comm import init_distributed as _init
+
+    return _init(dist_backend=dist_backend, **kwargs)
+
+
 def initialize(args=None,
                model=None,
                optimizer=None,

@@ -449,10 +449,13 @@ def _head_group(h: int, bq: int, bk: int, d: int) -> int:
 
 
 def _tile_divisors(s: int, cap: int):
-    """Divisors of ``s`` in [128, cap], descending — every legal tile
+    """Divisors of ``s`` in [floor, cap], descending — every legal tile
     size, not just the halving chain (seq 384 must be able to reach 128
-    even though 384 -> 192 -> 96 skips it)."""
-    return [t for t in range(min(cap, s), 127, -1) if s % t == 0]
+    even though 384 -> 192 -> 96 skips it). The floor is 128 for the
+    default walk, but an explicitly smaller ``cap`` (a caller-passed
+    sub-128 block size) is honored as its own floor."""
+    floor = min(128, cap)
+    return [t for t in range(min(cap, s), floor - 1, -1) if s % t == 0]
 
 
 def _bthd_tiles(sq, sk, h, d, block_q, block_k):

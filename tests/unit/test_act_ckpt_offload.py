@@ -104,6 +104,7 @@ class TestCpuCheckpointing:
 
 
 class TestPartitionActivations:
+    @pytest.mark.heavy
     def test_saved_bytes_drop_by_model_parallel(self):
         """Compiled temp bytes fall ~1/mp when the saved residual stream
         is sharded over the model axis (mp=4 here: measured ratio ~0.20;

@@ -39,6 +39,7 @@ IDS = np.array([[3, 17, 42, 99, 7, 23, 56, 1]], np.int32)
 
 
 class TestBertParity:
+    @pytest.mark.heavy
     def test_logits_match_hf(self):
         hf, cfg = _tiny_hf_bert()
         config, params = load_hf_bert(

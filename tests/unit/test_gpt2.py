@@ -185,6 +185,9 @@ class TestBthdTileSelection:
         assert _tile_divisors(384, 512) == [384, 192, 128]
         assert _tile_divisors(1024, 512) == [512, 256, 128]
         assert _tile_divisors(64, 512) == []  # below floor -> caller keeps bq0
+        # an explicit sub-128 block size is its own floor (callers who
+        # pass block_q=64 must keep getting 64-wide tiles, not full-seq)
+        assert _tile_divisors(1024, 64) == [64]
 
     def test_tiles_deterministic_and_legal(self):
         from deepspeed_tpu.ops.flash_attention import _bthd_tiles

@@ -81,6 +81,7 @@ class TestGPTMoE:
         shard = wi.addressable_shards[0].data
         assert shard.shape[1] == wi.shape[1] // 4
 
+    @pytest.mark.heavy
     def test_ep_with_tp(self):
         losses, _ = _train({"data": 2, "expert": 2, "model": 2})
         dp, _ = _train({"data": 8})
@@ -90,6 +91,7 @@ class TestGPTMoE:
         losses, _ = _train({"data": 4}, scan=False)
         assert losses[-1] < losses[0]
 
+    @pytest.mark.heavy
     def test_serves_through_inference_engine(self):
         """init_inference handles the (logits, aux) output contract: greedy
         generation continues the argmax chain of the dense forward."""
@@ -109,6 +111,7 @@ class TestGPTMoE:
             cur = np.concatenate([cur, nxt[:, None].astype(np.int32)], axis=1)
         np.testing.assert_array_equal(out, cur)
 
+    @pytest.mark.heavy
     def test_decode_matches_dense(self):
         cfg = GPTMoEConfig.tiny(gpt_kw={"dtype": jnp.float32,
                                         "n_positions": 16})

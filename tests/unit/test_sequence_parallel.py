@@ -184,6 +184,7 @@ class TestSPTraining:
         sp = _train_losses({"data": 2, "seq": 4})
         np.testing.assert_allclose(dp, sp, rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.heavy
     def test_sp_with_tp(self):
         losses = _train_losses({"data": 2, "seq": 2, "model": 2})
         dp = _train_losses({"data": 4})

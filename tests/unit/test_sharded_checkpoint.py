@@ -89,6 +89,7 @@ class TestShardedSave:
         # training continues
         assert np.isfinite(_step(engine2, dp2, seed=1))
 
+    @pytest.mark.heavy
     def test_restore_is_sharded_not_replicated(self, tmp_path):
         engine, dp = _engine({"data": 8})
         _step(engine, dp)
@@ -107,6 +108,7 @@ class TestShardedSave:
         assert sharded_leaves, "restored params are fully replicated"
 
 
+@pytest.mark.heavy
 class TestMeshChangeRestore:
     def test_save_data8_load_data4_model2(self, tmp_path):
         """The universal-checkpoint capability: the storage layer reshards

@@ -24,6 +24,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# pure compile-level memory proofs (no numerics): one ~20s module-scoped
+# stage-3 compile serves all tests — a heavy gate, not a fast-loop one
+pytestmark = pytest.mark.heavy
+
 import deepspeed_tpu
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
 from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology

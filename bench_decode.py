@@ -52,15 +52,25 @@ def main():
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
 
-    # --- TTFT: prefill-only latency (the first forward of a request) ---
-    engine.forward(ids)  # compile
-    ttfts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = engine.forward(ids)
-        np.asarray(jax.device_get(out[:, -1, :8]))  # fence through tunnel
-        ttfts.append(1e3 * (time.perf_counter() - t0))
-    ttft_p50 = float(np.percentile(ttfts, 50))
+    # --- TTFT: prefill-only latency (the first forward of a request).
+    # Serving needs only the LAST position's logits to pick the first
+    # token, so the serving-true prefill is forward_last (XLA cuts the
+    # vocab projection to one position); the full-logits forward is kept
+    # as a secondary series for scoring-style callers ---
+    def p50(fn):
+        np.asarray(jax.device_get(fn().reshape(-1)[:8]))  # compile + sync
+        ms = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            np.asarray(jax.device_get(out.reshape(-1)[:8]))  # fence
+            ms.append(1e3 * (time.perf_counter() - t0))
+        return float(np.percentile(ms, 50))
+
+    # ttft_ms_p50 keeps its historical meaning (full-logits forward, the
+    # series PERF.md records); the serving-true prefill gets its own key
+    ttft_serving_p50 = p50(lambda: engine.forward_last(ids))
+    ttft_p50 = p50(lambda: engine.forward(ids))
 
     # --- steady-state decode rate: marginal cost between two generation
     # lengths — (T(2N) - T(N)) / N cancels prefill, dispatch, and the
@@ -83,6 +93,7 @@ def main():
     print(json.dumps({
         "metric": METRIC,
         "ttft_ms_p50": round(ttft_p50, 2),
+        "ttft_serving_ms_p50": round(ttft_serving_p50, 2),
         "decode_tokens_per_sec": round(tokens_per_sec, 1),
         "per_token_ms": round(per_token_ms, 3),
         "batch": batch, "prompt": prompt, "new_tokens": new_tokens,

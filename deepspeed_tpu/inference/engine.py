@@ -150,6 +150,7 @@ class InferenceEngine:
 
         self._timer = SynchronizedWallClockTimer()
         self._forward_fn = None
+        self._forward_last_fn = None
         self._generate_cache: Dict[Any, Callable] = {}
         self._model_times = []
         log_dist(
@@ -282,6 +283,29 @@ class InferenceEngine:
         return out
 
     __call__ = forward
+
+    def forward_last(self, input_ids):
+        """Last-position logits only — the prefill a serving request
+        actually needs (the next token depends on ``logits[:, -1]``
+        alone). Slicing INSIDE the jit lets XLA cut the vocab-projection
+        matmul to one position and shrink the output ``seq_len``-fold;
+        :meth:`forward` keeps the reference's full-logits contract
+        (reference ``engine.py:496``) for scoring-style callers."""
+        if self._forward_last_fn is None:
+            module = self.module
+
+            def fwd(params, ids):
+                return self._logits_of(module.apply(
+                    {"params": self._dequantize(params)}, ids))[:, -1]
+
+            self._forward_last_fn = jax.jit(fwd)
+        t = self._timer("model_forward")   # same latency-collection
+        t.start()                          # contract as forward()
+        out = jax.block_until_ready(
+            self._forward_last_fn(self.params, input_ids))
+        t.stop()
+        self._model_times.append(t.elapsed(reset=True))
+        return out
 
     def profile_model_time(self, use_cuda_events=True):
         """API parity with reference ``profile_model_time``
@@ -422,6 +446,7 @@ class InferenceEngine:
             self.params, self._quant_meta = self._quantize_weights(self.params)
         self._generate_cache.clear()
         self._forward_fn = None
+        self._forward_last_fn = None
 
     def eval(self):
         return self

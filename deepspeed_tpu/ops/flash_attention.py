@@ -459,9 +459,10 @@ def _tile_divisors(s: int, cap: int):
 
 
 def _bthd_tiles(sq, sk, h, d, block_q, block_k):
-    """(bq, bk, g) for the strided layout: shrink the seq tiles (floor
-    128) until a Pallas-legal head group — a multiple of 8, or all ``h``
-    heads — fits the VMEM budget. Walks the full divisor lattice,
+    """(bq, bk, g) for the strided layout: shrink the seq tiles (128
+    floor by default; an explicitly sub-128 ``block_q``/``block_k`` is
+    its own floor) until a Pallas-legal head group — a multiple of 8, or
+    all ``h`` heads — fits the VMEM budget. Walks the full divisor lattice,
     largest tiles first, shrinking the larger of the two (keeps tiles
     squarish). Deterministic in its static args, so the fwd and bwd
     drivers always agree."""

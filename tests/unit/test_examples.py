@@ -1,0 +1,43 @@
+"""The examples/ scripts run end to end (subprocess, CPU platform)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _run(script, *args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=500, env=env, cwd=REPO)
+
+
+@pytest.mark.heavy
+def test_train_then_serve(tmp_path):
+    save = str(tmp_path / "ckpt")
+    r = _run("train_gpt2.py", "--steps", "12", "--save_dir", save)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "checkpoint saved" in r.stdout
+    # loss line prints "loss: a -> b"; the 12-step run must not diverge
+    first, last = (float(x) for x in
+                   r.stdout.split("loss: ")[1].split(" over")[0].split(" -> "))
+    assert last < first
+
+    r = _run("serve_gpt2.py", "--checkpoint", save, "--tokens", "16",
+             "--prompt", "hello ")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "hello " in r.stdout
+
+
+@pytest.mark.heavy
+def test_train_with_json_config(tmp_path):
+    r = _run("train_gpt2.py", "--steps", "6",
+             "--save_dir", str(tmp_path / "c"),
+             "--deepspeed_config",
+             os.path.join(REPO, "examples", "ds_config.json"))
+    assert r.returncode == 0, r.stderr[-2000:]

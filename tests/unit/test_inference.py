@@ -69,6 +69,18 @@ class TestInferenceEngine:
             ids = np.concatenate([ids, nxt], axis=1)
         np.testing.assert_array_equal(out, ids)
 
+    def test_forward_last_matches_full_forward(self):
+        # the serving prefill (bench_decode TTFT): last-position logits
+        # sliced INSIDE the jit must equal the full forward's last column
+        cfg = _tiny()
+        engine = deepspeed_tpu.init_inference(GPT2LMHeadModel(cfg),
+                                              dtype="fp32")
+        ids = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 7)).astype(np.int32)
+        np.testing.assert_allclose(
+            np.asarray(engine.forward_last(ids)),
+            np.asarray(engine.forward(ids))[:, -1], rtol=1e-6, atol=1e-6)
+
     def test_training_wrapper_accepted(self):
         cfg = _tiny()
         engine = deepspeed_tpu.init_inference(GPT2ForTraining(cfg), dtype="fp32")

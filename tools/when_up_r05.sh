@@ -33,7 +33,9 @@ assert jax.devices()[0].platform != 'cpu'" >/dev/null 2>&1; then
     echo "chip UP at $(date -u +%FT%TZ); running bench queue" >> "$LOG"
     run_once zero_infer python -u bench_zero_infer.py
     run_once bench python -u bench.py
-    if [ -f "$MARK.zero_infer" ] && [ -f "$MARK.bench" ]; then
+    run_once decode python -u bench_decode.py
+    if [ -f "$MARK.zero_infer" ] && [ -f "$MARK.bench" ] \
+        && [ -f "$MARK.decode" ]; then
       echo "== queue complete $(date -u +%FT%TZ) ==" >> "$LOG"
       exit 0
     fi

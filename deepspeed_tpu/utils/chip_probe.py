@@ -201,21 +201,54 @@ def assert_platform(metric: str, expected: str):
         sys.exit(1)
 
 
+_FLAP_RETRY_ENV = "DS_BENCH_FLAP_RETRIES"
+_FLAP_RETRY_MAX = 2
+
+
+def _flap_recovers(rounds: int = 3, wait_s: float = 70.0) -> bool:
+    """After a mid-run backend death: wait out a (possibly transient)
+    tunnel flap and report whether a fresh-subprocess probe answers.
+    Bounded to ~``rounds * (wait_s + probe timeout)``."""
+    for _ in range(rounds):
+        time.sleep(wait_s)
+        platform, _ = probe(timeout_s=60.0)
+        if platform and platform != "cpu":
+            return True
+    return False
+
+
 def run_guarded(metric: str, fn):
-    """Run ``fn``; convert backend-unavailability raised *mid-run* (the
-    chip can die between the probe and the workload) into the same
-    structured JSON line. Genuine bench bugs still raise loudly."""
+    """Run ``fn``; on backend-unavailability raised *mid-run* (the chip
+    can die between the probe and the workload), wait for the tunnel to
+    answer again and **re-exec the bench in a fresh process** (a dead
+    jax backend cannot be revived in-process) up to two times, then
+    convert to the structured JSON failure line. Genuine bench bugs
+    still raise loudly."""
     try:
         return fn()
     except Exception as e:  # noqa: BLE001 — filtered below
         msg = f"{type(e).__name__}: {e}"
         if ("UNAVAILABLE" in msg or "Unable to initialize backend" in msg
                 or "DEADLINE_EXCEEDED" in msg):
+            tries = int(os.environ.get(_FLAP_RETRY_ENV, "0"))
+            if tries < _FLAP_RETRY_MAX and _flap_recovers():
+                os.environ[_FLAP_RETRY_ENV] = str(tries + 1)
+                print(f"chip flapped mid-bench (retry {tries + 1}/"
+                      f"{_FLAP_RETRY_MAX}): re-exec after probe recovery",
+                      file=sys.stderr, flush=True)
+                # orig_argv keeps interpreter flags (-u etc.) the plain
+                # sys.argv rebuild would drop; sys.executable stays the
+                # exec target (orig_argv[0] may be a bare "python" that
+                # execv, which does not search PATH, cannot run)
+                rest = (list(sys.orig_argv[1:])
+                        if getattr(sys, "orig_argv", None) else sys.argv)
+                os.execv(sys.executable, [sys.executable] + rest)
             print(json.dumps({
                 "metric": metric, "value": None, "unit": "unavailable",
                 "vs_baseline": None,
                 "error": "accelerator backend unavailable",
                 "detail": msg[:500],
+                "flap_retries": tries,
             }))
             sys.exit(1)
         raise

@@ -39,7 +39,11 @@ def main():
                                           max_out_tokens=args.seq)
 
     ids = np.frombuffer(args.prompt.encode(), np.uint8)[None].astype(np.int32)
-    out = engine.generate(ids, max_new_tokens=args.tokens, do_sample=True,
+    tokens = min(args.tokens, args.seq - ids.shape[1])  # model window cap
+    if tokens < args.tokens:
+        print(f"[prompt {ids.shape[1]} bytes + {args.tokens} tokens exceeds "
+              f"the {args.seq}-position window; generating {tokens}]")
+    out = engine.generate(ids, max_new_tokens=tokens, do_sample=True,
                           temperature=args.temperature, top_k=40)
     text = bytes(np.asarray(out)[0].tolist()).decode("utf-8", errors="replace")
     print(text)

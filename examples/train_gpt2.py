@@ -69,8 +69,11 @@ def main():
     corpus = np.frombuffer(open(args.corpus, "rb").read(), np.uint8)
     corpus = corpus.astype(np.int32)
     rng = np.random.default_rng(0)
-    stream = batches(corpus, engine.train_micro_batch_size_per_gpu()
-                     * engine.gradient_accumulation_steps(), args.seq, rng)
+    # one engine() call consumes ONE micro-batch; the engine applies the
+    # optimizer every gradient_accumulation_steps calls (the reference's
+    # micro-step contract), so --steps counts micro-steps
+    stream = batches(corpus, engine.train_micro_batch_size_per_gpu(),
+                     args.seq, rng)
 
     first = None
     for step in range(args.steps):
@@ -80,7 +83,8 @@ def main():
         engine.step()
         if first is None:
             first = float(loss)
-    print(f"loss: {first:.3f} -> {float(loss):.3f} over {args.steps} steps")
+    print(f"loss: {first:.3f} -> {float(loss):.3f} over {args.steps} "
+          "micro-steps")
 
     engine.save_checkpoint(args.save_dir, tag="example")
     print(f"checkpoint saved to {args.save_dir} (tag 'example')")

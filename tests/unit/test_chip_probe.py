@@ -19,3 +19,45 @@ def test_round_key_handles_probe_logs_and_unmatched():
     assert sorted(paths, key=_round_key)[-1] == "tools/probe_log_r100.txt"
     # unmatched names sort first rather than raising
     assert _round_key("BENCH.json")[0] == -1
+
+
+def test_run_guarded_retries_on_flap_then_reports(monkeypatch, capsys):
+    """A mid-run backend death re-execs after probe recovery (bounded),
+    and reports the structured failure once retries are exhausted."""
+    import json
+
+    from deepspeed_tpu.utils import chip_probe as cp
+
+    execs = []
+    monkeypatch.setattr(cp.os, "execv", lambda *a: execs.append(a))
+    monkeypatch.setattr(cp, "_flap_recovers", lambda: True)
+    monkeypatch.setenv(cp._FLAP_RETRY_ENV, "0")
+
+    def dies():
+        raise RuntimeError("UNAVAILABLE: socket closed")
+
+    # retries remain -> re-exec path (monkeypatched execv returns, so the
+    # structured line still prints afterwards in-process)
+    with __import__("pytest").raises(SystemExit):
+        cp.run_guarded("m", dies)
+    assert len(execs) == 1
+    assert cp.os.environ[cp._FLAP_RETRY_ENV] == "1"
+
+    # retries exhausted -> no exec, structured JSON with the retry count
+    monkeypatch.setenv(cp._FLAP_RETRY_ENV, str(cp._FLAP_RETRY_MAX))
+    capsys.readouterr()
+    with __import__("pytest").raises(SystemExit):
+        cp.run_guarded("m", dies)
+    assert len(execs) == 1
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["error"] == "accelerator backend unavailable"
+    assert out["flap_retries"] == cp._FLAP_RETRY_MAX
+
+
+def test_run_guarded_does_not_retry_genuine_bugs(monkeypatch):
+    from deepspeed_tpu.utils import chip_probe as cp
+
+    monkeypatch.setattr(cp, "_flap_recovers",
+                        lambda: (_ for _ in ()).throw(AssertionError()))
+    with __import__("pytest").raises(ValueError):
+        cp.run_guarded("m", lambda: (_ for _ in ()).throw(ValueError("bug")))

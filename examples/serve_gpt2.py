@@ -39,7 +39,10 @@ def main():
                                           max_out_tokens=args.seq)
 
     ids = np.frombuffer(args.prompt.encode(), np.uint8)[None].astype(np.int32)
-    tokens = min(args.tokens, args.seq - ids.shape[1])  # model window cap
+    if ids.shape[1] >= args.seq:  # keep the window's most recent context
+        print(f"[prompt truncated to its last {args.seq - 1} bytes]")
+        ids = ids[:, -(args.seq - 1):]
+    tokens = max(1, min(args.tokens, args.seq - ids.shape[1]))  # window cap
     if tokens < args.tokens:
         print(f"[prompt {ids.shape[1]} bytes + {args.tokens} tokens exceeds "
               f"the {args.seq}-position window; generating {tokens}]")

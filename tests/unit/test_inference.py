@@ -69,12 +69,14 @@ class TestInferenceEngine:
             ids = np.concatenate([ids, nxt], axis=1)
         np.testing.assert_array_equal(out, ids)
 
-    def test_forward_last_matches_full_forward(self):
+    @pytest.mark.parametrize("dtype", ["fp32", "int8"])
+    def test_forward_last_matches_full_forward(self, dtype):
         # the serving prefill (bench_decode TTFT): last-position logits
         # sliced INSIDE the jit must equal the full forward's last column
+        # — including through the int8 dequant path
         cfg = _tiny()
         engine = deepspeed_tpu.init_inference(GPT2LMHeadModel(cfg),
-                                              dtype="fp32")
+                                              dtype=dtype)
         ids = np.random.default_rng(0).integers(
             0, cfg.vocab_size, (2, 7)).astype(np.int32)
         np.testing.assert_allclose(

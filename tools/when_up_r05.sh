@@ -18,7 +18,9 @@ echo "== when_up_r05 started $(date -u +%FT%TZ) ==" >> "$LOG"
 run_once() {  # $1 = marker name, $2... = command
   local name=$1; shift
   [ -f "$MARK.$name" ] && return 0
-  timeout 880 "$@" >> "$LOG" 2>&1
+  # 1500s: covers one mid-run flap retry (run_guarded re-execs the bench
+  # but an outer timeout keeps ticking across the exec)
+  timeout 1500 "$@" >> "$LOG" 2>&1
   local rc=$?
   echo "-- $name rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
   [ "$rc" -eq 0 ] && touch "$MARK.$name"
@@ -36,8 +38,14 @@ assert jax.devices()[0].platform != 'cpu'" >/dev/null 2>&1; then
     run_once decode python -u bench_decode.py
     if [ -f "$MARK.zero_infer" ] && [ -f "$MARK.bench" ] \
         && [ -f "$MARK.decode" ]; then
-      echo "== queue complete $(date -u +%FT%TZ) ==" >> "$LOG"
-      exit 0
+      # owed benches done: spend any remaining window on the perf sweep
+      # (confirms the bench config is still the optimum at HEAD)
+      run_once sweep python -u tools/perf_sweep.py --set base
+      if [ -f "$MARK.sweep" ]; then
+        echo "== queue complete $(date -u +%FT%TZ) ==" >> "$LOG"
+        exit 0
+      fi
+      echo "== sweep incomplete; will retry next window ==" >> "$LOG"
     fi
     echo "== incomplete (chip may have flapped); will retry ==" >> "$LOG"
   fi

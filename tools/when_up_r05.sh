@@ -41,7 +41,8 @@ assert jax.devices()[0].platform != 'cpu'" >/dev/null 2>&1; then
       # owed benches done: spend any remaining window on the perf sweep
       # (confirms the bench config is still the optimum at HEAD)
       run_once sweep python -u tools/perf_sweep.py --set base
-      if [ -f "$MARK.sweep" ]; then
+      run_once decode_decompose python -u tools/perf_decode_decompose.py
+      if [ -f "$MARK.sweep" ] && [ -f "$MARK.decode_decompose" ]; then
         echo "== queue complete $(date -u +%FT%TZ) ==" >> "$LOG"
         exit 0
       fi

@@ -1,0 +1,52 @@
+"""Reference import-path alias: ``deepspeed.runtime.utils``.
+
+The reference's grab-bag (``deepspeed/runtime/utils.py``) is where users
+import ``see_memory_usage`` and the norm helpers from. The real homes
+here are :mod:`deepspeed_tpu.utils.memory` and the engine's compiled
+clipping path; this module keeps reference-shaped imports working.
+"""
+
+from deepspeed_tpu.utils.memory import memory_stats, see_memory_usage
+
+
+def get_global_norm_of_tensors(tensors, norm_type=2):
+    """Global norm over a list/tree of arrays (reference
+    ``runtime/utils.py`` ``get_global_norm_of_tensors``). The engine's
+    compiled step computes this in-graph (``runtime/engine.py:91``); this
+    standalone form serves user code and tooling."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tensors)
+    if norm_type == 2:
+        return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                            for l in leaves))
+    acc = jnp.asarray(0.0, jnp.float32)
+    for l in leaves:
+        acc = acc + jnp.sum(jnp.abs(l.astype(jnp.float32)) ** norm_type)
+    return acc ** (1.0 / norm_type)
+
+
+def get_global_norm(norm_list):
+    """sqrt(sum of squared norms) — reference ``get_global_norm``."""
+    import math
+
+    return math.sqrt(sum(float(n) ** 2 for n in norm_list))
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2):
+    """Pure clipped-tree form of the reference's in-place
+    ``clip_grad_norm_``: returns ``(clipped_tree, total_norm)`` — JAX
+    arrays are immutable, so callers rebind instead of mutating."""
+    import jax
+    import jax.numpy as jnp
+
+    total = get_global_norm_of_tensors(parameters, norm_type)
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    return (jax.tree_util.tree_map(
+        lambda l: (l.astype(jnp.float32) * scale).astype(l.dtype),
+        parameters), total)
+
+
+__all__ = ["see_memory_usage", "memory_stats", "get_global_norm",
+           "get_global_norm_of_tensors", "clip_grad_norm_"]

@@ -1,0 +1,42 @@
+"""Reference import-path alias: ``deepspeed.utils.zero_to_fp32``.
+
+The reference ships checkpoint consolidation both as a copyable script and
+as an importable module (``deepspeed/utils/zero_to_fp32.py:1``) exposing
+``get_fp32_state_dict_from_zero_checkpoint`` /
+``convert_zero_checkpoint_to_fp32_state_dict`` /
+``load_state_dict_from_zero_checkpoint``. The implementations live in
+:mod:`deepspeed_tpu.checkpoint`; this module keeps reference-shaped
+imports working (the CLI form is ``bin/zero_to_fp32``).
+"""
+
+from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint)
+
+
+def load_state_dict_from_zero_checkpoint(model, checkpoint_dir, tag=None):
+    """Reference ``zero_to_fp32.py``'s model-patching loader: consolidate
+    the sharded checkpoint to fp32 and hand the state dict to the model.
+    ``model`` may be a flax-style holder with ``params`` (set in place) or
+    anything exposing ``load_state_dict`` (torch-style duck type)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=tag)
+    if hasattr(model, "load_state_dict"):
+        model.load_state_dict(sd)   # torch-style duck type keeps flat keys
+        return model
+    if hasattr(model, "params"):
+        # flax-style holders need the NESTED tree, not the flat
+        # slash-path dict the consolidated state dict uses
+        from deepspeed_tpu.runtime.engine import _unflatten_by_paths
+
+        model.params = _unflatten_by_paths(sd, "")
+        return model
+    raise TypeError(
+        "model must expose load_state_dict(...) or a params attribute; "
+        "for raw trees call get_fp32_state_dict_from_zero_checkpoint")
+
+
+__all__ = [
+    "convert_zero_checkpoint_to_fp32_state_dict",
+    "get_fp32_state_dict_from_zero_checkpoint",
+    "load_state_dict_from_zero_checkpoint",
+]

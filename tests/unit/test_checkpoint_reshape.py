@@ -127,3 +127,34 @@ class TestDeepSpeedCheckpoint:
         assert r.returncode == 0, r.stderr
         loaded = np.load(out)
         np.testing.assert_allclose(loaded["wte"], sd["wte"])
+
+    def test_module_loader_patches_flax_holder(self, tmp_path):
+        # deepspeed.utils.zero_to_fp32.load_state_dict_from_zero_checkpoint:
+        # the .params branch must install the NESTED tree and serve
+        # identical logits through the inference engine
+        from deepspeed_tpu.utils.zero_to_fp32 import (
+            load_state_dict_from_zero_checkpoint)
+
+        e = _engine(zero_stage=2, mesh={"data": 4, "model": 2})
+        e.train_batch(batch=BATCH)
+        e.save_checkpoint(str(tmp_path))
+        live_logits = None
+
+        class Holder:
+            params = None
+
+        reset_topology()
+        holder = load_state_dict_from_zero_checkpoint(Holder(),
+                                                      str(tmp_path))
+        assert isinstance(holder.params, dict)
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+
+        model = GPT2LMHeadModel(GPT2Config.tiny(dtype=jnp.float32,
+                                                use_flash=False))
+        eng = deepspeed_tpu.init_inference(model, params=holder.params,
+                                           dtype="fp32")
+        ids = BATCH["input_ids"][:2]
+        got = np.asarray(eng(ids))
+        want = np.asarray(jax.device_get(model.apply(
+            {"params": jax.device_get(e.state.params)}, jnp.asarray(ids))))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

@@ -140,9 +140,11 @@ def main():
                 if getattr(l, "shape", ()) == (cfg.vocab_size, cfg.n_embd)),
                None)
     if wte is not None:
-        head = jax.jit(lambda w, h: jnp.einsum("btc,vc->btv",
-                                               h.astype(jnp.float32),
-                                               w.astype(jnp.float32)))
+        # mirror the model's head exactly (gpt2.py: bf16 x bf16 with f32
+        # accumulation) — an f32-cast matmul would double the table
+        # traffic and misattribute the head's share of the step
+        head = jax.jit(lambda w, h: jnp.einsum(
+            "btc,vc->btv", h, w, preferred_element_type=jnp.float32))
         print(f"5. lm head [B,1]x[V,C] alone:         "
               f"{timeit(head, wte, h):.3f} ms")
 

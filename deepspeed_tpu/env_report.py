@@ -39,21 +39,46 @@ def software_report():
     return rows
 
 
+# the tunnel's register() hook pins the platform, and a DEAD tunnel makes
+# in-process backend init HANG rather than fail — so the whole device
+# inventory is gathered in ONE fresh timeout-guarded subprocess and the
+# parent never initializes a backend (a mid-report flap can't freeze the
+# table). Honors an explicit JAX_PLATFORMS like chip_probe does.
+_INVENTORY_SRC = (
+    "import os, jax; "
+    "p = os.environ.get('JAX_PLATFORMS'); "
+    "p and jax.config.update('jax_platforms', p); "
+    "ds = jax.devices(); "
+    "print('PLATFORM:' + ds[0].platform, flush=True); "
+    "print('COUNT:' + str(len(ds)), flush=True); "
+    "print('KINDS:' + ', '.join(sorted({getattr(d, 'device_kind', '?') "
+    "for d in ds})), flush=True); "
+    "print('PROCS:' + str(jax.process_count()), flush=True)"
+)
+
+
 def hardware_report():
     rows = []
     try:
-        import jax
-
-        devices = jax.devices()
-        platform = devices[0].platform if devices else "none"
+        r = subprocess.run([sys.executable, "-c", _INVENTORY_SRC],
+                           capture_output=True, text=True, timeout=60.0)
+        got = dict(line.split(":", 1) for line in r.stdout.splitlines()
+                   if ":" in line)
+    except subprocess.TimeoutExpired:
+        got = {}
+        r = None
+    if "PLATFORM" not in got:
+        detail = ("probe timed out after 60s (backend hang)" if r is None
+                  else (r.stderr or r.stdout).strip().splitlines()[-1:])
+        rows.append(("jax devices",
+                     f"backend unreachable: {str(detail)[:120]}", FAIL))
+    else:
+        platform = got["PLATFORM"].strip()
         rows.append(("platform", platform,
                      OKAY if platform in ("tpu", "axon") else WARN))
-        rows.append(("device count", str(len(devices)), OKAY))
-        kinds = sorted({getattr(d, "device_kind", "?") for d in devices})
-        rows.append(("device kind", ", ".join(kinds), OKAY))
-        rows.append(("process count", str(jax.process_count()), OKAY))
-    except Exception as e:  # report must never crash
-        rows.append(("jax devices", f"error: {e}", FAIL))
+        rows.append(("device count", got.get("COUNT", "?").strip(), OKAY))
+        rows.append(("device kind", got.get("KINDS", "?").strip(), OKAY))
+        rows.append(("process count", got.get("PROCS", "?").strip(), OKAY))
     try:
         from jax.experimental import pallas  # noqa: F401
 

@@ -12,7 +12,8 @@ import time
 
 import numpy as np
 
-from deepspeed_tpu.utils.chip_probe import (assert_platform, is_tpu,
+from deepspeed_tpu.utils.chip_probe import (assert_platform, emit_result,
+                                            is_tpu,
                                             require_backend, resolve_metric,
                                             run_guarded)
 
@@ -158,7 +159,7 @@ def main():
     mfu = tokens_per_sec * model_flops_per_token / peak
     # peak + formula inline so the driver capture is self-auditing (no
     # PERF.md cross-reference needed to re-derive the MFU arithmetic)
-    print(json.dumps({
+    emit_result({
         "metric": METRIC,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
@@ -169,7 +170,7 @@ def main():
         "mfu_formula": ("mfu = tokens_per_sec * flops_per_token / peak_bf16;"
                         " flops_per_token = 6N + 12*L*T*C/2 (causal attn,"
                         " PaLM appx B); vs_baseline = mfu / 0.40"),
-    }))
+    })
 
 
 if __name__ == "__main__":

@@ -8,12 +8,12 @@ sustained TFLOP/s divided by the reference's 64 TFLOP/s seq-128 number —
 >1.0 beats the reference hardware-for-era.
 """
 
-import json
 import time
 
 import numpy as np
 
-from deepspeed_tpu.utils.chip_probe import (assert_platform, is_tpu,
+from deepspeed_tpu.utils.chip_probe import (assert_platform, emit_result,
+                                            is_tpu,
                                             require_backend, resolve_metric,
                                             run_guarded)
 
@@ -86,7 +86,7 @@ def main():
     flops_per_token = (6 * n_params
                        + 12 * cfg.num_hidden_layers * seq * cfg.hidden_size)
     tflops = samples_per_sec * seq * flops_per_token / 1e12
-    print(json.dumps({
+    emit_result({
         "metric": METRIC,
         "value": round(tflops, 2),
         "unit": "TFLOP/s",
@@ -95,7 +95,7 @@ def main():
                           " / 1e12, T=seq (bidirectional attn);"
                           f" vs_baseline = tflops / {REF_TFLOPS} (reference"
                           " V100 seq-128 headline)"),
-    }))
+    })
 
 
 if __name__ == "__main__":

@@ -13,12 +13,12 @@ scanned decode loop with the Pallas decode-attention kernel on the KV
 cache. On CPU a tiny proxy keeps the script runnable anywhere.
 """
 
-import json
 import time
 
 import numpy as np
 
-from deepspeed_tpu.utils.chip_probe import (assert_platform, is_tpu,
+from deepspeed_tpu.utils.chip_probe import (assert_platform, emit_result,
+                                            is_tpu,
                                             require_backend, resolve_metric,
                                             run_guarded)
 
@@ -111,7 +111,7 @@ def main():
     per_token_s8 = per_token(engine8)
 
     bf16, int8 = rate(per_token_s), rate(per_token_s8)
-    print(json.dumps({
+    emit_result({
         "metric": METRIC,
         "ttft_ms_p50": round(ttft_p50, 2),
         "ttft_serving_ms_p50": round(ttft_serving_p50, 2),
@@ -120,7 +120,7 @@ def main():
         "int8_decode_tokens_per_sec": int8["tokens_per_sec"],
         "int8_per_token_ms": int8["per_token_ms"],
         "batch": batch, "prompt": prompt, "new_tokens": new_tokens,
-    }))
+    })
 
 
 if __name__ == "__main__":

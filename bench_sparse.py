@@ -13,11 +13,11 @@ compiled program, (T(N)-T(1))/(N-1) — which cancels dispatch/transfer
 overhead of the tunnel (same as tools/perf_sparse.py).
 """
 
-import json
 
 import numpy as np
 
-from deepspeed_tpu.utils.chip_probe import (assert_platform, is_tpu,
+from deepspeed_tpu.utils.chip_probe import (assert_platform, emit_result,
+                                            is_tpu,
                                             require_backend, resolve_metric,
                                             run_guarded)
 from deepspeed_tpu.utils.marginal_bench import marginal_cost_ms
@@ -100,7 +100,7 @@ def main():
         }
         best_fwdbwd = max(best_fwdbwd, t_fb / t_sb)
 
-    print(json.dumps({
+    emit_result({
         "metric": METRIC,
         "value": round(best_fwdbwd, 2),
         "unit": "x_vs_dense_flash",
@@ -108,7 +108,7 @@ def main():
         "detail": results,
         "note": ("vs_baseline = best fwd+bwd speedup / 6.3 (reference "
                  "sparse-attention headline); BigBird block layout"),
-    }))
+    })
 
 
 if __name__ == "__main__":

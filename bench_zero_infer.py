@@ -13,13 +13,13 @@ On TPU: GPT-2 medium-ish config streamed bf16 and int8 from host RAM.
 On CPU a tiny proxy keeps the script runnable anywhere.
 """
 
-import json
 import sys
 import time
 
 import numpy as np
 
-from deepspeed_tpu.utils.chip_probe import (assert_platform, is_tpu,
+from deepspeed_tpu.utils.chip_probe import (assert_platform, emit_result,
+                                            is_tpu,
                                             require_backend, resolve_metric,
                                             run_guarded)
 
@@ -141,7 +141,7 @@ def main():
         out["streamed_mb_per_step"] = round(streamed_bytes / 1e6, 1)
         out["projected_tokens_per_sec_at_16GBps_pcie3"] = round(
             batch * 16e9 / streamed_bytes, 1)
-    print(json.dumps(out))
+    emit_result(out)
 
 
 if __name__ == "__main__":

@@ -44,34 +44,36 @@ def software_report():
 # inventory is gathered in ONE fresh timeout-guarded subprocess and the
 # parent never initializes a backend (a mid-report flap can't freeze the
 # table). Honors an explicit JAX_PLATFORMS like chip_probe does.
-_INVENTORY_SRC = (
-    "import os, jax; "
-    "p = os.environ.get('JAX_PLATFORMS'); "
-    "p and jax.config.update('jax_platforms', p); "
-    "ds = jax.devices(); "
-    "print('PLATFORM:' + ds[0].platform, flush=True); "
-    "print('COUNT:' + str(len(ds)), flush=True); "
-    "print('KINDS:' + ', '.join(sorted({getattr(d, 'device_kind', '?') "
-    "for d in ds})), flush=True); "
-    "print('PROCS:' + str(jax.process_count()), flush=True)"
-)
+def _inventory_src():
+    from deepspeed_tpu.utils.chip_probe import PLATFORM_PREAMBLE
+
+    return PLATFORM_PREAMBLE + (
+        "ds = jax.devices(); "
+        "print('PLATFORM:' + ds[0].platform, flush=True); "
+        "print('COUNT:' + str(len(ds)), flush=True); "
+        "print('KINDS:' + ', '.join(sorted({getattr(d, 'device_kind', '?') "
+        "for d in ds})), flush=True); "
+        "print('PROCS:' + str(jax.process_count()), flush=True)"
+    )
 
 
 def hardware_report():
     rows = []
+    got, detail = {}, ""
     try:
-        r = subprocess.run([sys.executable, "-c", _INVENTORY_SRC],
+        r = subprocess.run([sys.executable, "-c", _inventory_src()],
                            capture_output=True, text=True, timeout=60.0)
         got = dict(line.split(":", 1) for line in r.stdout.splitlines()
                    if ":" in line)
+        tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
+        detail = " | ".join(t.strip() for t in tail) or "no output"
     except subprocess.TimeoutExpired:
-        got = {}
-        r = None
+        detail = "probe timed out after 60s (backend hang)"
+    except Exception as e:  # report must never crash
+        detail = f"{type(e).__name__}: {e}"
     if "PLATFORM" not in got:
-        detail = ("probe timed out after 60s (backend hang)" if r is None
-                  else (r.stderr or r.stdout).strip().splitlines()[-1:])
         rows.append(("jax devices",
-                     f"backend unreachable: {str(detail)[:120]}", FAIL))
+                     f"backend unreachable: {detail[:120]}", FAIL))
     else:
         platform = got["PLATFORM"].strip()
         rows.append(("platform", platform,

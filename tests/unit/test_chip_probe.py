@@ -61,3 +61,33 @@ def test_run_guarded_does_not_retry_genuine_bugs(monkeypatch):
                         lambda: (_ for _ in ()).throw(AssertionError()))
     with __import__("pytest").raises(ValueError):
         cp.run_guarded("m", lambda: (_ for _ in ()).throw(ValueError("bug")))
+
+
+def test_emit_result_ledger(monkeypatch, tmp_path, capsys):
+    """Green hardware results append to the ledger with a timestamp;
+    cpu-smoke results and null values never do; the failure-line lookup
+    returns the latest entry labeled as builder-recorded."""
+    import json
+
+    from deepspeed_tpu.utils import chip_probe as cp
+
+    monkeypatch.setattr(cp, "_LEDGER", "ledger_test.jsonl")
+    monkeypatch.setattr(cp.os.path, "dirname",
+                        lambda p: str(tmp_path))  # reroute repo root
+    led = tmp_path / "ledger_test.jsonl"
+
+    cp.emit_result({"metric": "m_cpu_smoke_tokens", "value": 1.0})
+    cp.emit_result({"metric": "m", "value": None})
+    assert not led.exists()
+
+    cp.emit_result({"metric": "m", "value": 10.0, "vs_baseline": 0.9})
+    cp.emit_result({"metric": "m", "value": 12.0, "vs_baseline": 1.1})
+    lines = [json.loads(l) for l in led.read_text().splitlines()]
+    assert [l["value"] for l in lines] == [10.0, 12.0]
+    assert all("recorded_utc" in l for l in lines)
+    # every emit printed its JSON line regardless of ledger outcome
+    assert len(capsys.readouterr().out.strip().splitlines()) == 4
+
+    got = cp._last_builder_recorded("m")
+    assert got["value"] == 12.0 and "builder ledger" in got["source"]
+    assert cp._last_builder_recorded("absent") is None

@@ -148,6 +148,35 @@ def main():
         print(f"5. lm head [B,1]x[V,C] alone:         "
               f"{timeit(head, wte, h):.3f} ms")
 
+    # 6. batch sweep: off-chip XLA cost analysis says per-step memory
+    # traffic is near-ideal (~1.7 GB fp32 incl. one cache-sized scan
+    # temp), so if the measured per-step time is ~flat in batch, the
+    # floor is MXU/VPU latency at tiny [B, C] operands (8 rows of a
+    # 128-row MXU tile), NOT bandwidth — and decode tokens/s scales
+    # ~linearly with batch until the tile fills
+    if on_tpu:
+        for B2 in (16, 32):
+            ids2 = rng.integers(0, cfg.vocab_size,
+                                (B2, prompt)).astype(np.int32)
+            eng2 = deepspeed_tpu.init_inference(
+                model, dtype=cfg.dtype, max_out_tokens=cfg.n_positions)
+            eng2.generate(ids2, max_new_tokens=16, do_sample=False)
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                eng2.generate(ids2, max_new_tokens=16, do_sample=False)
+                ts.append(time.perf_counter() - t0)
+            t16 = min(ts)
+            eng2.generate(ids2, max_new_tokens=32, do_sample=False)
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                eng2.generate(ids2, max_new_tokens=32, do_sample=False)
+                ts.append(time.perf_counter() - t0)
+            per = (min(ts) - t16) / 16
+            print(f"6. batch {B2}: {1e3 * per:.3f} ms/step = "
+                  f"{B2 / per:.0f} tokens/s")
+
 
 if __name__ == "__main__":
     main()

@@ -35,7 +35,7 @@ def _run_doc(name):
 
 
 RUN_LIST = ["getting-started.md", "parallelism.md", "inference.md",
-            "zero-inference.md"]
+            "zero-inference.md", "sparse-attention.md", "autotuning.md"]
 
 
 @pytest.mark.heavy

@@ -34,3 +34,19 @@ def test_engine_training_across_processes(world_size):
                   devices_per_proc=4 if world_size == 2 else 2)
     for rank, out in enumerate(outs):
         assert f"MULTIHOST-TRAIN-OK rank={rank}" in out, out
+
+
+@pytest.mark.heavy
+def test_checkpoint_across_world_sizes(tmp_path, monkeypatch):
+    """Reference DistributedFixture pattern (tests/unit/common.py:180):
+    save at world_size=2, restore at world_size=4 — params AND optimizer
+    state must survive bit-exactly (per-leaf sha256) and keep training."""
+    monkeypatch.setenv("DS_TEST_CKPT_DIR", str(tmp_path))
+    outs = launch("tests.unit.dist_bodies:save_ckpt_cross_ws", 2,
+                  devices_per_proc=2)
+    for rank, out in enumerate(outs):
+        assert f"XWS-SAVE-OK rank={rank}" in out, out
+    outs = launch("tests.unit.dist_bodies:load_ckpt_cross_ws", 4,
+                  devices_per_proc=2)
+    for rank, out in enumerate(outs):
+        assert f"XWS-LOAD-OK rank={rank}" in out, out

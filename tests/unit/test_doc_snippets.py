@@ -35,7 +35,8 @@ def _run_doc(name):
 
 
 RUN_LIST = ["getting-started.md", "parallelism.md", "inference.md",
-            "zero-inference.md", "sparse-attention.md", "autotuning.md"]
+            "zero-inference.md", "sparse-attention.md", "autotuning.md",
+            "training-efficiency.md"]
 
 
 @pytest.mark.heavy

@@ -133,3 +133,25 @@ def add_config_arguments(parser):
     group.add_argument("--deepspeed_mpi", default=False, action="store_true",
                        help="Run via MPI discovery")
     return parser
+
+
+def __getattr__(name):
+    """Lazy top-level classes the reference exposes from ``deepspeed``
+    directly (``DeepSpeedEngine``, ``InferenceEngine``, ...) — resolved on
+    first touch so importing the package stays light."""
+    lazy = {
+        "DeepSpeedEngine": ("deepspeed_tpu.runtime.engine", "DeepSpeedEngine"),
+        "PipelineEngine": ("deepspeed_tpu.runtime.pipe.engine",
+                           "PipelineEngine"),
+        "InferenceEngine": ("deepspeed_tpu.inference.engine",
+                            "InferenceEngine"),
+        "PipelineModule": ("deepspeed_tpu.runtime.pipe.module",
+                           "PipelineModule"),
+        "OnDevice": ("deepspeed_tpu.utils.init_on_device", "OnDevice"),
+    }
+    if name in lazy:
+        import importlib
+
+        mod, sym = lazy[name]
+        return getattr(importlib.import_module(mod), sym)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

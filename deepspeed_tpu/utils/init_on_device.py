@@ -1,0 +1,97 @@
+"""Reference ``deepspeed.utils.OnDevice`` (``utils/init_on_device.py:10``)
+re-thought for JAX.
+
+The reference monkey-patches ``torch.empty``/``zeros``/... so module
+construction materializes tensors on a chosen device (or as ``meta``
+tensors). In flax, module CONSTRUCTION is always parameter-free — the
+"meta" regime the reference has to fake is the native default — and
+materialization happens at ``model.init``. So here:
+
+- ``device="meta"``: parameters materialize as ``ShapeDtypeStruct``
+  abstract values (``jax.eval_shape`` of the init) — shapes/dtypes with
+  zero memory, the true analog of torch meta tensors. Use
+  :meth:`OnDevice.init` inside the context.
+- a real device (``jax.Device`` or ``"cpu"``): the context sets
+  ``jax.default_device`` so ``model.init`` (called directly OR through
+  :meth:`OnDevice.init`) lands there — e.g. host RAM for models that
+  must not touch HBM before sharding (the ZeRO-Inference tier does the
+  same internally via ``host_init_params``).
+- ``dtype``: overrides the dtype of every floating leaf the init
+  produces, like the reference's fp16 constructor wrapping.
+
+For sharded ZeRO-3 materialization use :class:`deepspeed_tpu.zero.Init`,
+which never builds an unsharded copy at all.
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice:
+    """``with OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:``
+    then ``params = ctx.init(model, rng, batch)``."""
+
+    def __init__(self, dtype: Optional[Any] = None, device: Any = "meta",
+                 enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._cm = None
+
+    def __enter__(self):
+        if self.enabled and self.device != "meta":
+            dev = self.device
+            if isinstance(dev, str):
+                backend, _, idx = dev.partition(":")
+                dev = jax.local_devices(backend=backend)[int(idx) if idx
+                                                         else 0]
+            self._cm = jax.default_device(dev)
+            self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._cm is not None:
+            self._cm.__exit__(*exc)
+            self._cm = None
+        return False
+
+    def _cast(self, tree):
+        """Cast floating leaves to ``self.dtype``. Real arrays are cast
+        leaf-by-leaf with the source released before the next leaf casts,
+        so peak memory is one full-precision tree plus ONE leaf — not two
+        full trees (the init itself necessarily materializes the model's
+        native dtype first; models too big for that should init under
+        ``device="meta"`` and materialize sharded via ``zero.Init``)."""
+        if self.dtype is None:
+            return tree
+
+        flat, treedef = jax.tree_util.tree_flatten(tree)
+        del tree  # drop the container refs so leaves free one by one
+        for i, x in enumerate(flat):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                if isinstance(x, jax.ShapeDtypeStruct):
+                    flat[i] = jax.ShapeDtypeStruct(x.shape, self.dtype,
+                                                   sharding=x.sharding)
+                else:
+                    flat[i] = x.astype(self.dtype)
+                    del x  # free the full-precision leaf now
+        return jax.tree_util.tree_unflatten(treedef, flat)
+
+    def init(self, model, rng, *init_args, **init_kw):
+        """``model.init`` under this context's regime: abstract
+        (zero-memory) values for ``device="meta"``, real arrays on the
+        chosen device otherwise (the surrounding ``with`` block already
+        holds ``jax.default_device``); floating leaves cast to
+        ``dtype``. Call inside the ``with`` block."""
+        if not self.enabled:
+            return model.init(rng, *init_args, **init_kw)
+        if self.device == "meta":
+            out = jax.eval_shape(
+                lambda r: model.init(r, *init_args, **init_kw), rng)
+            return self._cast(out)
+        return self._cast(model.init(rng, *init_args, **init_kw))
+
+
+__all__ = ["OnDevice"]

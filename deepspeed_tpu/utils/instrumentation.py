@@ -5,20 +5,18 @@
   function in a profiler range. NVTX is CUDA-only; the TPU-native range
   marker is ``jax.profiler.TraceAnnotation``, which shows up in the
   XPlane traces ``jax.profiler.start_trace`` captures.
-- :class:`OnDevice` (reference ``utils/init_on_device.py``): torch needs
-  a context manager to construct modules on meta/target devices without
-  materializing weights. Flax modules are dataclasses — construction
-  allocates nothing and ``jax.eval_shape``/``zero.Init`` cover the
-  deferred/ sharded materialization — so the context is a documented
-  no-op that validates its arguments and keeps reference code running.
+- :class:`OnDevice` now lives at the reference path
+  (:mod:`deepspeed_tpu.utils.init_on_device`) with real behavior —
+  meta = ``jax.eval_shape`` abstract init, real devices via
+  ``jax.default_device``, dtype casting — and is re-exported here for
+  the established ``deepspeed_tpu.utils`` import.
 """
 
-import contextlib
 import functools
 
 import jax
 
-from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.init_on_device import OnDevice  # noqa: F401
 
 
 def instrument_w_nvtx(func):
@@ -31,26 +29,3 @@ def instrument_w_nvtx(func):
             return func(*args, **kwargs)
 
     return wrapped
-
-
-class OnDevice:
-    """Reference ``OnDevice`` (utils/init_on_device.py): construct a
-    model under a device/dtype context. Flax module CONSTRUCTION never
-    allocates parameters (init does), so nothing needs deferring —
-    entering records the intent and points users at the native
-    materializers."""
-
-    def __init__(self, dtype=None, device=None, enabled: bool = True):
-        self.dtype = dtype
-        self.device = device
-        if enabled and device in ("meta",):
-            logger.info(
-                "OnDevice(device='meta'): flax construction is already "
-                "weight-free; use jax.eval_shape for abstract params or "
-                "deepspeed_tpu.zero.Init().materialize for sharded ones")
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        return False

@@ -42,6 +42,10 @@ assert jax.devices()[0].platform != 'cpu'" >/dev/null 2>&1; then
       # (confirms the bench config is still the optimum at HEAD)
       run_once sweep python -u tools/perf_sweep.py --set base
       run_once decode_decompose python -u tools/perf_decode_decompose.py
+      # the user-facing example has never run on real hardware
+      run_once example bash -c \
+        "python -u examples/train_gpt2.py --steps 30 --save_dir /tmp/ds_ex_tpu \
+         && python -u examples/serve_gpt2.py --checkpoint /tmp/ds_ex_tpu --tokens 40"
       if [ -f "$MARK.sweep" ] && [ -f "$MARK.decode_decompose" ]; then
         echo "== queue complete $(date -u +%FT%TZ) ==" >> "$LOG"
         exit 0

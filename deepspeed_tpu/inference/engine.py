@@ -66,6 +66,26 @@ def sample_logits(logits, rng, temperature, do_sample: bool, top_k: int,
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def resolve_checkpoint_params(checkpoint):
+    """Params for an inference engine's ``checkpoint=`` kwarg (reference
+    ``engine.py:269`` loads it at construction; dropping it silently
+    would serve random weights for a call that names a real model).
+    Accepts a checkpoint DIRECTORY — training ``save_checkpoint`` layout
+    or a ``save_mp_checkpoint_path`` output; anything else fails loudly
+    with guidance. Shared by both serving tiers so they cannot drift."""
+    import os
+
+    from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+
+    if isinstance(checkpoint, str) and os.path.isdir(checkpoint):
+        return load_module_params(checkpoint)
+    raise DeepSpeedConfigError(
+        "checkpoint= expects a checkpoint DIRECTORY (training "
+        "save_checkpoint layout or a save_mp_checkpoint_path output); "
+        "for HF model names / sharded-index dirs / Megatron descriptors "
+        "use deepspeed_tpu.inference.auto.from_pretrained")
+
+
 def load_module_params(load_dir, tag=None):
     """Raw module param tree from a training checkpoint dir — the shared
     tag-resolution ('latest' file, ``global_step0`` fallback) and layout
@@ -131,8 +151,11 @@ class InferenceEngine:
         self.mesh = self.topo.mesh
         self.mp_world_size = self.topo.get_model_parallel_world_size()
 
-        # ---- params: init or adopt, then dtype-convert + shard
+        # ---- params: adopt / load from checkpoint / init, then
+        # dtype-convert + shard
         self._rng = jax.random.PRNGKey(seed)
+        if params is None and config.checkpoint is not None:
+            params = resolve_checkpoint_params(config.checkpoint)
         if params is None:
             if example_input is None:
                 example_input = jnp.zeros((1, 8), jnp.int32)
@@ -142,6 +165,8 @@ class InferenceEngine:
         params = unwrap_variables_dict(params)
         self.policy = self._resolve_policy(config.injection_policy)
         params = self._convert_dtype(params)
+        if config.save_mp_checkpoint_path:
+            self._save_mp_checkpoint(config.save_mp_checkpoint_path, params)
         self.params, self.param_shardings = self._shard_params(params)
 
         self._quantized = config.dtype == jnp.int8
@@ -435,6 +460,32 @@ class InferenceEngine:
         t.stop()
         self._model_times.append(t.elapsed(reset=True))
         return np.concatenate([np.asarray(input_ids), np.asarray(new)], axis=1)
+
+    # ------------------------------------------------------------------
+    def _save_mp_checkpoint(self, path, params_host):
+        """Reference ``save_mp_checkpoint_path`` (inference config): write
+        the dtype-CONVERTED weights so the next
+        ``init_inference(checkpoint=path)`` (or ``load_checkpoint``)
+        skips source parsing and conversion. The reference writes
+        per-mp-rank shard files; here the full tree is saved once in the
+        training-checkpoint layout — resharding to any TP degree is a
+        sharding annotation at load, not a data transform."""
+        import os
+
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+            ArrayCheckpointEngine)
+
+        if dist.get_rank() != 0:
+            return  # one writer: concurrent multi-host saves to a shared
+            # filesystem would interleave into a corrupt archive
+        tag = "inference"
+        eng = ArrayCheckpointEngine()
+        eng.save({"params": jax.device_get(params_host)},
+                 os.path.join(path, tag, "module"))
+        with open(os.path.join(path, "latest"), "w") as f:
+            f.write(tag)
+        log_dist(f"saved inference (mp) checkpoint to {path}", ranks=[0])
 
     # ------------------------------------------------------------------
     # reference checkpoint surface (engine.py:269,369)

@@ -167,6 +167,11 @@ class ZeroInferenceEngine:
         self._q_groups = max(1, int(config.quant.weight.q_groups))
 
         # ---- host-resident parameter tree (canonical layout) ----
+        if params is None and config.checkpoint is not None:
+            from deepspeed_tpu.inference.engine import (
+                resolve_checkpoint_params)
+
+            params = resolve_checkpoint_params(config.checkpoint)
         if params is None:
             params = host_init_params(model, seed)
         self._off = off

@@ -74,7 +74,15 @@ class ArrayCheckpointEngine(CheckpointEngine):
             if k.endswith("#none"):
                 meta[k] = None
             elif hasattr(v, "shape"):
-                arrays[k] = np.asarray(v)
+                a = np.asarray(v)
+                if a.dtype.kind not in "biufcSU?":
+                    # npz silently stores ml_dtypes (bfloat16, float8_*)
+                    # as raw void — a bf16 leaf would round-trip as |V2.
+                    # Store a same-width uint view + the dtype name.
+                    meta[k + "#dtype"] = str(v.dtype)
+                    a = a.view({1: np.uint8, 2: np.uint16,
+                                4: np.uint32}[a.dtype.itemsize])
+                arrays[k] = a
             else:
                 meta[k] = v
         np.savez(path + ".npz", **arrays)
@@ -92,6 +100,13 @@ class ArrayCheckpointEngine(CheckpointEngine):
             for k, v in meta.items():
                 if k.endswith("#none"):
                     flat[k[:-len("#none")]] = None
+                elif k.endswith("#dtype"):
+                    # re-view uint payloads back to their ml_dtypes type
+                    import ml_dtypes  # noqa: F401 — registers the names
+
+                    base = k[:-len("#dtype")]
+                    if base in flat:
+                        flat[base] = flat[base].view(np.dtype(v))
                 else:
                     flat[k] = v
         return flat

@@ -223,6 +223,46 @@ class TestInferenceQuant:
 
 
 class TestCheckpointRoundTrip:
+    @pytest.mark.parametrize("dtype", ["fp32", "bf16"])
+    def test_checkpoint_kwarg_and_save_mp_fast_reload(self, tmp_path, dtype):
+        # reference surface: init_inference(checkpoint=dir) loads at
+        # construction; save_mp_checkpoint_path writes the CONVERTED
+        # weights so the next engine reloads without re-conversion.
+        # bf16 (the default dtype) pins the npz ml_dtypes round-trip
+        cfg = _tiny()
+        src = deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype=dtype,
+            save_mp_checkpoint_path=str(tmp_path / "mp"))
+        ids = np.array([[5, 6, 7, 8]], dtype=np.int32)
+        want = np.asarray(src(ids))
+
+        again = deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype=dtype,
+            checkpoint=str(tmp_path / "mp"))
+        np.testing.assert_array_equal(np.asarray(again(ids)), want)
+
+        # a non-directory checkpoint value must FAIL LOUDLY, not serve
+        # random weights
+        from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+
+        with pytest.raises(DeepSpeedConfigError):
+            deepspeed_tpu.init_inference(GPT2LMHeadModel(cfg), dtype="fp32",
+                                         checkpoint="openai-community/gpt2")
+
+    def test_zero_inference_checkpoint_kwarg(self, tmp_path):
+        cfg = _tiny()
+        src = deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype="fp32",
+            save_mp_checkpoint_path=str(tmp_path / "mp"))
+        ids = np.array([[5, 6, 7, 8]], dtype=np.int32)
+        want = np.asarray(src(ids))
+        zeng = deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype="fp32",
+            checkpoint=str(tmp_path / "mp"),
+            zero={"stage": 3, "offload_param": {"device": "cpu"}})
+        np.testing.assert_allclose(np.asarray(zeng(ids)), want,
+                                   rtol=2e-5, atol=2e-5)
+
     def test_train_save_then_inference_load(self, tmp_path):
         cfg = _tiny()
         wrapper = GPT2ForTraining(cfg)

@@ -141,3 +141,23 @@ class TestMeshChangeRestore:
         after = _params_host(engine2)
         jax.tree_util.tree_map(
             lambda a, b: np.testing.assert_array_equal(a, b), before, after)
+
+
+def test_array_engine_bf16_roundtrip(tmp_path):
+    """npz stores ml_dtypes payloads as raw void unless the engine views
+    them through a native dtype — bf16 leaves must round-trip exactly
+    (this is the training default dtype on TPU)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+        ArrayCheckpointEngine)
+
+    eng = ArrayCheckpointEngine()
+    tree = {"w": jnp.arange(6, dtype=jnp.bfloat16) * 0.5,
+            "b": np.ones((2,), np.float32), "s": 3, "n": None}
+    eng.save(tree, str(tmp_path / "m"))
+    out = eng.load(str(tmp_path / "m"))
+    assert str(out["w"].dtype) == "bfloat16"
+    np.testing.assert_array_equal(np.asarray(tree["w"]), out["w"])
+    np.testing.assert_array_equal(out["b"], tree["b"])
+    assert out["s"] == 3 and out["n"] is None

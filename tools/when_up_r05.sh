@@ -46,7 +46,8 @@ assert jax.devices()[0].platform != 'cpu'" >/dev/null 2>&1; then
       run_once example bash -c \
         "python -u examples/train_gpt2.py --steps 30 --save_dir /tmp/ds_ex_tpu \
          && python -u examples/serve_gpt2.py --checkpoint /tmp/ds_ex_tpu --tokens 40"
-      if [ -f "$MARK.sweep" ] && [ -f "$MARK.decode_decompose" ]; then
+      if [ -f "$MARK.sweep" ] && [ -f "$MARK.decode_decompose" ] \
+          && [ -f "$MARK.example" ]; then
         echo "== queue complete $(date -u +%FT%TZ) ==" >> "$LOG"
         exit 0
       fi

@@ -19,6 +19,7 @@ Capability parity with the reference ``InferenceEngine``
 """
 
 import dataclasses
+import os
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -66,17 +67,20 @@ def sample_logits(logits, rng, temperature, do_sample: bool, top_k: int,
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-def resolve_checkpoint_params(checkpoint):
+def resolve_checkpoint_params(checkpoint, base_dir=""):
     """Params for an inference engine's ``checkpoint=`` kwarg (reference
     ``engine.py:269`` loads it at construction; dropping it silently
     would serve random weights for a call that names a real model).
     Accepts a checkpoint DIRECTORY — training ``save_checkpoint`` layout
-    or a ``save_mp_checkpoint_path`` output; anything else fails loudly
-    with guidance. Shared by both serving tiers so they cannot drift."""
-    import os
+    or a ``save_mp_checkpoint_path`` output — optionally joined onto
+    ``base_dir`` when relative; anything else fails loudly with
+    guidance. Shared by both serving tiers so they cannot drift."""
 
     from deepspeed_tpu.runtime.config import DeepSpeedConfigError
 
+    if base_dir and isinstance(checkpoint, str) \
+            and not os.path.isabs(checkpoint):
+        checkpoint = os.path.join(base_dir, checkpoint)
     if isinstance(checkpoint, str) and os.path.isdir(checkpoint):
         return load_module_params(checkpoint)
     raise DeepSpeedConfigError(
@@ -86,11 +90,63 @@ def resolve_checkpoint_params(checkpoint):
         "use deepspeed_tpu.inference.auto.from_pretrained")
 
 
+def warn_inert_options(config):
+    """Loudly name reference options that are accepted but have no
+    TPU-side behavior (same contract as the training engine's inert
+    activation-checkpointing knobs): the call keeps working, the user
+    learns the knob does nothing here, nothing is silently dropped.
+    Shared by both serving tiers."""
+    inert = {
+        "enable_cuda_graph": "XLA's jit compile cache supersedes "
+                             "CUDA-graph capture",
+        "triangular_masking": "each model owns its masking (causal "
+                              "decoders mask causally regardless)",
+        "set_empty_params": "flax init is deferred by construction; "
+                            "pass checkpoint= or params=",
+        "training_mp_size": "checkpoint loaders reshape TP degree "
+                            "automatically",
+        "return_tuple": "forward returns the logits array",
+        "min_out_tokens": "no kernel workspace needs a floor here",
+        "transposed_mode": "weight layouts are canonical",
+        "moe": "MoE serving is selected by the model family "
+               "(GPTMoE), not a config switch",
+    }
+    fields_set = config.model_fields_set or ()
+    for name, why in inert.items():
+        if name in fields_set:
+            log_dist(f"inference config '{name}' has no effect on "
+                     f"this backend: {why}", ranks=[0])
+
+
+def save_mp_checkpoint(path, params_host):
+    """Reference ``save_mp_checkpoint_path`` (inference config): write the
+    dtype-CONVERTED weights so the next ``init_inference(checkpoint=path)``
+    (or ``load_checkpoint``) skips source parsing and conversion. The
+    reference writes per-mp-rank shard files; here rank 0 saves the full
+    tree once in the training-checkpoint layout — resharding to any TP
+    degree is a sharding annotation at load, not a data transform — and
+    every rank barriers so a follow-up load never races the write."""
+
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+        ArrayCheckpointEngine)
+
+    if dist.get_rank() == 0:
+        tag = "inference"
+        eng = ArrayCheckpointEngine()
+        eng.save({"params": jax.device_get(params_host)},
+                 os.path.join(path, tag, "module"))
+        with open(os.path.join(path, "latest"), "w") as f:
+            f.write(tag)
+        log_dist(f"saved inference (mp) checkpoint to {path}", ranks=[0])
+    if dist.get_world_size() > 1:
+        dist.barrier()
+
+
 def load_module_params(load_dir, tag=None):
     """Raw module param tree from a training checkpoint dir — the shared
     tag-resolution ('latest' file, ``global_step0`` fallback) and layout
     parsing both serving tiers load through (reference ``engine.py:269``)."""
-    import os
 
     from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
         ArrayCheckpointEngine)
@@ -154,8 +210,10 @@ class InferenceEngine:
         # ---- params: adopt / load from checkpoint / init, then
         # dtype-convert + shard
         self._rng = jax.random.PRNGKey(seed)
+        warn_inert_options(config)
         if params is None and config.checkpoint is not None:
-            params = resolve_checkpoint_params(config.checkpoint)
+            params = resolve_checkpoint_params(config.checkpoint,
+                                               config.base_dir)
         if params is None:
             if example_input is None:
                 example_input = jnp.zeros((1, 8), jnp.int32)
@@ -163,7 +221,8 @@ class InferenceEngine:
         from deepspeed_tpu.utils.pytree import unwrap_variables_dict
 
         params = unwrap_variables_dict(params)
-        self.policy = self._resolve_policy(config.injection_policy)
+        self.policy = self._resolve_policy(config.injection_policy
+                                           or config.injection_policy_tuple)
         params = self._convert_dtype(params)
         if config.save_mp_checkpoint_path:
             self._save_mp_checkpoint(config.save_mp_checkpoint_path, params)
@@ -193,6 +252,10 @@ class InferenceEngine:
 
         if injection_policy is None:
             return get_tp_policy("auto")
+        if isinstance(injection_policy, (tuple, list)):
+            # reference injection_policy_tuple: a bare tuple naming the
+            # row-parallel output params
+            injection_policy = {"_tuple": tuple(injection_policy)}
         if isinstance(injection_policy, dict):
             rules = []
             for key, val in injection_policy.items():
@@ -463,29 +526,7 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
     def _save_mp_checkpoint(self, path, params_host):
-        """Reference ``save_mp_checkpoint_path`` (inference config): write
-        the dtype-CONVERTED weights so the next
-        ``init_inference(checkpoint=path)`` (or ``load_checkpoint``)
-        skips source parsing and conversion. The reference writes
-        per-mp-rank shard files; here the full tree is saved once in the
-        training-checkpoint layout — resharding to any TP degree is a
-        sharding annotation at load, not a data transform."""
-        import os
-
-        import deepspeed_tpu.comm as dist
-        from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
-            ArrayCheckpointEngine)
-
-        if dist.get_rank() != 0:
-            return  # one writer: concurrent multi-host saves to a shared
-            # filesystem would interleave into a corrupt archive
-        tag = "inference"
-        eng = ArrayCheckpointEngine()
-        eng.save({"params": jax.device_get(params_host)},
-                 os.path.join(path, tag, "module"))
-        with open(os.path.join(path, "latest"), "w") as f:
-            f.write(tag)
-        log_dist(f"saved inference (mp) checkpoint to {path}", ranks=[0])
+        save_mp_checkpoint(path, params_host)
 
     # ------------------------------------------------------------------
     # reference checkpoint surface (engine.py:269,369)

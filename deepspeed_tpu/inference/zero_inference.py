@@ -167,13 +167,20 @@ class ZeroInferenceEngine:
         self._q_groups = max(1, int(config.quant.weight.q_groups))
 
         # ---- host-resident parameter tree (canonical layout) ----
-        if params is None and config.checkpoint is not None:
-            from deepspeed_tpu.inference.engine import (
-                resolve_checkpoint_params)
+        from deepspeed_tpu.inference.engine import (resolve_checkpoint_params,
+                                                    save_mp_checkpoint,
+                                                    warn_inert_options)
 
-            params = resolve_checkpoint_params(config.checkpoint)
+        warn_inert_options(config)
+        if params is None and config.checkpoint is not None:
+            params = resolve_checkpoint_params(config.checkpoint,
+                                               config.base_dir)
         if params is None:
             params = host_init_params(model, seed)
+        if config.save_mp_checkpoint_path:
+            # the resolved host tree in the model's native dtype — the
+            # same fast-reload cache the device tier writes
+            save_mp_checkpoint(config.save_mp_checkpoint_path, params)
         self._off = off
         self._install_params(params)
         log_dist(

@@ -83,6 +83,31 @@ class TestInferenceEngine:
             np.asarray(engine.forward_last(ids)),
             np.asarray(engine.forward(ids))[:, -1], rtol=1e-6, atol=1e-6)
 
+    def test_inert_options_warn_and_tuple_policy_resolves(self, monkeypatch):
+        # assert on the warn CALLS (the logger's stream binding predates
+        # pytest's capture, so output-based assertions are unreliable)
+        import deepspeed_tpu.inference.engine as eng_mod
+
+        calls = []
+        monkeypatch.setattr(eng_mod, "log_dist",
+                            lambda msg, ranks=None: calls.append(msg))
+        cfg = _tiny()
+        deepspeed_tpu.init_inference(GPT2LMHeadModel(cfg), dtype="fp32",
+                                     enable_cuda_graph=True)
+        assert any("enable_cuda_graph" in m and "no effect" in m
+                   for m in calls)
+        # unset inert keys stay silent
+        calls.clear()
+        deepspeed_tpu.init_inference(GPT2LMHeadModel(cfg), dtype="fp32")
+        assert not any("no effect" in m for m in calls)
+        # reference injection_policy_tuple (bare tuple of row-parallel
+        # outputs) resolves to a usable policy
+        eng = deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype="fp32",
+            injection_policy_tuple=("attn.c_proj",))
+        assert eng(np.array([[1, 2, 3]], np.int32)).shape == (1, 3,
+                                                              cfg.vocab_size)
+
     def test_training_wrapper_accepted(self):
         cfg = _tiny()
         engine = deepspeed_tpu.init_inference(GPT2ForTraining(cfg), dtype="fp32")
@@ -262,6 +287,21 @@ class TestCheckpointRoundTrip:
             zero={"stage": 3, "offload_param": {"device": "cpu"}})
         np.testing.assert_allclose(np.asarray(zeng(ids)), want,
                                    rtol=2e-5, atol=2e-5)
+        # the zero tier also WRITES the fast-reload cache, and base_dir
+        # joins a relative checkpoint in both tiers
+        zsave = deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype="fp32",
+            checkpoint=str(tmp_path / "mp"),
+            save_mp_checkpoint_path=str(tmp_path / "zmp"),
+            zero={"stage": 3, "offload_param": {"device": "cpu"}})
+        del zsave
+        back = deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype="fp32",
+            checkpoint="zmp", base_dir=str(tmp_path),
+            zero={"stage": 3, "offload_param": {"device": "cpu"}})
+        np.testing.assert_allclose(np.asarray(back(ids)),
+                                   np.asarray(zeng(ids)), rtol=1e-6,
+                                   atol=1e-6)
 
     def test_train_save_then_inference_load(self, tmp_path):
         cfg = _tiny()

@@ -84,10 +84,11 @@ def resolve_checkpoint_params(checkpoint, base_dir=""):
     if isinstance(checkpoint, str) and os.path.isdir(checkpoint):
         return load_module_params(checkpoint)
     raise DeepSpeedConfigError(
-        "checkpoint= expects a checkpoint DIRECTORY (training "
-        "save_checkpoint layout or a save_mp_checkpoint_path output); "
-        "for HF model names / sharded-index dirs / Megatron descriptors "
-        "use deepspeed_tpu.inference.auto.from_pretrained")
+        f"checkpoint= resolved to {checkpoint!r}, which is not a "
+        "checkpoint DIRECTORY (training save_checkpoint layout or a "
+        "save_mp_checkpoint_path output); for HF model names / "
+        "sharded-index dirs / Megatron descriptors use "
+        "deepspeed_tpu.inference.auto.from_pretrained")
 
 
 def warn_inert_options(config):
@@ -113,7 +114,11 @@ def warn_inert_options(config):
     }
     fields_set = config.model_fields_set or ()
     for name, why in inert.items():
-        if name in fields_set:
+        if name in fields_set and getattr(config, name) != \
+                type(config).model_fields[name].get_default():
+            # a value equal to the default (common in dumped reference
+            # configs) is not worth a warning — only a knob someone
+            # actually turned
             log_dist(f"inference config '{name}' has no effect on "
                      f"this backend: {why}", ranks=[0])
 

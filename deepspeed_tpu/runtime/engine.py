@@ -72,7 +72,9 @@ class TrainState(NamedTuple):
 
     params: Any                 # fp32 master weights
     opt_state: Any              # optimizer-specific pytree (e.g. AdamState)
-    grad_acc: Any               # fp32 accumulation buffer (sharded like opt state)
+    grad_acc: Any               # grad accumulation buffer, fp32 by default
+                                # (data_types.grad_accum_dtype may reduce it);
+                                # sharded like opt state
     loss_scale: LossScaleState
     global_step: jnp.ndarray    # i32
     skipped_steps: jnp.ndarray  # i32
@@ -275,6 +277,9 @@ class DeepSpeedEngine:
         # (update_local under shard_map) — engine compiles a fused step
         self._onebit = hasattr(self.optimizer, "update_local")
 
+        self._grad_accum_dtype()  # validate data_types.grad_accum_dtype NOW
+        # (the buffer is built lazily at the first step; a bad name must
+        # fail at initialize, not mid-training)
         # fused_step: one compiled program for fwd+bwd+apply (gas=1 only)
         self._fused_step = bool(self._config.fused_step)
         if self._fused_step and (self._config.gradient_accumulation_steps != 1
@@ -624,10 +629,11 @@ class DeepSpeedEngine:
             # in the param layout (stage-2 scatter would make device_get span
             # non-addressable devices on multi-host)
             grad_shardings = param_shardings
+        accum_dtype = self._grad_accum_dtype()
         with self.mesh:
             grad_acc = jax.jit(
                 lambda p: jax.tree_util.tree_map(
-                    lambda x: jnp.zeros(x.shape, jnp.float32), p),
+                    lambda x: jnp.zeros(x.shape, accum_dtype), p),
                 out_shardings=grad_shardings)(params)
         self.state = TrainState(
             params=params,
@@ -821,8 +827,9 @@ class DeepSpeedEngine:
 
             loss_scaled, grads = jax.value_and_grad(scaled_loss)(state.params)
             grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
+            accum_dtype = self._grad_accum_dtype()
             grad_acc = jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(jnp.float32), state.grad_acc, grads)
+                lambda a, g: a + g.astype(accum_dtype), state.grad_acc, grads)
             loss = loss_scaled * gas / (state.loss_scale.loss_scale if fp16 else 1.0)
             return state._replace(grad_acc=grad_acc, rng=rng), loss
 
@@ -858,12 +865,22 @@ class DeepSpeedEngine:
         schedule_fn = self._schedule_fn
         scaler_config = self._scaler_config
 
+        accum_can_overflow = self._grad_accum_dtype() == jnp.float16
+
         def apply_math(state: TrainState, scaled_grads, lr_override):
             """Unscale → overflow check → clip → update → loss-scale update.
-            ``scaled_grads``: loss-scaled fp32 grads summed over micro-steps."""
+            ``scaled_grads``: loss-scaled grads summed over micro-steps in
+            the configured accumulation dtype (fp32 by default)."""
             inv_scale = (1.0 / state.loss_scale.loss_scale) if fp16 else 1.0
-            grads = jax.tree_util.tree_map(lambda g: g * inv_scale, scaled_grads)
-            overflow = has_inf_or_nan(grads) if fp16 else jnp.asarray(False)
+            # the optimizer math runs fp32 regardless of the (possibly
+            # reduced) accumulation dtype (data_types.grad_accum_dtype)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) * inv_scale, scaled_grads)
+            # an fp16 ACCUMULATOR can overflow even without fp16 loss
+            # scaling — a silent inf would corrupt params with no skipped
+            # step, so the check runs for either reason
+            overflow = (has_inf_or_nan(grads) if (fp16 or accum_can_overflow)
+                        else jnp.asarray(False))
             grad_norm = _global_norm(grads)
             if clip and clip > 0:
                 coef = jnp.minimum(clip / (grad_norm + 1e-6), 1.0)
@@ -1510,7 +1527,24 @@ class DeepSpeedEngine:
         return self._config.aio_config
 
     def get_data_types(self):
-        return (self._config.precision_dtype, jnp.float32)
+        return (self._config.precision_dtype, self._grad_accum_dtype())
+
+    def _grad_accum_dtype(self):
+        """data_types.grad_accum_dtype (reference ``constants.py:71``):
+        fp32 by default; a reduced dtype halves the gas>1 accumulation
+        buffer at the cost of accumulation precision."""
+        name = self._config.data_types_config.grad_accum_dtype
+        if name is None:
+            return jnp.float32
+        table = {"fp32": jnp.float32, "float32": jnp.float32,
+                 "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                 "fp16": jnp.float16, "float16": jnp.float16}
+        try:
+            return table[str(name).lower()]
+        except KeyError:
+            raise DeepSpeedConfigError(
+                f"data_types.grad_accum_dtype {name!r}: expected one of "
+                f"{sorted(set(table))}") from None
 
     def curriculum_learning_config(self):
         return self._config.data_efficiency_config.get(

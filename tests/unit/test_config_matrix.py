@@ -69,3 +69,48 @@ def test_feature_combination_trains(prec, stage, gas, fused):
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses  # memorizes the fixed batch
     assert engine.global_steps == 3, engine.global_steps
+
+
+@pytest.mark.parametrize("name,expect", [("bf16", "bfloat16"),
+                                         (None, "float32")])
+def test_grad_accum_dtype_honored(name, expect):
+    """data_types.grad_accum_dtype sizes the gas>1 accumulation buffer
+    (reference constants.py:71); it was parsed but ignored."""
+    import jax
+    import numpy as np
+
+    cfg = {"train_micro_batch_size_per_gpu": 1,
+           "gradient_accumulation_steps": 2,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "steps_per_print": 10_000}
+    if name:
+        cfg["data_types"] = {"grad_accum_dtype": name}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT2ForTraining(GPT2Config.tiny()), config=cfg)
+    assert engine.get_data_types()[1] == {"bfloat16": __import__(
+        "jax.numpy", fromlist=["x"]).bfloat16,
+        "float32": __import__("jax.numpy", fromlist=["x"]).float32}[expect]
+    ids = np.random.default_rng(0).integers(0, 256, (8, 32)).astype(np.int32)
+    losses = []
+    for _ in range(6):  # three optimizer steps at gas=2
+        loss = engine({"input_ids": ids})
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # the accumulator (built lazily at the first step) carries the
+    # configured dtype
+    leaf = jax.tree_util.tree_leaves(engine.state.grad_acc)[0]
+    assert str(leaf.dtype) == expect
+
+
+def test_grad_accum_dtype_invalid_raises():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+
+    with pytest.raises(DeepSpeedConfigError, match="grad_accum_dtype"):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=GPT2ForTraining(GPT2Config.tiny()),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "data_types": {"grad_accum_dtype": "int7"},
+                    "steps_per_print": 10_000})

@@ -36,7 +36,7 @@ def _run_doc(name):
 
 RUN_LIST = ["getting-started.md", "parallelism.md", "inference.md",
             "zero-inference.md", "sparse-attention.md", "autotuning.md",
-            "training-efficiency.md"]
+            "training-efficiency.md", "checkpointing.md"]
 
 
 @pytest.mark.heavy

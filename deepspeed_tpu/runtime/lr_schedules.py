@@ -42,17 +42,31 @@ def one_cycle_fn(cycle_min_lr,
                  cycle_max_lr,
                  cycle_first_step_size=2000,
                  cycle_second_step_size=None,
+                 cycle_first_stair_count=0,
+                 cycle_second_stair_count=None,
                  decay_step_size=0,
                  decay_lr_rate=0.0,
                  **_) -> Callable:
     """Triangular one-cycle policy (reference ``OneCycle``; momentum cycling
-    is a no-op on TPU adam — betas stay config-driven)."""
+    is a no-op on TPU adam — betas stay config-driven). A positive stair
+    count quantizes the corresponding phase into that many discrete lr
+    levels (reference staircase mode)."""
     second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    stairs2 = (cycle_second_stair_count if cycle_second_stair_count
+               is not None else cycle_first_stair_count)
     total = cycle_first_step_size + second
 
+    def _quantize(frac, count):
+        if count and count > 0:
+            return jnp.floor(frac * count) / count
+        return frac
+
     def schedule(step):
-        up = jnp.minimum(step / cycle_first_step_size, 1.0)
-        down = jnp.clip((step - cycle_first_step_size) / second, 0.0, 1.0)
+        up = _quantize(jnp.minimum(step / cycle_first_step_size, 1.0),
+                       cycle_first_stair_count)
+        down = _quantize(
+            jnp.clip((step - cycle_first_step_size) / second, 0.0, 1.0),
+            stairs2)
         lr = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * (up - down)
         if decay_step_size > 0:
             decay_steps = jnp.maximum(step - total, 0.0) / decay_step_size
@@ -157,22 +171,113 @@ def WarmupDecayLR(optimizer=None, **params):
     return LRScheduler(warmup_decay_lr_fn(**params))
 
 
+
+# ----------------------------------------------------------------------
+# CLI tuning-argument helpers (reference ``lr_schedules.py:55-267``): let a
+# training script expose the schedule knobs as flags and build the
+# ``scheduler`` config section from parsed args. Grouped by the prefix
+# each schedule's params share, so the override step is a comprehension
+# over the schedule's own arg set rather than a hand-written list per
+# schedule.
+
+_TUNING_FLAGS = {
+    LR_RANGE_TEST: {
+        "lr_range_test_min_lr": (float, 1e-3),
+        "lr_range_test_step_rate": (float, 1.0),
+        "lr_range_test_step_size": (int, 1000),
+        "lr_range_test_staircase": (bool, False),
+    },
+    ONE_CYCLE: {
+        "cycle_first_step_size": (int, 1000),
+        "cycle_first_stair_count": (int, -1),
+        "cycle_second_step_size": (int, -1),
+        "cycle_second_stair_count": (int, -1),
+        "decay_step_size": (int, 1000),
+        "cycle_min_lr": (float, 0.01),
+        "cycle_max_lr": (float, 0.1),
+        "decay_lr_rate": (float, 0.0),
+        # momentum flags ride along for reference-CLI compatibility;
+        # one_cycle_fn documents that momentum cycling is a no-op on
+        # TPU adam (betas stay config-driven)
+        "cycle_min_mom": (float, 0.8),
+        "cycle_max_mom": (float, 0.9),
+        "decay_mom_rate": (float, 0.0),
+    },
+    WARMUP_LR: {
+        "warmup_min_lr": (float, 0.0),
+        "warmup_max_lr": (float, 0.001),
+        "warmup_num_steps": (int, 1000),
+        "warmup_type": (str, "log"),
+    },
+}
+# WarmupDecayLR shares WarmupLR's flags plus the total step count
+_TUNING_FLAGS[WARMUP_DECAY_LR] = {
+    **_TUNING_FLAGS[WARMUP_LR], "total_num_steps": (int, 10_000),
+}
+
+
 def add_tuning_arguments(parser):
-    """Reference CLI tuning args (``lr_schedules.py`` convergence-tuning group)."""
-    group = parser.add_argument_group("Convergence Tuning")
-    group.add_argument("--lr_schedule", type=str, default=None)
-    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
-    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
-    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
-    group.add_argument("--lr_range_test_staircase", type=bool, default=False)
-    group.add_argument("--cycle_first_step_size", type=int, default=1000)
-    group.add_argument("--cycle_second_step_size", type=int, default=None)
-    group.add_argument("--cycle_min_lr", type=float, default=0.01)
-    group.add_argument("--cycle_max_lr", type=float, default=0.1)
-    group.add_argument("--decay_step_size", type=int, default=0)
-    group.add_argument("--decay_lr_rate", type=float, default=0.0)
-    group.add_argument("--warmup_min_lr", type=float, default=0)
-    group.add_argument("--warmup_max_lr", type=float, default=0.001)
-    group.add_argument("--warmup_num_steps", type=int, default=1000)
-    group.add_argument("--warmup_type", type=str, default=WARMUP_LOG_RATE)
+    """Add ``--lr_schedule`` + every schedule's flags (reference ``:55``)."""
+    group = parser.add_argument_group(
+        "Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help=f"LR schedule for training; one of "
+                            f"{VALID_LR_SCHEDULES}")
+    def _str2bool(v):
+        return str(v).lower() in ("1", "true", "yes", "on")
+
+    seen = set()
+    for flags in _TUNING_FLAGS.values():
+        for name, (typ, default) in flags.items():
+            if name in seen:
+                continue
+            seen.add(name)
+            group.add_argument(f"--{name}",
+                               type=_str2bool if typ is bool else typ,
+                               default=default)
     return parser
+
+
+def parse_arguments(parser=None):
+    """Standalone parser over the tuning flags (reference ``:159``)."""
+    import argparse
+
+    parser = parser or argparse.ArgumentParser()
+    add_tuning_arguments(parser)
+    args, _ = parser.parse_known_args()
+    return args
+
+
+def get_config_from_args(args):
+    """``(scheduler_config, error)`` from parsed args (reference ``:248``):
+    the config is ``{"type": ..., "params": {...}}`` ready for the
+    ``scheduler`` section; ``error`` is a message when ``--lr_schedule``
+    is absent or unknown."""
+    name = getattr(args, "lr_schedule", None)
+    if name is None:
+        return None, "--lr_schedule not specified on command line"
+    if name not in VALID_LR_SCHEDULES:
+        return None, f"{name} is not a supported LR schedule"
+    # -1 is the reference's "unset" sentinel ONLY for the flags that
+    # default to it (stair counts, second step size)
+    sentinels = {k for k, (_, d) in _TUNING_FLAGS[name].items() if d == -1}
+    params = {k: getattr(args, k)
+              for k in _TUNING_FLAGS[name]
+              if hasattr(args, k)
+              and not (k in sentinels and getattr(args, k) == -1)}
+    return {"type": name, "params": params}, None
+
+
+def get_lr_from_config(config):
+    """``(initial_lr, error)`` for a scheduler config (reference ``:267``)."""
+    if "type" not in config:
+        return None, "LR schedule type not defined in config"
+    params = config.get("params", {})
+    name = config["type"]
+    if name == LR_RANGE_TEST:
+        return params.get("lr_range_test_min_lr", 1e-3), None
+    if name == ONE_CYCLE:
+        return params.get("cycle_min_lr", 0.001), None
+    if name in (WARMUP_LR, WARMUP_DECAY_LR):
+        return params.get("warmup_max_lr", 0.001), None
+    return None, f"{name} is not a supported LR schedule"

@@ -70,3 +70,42 @@ class TestLRRangeTest:
 def test_unknown_schedule_raises():
     with pytest.raises(ValueError):
         get_lr_schedule_fn("NotASchedule", {})
+
+
+class TestTuningArguments:
+    """CLI tuning-argument helpers (reference lr_schedules.py:55-267)."""
+
+    def test_config_from_args_all_schedules(self):
+        import argparse
+
+        from deepspeed_tpu.runtime.lr_schedules import (
+            VALID_LR_SCHEDULES, add_tuning_arguments, get_config_from_args,
+            get_lr_from_config, get_lr_schedule_fn)
+
+        for name in VALID_LR_SCHEDULES:
+            p = argparse.ArgumentParser()
+            add_tuning_arguments(p)
+            args = p.parse_args(["--lr_schedule", name])
+            cfg, err = get_config_from_args(args)
+            assert err is None and cfg["type"] == name
+            # -1 sentinels must not leak (they poison the schedule math:
+            # OneCycle's down-phase divided by -1 clamps lr to 0)
+            assert all(v != -1 for v in cfg["params"].values()), cfg
+            fn = get_lr_schedule_fn(name, cfg["params"])
+            assert float(fn(10)) > 0.0
+            lr, err = get_lr_from_config(cfg)
+            assert err is None and lr > 0
+
+    def test_missing_and_invalid_schedule(self):
+        import argparse
+
+        from deepspeed_tpu.runtime.lr_schedules import (add_tuning_arguments,
+                                                        get_config_from_args)
+
+        p = argparse.ArgumentParser()
+        add_tuning_arguments(p)
+        cfg, err = get_config_from_args(p.parse_args([]))
+        assert cfg is None and "not specified" in err
+        cfg, err = get_config_from_args(
+            p.parse_args(["--lr_schedule", "Nope"]))
+        assert cfg is None and "not a supported" in err

@@ -231,6 +231,11 @@ class DeepSpeedEngine:
                 logger.warning(
                     f"activation_checkpointing.{key} is accepted but INERT "
                     f"on TPU: {why}")
+        if self._config.disable_allgather:
+            logger.warning(
+                "disable_allgather is accepted but INERT on TPU: GSPMD "
+                "chooses the gather/broadcast strategy; there is no "
+                "hand-scheduled allgather to disable")
         if self._config.pld_enabled and hasattr(model,
                                                 "with_progressive_layer_drop"):
             model = model.with_progressive_layer_drop(True)

@@ -300,15 +300,22 @@ class InferenceEngine:
         one-layer-sized."""
         from deepspeed_tpu.ops.quantizer import quantize
 
-        groups = max(1, int(self._config.quant.weight.q_groups))
+        wq = self._config.quant.weight
+        groups = max(1, int(wq.q_groups))
+        symmetric = str(getattr(wq, "q_type", "symmetric")) != "asymmetric"
         flat, treedef = jax.tree_util.tree_flatten(params)
         # quantization is a pytree-wide transform; remember which leaves
         qflat, meta = [], []
         for leaf in flat:
             if _is_floating(leaf) and leaf.ndim >= 2:
-                q, scale = quantize(leaf.astype(jnp.float32), num_groups=groups,
-                                    num_bits=self._config.quant.weight.num_bits)
-                qflat.append({"q": q, "scale": scale})
+                out = quantize(leaf.astype(jnp.float32), num_groups=groups,
+                               num_bits=wq.num_bits, symmetric=symmetric)
+                if symmetric:
+                    q, scale = out
+                    qflat.append({"q": q, "scale": scale})
+                else:  # asymmetric carries the per-group zero point
+                    q, scale, zp = out
+                    qflat.append({"q": q, "scale": scale, "zp": zp})
                 meta.append((True, leaf.dtype, leaf.shape))
             else:
                 qflat.append(leaf)
@@ -321,13 +328,17 @@ class InferenceEngine:
         if not self._quantized:
             return params
         treedef, meta = self._quant_meta
-        groups = max(1, int(self._config.quant.weight.q_groups))
-        is_q = lambda x: isinstance(x, dict) and set(x) == {"q", "scale"}
+        wq = self._config.quant.weight
+        groups = max(1, int(wq.q_groups))
+        is_q = lambda x: (isinstance(x, dict)
+                          and set(x) in ({"q", "scale"}, {"q", "scale", "zp"}))
         flat = treedef.flatten_up_to(params)
         out = []
         for leaf, (was_q, dtype, shape) in zip(flat, meta):
             if was_q and is_q(leaf):
-                w = dequantize(leaf["q"], leaf["scale"], num_groups=groups)
+                w = dequantize(leaf["q"], leaf["scale"],
+                               zero_point=leaf.get("zp"), num_groups=groups,
+                               num_bits=wq.num_bits)
                 out.append(w.reshape(shape).astype(dtype))
             else:
                 out.append(leaf)

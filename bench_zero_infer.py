@@ -18,7 +18,8 @@ import time
 
 import numpy as np
 
-from deepspeed_tpu.utils.chip_probe import (assert_platform, emit_result,
+from deepspeed_tpu.utils.chip_probe import (arm_compilation_cache,
+                                            assert_platform, emit_result,
                                             is_tpu,
                                             require_backend, resolve_metric,
                                             run_guarded)
@@ -35,6 +36,9 @@ def main():
     from deepspeed_tpu.inference.zero_inference import ZeroInferenceEngine
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
 
+    # window-proof: a flap re-exec replays compiles from the persistent
+    # cache instead of burning the UP window recompiling
+    arm_compilation_cache()
     assert_platform(METRIC, platform)
     on_tpu = is_tpu(platform)
     if on_tpu:
@@ -103,7 +107,22 @@ def main():
 
     bf16_rate, model_bytes, streamed_bytes = rate(
         "bf16" if on_tpu else "fp32")
-    int8_rate, _, _ = rate("int8")
+    # HEADLINE EMITTED NOW (VERDICT r5 #1 window-proofing): the int8
+    # series and the h2d probe below are optional extras — a chip flap
+    # during them can no longer zero the artifact. The final complete
+    # line re-emits the same headline keys plus the extras; consumers
+    # taking either the first or the last JSON line get a valid record.
+    emit_result({
+        "metric": METRIC,
+        "decode_tokens_per_sec": round(bf16_rate, 1),
+        "int8_tokens_per_sec": None,
+        "model_mb": round(model_bytes / 1e6, 1),
+        "batch": batch, "prompt": prompt, "new_tokens": new_tokens,
+        "partial": "headline-early emit; int8/comm series follows",
+    })
+    # comm_compression series for the offload regime: the "wire" here is
+    # the H2D link, and int8-at-rest halves the streamed bytes per step
+    int8_rate, _, int8_streamed = rate("int8")
 
     out = {
         "metric": METRIC,
@@ -111,6 +130,11 @@ def main():
         "int8_tokens_per_sec": round(int8_rate, 1),
         "model_mb": round(model_bytes / 1e6, 1),
         "batch": batch, "prompt": prompt, "new_tokens": new_tokens,
+        "comm_compression": {
+            "streamed_mb_per_step_bf16": round(streamed_bytes / 1e6, 1),
+            "streamed_mb_per_step_int8": round(int8_streamed / 1e6, 1),
+            "int8_tokens_per_sec": round(int8_rate, 1),
+        },
     }
     if on_tpu:
         # measured host->device bandwidth: the regime's governing

@@ -9,6 +9,14 @@ traced code, host-level agreement goes through the JAX distributed runtime
 
 
 class Backend:
+    # wire formats the backend's collectives can carry — USER-FACING
+    # capability surface (like the module-level has_* probes); internal
+    # dispatch goes through config/dtype strings, and the canonical tier
+    # lists live in runtime/comm/{quantized,compressed}.py (a test pins
+    # this tuple to them). XlaBackend adds the compressed tiers
+    # (deepspeed_tpu.comm.quantized_all_reduce / onebit_all_reduce).
+    comm_dtypes = ("dense",)
+
     def __init__(self, name="backend", rank=0, size=1):
         self.name = name
         self.world_group = None
@@ -19,6 +27,9 @@ class Backend:
 
     def is_initialized(self):
         return self.initialized
+
+    def supports_comm_dtype(self, comm_dtype: str) -> bool:
+        return comm_dtype in self.comm_dtypes
 
     def new_group(self, ranks):
         raise NotImplementedError
@@ -33,7 +44,14 @@ class XlaBackend(Backend):
     "Ranks" map as: device-level parallelism is expressed through the mesh
     (one Python process drives many devices), while process-level rank/size
     come from ``jax.process_index()/process_count()`` for multi-host pods.
+
+    Compressed wire tiers: traced collectives can carry int8 (two-leg
+    quantized allreduce) or a packed 1-bit sign bitfield with error
+    feedback — see ``deepspeed_tpu.comm.quantized_all_reduce`` /
+    ``onebit_all_reduce`` and the ``comm_quantization`` config block.
     """
+
+    comm_dtypes = ("dense", "int8", "1bit")
 
     def __init__(self, name="xla"):
         import jax

@@ -458,6 +458,87 @@ def all_to_all_single(tensor, group: Group = None, async_op=False, prof=False,
 all_to_all = all_to_all_single
 
 
+def _traced_axis_size(group) -> Optional[int]:
+    """Static member count of mesh axes bound in the CURRENT trace
+    (shard_map/pmap): ``psum`` of a literal constant-folds to the axis
+    size without emitting a collective. None when the axes are not bound
+    (or the fold returns a tracer on some jax version)."""
+    from jax import lax
+
+    try:
+        n = lax.psum(1, group if isinstance(group, str) else tuple(group))
+        return int(n)
+    except Exception:
+        return None
+
+
+@timed_op
+def quantized_all_reduce(tensor, group: Group = None, comm_dtype="int8",
+                         group_size: int = 1024, op=ReduceOp.AVG,
+                         async_op=False, prof=False,
+                         log_name="quantized_all_reduce", debug=None):
+    """Wire-compressed all-reduce: the collective operand crosses the wire
+    as int8 (EQuARX-style two-leg scheme, ``runtime/comm/quantized.py``) or,
+    with ``comm_dtype="none"``, full-width. Traced-only — the wire format
+    is a property of the compiled collective. ``op`` must be AVG or SUM.
+    For the stateful 1-bit tier use :func:`onebit_all_reduce`."""
+    if not _is_traced(tensor):
+        raise NotImplementedError(
+            "quantized_all_reduce requires traced tensors (use inside "
+            "jit/shard_map)")
+    if op not in (ReduceOp.AVG, ReduceOp.SUM):
+        raise NotImplementedError(f"quantized_all_reduce with {op}")
+    group = _resolve_group(group, tensor)
+    # member count from the bound trace first (works without any global
+    # topology); int8_allreduce short-circuits at n == 1, so silently
+    # defaulting to 1 here would skip the reduction and let replicas
+    # diverge — refuse instead
+    n = _traced_axis_size(group)
+    if n is None:
+        from deepspeed_tpu.parallel import topology as topo
+
+        if topo.get_topology(create_if_missing=False) is None:
+            raise ValueError(
+                "quantized_all_reduce could not determine the group size: "
+                f"axes {group!r} are not bound in this trace and no global "
+                "mesh topology is set (call init_distributed()/"
+                "set_topology(), or use the op inside shard_map)")
+        n = _axis_world_size(group)
+    from deepspeed_tpu.runtime.comm.quantized import (dense_allreduce,
+                                                      int8_allreduce)
+
+    if comm_dtype in ("int8", "8bit"):
+        return int8_allreduce(tensor, group, n, group_size=group_size,
+                              mean=op == ReduceOp.AVG)
+    if comm_dtype in ("none", None):
+        return dense_allreduce(tensor, group, n, mean=op == ReduceOp.AVG)
+    raise ValueError(
+        f"comm_dtype must be 'int8' or 'none', got {comm_dtype!r}")
+
+
+@timed_op
+def onebit_all_reduce(tensor, error, group: Group = None, carrier="packed",
+                      async_op=False, prof=False,
+                      log_name="onebit_all_reduce", debug=None):
+    """1-bit mean-allreduce with error feedback (the reference
+    ``compressed_allreduce``): returns ``(avg, new_error)``. With the
+    default packed carrier the collective operand is a uint8 sign bitfield
+    + one f32 scale per tensor (``runtime/comm/compressed.py``).
+    Traced-only; the caller owns the error state across steps."""
+    if not _is_traced(tensor):
+        raise NotImplementedError(
+            "onebit_all_reduce requires traced tensors (use inside "
+            "jit/shard_map)")
+    group = _resolve_group(group, tensor)
+    from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+
+    return compressed_allreduce(tensor, error, group, carrier=carrier)
+
+
+def has_quantized_all_reduce() -> bool:
+    return True
+
+
 @timed_op
 def broadcast(tensor, src: int = 0, group: Group = None, async_op=False,
               prof=False, log_name="broadcast", debug=None):
@@ -473,11 +554,13 @@ def broadcast(tensor, src: int = 0, group: Group = None, async_op=False,
     if _is_traced(tensor):
         group = _resolve_group(group, tensor)
         # linear index over all group axes (row-major in group order), so a
-        # multi-axis group broadcasts from exactly one member
+        # multi-axis group broadcasts from exactly one member. psum of a
+        # literal constant-folds to the axis size (works on jax versions
+        # without lax.axis_size).
         axes = (group,) if isinstance(group, str) else tuple(group)
         linear = jnp.zeros((), dtype=jnp.int32)
         for a in axes:
-            linear = linear * lax.axis_size(a) + lax.axis_index(a)
+            linear = linear * lax.psum(1, a) + lax.axis_index(a)
         masked = jnp.where(linear == src, tensor, jnp.zeros_like(tensor))
         return lax.psum(masked, group)
     if jax.process_count() == 1:

@@ -453,9 +453,16 @@ def _tile_divisors(s: int, cap: int):
     size, not just the halving chain (seq 384 must be able to reach 128
     even though 384 -> 192 -> 96 skips it). The floor is 128 for the
     default walk, but an explicitly smaller ``cap`` (a caller-passed
-    sub-128 block size) is honored as its own floor."""
+    sub-128 block size) is honored as its own floor.
+
+    Only sublane-aligned tiles (multiples of 8) are admitted, unless the
+    tile IS the full dim (the always-legal fallback): a tile like 300 for
+    s=600 divides the seq but dies inside Mosaic lowering — not a
+    ValueError, so the caller's standard-path fallback would never engage
+    and the forward would crash instead of dispatching dense attention."""
     floor = min(128, cap)
-    return [t for t in range(min(cap, s), floor - 1, -1) if s % t == 0]
+    return [t for t in range(min(cap, s), floor - 1, -1)
+            if s % t == 0 and (t % 8 == 0 or t == s)]
 
 
 def _bthd_tiles(sq, sk, h, d, block_q, block_k):

@@ -52,6 +52,34 @@ def dequantize(q, scale, zero_point=None, num_groups: int = 1,
     return out.astype(dtype).reshape(q.shape)
 
 
+def quantize_chunks(x, group_size: int = 1024):
+    """Symmetric int8 quantization of a flat vector with one scale per
+    ``group_size``-element chunk (the wire format of the quantized
+    collectives in ``runtime/comm/quantized.py``).
+
+    Unlike :func:`quantize`, the input need not divide evenly: the vector
+    is zero-padded up to a chunk multiple (zeros quantize to 0, so padding
+    is exact). Returns ``(q int8[padded], scales f32[n_chunks])``.
+    """
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = (-flat.size) % group_size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    g = flat.reshape(-1, group_size)
+    scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
+    return q.reshape(-1), scale[:, 0]
+
+
+def dequantize_chunks(q, scales, group_size: int = 1024, size=None,
+                      dtype=jnp.float32):
+    """Inverse of :func:`quantize_chunks`; ``size`` trims the padding."""
+    g = q.reshape(-1, group_size).astype(jnp.float32) * scales[:, None]
+    flat = g.reshape(-1).astype(dtype)
+    return flat if size is None else flat[:size]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
 def fake_quantize(x, num_groups: int = 1, num_bits: int = 8, symmetric: bool = True):
     """Quantize→dequantize in one step with a straight-through gradient

@@ -26,6 +26,7 @@ from deepspeed_tpu.parallel.topology import (
     AXIS_MODEL,
     AXIS_SEQ,
 )
+from deepspeed_tpu.utils.compat import shard_map
 
 NEG_INF = -1e30
 
@@ -111,6 +112,6 @@ def ring_attention(q, k, v,
     spec = P(bspec, hspec, axis_name, None)
     body = functools.partial(_ring_body, axis_name=axis_name, n=n,
                              causal=causal, scale=scale)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

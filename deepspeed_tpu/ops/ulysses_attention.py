@@ -27,6 +27,8 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.utils.compat import shard_map
+
 from deepspeed_tpu.parallel.topology import (
     AXIS_DATA,
     AXIS_EXPERT,
@@ -95,6 +97,6 @@ def ulysses_attention(q, k, v,
     spec = P(bspec, hspec, axis_name, None)
     body = functools.partial(_ulysses_body, axis_name=axis_name,
                              causal=causal, scale=scale, use_flash=use_flash)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

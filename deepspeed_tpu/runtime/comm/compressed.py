@@ -5,20 +5,68 @@ Capability parity with the reference compressed-allreduce backends
 compression with worker+server error feedback over NCCL igather/scatter,
 and the CUDA-aware MPI variant in ``runtime/comm/mpi.py``).
 
-TPU-native form: compression is a *math transform around a psum*. Inside a
-``shard_map`` over the ``data`` axis each replica holds its local tensor;
-``compressed_allreduce`` corrects it with the carried error, reduces it to
-sign × mean-|x| (a 32× wire-size cut on DCN — on-chip ICI rarely needs it,
-cross-pod DCN does), averages the compressed values with ``lax.psum``, and
-returns the new local error. No igather/scatter choreography: the XLA
-collective handles layout.
+TPU-native form: compression is a *math transform around a collective*.
+Inside a ``shard_map`` over the ``data`` axis each replica holds its local
+tensor; ``compressed_allreduce`` corrects it with the carried error and
+reduces it to sign × mean-|x|. Two wire carriers exist:
+
+- ``carrier="packed"`` (default, wire-true): sign bits are packed 8-per-byte
+  into a ``uint8`` bitfield and exchanged with an **all-gather of packed
+  worker signs + one f32 scale per tensor** — the collective operand is
+  uint8, so the DCN payload really is 1/32 of the f32 tensor (the
+  reference's igather of sign bytes, minus the byte-per-sign waste). Every
+  replica then reconstructs the server-style mean of signs locally.
+- ``carrier="dense"``: the sign×scale tensor is psum'd at full f32 width —
+  the compression is numerical only, not a wire cut. Kept as the reference
+  semantics baseline; the packed carrier reproduces its trajectories
+  bit-for-bit (reconstruction accumulates worker contributions
+  left-to-right, the same association XLA's all-reduce applies).
+
+Both carriers share one compression rule: ``sign(x) = +1 if x >= 0 else
+-1``. A packed bitfield has no zero symbol, so the dense carrier uses the
+same convention — otherwise the two would diverge on exact zeros and the
+bit-parity contract between them would be unverifiable.
 """
 
-from functools import partial
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+CARRIERS = ("packed", "dense")
+
+
+# ----------------------------------------------------------------------
+# uint8 bitfield packing (jnp.packbits-equivalent via shift/or lanes)
+def pack_signs(x: jnp.ndarray) -> jnp.ndarray:
+    """Pack the sign bits of ``x`` (+ = 1, - = 0) into a flat uint8
+    bitfield, least-significant bit first, zero-padded to a lane multiple
+    of 8. Returns ``uint8[ceil(x.size / 8)]``."""
+    flat = x.reshape(-1)
+    bits = jnp.where(flat >= 0, jnp.uint8(1), jnp.uint8(0))
+    pad = (-bits.size) % 8
+    if pad:
+        bits = jnp.concatenate([bits, jnp.zeros((pad,), jnp.uint8)])
+    lanes = bits.reshape(-1, 8)
+    packed = lanes[:, 0]
+    for i in range(1, 8):
+        packed = packed | (lanes[:, i] << np.uint8(i))
+    return packed
+
+
+def unpack_signs(packed: jnp.ndarray, n: int,
+                 dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`pack_signs`: flat ``±1`` vector of length ``n``
+    from a uint8 bitfield (padding bits discarded)."""
+    bits = (packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
+    return bits.reshape(-1)[:n].astype(dtype) * 2 - 1
+
+
+def _sign(x: jnp.ndarray) -> jnp.ndarray:
+    """Zero-free sign: ±1 with sign(0) = +1 (the packable convention)."""
+    return jnp.where(x >= 0, jnp.float32(1), jnp.float32(-1))
 
 
 def onebit_compress(x: jnp.ndarray, error: jnp.ndarray
@@ -31,22 +79,54 @@ def onebit_compress(x: jnp.ndarray, error: jnp.ndarray
     """
     corrected = x + error
     scale = jnp.mean(jnp.abs(corrected))
-    compressed = scale * jnp.sign(corrected)
+    compressed = scale * _sign(corrected)
     return compressed, corrected - compressed
 
 
-def compressed_allreduce(x: jnp.ndarray, error: jnp.ndarray, axis_name: str
+def packed_onebit_allreduce(x: jnp.ndarray, error: jnp.ndarray,
+                            axis_name) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Wire-true 1-bit mean-allreduce: all-gather of packed uint8 worker
+    signs + per-tensor f32 scales, then server-style mean-of-signs
+    reconstruction on every replica.
+
+    Bit-parity with the dense carrier: each worker's contribution is
+    ``scale_i * (±1)`` — exactly the float the dense carrier psums — and
+    the reconstruction accumulates workers left-to-right, matching the
+    all-reduce association, so the result is bit-identical to
+    ``psum(scale * sign) / n``.
+    """
+    corrected = x + error
+    scale = jnp.mean(jnp.abs(corrected))
+    compressed = scale * _sign(corrected)
+    new_error = corrected - compressed
+    wire = pack_signs(corrected)                       # uint8[ceil(n/8)]
+    signs = lax.all_gather(wire, axis_name, axis=0)    # uint8[w, ceil(n/8)]
+    scales = lax.all_gather(scale, axis_name, axis=0)  # f32[w]
+    world = signs.shape[0]
+    total = scales[0] * unpack_signs(signs[0], x.size)
+    for i in range(1, world):
+        total = total + scales[i] * unpack_signs(signs[i], x.size)
+    return (total / world).reshape(x.shape), new_error
+
+
+def compressed_allreduce(x: jnp.ndarray, error: jnp.ndarray, axis_name,
+                         carrier: str = "packed"
                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Mean-allreduce of 1-bit-compressed tensors over ``axis_name``.
 
     Must run inside ``shard_map``/``pmap`` where ``axis_name`` is bound.
-    Wire format is sign ± one scalar scale per tensor; the mean of the
-    compressed replicas is what lands on every replica (the reference's
-    server-side averaging of worker signs).
+    ``carrier`` picks the wire format (module docstring): ``"packed"``
+    exchanges uint8 bitfields + scales, ``"dense"`` psums the sign×scale
+    tensor at full width. The error-feedback semantics (and, by
+    construction, the trajectories) are identical.
     """
+    if carrier not in CARRIERS:
+        raise ValueError(f"carrier must be one of {CARRIERS}, got {carrier!r}")
+    if carrier == "packed":
+        return packed_onebit_allreduce(x, error, axis_name)
     compressed, new_error = onebit_compress(x, error)
-    n = jax.lax.psum(1, axis_name)
-    avg = jax.lax.psum(compressed, axis_name) / n
+    n = lax.psum(1, axis_name)
+    avg = lax.psum(compressed, axis_name) / n
     return avg, new_error
 
 
@@ -61,7 +141,8 @@ def init_error_tree(params, dp: int):
         lambda p: jnp.zeros((dp,) + p.shape, p.dtype), params)
 
 
-def make_compressed_grad_fn(loss_fn, mesh, data_axis: str = "data"):
+def make_compressed_grad_fn(loss_fn, mesh, data_axis: str = "data",
+                            carrier: str = "packed"):
     """Wrap a loss fn so grads are averaged with 1-bit compression.
 
     Returns ``fn(params, batch, error_tree) -> (loss, grads, new_error_tree)``
@@ -71,8 +152,9 @@ def make_compressed_grad_fn(loss_fn, mesh, data_axis: str = "data"):
     is per-replica state. This is the plumbing 1-bit optimizers use
     post-warmup.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.utils.compat import shard_map
 
     def local_step(params, batch, errors):
         # errors arrive as this replica's [1, ...] slice of the stack
@@ -82,7 +164,7 @@ def make_compressed_grad_fn(loss_fn, mesh, data_axis: str = "data"):
         flat_e = treedef.flatten_up_to(errors)
         out_g, out_e = [], []
         for g, e in zip(flat_g, flat_e):
-            avg, ne = compressed_allreduce(g, e, data_axis)
+            avg, ne = compressed_allreduce(g, e, data_axis, carrier=carrier)
             out_g.append(avg)
             out_e.append(ne[None])  # restack the per-replica row
         n = jax.lax.psum(1, data_axis)

@@ -1,0 +1,81 @@
+"""Int8 quantized all-reduce — the EQuARX-style middle tier.
+
+Between the dense bf16/f32 carrier and the 1-bit sign wire
+(``runtime/comm/compressed.py``) sits the int8 tier (EQuARX,
+arxiv 2506.17615): symmetric per-chunk scales, quantize around *both* legs
+of a reduce-scatter + all-gather decomposition so every collective operand
+on the wire is int8:
+
+1. **scatter leg**: each replica splits its tensor into ``world`` equal
+   chunks, quantizes them (``ops/quantizer.py`` chunked symmetric int8),
+   and an ``all_to_all`` routes chunk *i* of every replica to replica *i*
+   — int8 payload, f32 scales riding along at 1/group_size density.
+2. **local reduce**: replica *i* dequantizes the ``world`` copies of its
+   chunk and accumulates them left-to-right (the same association XLA's
+   all-reduce uses, so the ``"none"``/dense tier through this module is
+   bit-identical to a raw psum).
+3. **gather leg**: the reduced chunk is re-quantized and an ``all_gather``
+   (int8 again) reassembles the full tensor on every replica.
+
+Wire cut vs a bf16 dense all-reduce: 2× per element, plus the scales
+overhead (4/group_size per element). Unlike the 1-bit tier there is no
+error-feedback state — int8 round-off on gradients is small enough that
+the reference (and EQuARX) run it stateless.
+
+Must run inside ``shard_map``/``pmap`` where ``axis_name`` is bound;
+``axis_size`` is the static member count (collective layouts depend on it
+at trace time, so it cannot be read from a traced ``psum(1)``).
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.ops.quantizer import dequantize_chunks, quantize_chunks
+
+COMM_DTYPES = ("none", "int8", "1bit")
+
+
+def int8_allreduce(x, axis_name, axis_size: int, group_size: int = 1024,
+                   mean: bool = True):
+    """Quantized mean/sum-allreduce of ``x`` over ``axis_name``.
+
+    Both wire legs carry int8 (module docstring). Returns f32 in ``x``'s
+    shape. ``axis_size == 1`` short-circuits (nothing to reduce, and a
+    quantize round-trip would add error for no wire win).
+    """
+    if axis_size == 1:
+        return x.astype(jnp.float32)
+    flat = x.reshape(-1).astype(jnp.float32)
+    orig = flat.size
+    # each member owns one equal, group-aligned chunk
+    chunk = -(-orig // axis_size)
+    chunk = -(-chunk // group_size) * group_size
+    pad = chunk * axis_size - orig
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    q, scales = quantize_chunks(flat, group_size)
+    q = q.reshape(axis_size, chunk)
+    scales = scales.reshape(axis_size, chunk // group_size)
+    # scatter leg: row j of every member lands on member j
+    q_t = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    s_t = lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0)
+    partial = dequantize_chunks(q_t[0], s_t[0], group_size)
+    for i in range(1, axis_size):
+        partial = partial + dequantize_chunks(q_t[i], s_t[i], group_size)
+    if mean:
+        partial = partial / axis_size
+    # gather leg: requantize the reduced chunk, reassemble everywhere
+    q2, s2 = quantize_chunks(partial, group_size)
+    q_full = lax.all_gather(q2, axis_name, axis=0, tiled=True)
+    s_full = lax.all_gather(s2, axis_name, axis=0, tiled=True)
+    out = dequantize_chunks(q_full, s_full, group_size, size=orig)
+    return out.reshape(x.shape)
+
+
+def dense_allreduce(x, axis_name, axis_size: int, mean: bool = True):
+    """Full-width psum, shape-preserving — the ``"none"`` tier, kept here
+    so the bucketed dispatch treats every tier uniformly."""
+    out = lax.psum(x, axis_name)
+    if mean:
+        out = out / axis_size
+    return out

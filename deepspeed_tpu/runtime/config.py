@@ -165,6 +165,48 @@ class DataTypesConfig(DeepSpeedConfigModel):
     grad_accum_dtype: Optional[str] = None
 
 
+class CommQuantizationConfig(DeepSpeedConfigModel):
+    """``comm_quantization`` section: wire format of the gradient-reduction
+    collectives (TPU-native; the reference's nearest knob is
+    ``communication_data_type`` plus the 1-bit optimizer family).
+
+    - ``dtype``: ``"none"`` keeps the full-width carrier (bucketing still
+      applies), ``"int8"`` runs the EQuARX-style two-leg quantized
+      allreduce (``runtime/comm/quantized.py``), ``"1bit"`` selects the
+      packed sign wire — valid only with a 1-bit optimizer, whose state
+      carries the error feedback.
+    - ``group_size``: elements per int8 scale chunk.
+    - ``bucket_bytes``: byte budget per reduction bucket; each bucket is an
+      independent collective that overlaps remaining backward compute
+      (``runtime/zero/reduce.py``).
+    - ``onebit_carrier``: wire carrier for the 1-bit optimizer family —
+      ``"packed"`` (uint8 bitfield all-gather, the 32x DCN cut) or
+      ``"dense"`` (f32 psum of sign x scale, the semantics baseline).
+    """
+
+    enabled: bool = False
+    dtype: str = "int8"
+    group_size: int = 1024
+    bucket_bytes: int = 16 * 1024 * 1024
+    onebit_carrier: str = "packed"
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.dtype not in ("none", "int8", "1bit"):
+            raise ValueError(
+                f"comm_quantization.dtype must be one of none/int8/1bit, "
+                f"got {self.dtype!r}")
+        if self.onebit_carrier not in ("packed", "dense"):
+            raise ValueError(
+                f"comm_quantization.onebit_carrier must be packed or dense, "
+                f"got {self.onebit_carrier!r}")
+        if self.group_size <= 0 or self.bucket_bytes <= 0:
+            raise ValueError(
+                "comm_quantization.group_size and bucket_bytes must be "
+                "positive")
+        return self
+
+
 def _resolve_batch_triangle(train_batch, micro_batch, gas, dp_world_size):
     """Resolve/validate train_batch = micro_batch * gas * dp_world.
 
@@ -261,6 +303,8 @@ class DeepSpeedConfig:
         self.checkpoint_config = CheckpointConfig(**d.get(C.CHECKPOINT, {}))
         self.nebula_config = NebulaConfig(**d.get("nebula", {}))
         self.data_types_config = DataTypesConfig(**d.get(C.DATA_TYPES, {}))
+        self.comm_quantization = CommQuantizationConfig(
+            **d.get("comm_quantization", {}))
 
         if self.fp16.enabled and self.bf16.enabled:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")

@@ -282,6 +282,20 @@ class DeepSpeedEngine:
         # (update_local under shard_map) — engine compiles a fused step
         self._onebit = hasattr(self.optimizer, "update_local")
 
+        # --- comm_quantization: wire format of gradient reduction ---
+        cq = self._config.comm_quantization
+        if (self._onebit and hasattr(self.optimizer, "carrier")
+                and "comm_quantization" in self._config._param_dict):
+            # the 1-bit family owns its collective; the block only selects
+            # its wire carrier (packed uint8 bitfield vs dense f32 psum)
+            self.optimizer.carrier = cq.onebit_carrier
+        if cq.enabled and cq.dtype == "1bit" and not self._onebit:
+            raise DeepSpeedConfigError(
+                "comm_quantization.dtype='1bit' needs error feedback carried "
+                "in optimizer state — use a 1-bit optimizer (OneBitAdam/"
+                "OneBitLamb/ZeroOneAdam); the stateless engine tier is "
+                "'int8'")
+
         self._grad_accum_dtype()  # validate data_types.grad_accum_dtype NOW
         # (the buffer is built lazily at the first step; a bad name must
         # fail at initialize, not mid-training)
@@ -325,6 +339,9 @@ class DeepSpeedEngine:
             logger.warning("fused_step is incompatible with optimizer "
                            "offload; disabling")
             self._fused_step = False
+        # active wire tier for the engine's gradient reduction (None = the
+        # standard GSPMD full-width path); needs _host_offload resolved
+        self._comm_quant = self._resolve_comm_quant()
 
         # --- lr schedule (reference _configure_lr_scheduler, engine.py:900) ---
         if lr_scheduler is not None:
@@ -503,7 +520,9 @@ class DeepSpeedEngine:
 
         log_dist(f"DeepSpeedEngine configured: zero_stage={self.zero_optimization_stage()} "
                  f"mesh={self.topology} micro_batch={self.train_micro_batch_size_per_gpu()} "
-                 f"gas={self.gradient_accumulation_steps()}", ranks=[0])
+                 f"gas={self.gradient_accumulation_steps()}"
+                 + (f" comm_quantization={self._comm_quant}"
+                    if self._comm_quant else ""), ranks=[0])
 
     # ------------------------------------------------------------------
     # model / loss contract
@@ -712,8 +731,9 @@ class DeepSpeedEngine:
         key = (flag_name, bool(flag))
         if key in self._jit_onebit:
             return self._jit_onebit[key]
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.utils.compat import shard_map
 
         loss_fn = self._loss_fn
         optimizer = self.optimizer
@@ -755,6 +775,108 @@ class DeepSpeedEngine:
         return fn
 
     # ------------------------------------------------------------------
+    # comm_quantization: wire-compressed, bucketed gradient reduction
+    def _resolve_comm_quant(self):
+        """Active wire tier ("int8"/"none") for the engine's gradient
+        reduction, or None for the standard GSPMD path. The compressed path
+        runs fwd+bwd under shard_map over the data axis with explicit
+        bucketed collectives (``runtime/zero/reduce.py``), so it is gated
+        to the regimes where that is the whole reduction story."""
+        cq = self._config.comm_quantization
+        if not cq.enabled or cq.dtype == "1bit" or self._onebit:
+            return None  # 1-bit: the optimizer owns the collective
+        from deepspeed_tpu.parallel.topology import (AXIS_EXPERT, AXIS_MODEL,
+                                                     AXIS_PIPE, AXIS_SEQ)
+
+        for axis in (AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, AXIS_EXPERT):
+            if self.topology.axis_size(axis) > 1:
+                logger.warning(
+                    f"comm_quantization is data-parallel only (mesh axis "
+                    f"{axis!r} has size {self.topology.axis_size(axis)}); "
+                    "falling back to the full-width GSPMD reduction")
+                return None
+        if self._host_offload:
+            logger.warning(
+                "comm_quantization is not supported with optimizer offload "
+                "(grads transfer D2H full-width anyway); falling back")
+            return None
+        if self.topology.get_data_parallel_world_size() == 1:
+            return None  # nothing crosses a wire
+        return cq.dtype
+
+    def _comm_quant_grad_fn(self, gas_divisor: int):
+        """shard_map'd fused forward+backward whose gradient mean-reduction
+        is explicit: bucketed by ``comm_quantization.bucket_bytes`` and
+        carried on the configured wire tier, one independent collective per
+        bucket so XLA overlaps them with remaining backward compute
+        (``runtime/zero/reduce.py``). ZeRO-3 param shards are all-gathered
+        inside (the shard_map mirror of GSPMD's gather); returned grads are
+        replicated — the caller's sharding constraint re-scatters them for
+        ZeRO >= 2 with a local slice, no extra wire."""
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.runtime.zero.reduce import reduce_gradients
+        from deepspeed_tpu.utils.compat import shard_map
+
+        cq = self._config.comm_quantization
+        comm_dtype = self._comm_quant
+        loss_fn = self._loss_fn
+        fp16 = self.fp16_enabled_
+        compressor = self._compressor
+        pld = self.progressive_layer_drop
+        use_pld = pld is not None and self._loss_accepts_pld
+        shardings = self._state_shardings
+        param_specs = jax.tree_util.tree_map(
+            lambda s: s.spec, shardings.params)
+        spec_list = [s.spec for s in jax.tree_util.tree_leaves(
+            shardings.params)]
+        treedef = jax.tree_util.tree_structure(shardings.params)
+        dp = self.topology.get_data_parallel_world_size()
+
+        def gather_full(p, spec):
+            # undo ZeRO-3 sharding: all-gather each sharded dim in place
+            for dim, entry in enumerate(tuple(spec)):
+                if entry is not None:
+                    p = jax.lax.all_gather(p, entry, axis=dim, tiled=True)
+            return p
+
+        def local_grads(params, batch, loss_scale, global_step, key):
+            idx = jax.lax.axis_index(AXIS_DATA)
+            sub, sub2, sub3 = jax.random.split(
+                jax.random.fold_in(key, idx), 3)
+            flat = treedef.flatten_up_to(params)
+            full = jax.tree_util.tree_unflatten(
+                treedef,
+                [gather_full(p, s) for p, s in zip(flat, spec_list)])
+
+            def scaled_loss(p):
+                if compressor is not None and compressor.any_active():
+                    p = compressor.transform(p, global_step)
+                with _quant_ctx(compressor, global_step):
+                    loss = loss_fn(
+                        p, batch,
+                        rngs={"dropout": sub, "gating": sub2, "pld": sub3},
+                        **({"pld_theta": pld.theta_at(global_step)}
+                           if use_pld else {}))
+                # local-batch mean; the mean-reduce below restores the
+                # global-mean gradient (loss fns return batch means)
+                return loss * (loss_scale if fp16 else 1.0) / gas_divisor
+
+            loss_scaled, grads = jax.value_and_grad(scaled_loss)(full)
+            grads = reduce_gradients(
+                grads, AXIS_DATA, dp, comm_dtype=comm_dtype,
+                group_size=cq.group_size, bucket_bytes=cq.bucket_bytes,
+                mean=True)
+            loss_scaled = jax.lax.psum(loss_scaled, AXIS_DATA) / dp
+            return loss_scaled, grads
+
+        return shard_map(
+            local_grads, mesh=self.mesh,
+            in_specs=(param_specs, P(AXIS_DATA), P(), P(), P()),
+            out_specs=(P(), P()),
+            check_vma=False)
+
+    # ------------------------------------------------------------------
     # jitted hot paths
     def _compile_steps(self):
         if self._onebit:
@@ -783,6 +905,10 @@ class DeepSpeedEngine:
         rep = replicated(self.mesh)
         self._compile_steps_apply_only()  # defines self._apply_math
 
+        # wire-compressed reduction: one shard_map'd grad program serves
+        # the micro and fused paths (fused implies gas == 1)
+        cq_grad = self._comm_quant_grad_fn(gas) if self._comm_quant else None
+
         if self._fused_step:
             apply_math = self._apply_math
 
@@ -799,7 +925,13 @@ class DeepSpeedEngine:
                                        **pld_kwargs(state.global_step))
                     return loss * (state.loss_scale.loss_scale if fp16 else 1.0)
 
-                loss_scaled, grads = jax.value_and_grad(scaled_loss)(state.params)
+                if cq_grad is not None:
+                    loss_scaled, grads = cq_grad(
+                        state.params, batch, state.loss_scale.loss_scale,
+                        state.global_step, sub)
+                else:
+                    loss_scaled, grads = jax.value_and_grad(scaled_loss)(
+                        state.params)
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(jnp.float32), grads)
                 grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
@@ -830,7 +962,13 @@ class DeepSpeedEngine:
                                    **pld_kwargs(state.global_step))
                 return loss * (state.loss_scale.loss_scale if fp16 else 1.0) / gas
 
-            loss_scaled, grads = jax.value_and_grad(scaled_loss)(state.params)
+            if cq_grad is not None:
+                loss_scaled, grads = cq_grad(
+                    state.params, batch, state.loss_scale.loss_scale,
+                    state.global_step, sub)
+            else:
+                loss_scaled, grads = jax.value_and_grad(scaled_loss)(
+                    state.params)
             grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
             accum_dtype = self._grad_accum_dtype()
             grad_acc = jax.tree_util.tree_map(
@@ -1498,6 +1636,14 @@ class DeepSpeedEngine:
 
     def communication_data_type(self):
         return self._config.communication_data_type
+
+    def comm_quantization_config(self):
+        return self._config.comm_quantization
+
+    def comm_quantization_enabled(self):
+        """Whether the engine's gradient reduction runs wire-compressed —
+        the resolved tier after regime gating, not just the config flag."""
+        return self._comm_quant is not None
 
     def sparse_gradients_enabled(self):
         return self._config.sparse_gradients_enabled

@@ -49,13 +49,16 @@ class OnebitAdam:
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, freeze_step=100000, data_axis="data",
-                 **_unused):
+                 carrier="packed", **_unused):
         self.lr = float(lr)
         self.b1, self.b2 = betas
         self.eps = float(eps)
         self.weight_decay = float(weight_decay)
         self.freeze_step = int(freeze_step)
         self.data_axis = data_axis
+        # wire carrier of the compressed stage: "packed" = uint8 bitfield
+        # all-gather (wire-true), "dense" = f32 psum of sign x scale
+        self.carrier = carrier
 
     def init(self, params) -> OnebitAdamState:
         zeros = lambda: jax.tree_util.tree_map(
@@ -80,8 +83,8 @@ class OnebitAdam:
             p32 = p.astype(jnp.float32)
             if compressed:
                 m_local = b1 * m + (1 - b1) * g
-                m_new, e_new = compressed_allreduce(m_local, e,
-                                                    self.data_axis)
+                m_new, e_new = compressed_allreduce(
+                    m_local, e, self.data_axis, carrier=self.carrier)
                 v_new = v  # frozen
             else:
                 n = jax.lax.psum(1, self.data_axis)

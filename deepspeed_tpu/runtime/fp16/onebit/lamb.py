@@ -28,7 +28,8 @@ class OnebitLamb:
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, freeze_step=100000, data_axis="data",
-                 max_coeff=10.0, min_coeff=0.01, **_unused):
+                 max_coeff=10.0, min_coeff=0.01, carrier="packed",
+                 **_unused):
         self.lr = float(lr)
         self.b1, self.b2 = betas
         self.eps = float(eps)
@@ -37,6 +38,7 @@ class OnebitLamb:
         self.data_axis = data_axis
         self.max_coeff = float(max_coeff)
         self.min_coeff = float(min_coeff)
+        self.carrier = carrier
 
     def init(self, params) -> OnebitLambState:
         zeros = lambda: jax.tree_util.tree_map(
@@ -61,8 +63,8 @@ class OnebitLamb:
             p32 = p.astype(jnp.float32)
             if compressed:
                 m_local = b1 * m + (1 - b1) * g
-                m_new, e_new = compressed_allreduce(m_local, e,
-                                                    self.data_axis)
+                m_new, e_new = compressed_allreduce(
+                    m_local, e, self.data_axis, carrier=self.carrier)
                 v_new = v
             else:
                 n = jax.lax.psum(1, self.data_axis)

@@ -28,13 +28,14 @@ class ZeroOneAdam:
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, var_sync_interval=16, data_axis="data",
-                 **_unused):
+                 carrier="packed", **_unused):
         self.lr = float(lr)
         self.b1, self.b2 = betas
         self.eps = float(eps)
         self.weight_decay = float(weight_decay)
         self.var_sync_interval = int(var_sync_interval)
         self.data_axis = data_axis
+        self.carrier = carrier
 
     def init(self, params) -> ZeroOneAdamState:
         zeros = lambda: jax.tree_util.tree_map(
@@ -56,7 +57,8 @@ class ZeroOneAdam:
         def leaf(g, m, v, e, p):
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
-            g_comp, e_new = compressed_allreduce(g, e, self.data_axis)
+            g_comp, e_new = compressed_allreduce(
+                g, e, self.data_axis, carrier=self.carrier)
             if sync:
                 n = jax.lax.psum(1, self.data_axis)
                 g_for_v = jax.lax.psum(g, self.data_axis) / n

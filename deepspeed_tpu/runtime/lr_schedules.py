@@ -239,13 +239,15 @@ def add_tuning_arguments(parser):
 
 
 def parse_arguments(parser=None):
-    """Standalone parser over the tuning flags (reference ``:159``)."""
+    """Standalone parser over the tuning flags (reference ``:159``).
+    Returns ``(lr_sched_args, unknown_args)`` — reference signature; ported
+    callers unpack two values."""
     import argparse
 
     parser = parser or argparse.ArgumentParser()
     add_tuning_arguments(parser)
-    args, _ = parser.parse_known_args()
-    return args
+    args, unknown = parser.parse_known_args()
+    return args, unknown
 
 
 def get_config_from_args(args):
@@ -269,15 +271,20 @@ def get_config_from_args(args):
 
 
 def get_lr_from_config(config):
-    """``(initial_lr, error)`` for a scheduler config (reference ``:267``)."""
+    """``(initial_lr, error)`` for a scheduler config (reference ``:267``):
+    a missing ``params`` section is an error, and OneCycle reports
+    ``cycle_max_lr`` (the reference's choice — the cycle peak, what a
+    range-test consumer wants), not the floor."""
     if "type" not in config:
         return None, "LR schedule type not defined in config"
-    params = config.get("params", {})
+    if "params" not in config:
+        return None, "LR schedule params not defined in config"
+    params = config["params"]
     name = config["type"]
     if name == LR_RANGE_TEST:
         return params.get("lr_range_test_min_lr", 1e-3), None
     if name == ONE_CYCLE:
-        return params.get("cycle_min_lr", 0.001), None
+        return params.get("cycle_max_lr", 0.1), None
     if name in (WARMUP_LR, WARMUP_DECAY_LR):
         return params.get("warmup_max_lr", 0.001), None
     return None, f"{name} is not a supported LR schedule"

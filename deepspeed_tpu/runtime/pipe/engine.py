@@ -35,6 +35,7 @@ from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
 from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
 from deepspeed_tpu.runtime.zero.partition import replicated
+from deepspeed_tpu.utils.compat import shard_map
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -193,7 +194,7 @@ def pipeline_loss_fn(module: PipelineModule, mesh, n_micro: int):
         return loss_sum / count.astype(jnp.float32)
 
     spec_params = {"pre": P(), "blocks": P(AXIS_PIPE), "post": P(), "tied": P()}
-    smapped = jax.shard_map(
+    smapped = shard_map(
         body, mesh=mesh,
         in_specs=(spec_params, P(AXIS_PIPE), P(AXIS_PIPE), P()),
         out_specs=P(),

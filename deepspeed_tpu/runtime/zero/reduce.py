@@ -1,0 +1,101 @@
+"""Bucketed, wire-compressed gradient reduction.
+
+The reference reduces gradients through the IPG ("independent parallel
+gradient") machinery: grads are copied into fixed-byte buckets as backward
+hooks fire and each bucket's allreduce launches while the rest of backward
+still runs (``runtime/zero/stage_1_and_2.py:836-942``,
+``reduce_bucket_size``). Under XLA there are no hooks — but the same
+overlap falls out of dataflow: emit one *independent* collective per
+bucket and the latency-hiding scheduler starts bucket k's collective as
+soon as its last gradient is produced, while later buckets' backward
+segments are still computing (T3, arxiv 2401.16677, shows this
+backward/collective overlap is the second half of the compressed-wire
+win). One tail-barrier psum of the whole gradient pytree — what a naive
+``psum(grads)`` compiles to — cannot overlap anything.
+
+Bucketing walks the gradient leaves in *reverse* flatten order: autodiff
+produces the last layers' gradients first, so the reverse walk approximates
+completion order and the first buckets' collectives can issue while the
+early layers' backward is still in flight. Leaves are flattened and
+concatenated per bucket so each collective carries one contiguous operand
+(the reference's flat IPG buffer).
+
+Wire tiers (``comm_quantization.dtype``): ``"none"`` full-width psum,
+``"int8"`` the EQuARX-style two-leg quantized allreduce
+(``runtime/comm/quantized.py``). The 1-bit tier needs error-feedback state
+and therefore lives in the 1-bit optimizer family
+(``runtime/fp16/onebit/``), not in this stateless path.
+
+This is also the ZeRO reduce path: at stages >= 2 the engine constrains the
+returned (replicated) gradients to their scattered shardings immediately
+outside the ``shard_map``, which lowers to a local slice — the cross-wire
+part of the reduction happens entirely here, on the compressed carrier.
+"""
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.comm.quantized import (
+    COMM_DTYPES,
+    dense_allreduce,
+    int8_allreduce,
+)
+
+DEFAULT_BUCKET_BYTES = 16 * 1024 * 1024
+
+
+def bucket_by_bytes(leaves: Sequence, bucket_bytes: int) -> List[List[int]]:
+    """Partition leaf indices into buckets of at most ``bucket_bytes``
+    (f32 wire bytes), walking leaves in reverse order (module docstring).
+    A leaf larger than the budget gets a bucket of its own."""
+    budget = max(1, int(bucket_bytes))
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i in reversed(range(len(leaves))):
+        nbytes = int(leaves[i].size) * 4
+        if cur and cur_bytes + nbytes > budget:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def reduce_gradients(grads, axis_name, axis_size: int,
+                     comm_dtype: str = "none",
+                     group_size: int = 1024,
+                     bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                     mean: bool = True):
+    """Mean-reduce a gradient pytree over ``axis_name`` in byte-budget
+    buckets, one independent collective per bucket (must run inside
+    ``shard_map`` with ``axis_name`` bound). Returns f32 leaves in the
+    input structure."""
+    if comm_dtype not in COMM_DTYPES or comm_dtype == "1bit":
+        raise ValueError(
+            f"comm_dtype must be 'none' or 'int8' here (got {comm_dtype!r}); "
+            "the 1-bit tier carries error feedback in optimizer state — use "
+            "the 1-bit optimizer family")
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    out = [None] * len(leaves)
+    for bucket in bucket_by_bytes(leaves, bucket_bytes):
+        vec = jnp.concatenate(
+            [leaves[i].reshape(-1).astype(jnp.float32) for i in bucket]) \
+            if len(bucket) > 1 else \
+            leaves[bucket[0]].reshape(-1).astype(jnp.float32)
+        if comm_dtype == "int8":
+            red = int8_allreduce(vec, axis_name, axis_size,
+                                 group_size=group_size, mean=mean)
+        else:
+            red = dense_allreduce(vec, axis_name, axis_size, mean=mean)
+        offset = 0
+        for i in bucket:
+            n = int(leaves[i].size)
+            out[i] = jax.lax.dynamic_slice_in_dim(red, offset, n).reshape(
+                leaves[i].shape)
+            offset += n
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -112,6 +112,37 @@ def _round_key(path: str):
 _LEDGER = "tools/bench_ledger.jsonl"
 
 
+def arm_compilation_cache():
+    """Arm JAX's persistent compilation cache for a bench process.
+
+    Window-proofing (VERDICT r5 #1): a mid-run chip flap re-execs the
+    bench (:func:`run_guarded`), and the retry must not re-pay
+    multi-minute XLA compiles inside the same UP window — with the cache
+    armed, the re-exec replays compiles from disk and reaches the timed
+    region in seconds. Same cache location as tests/conftest.py; override
+    with JAX_COMPILATION_CACHE_DIR. Best-effort: a read-only HOME runs
+    uncached rather than failing the bench, and known-crashy
+    version/backend combinations stay uncached (old jaxlib segfaults
+    deserializing cached multi-device CPU executables)."""
+    import jax
+
+    from deepspeed_tpu.utils.compat import persistent_compilation_cache_safe
+
+    if not persistent_compilation_cache_safe():
+        return None
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.expanduser("~/.cache/deepspeed_tpu/jax_compile_cache"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        return None
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return cache_dir
+
+
 def emit_result(out: dict):
     """Print a bench's ONE JSON line and, when it was measured on the
     real chip, append it to the session ledger

@@ -1,0 +1,62 @@
+"""JAX version compatibility shims.
+
+The repo targets the current jax API surface (``jax.shard_map`` with
+``check_vma=``); older runtimes (< 0.5) ship ``shard_map`` under
+``jax.experimental.shard_map`` with the ``check_rep=`` spelling of the same
+knob. Every shard_map call site in the tree routes through this module so
+the fallback logic lives in exactly one place.
+"""
+
+import inspect
+
+
+def _resolve_shard_map():
+    try:
+        from jax import shard_map as sm  # jax >= 0.5
+        return sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+        return sm
+
+
+def persistent_compilation_cache_safe() -> bool:
+    """Whether arming JAX's persistent compilation cache is safe here.
+
+    jaxlib < 0.5 segfaults (SIGSEGV/SIGABRT, not a Python error)
+    deserializing its own cached **multi-device CPU** executables: a cold
+    run passes and writes entries, every warm run dies re-loading them —
+    which turned the whole virtual-8-device test suite into a one-shot.
+    On those versions the cache must stay off for CPU; TPU executables
+    round-trip fine everywhere we have run them."""
+    import jax
+
+    try:
+        version = tuple(int(p) for p in jax.__version__.split(".")[:2])
+    except ValueError:
+        return True
+    if version >= (0, 5):
+        return True
+    return jax.default_backend() != "cpu"
+
+
+_SM_PARAMS = None  # resolved lazily from the resolved shard_map's signature
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None, axis_names=None,
+              **kwargs):
+    """``jax.shard_map`` with new-API kwargs translated for older jax:
+    ``check_vma`` -> ``check_rep``, and ``axis_names`` (the *manual* axes)
+    -> its complement ``auto`` (the axes left to the partitioner)."""
+    global _SM_PARAMS
+    sm = _resolve_shard_map()
+    if _SM_PARAMS is None:
+        _SM_PARAMS = frozenset(inspect.signature(sm).parameters)
+    if check_vma is not None:
+        kwargs["check_vma" if "check_vma" in _SM_PARAMS
+               else "check_rep"] = check_vma
+    if axis_names is not None:
+        if "axis_names" in _SM_PARAMS:
+            kwargs["axis_names"] = axis_names
+        else:
+            kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

@@ -1,0 +1,89 @@
+"""Collective-op inspection of compiled HLO text.
+
+The wire-truth of the compressed collectives (``runtime/comm/compressed.py``
+/ ``quantized.py``) is a property of the *compiled program*: the claim
+"the 1-bit exchange carries uint8" is proven by finding the all-gather in
+the optimized HLO and reading its operand type, not by trusting the Python
+that requested it. This module is that reader — shared by the HLO
+regression tests (``tests/unit/test_comm_quantization.py``) and the
+PERF.md wire-bytes extractor (``tools/perf_comm_wire.py``), so the test
+and the published table can never disagree on parsing.
+"""
+
+import re
+from typing import Dict, List, Optional, Sequence
+
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "all-to-all",
+                  "reduce-scatter", "collective-permute")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+# `u8[8,513]{1,0}` — dtype + dims (scalar shapes print as `f32[]`)
+_SHAPE_RE = re.compile(r"\b(pred|[suf]\d+|bf16)\[([\d,]*)\]")
+_OP_RE = re.compile(
+    r"=\s*(?:\([^)]*\)|\S+)\s+(" + "|".join(COLLECTIVE_OPS) +
+    r")(?:-start)?\(")
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES[dtype]
+
+
+def parse_collectives(hlo_text: str) -> List[Dict]:
+    """Collective ops of a compiled-HLO module as
+    ``{op, operands: [(dtype, bytes)], operand_bytes}`` dicts.
+
+    ``operand_bytes`` is the per-member contribution each device feeds the
+    collective — the honest wire-size proxy (an all-gather *result* is
+    world× larger but each member only sends its operand).
+    """
+    out = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        args = line[m.end():]
+        depth = 1
+        for i, c in enumerate(args):
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    args = args[:i]
+                    break
+        operands = [(d, _shape_bytes(d, dims))
+                    for d, dims in _SHAPE_RE.findall(args)]
+        out.append({
+            "op": m.group(1),
+            "operands": operands,
+            "operand_bytes": sum(b for _, b in operands),
+        })
+    return out
+
+
+def collective_operand_bytes(hlo_text: str,
+                             ops: Optional[Sequence[str]] = None,
+                             min_bytes: int = 0) -> int:
+    """Total per-member collective operand bytes in the module; ``ops``
+    restricts to op names, ``min_bytes`` skips control-sized collectives
+    (loss scalars, flags)."""
+    return sum(c["operand_bytes"] for c in parse_collectives(hlo_text)
+               if (ops is None or c["op"] in ops)
+               and c["operand_bytes"] >= min_bytes)
+
+
+def collective_operand_dtypes(hlo_text: str, min_bytes: int = 0):
+    """Set of operand dtypes appearing in collectives >= ``min_bytes``."""
+    dtypes = set()
+    for c in parse_collectives(hlo_text):
+        if c["operand_bytes"] >= min_bytes:
+            dtypes.update(d for d, _ in c["operands"])
+    return dtypes

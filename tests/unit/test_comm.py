@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.utils.compat import shard_map
 
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology, set_topology

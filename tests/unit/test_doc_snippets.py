@@ -36,7 +36,8 @@ def _run_doc(name):
 
 RUN_LIST = ["getting-started.md", "parallelism.md", "inference.md",
             "zero-inference.md", "sparse-attention.md", "autotuning.md",
-            "training-efficiency.md", "checkpointing.md"]
+            "training-efficiency.md", "checkpointing.md",
+            "comm-quantization.md"]
 
 
 @pytest.mark.heavy

@@ -1,0 +1,106 @@
+"""Per-bucket collective wire bytes, extracted from compiled HLO.
+
+Compiles the three gradient-reduction tiers (dense / int8 / packed 1-bit)
+over the same bucket on the 8-device CPU mesh and reads the collective
+operand bytes out of the optimized HLO (``deepspeed_tpu/utils/hlo_inspect``
+— the same parser the regression tests use, so this table and the test
+can't disagree). Run::
+
+    JAX_PLATFORMS=cpu python tools/perf_comm_wire.py [--elems N]
+
+Prints a markdown table (for PERF.md) followed by one JSON line.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce  # noqa: E402
+from deepspeed_tpu.runtime.zero.reduce import reduce_gradients  # noqa: E402
+from deepspeed_tpu.utils.compat import shard_map  # noqa: E402
+from deepspeed_tpu.utils.hlo_inspect import parse_collectives  # noqa: E402
+
+
+def wire_bytes(hlo: str):
+    """(total operand bytes, per-op breakdown) for wire-significant
+    collectives (>= 16 B; skips loss scalars / control flags)."""
+    colls = [c for c in parse_collectives(hlo) if c["operand_bytes"] >= 16]
+    return sum(c["operand_bytes"] for c in colls), colls
+
+
+def lower(fn, *args):
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--elems", type=int, default=262_144,
+                    help="f32 elements per bucket (default 1 MiB)")
+    args = ap.parse_args()
+    n = args.elems
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    arg = jax.ShapeDtypeStruct((8, n), jnp.float32)
+
+    def tier(comm_dtype):
+        def f(v):
+            return reduce_gradients(v.reshape(n), "data", 8,
+                                    comm_dtype=comm_dtype,
+                                    bucket_bytes=1 << 62)
+        return lower(shard_map(f, mesh=mesh, in_specs=P("data"),
+                               out_specs=P(), check_vma=False), arg)
+
+    def onebit(carrier):
+        def f(v, e):
+            avg, ne = compressed_allreduce(v.reshape(n), e.reshape(n),
+                                           "data", carrier=carrier)
+            return avg, ne.reshape(1, n)
+        return lower(shard_map(f, mesh=mesh, in_specs=(P("data"), P("data")),
+                               out_specs=(P(), P("data")), check_vma=False),
+                     arg, arg)
+
+    rows = []
+    dense_total, _ = wire_bytes(tier("none"))
+    bf16_dense = 2 * n  # the bf16 carrier a mixed-precision run would ship
+    for name, hlo in [("dense f32 (psum)", tier("none")),
+                      ("int8 (all-to-all + all-gather)", tier("int8")),
+                      ("packed 1-bit (uint8 all-gather + scale)",
+                       onebit("packed"))]:
+        total, colls = wire_bytes(hlo)
+        ops = "+".join(sorted({c["op"] for c in colls}))
+        dtypes = "+".join(sorted({d for c in colls
+                                  for d, _ in c["operands"]}))
+        rows.append({"carrier": name, "ops": ops, "dtypes": dtypes,
+                     "operand_bytes": total,
+                     "vs_bf16_dense": round(bf16_dense / total, 2),
+                     "vs_f32_dense": round(dense_total / total, 2)})
+
+    print(f"Per-bucket collective operand bytes, {n} f32 elements "
+          f"({n * 4 // 1024} KiB dense), 8-device mesh, compiled HLO:\n")
+    print("| carrier | collectives | operand dtypes | bytes/member | "
+          "vs bf16 dense | vs f32 dense |")
+    print("|---|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['carrier']} | {r['ops']} | {r['dtypes']} | "
+              f"{r['operand_bytes']:,} | {r['vs_bf16_dense']}x | "
+              f"{r['vs_f32_dense']}x |")
+    print()
+    print(json.dumps({"metric": "comm_wire_bytes_per_bucket", "elems": n,
+                      "bf16_dense_bytes": bf16_dense, "tiers": rows}))
+
+
+if __name__ == "__main__":
+    main()

@@ -472,7 +472,21 @@ def _traced_axis_size(group) -> Optional[int]:
         return None
 
 
-@timed_op
+def _log_wire_op(raw_name: str, log_name: str, wire_bytes: int, n: int,
+                 prof: bool):
+    """Comms-logger record for a wire-compressed traced collective with
+    its WIRE-TRUE operand bytes (packed uint8 + scales), not the logical
+    f32 size — so compressed and dense collectives are comparable in the
+    same log. Latency is 0.0: traced ops compile into the step (same
+    convention as ``timed_op``'s traced branch)."""
+    if not comms_logger.enabled:
+        return
+    if not (comms_logger.prof_all or prof
+            or log_name in comms_logger.prof_ops):
+        return
+    comms_logger.append(raw_name, f"{log_name}(traced)", 0.0, wire_bytes, n)
+
+
 def quantized_all_reduce(tensor, group: Group = None, comm_dtype="int8",
                          group_size: int = 1024, op=ReduceOp.AVG,
                          async_op=False, prof=False,
@@ -505,33 +519,45 @@ def quantized_all_reduce(tensor, group: Group = None, comm_dtype="int8",
                 "set_topology(), or use the op inside shard_map)")
         n = _axis_world_size(group)
     from deepspeed_tpu.runtime.comm.quantized import (dense_allreduce,
-                                                      int8_allreduce)
+                                                      int8_allreduce,
+                                                      int8_wire_bytes)
 
     if comm_dtype in ("int8", "8bit"):
+        _log_wire_op("quantized_all_reduce", log_name,
+                     int8_wire_bytes(int(np.prod(tensor.shape)), n,
+                                     group_size=group_size), n, prof)
         return int8_allreduce(tensor, group, n, group_size=group_size,
                               mean=op == ReduceOp.AVG)
     if comm_dtype in ("none", None):
+        _log_wire_op("quantized_all_reduce", log_name, _nbytes(tensor), n,
+                     prof)
         return dense_allreduce(tensor, group, n, mean=op == ReduceOp.AVG)
     raise ValueError(
         f"comm_dtype must be 'int8' or 'none', got {comm_dtype!r}")
 
 
-@timed_op
 def onebit_all_reduce(tensor, error, group: Group = None, carrier="packed",
                       async_op=False, prof=False,
                       log_name="onebit_all_reduce", debug=None):
     """1-bit mean-allreduce with error feedback (the reference
     ``compressed_allreduce``): returns ``(avg, new_error)``. With the
     default packed carrier the collective operand is a uint8 sign bitfield
-    + one f32 scale per tensor (``runtime/comm/compressed.py``).
-    Traced-only; the caller owns the error state across steps."""
+    + one f32 scale per tensor (``runtime/comm/compressed.py``) — and that
+    packed size is what the comms logger records. Traced-only; the caller
+    owns the error state across steps."""
     if not _is_traced(tensor):
         raise NotImplementedError(
             "onebit_all_reduce requires traced tensors (use inside "
             "jit/shard_map)")
     group = _resolve_group(group, tensor)
-    from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+    from deepspeed_tpu.runtime.comm.compressed import (compressed_allreduce,
+                                                       onebit_wire_bytes)
 
+    if comms_logger.enabled:
+        n = _traced_axis_size(group) or _axis_world_size(group)
+        _log_wire_op("onebit_all_reduce", log_name,
+                     onebit_wire_bytes(int(np.prod(tensor.shape)),
+                                       carrier=carrier), n, prof)
     return compressed_allreduce(tensor, error, group, carrier=carrier)
 
 

@@ -90,6 +90,9 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
 
     replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
     dtype: Any = jnp.bfloat16
+    # TPU-native: unified telemetry event stream (same section shape as the
+    # training config's `telemetry` block — runtime/config.TelemetryConfig)
+    telemetry: Dict = {}
     tensor_parallel: DeepSpeedTPConfig = Field(DeepSpeedTPConfig(), alias="tp")
     enable_cuda_graph: bool = False  # accepted; XLA jit-cache supersedes it
     zero: Dict = {}

@@ -242,6 +242,13 @@ class InferenceEngine:
         self._forward_last_fn = None
         self._generate_cache: Dict[Any, Callable] = {}
         self._model_times = []
+        # telemetry: serving-side compile watchdog / HLO cost / memory —
+        # a generate-shape recompile storm is the serving analog of the
+        # training engine's retrace blind spot
+        from deepspeed_tpu.telemetry import Telemetry
+
+        self.telemetry = Telemetry(config.telemetry, name="inference")
+        self._request_count = 0
         log_dist(
             f"InferenceEngine: tp={self.mp_world_size} dtype={config.dtype} "
             f"kernel_inject={config.replace_with_kernel_inject}", ranks=[0])
@@ -378,7 +385,8 @@ class InferenceEngine:
                 return self._logits_of(module.apply(
                     {"params": self._dequantize(params)}, ids))
 
-            self._forward_fn = jax.jit(fwd)
+            self._forward_fn = self.telemetry.watch_jit(
+                jax.jit(fwd), "inference.forward")
         t = self._timer("model_forward")
         t.start()
         out = jax.block_until_ready(self._forward_fn(self.params, input_ids))
@@ -402,7 +410,8 @@ class InferenceEngine:
                 return self._logits_of(module.apply(
                     {"params": self._dequantize(params)}, ids))[:, -1]
 
-            self._forward_last_fn = jax.jit(fwd)
+            self._forward_last_fn = self.telemetry.watch_jit(
+                jax.jit(fwd), "inference.forward_last")
         t = self._timer("model_forward")   # same latency-collection
         t.start()                          # contract as forward()
         out = jax.block_until_ready(
@@ -477,7 +486,12 @@ class InferenceEngine:
             tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
             return tokens
 
-        return jax.jit(generate_fn)
+        return self.telemetry.watch_jit(
+            jax.jit(generate_fn),
+            # full build key in the label (one entry per compiled program);
+            # the bracketed suffix is stripped for watchdog family grouping
+            f"inference.generate[T={prompt_len},new={max_new_tokens},"
+            f"sample={do_sample},k={top_k},p={top_p},padded={padded}]")
 
     def generate(self, input_ids, max_new_tokens: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
@@ -538,6 +552,11 @@ class InferenceEngine:
         new.block_until_ready()
         t.stop()
         self._model_times.append(t.elapsed(reset=True))
+        # request boundary: memory sample / trace window arming (the
+        # block_until_ready above is the fence it piggybacks on)
+        self._request_count += 1
+        self.telemetry.on_step_boundary(self._request_count,
+                                        samples=int(B))
         return np.concatenate([np.asarray(input_ids), np.asarray(new)], axis=1)
 
     # ------------------------------------------------------------------
@@ -555,6 +574,15 @@ class InferenceEngine:
         self._generate_cache.clear()
         self._forward_fn = None
         self._forward_last_fn = None
+
+    def destroy(self):
+        """Release compiled programs and close telemetry (stopping any
+        open trace window — XPlane data is only written on stop; the
+        training engine's ``destroy`` does the same)."""
+        self._generate_cache.clear()
+        self._forward_fn = None
+        self._forward_last_fn = None
+        self.telemetry.close()
 
     def eval(self):
         return self

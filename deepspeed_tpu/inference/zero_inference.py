@@ -149,6 +149,13 @@ class ZeroInferenceEngine:
         self._device = jax.devices()[0]
         self._timer = SynchronizedWallClockTimer()
         self._model_times = []
+        # telemetry: the per-layer programs compile once and stream every
+        # layer through them — a retrace here multiplies by n_layer, which
+        # is exactly what the compile watchdog exists to catch
+        from deepspeed_tpu.telemetry import Telemetry
+
+        self.telemetry = Telemetry(config.telemetry, name="zero_inference")
+        self._request_count = 0
 
         z = config.zero or {}
         off: Dict[str, Any] = dict(z.get("offload_param") or {})
@@ -443,15 +450,20 @@ class ZeroInferenceEngine:
         def plain_block(bp, x):
             return block_fwd.apply({"params": dq(bp)}, x, True)
 
+        tag = f"[B={B},T={T}{',padded' if padded else ''}]"
         fns = {
             "embed": jax.jit(embed),
             "embed_rows": jax.jit(embed_rows),
             "row_positions": jax.jit(row_positions),
             "logits_all": jax.jit(logits_all),
             "logits_last": jax.jit(logits_last),
-            "prefill_block": jax.jit(prefill_block),
-            "decode_block": jax.jit(decode_block, donate_argnums=(1,)),
-            "plain_block": jax.jit(plain_block),
+            "prefill_block": self.telemetry.watch_jit(
+                jax.jit(prefill_block), f"zero_infer.prefill_block{tag}"),
+            "decode_block": self.telemetry.watch_jit(
+                jax.jit(decode_block, donate_argnums=(1,)),
+                f"zero_infer.decode_block{tag}"),
+            "plain_block": self.telemetry.watch_jit(
+                jax.jit(plain_block), f"zero_infer.plain_block{tag}"),
         }
         self._compiled[key] = fns
         return fns
@@ -623,6 +635,10 @@ class ZeroInferenceEngine:
             token = jnp.asarray(nxt)
         t.stop()
         self._model_times.append(t.elapsed(reset=True))
+        # request boundary: the per-token host loop above already syncs
+        # (np.asarray on each sampled token), so the sample is passive
+        self._request_count += 1
+        self.telemetry.on_step_boundary(self._request_count, samples=int(B))
         return np.concatenate(
             [np.asarray(ids)] + [tk[:, None] for tk in tokens], axis=1)
 
@@ -635,6 +651,12 @@ class ZeroInferenceEngine:
     def profile_model_time(self, use_cuda_events=True):
         del use_cuda_events
         self.model_profile_enabled = True
+
+    def destroy(self):
+        """Release the per-shape compiled programs and close telemetry
+        (stopping any open trace window)."""
+        self._compiled.clear()
+        self.telemetry.close()
 
     def eval(self):
         return self

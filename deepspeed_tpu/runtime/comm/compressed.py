@@ -38,6 +38,16 @@ from jax import lax
 CARRIERS = ("packed", "dense")
 
 
+def onebit_wire_bytes(n_elements: int, carrier: str = "packed") -> int:
+    """Per-member collective operand bytes of :func:`compressed_allreduce`
+    — the wire-true size a comms log must record. Packed carrier: the
+    uint8 sign bitfield + one f32 scale per tensor (all-gather operands);
+    dense carrier: the full f32 sign×scale tensor (psum operand)."""
+    if carrier == "packed":
+        return -(-n_elements // 8) + 4
+    return n_elements * 4
+
+
 # ----------------------------------------------------------------------
 # uint8 bitfield packing (jnp.packbits-equivalent via shift/or lanes)
 def pack_signs(x: jnp.ndarray) -> jnp.ndarray:

@@ -35,6 +35,24 @@ from deepspeed_tpu.ops.quantizer import dequantize_chunks, quantize_chunks
 COMM_DTYPES = ("none", "int8", "1bit")
 
 
+def int8_wire_bytes(n_elements: int, axis_size: int,
+                    group_size: int = 1024) -> int:
+    """Per-member collective operand bytes of :func:`int8_allreduce` —
+    the wire-true size a comms log must record (NOT the logical f32
+    size). Mirrors the padding/chunking arithmetic below exactly: scatter
+    leg = full padded int8 tensor + f32 scales, gather leg = one reduced
+    chunk + its scales. The HLO regression test pins this formula against
+    the compiled program's collective operands."""
+    if axis_size <= 1:
+        return 0
+    chunk = -(-n_elements // axis_size)
+    chunk = -(-chunk // group_size) * group_size
+    padded = chunk * axis_size
+    scatter = padded + (padded // group_size) * 4   # all_to_all: q + scales
+    gather = chunk + (chunk // group_size) * 4      # all_gather: q + scales
+    return scatter + gather
+
+
 def int8_allreduce(x, axis_name, axis_size: int, group_size: int = 1024,
                    mean: bool = True):
     """Quantized mean/sum-allreduce of ``x`` over ``axis_name``.

@@ -207,6 +207,63 @@ class CommQuantizationConfig(DeepSpeedConfigModel):
         return self
 
 
+class TelemetryTraceConfig(DeepSpeedConfigModel):
+    """``telemetry.trace``: capture a ``jax.profiler`` XPlane trace for
+    exactly ``num_steps`` optimizer steps starting once ``start_step``
+    steps have completed (``num_steps == 0`` disables the window)."""
+
+    start_step: int = 0
+    num_steps: int = 0
+    dir: str = "./telemetry/trace"
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.start_step < 0 or self.num_steps < 0:
+            raise ValueError("telemetry.trace.start_step/num_steps must be "
+                             ">= 0")
+        return self
+
+
+class TelemetryConfig(DeepSpeedConfigModel):
+    """``telemetry`` section (TPU-native): the unified observability event
+    stream (``deepspeed_tpu/telemetry/``). Four collectors:
+
+    - ``compile_watchdog``: per-jitted-function compile wall time and
+      retrace count, with loud warnings on recompile storms after
+      ``warmup_steps`` (``recompile_warn_after`` recompiles trip it).
+    - ``hlo_cost``: once per compile, FLOPs / per-collective wire bytes /
+      executable memory analysis from the compiled step program.
+    - ``memory``: device memory stats sampled every ``sample_every`` step
+      boundaries, passively (no added host syncs).
+    - ``trace``: config-driven ``jax.profiler`` trace window.
+
+    Events land in a rank-0-gated JSON-lines sink at
+    ``<dir>/telemetry.jsonl`` (``jsonl: false`` keeps collectors live for
+    the monitor bridge only) — render it with
+    ``python tools/telemetry_report.py <path>``.
+    """
+
+    enabled: bool = False
+    dir: str = "./telemetry"
+    jsonl: bool = True
+    compile_watchdog: bool = True
+    hlo_cost: bool = True
+    memory: bool = True
+    sample_every: int = 1
+    warmup_steps: int = 1
+    recompile_warn_after: int = 1
+    trace: TelemetryTraceConfig = Field(default_factory=TelemetryTraceConfig)
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.sample_every <= 0:
+            raise ValueError("telemetry.sample_every must be positive")
+        if self.warmup_steps < 0 or self.recompile_warn_after < 1:
+            raise ValueError("telemetry.warmup_steps must be >= 0 and "
+                             "recompile_warn_after >= 1")
+        return self
+
+
 def _resolve_batch_triangle(train_batch, micro_batch, gas, dp_world_size):
     """Resolve/validate train_batch = micro_batch * gas * dp_world.
 
@@ -305,6 +362,7 @@ class DeepSpeedConfig:
         self.data_types_config = DataTypesConfig(**d.get(C.DATA_TYPES, {}))
         self.comm_quantization = CommQuantizationConfig(
             **d.get("comm_quantization", {}))
+        self.telemetry_config = TelemetryConfig(**d.get("telemetry", {}))
 
         if self.fp16.enabled and self.bf16.enabled:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")

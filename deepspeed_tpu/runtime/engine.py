@@ -420,6 +420,13 @@ class DeepSpeedEngine:
 
         self.monitor = MonitorMaster(self._config.monitor_config)
 
+        # --- telemetry (compile watchdog / HLO cost / memory / trace
+        #     windows — deepspeed_tpu/telemetry) ---
+        from deepspeed_tpu.telemetry import Telemetry
+
+        self.telemetry = Telemetry(self._config.telemetry_config,
+                                   monitor=self.monitor, name="engine")
+
         # --- data-efficiency / PLD / eigenvalue hooks (reference
         #     engine.py:319,365,368,375 optional-feature configuration) ---
         self.progressive_layer_drop = None
@@ -767,10 +774,16 @@ class DeepSpeedEngine:
             return state._replace(params=new_p, opt_state=new_opt, rng=rng,
                                   global_step=state.global_step + 1), loss
 
-        fn = jax.jit(fused,
-                     in_shardings=(shardings, None, replicated(self.mesh)),
-                     out_shardings=(shardings, replicated(self.mesh)),
-                     donate_argnums=(0,))
+        fn = self.telemetry.watch_jit(
+            jax.jit(fused,
+                    in_shardings=(shardings, None, replicated(self.mesh)),
+                    out_shardings=(shardings, replicated(self.mesh)),
+                    donate_argnums=(0,)),
+            # parens, not brackets: the two staged programs (warmup vs
+            # compressed) are INTENTIONALLY distinct — they must not share
+            # a watchdog family or the planned stage change would read as
+            # a recompile storm
+            f"engine.onebit_step({flag_name}={bool(flag)})")
         self._jit_onebit[key] = fn
         return fn
 
@@ -941,11 +954,13 @@ class DeepSpeedEngine:
                 return new_state, loss, overflow, grad_norm
 
             self._jit_micro = None
-            self._jit_fused = jax.jit(
-                fused_step,
-                in_shardings=(shardings, None, rep),
-                out_shardings=(shardings, rep, rep, rep),
-                donate_argnums=(0,))
+            self._jit_fused = self.telemetry.watch_jit(
+                jax.jit(
+                    fused_step,
+                    in_shardings=(shardings, None, rep),
+                    out_shardings=(shardings, rep, rep, rep),
+                    donate_argnums=(0,)),
+                "engine.fused_step")
             return
 
         def micro_step(state: TrainState, batch):
@@ -976,11 +991,13 @@ class DeepSpeedEngine:
             loss = loss_scaled * gas / (state.loss_scale.loss_scale if fp16 else 1.0)
             return state._replace(grad_acc=grad_acc, rng=rng), loss
 
-        self._jit_micro = jax.jit(
-            micro_step,
-            in_shardings=(shardings, None),
-            out_shardings=(shardings, replicated(self.mesh)),
-            donate_argnums=(0,))
+        self._jit_micro = self.telemetry.watch_jit(
+            jax.jit(
+                micro_step,
+                in_shardings=(shardings, None),
+                out_shardings=(shardings, replicated(self.mesh)),
+                donate_argnums=(0,)),
+            "engine.micro_step")
 
     def _compile_steps_apply_only(self):
         """Compile the optimizer-apply program (shared with PipelineEngine)."""
@@ -996,11 +1013,13 @@ class DeepSpeedEngine:
                                                     state.grad_acc),
                     global_step=state.global_step + 1)
 
-            self._jit_offload_commit = jax.jit(
-                zero_grads,
-                in_shardings=(shardings, shardings.params),
-                out_shardings=shardings,
-                donate_argnums=(0,))
+            self._jit_offload_commit = self.telemetry.watch_jit(
+                jax.jit(
+                    zero_grads,
+                    in_shardings=(shardings, shardings.params),
+                    out_shardings=shardings,
+                    donate_argnums=(0,)),
+                "engine.offload_commit")
             return
         fp16 = self.fp16_enabled_
         clip = self._config.gradient_clipping
@@ -1058,11 +1077,14 @@ class DeepSpeedEngine:
             zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
             return new_state._replace(grad_acc=zero_acc), overflow, grad_norm
 
-        self._jit_apply = jax.jit(
-            apply_step,
-            in_shardings=(shardings, replicated(self.mesh)),
-            out_shardings=(shardings, replicated(self.mesh), replicated(self.mesh)),
-            donate_argnums=(0,))
+        self._jit_apply = self.telemetry.watch_jit(
+            jax.jit(
+                apply_step,
+                in_shardings=(shardings, replicated(self.mesh)),
+                out_shardings=(shardings, replicated(self.mesh),
+                               replicated(self.mesh)),
+                donate_argnums=(0,)),
+            "engine.apply_step")
 
     def _shard_batch(self, batch):
         def put(x):
@@ -1103,17 +1125,18 @@ class DeepSpeedEngine:
             # (engine.py:1774,1797); floored at step 2 here so the profiled
             # window never includes XLA compilation of the step programs
             self.flops_profiler.start_profile()
-        if self._onebit:
-            # fused fwd+bwd+compressed-update program, staged on the
-            # optimizer's warmup/compression flag
-            fn = self._get_onebit_fn(*self._onebit_flag())
-            self.state, loss = fn(self.state, batch, self._lr_override())
-        elif self._fused_step:
-            self.state, loss, overflow, grad_norm = self._jit_fused(
-                self.state, batch, self._lr_override())
-            self._fused_meta = (overflow, grad_norm)
-        else:
-            self.state, loss = self._jit_micro(self.state, batch)
+        with self.telemetry.annotation("ds.fwd_bwd"):
+            if self._onebit:
+                # fused fwd+bwd+compressed-update program, staged on the
+                # optimizer's warmup/compression flag
+                fn = self._get_onebit_fn(*self._onebit_flag())
+                self.state, loss = fn(self.state, batch, self._lr_override())
+            elif self._fused_step:
+                self.state, loss, overflow, grad_norm = self._jit_fused(
+                    self.state, batch, self._lr_override())
+                self._fused_meta = (overflow, grad_norm)
+            else:
+                self.state, loss = self._jit_micro(self.state, batch)
         self._last_loss = loss
         if self.wall_clock_breakdown_:
             self.timers(FORWARD_GLOBAL_TIMER).stop()
@@ -1209,20 +1232,22 @@ class DeepSpeedEngine:
         if self.is_gradient_accumulation_boundary():
             if self.wall_clock_breakdown_:
                 self.timers(STEP_GLOBAL_TIMER).start()
-            if self._host_offload:
-                self._host_apply()
-            elif self._onebit:
-                pass  # update applied inside the forward program
-            elif self._fused_step:
-                # optimizer already applied inside the fused forward program
-                if self._fused_meta is not None:
-                    self._last_grad_norm = self._fused_meta[1]
-                    self._last_overflow = self._fused_meta[0]
-            else:
-                self.state, overflow, grad_norm = self._jit_apply(
-                    self.state, self._lr_override())
-                self._last_grad_norm = grad_norm
-                self._last_overflow = overflow
+            with self.telemetry.annotation("ds.optimizer_step"):
+                if self._host_offload:
+                    self._host_apply()
+                elif self._onebit:
+                    pass  # update applied inside the forward program
+                elif self._fused_step:
+                    # optimizer already applied inside the fused forward
+                    # program
+                    if self._fused_meta is not None:
+                        self._last_grad_norm = self._fused_meta[1]
+                        self._last_overflow = self._fused_meta[0]
+                else:
+                    self.state, overflow, grad_norm = self._jit_apply(
+                        self.state, self._lr_override())
+                    self._last_grad_norm = grad_norm
+                    self._last_overflow = overflow
             self.global_steps += 1
             self.global_samples += self.train_batch_size()
             if self.lr_scheduler is not None:
@@ -1245,6 +1270,12 @@ class DeepSpeedEngine:
                     output_file=self._config.flops_profiler_config.output_file)
             if self.wall_clock_breakdown_:
                 self.timers(STEP_GLOBAL_TIMER).stop()
+            # telemetry step boundary: step/memory events + trace-window
+            # arming — passive (reads counters and PJRT stats only; the
+            # timers above already own whatever fences exist here)
+            self.telemetry.on_step_boundary(
+                self.global_steps, samples=self.global_samples,
+                micro_steps=self.micro_steps + 1)
             self._report_progress()
             self.tput_timer.stop(global_step=True)
         else:
@@ -1326,12 +1357,24 @@ class DeepSpeedEngine:
             def eval_loss(params, b):
                 return loss_fn(params, b, rngs=None)
 
-            self._jit_eval = jax.jit(eval_loss,
-                                     in_shardings=(self._state_shardings.params, None),
-                                     out_shardings=replicated(self.mesh))
+            self._jit_eval = self.telemetry.watch_jit(
+                jax.jit(eval_loss,
+                        in_shardings=(self._state_shardings.params, None),
+                        out_shardings=replicated(self.mesh)),
+                "engine.eval_step")
         return self._jit_eval(self.state.params, batch)
 
     def _report_progress(self):
+        if (self.wall_clock_breakdown_
+                and self.global_steps % self.steps_per_print() == 0):
+            # wall_clock_breakdown output routes through the telemetry
+            # stream (the legacy flag keeps its rank-0 log line; with
+            # telemetry enabled the means also land as `wallclock` events)
+            self.telemetry.wallclock(
+                self.timers.get_mean(
+                    [FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                     STEP_GLOBAL_TIMER], reset=True),
+                step=self.global_steps)
         if self.global_steps % self.steps_per_print() == 0:
             lr = self.get_lr()
             loss = float(self._last_loss) if self._last_loss is not None else float("nan")
@@ -1582,6 +1625,7 @@ class DeepSpeedEngine:
         if hasattr(self, "_jit_eval"):
             del self._jit_eval
         self.state = None
+        self.telemetry.close()
 
     # -- thin config getters (reference engine.py:502-883 accessor zoo;
     #    each returns the parsed config value, including knobs that are

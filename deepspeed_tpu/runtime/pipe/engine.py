@@ -344,11 +344,13 @@ class PipelineEngine(DeepSpeedEngine):
             return state._replace(grad_acc=grad_acc, rng=rng), loss
 
         shardings = self._state_shardings
-        self._jit_micro = jax.jit(
-            micro_step,
-            in_shardings=(shardings, None),
-            out_shardings=(shardings, replicated(mesh)),
-            donate_argnums=(0,))
+        self._jit_micro = self.telemetry.watch_jit(
+            jax.jit(
+                micro_step,
+                in_shardings=(shardings, None),
+                out_shardings=(shardings, replicated(mesh)),
+                donate_argnums=(0,)),
+            "pipe.micro_step")
         # reuse the base apply_step (optimizer/clip/loss-scale machinery)
         super()._compile_steps_apply_only()
 
@@ -379,10 +381,12 @@ class PipelineEngine(DeepSpeedEngine):
                                  (jax.tree_util.tree_map(to_micro, inputs),
                                   jax.tree_util.tree_map(to_micro, labels)))
 
-            self._jit_eval = jax.jit(
-                eval_loss,
-                in_shardings=(self._state_shardings.params, None),
-                out_shardings=replicated(self.mesh))
+            self._jit_eval = self.telemetry.watch_jit(
+                jax.jit(
+                    eval_loss,
+                    in_shardings=(self._state_shardings.params, None),
+                    out_shardings=replicated(self.mesh)),
+                "pipe.eval_step")
         return self._jit_eval(self.state.params, batch)
 
     def train_schedule(self, stage_id: int = 0) -> TrainSchedule:

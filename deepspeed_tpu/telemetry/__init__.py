@@ -1,0 +1,25 @@
+"""deepspeed_tpu.telemetry — unified observability event stream.
+
+One structured stream every engine (training, pipeline, inference,
+ZeRO-inference) emits into, carrying the four XLA-native collector
+families the reference's monitor/profiler stack has no analog for:
+compile watchdog, once-per-compile HLO cost accounting, passive device
+memory stats, and config-driven ``jax.profiler`` trace windows. Consumed
+by the JSONL sink (``tools/telemetry_report.py``), ``MonitorMaster``
+(scalar series), and the comms logger (compiled-HLO collective mirrors).
+
+Enable via the ``telemetry`` config block (``runtime/config.py``)::
+
+    {"telemetry": {"enabled": true, "dir": "./telemetry",
+                   "trace": {"start_step": 100, "num_steps": 3,
+                             "dir": "./telemetry/trace"}}}
+"""
+
+from deepspeed_tpu.telemetry import compile_watch  # noqa: F401
+from deepspeed_tpu.telemetry.events import load_events, make_event  # noqa: F401
+from deepspeed_tpu.telemetry.jit_watch import (  # noqa: F401
+    WatchedFunction,
+    compiled_cost_summary,
+)
+from deepspeed_tpu.telemetry.manager import Telemetry  # noqa: F401
+from deepspeed_tpu.telemetry.sink import JsonlSink, MonitorBridge  # noqa: F401

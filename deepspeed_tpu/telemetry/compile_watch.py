@@ -1,0 +1,158 @@
+"""Global compile watchdog: ``jax.monitoring`` listener + attribution.
+
+JAX records every jaxpr trace / MLIR lowering / XLA backend compile
+through ``jax.monitoring.record_event_duration_secs`` (``jax/_src/
+dispatch.py``: ``/jax/core/compile/*``). The listener here is *passive* —
+it only runs when a compile actually happens, costs nothing on the hot
+path, and works for compiles the engines never see (a user's own jits, a
+library's helper programs). ``WatchedFunction`` (``jit_watch.py``) sets a
+label around its lower/compile so durations attribute to the engine entry
+point that triggered them; everything else lands under ``<unlabeled>``.
+
+``install()`` is idempotent and safe to call from benches and tests:
+registration itself adds zero per-dispatch work (the listener list is
+only walked inside compile paths).
+"""
+
+import threading
+import weakref
+from typing import Dict, Optional
+
+_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+_JAXPR_TRACE = "/jax/core/compile/jaxpr_trace_duration"
+_MLIR_LOWER = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+_CACHE_HIT = "/jax/compilation_cache/cache_hits"
+
+_lock = threading.Lock()
+_installed = False
+_label = threading.local()
+
+_counts: Dict[str, float] = {
+    "backend_compiles": 0,
+    "backend_compile_secs": 0.0,
+    "jaxpr_trace_secs": 0.0,
+    "mlir_lower_secs": 0.0,
+    "persistent_cache_hits": 0,
+}
+_by_label: Dict[str, Dict[str, float]] = {}
+_subscribers = []
+
+
+def current_label() -> Optional[str]:
+    return getattr(_label, "value", None)
+
+
+class label_scope:
+    """Attribute compile events fired inside the scope to ``name``."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._prev = current_label()
+        _label.value = self.name
+        return self
+
+    def __exit__(self, *exc):
+        _label.value = self._prev
+        return False
+
+
+def _on_duration(event: str, duration: float, **kwargs):
+    if event == _BACKEND_COMPILE:
+        key = current_label() or "<unlabeled>"
+        with _lock:
+            _counts["backend_compiles"] += 1
+            _counts["backend_compile_secs"] += duration
+            per = _by_label.setdefault(key, {"compiles": 0, "secs": 0.0})
+            per["compiles"] += 1
+            per["secs"] += duration
+        dead = []
+        for ref in list(_subscribers):
+            cb = ref()
+            if cb is None:
+                dead.append(ref)
+                continue
+            try:
+                cb(key, duration)
+            except Exception:
+                pass
+        for ref in dead:
+            try:
+                _subscribers.remove(ref)
+            except ValueError:
+                pass
+    elif event == _JAXPR_TRACE:
+        with _lock:
+            _counts["jaxpr_trace_secs"] += duration
+    elif event == _MLIR_LOWER:
+        with _lock:
+            _counts["mlir_lower_secs"] += duration
+
+
+def _on_event(event: str, **kwargs):
+    if event == _CACHE_HIT:
+        with _lock:
+            _counts["persistent_cache_hits"] += 1
+
+
+def install() -> None:
+    """Register the jax.monitoring listeners (idempotent, passive)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    from jax._src import monitoring
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    monitoring.register_event_listener(_on_event)
+
+
+def subscribe(callback) -> None:
+    """``callback(label, duration_secs)`` on every backend compile.
+
+    Held WEAKLY (``WeakMethod`` for bound methods): a Telemetry instance
+    whose engine was dropped without an explicit ``destroy()``/``close()``
+    must not be pinned alive — and keep appending to its sink — for the
+    rest of the process just because it once subscribed."""
+    install()
+    try:
+        ref = weakref.WeakMethod(callback)
+    except TypeError:
+        ref = weakref.ref(callback)
+    _subscribers.append(ref)
+
+
+def unsubscribe(callback) -> None:
+    for ref in list(_subscribers):
+        cb = ref()
+        # bound-method equality (same __self__ and __func__), not identity:
+        # WeakMethod() rebuilds a fresh bound method on every deref
+        if cb is None or cb == callback:
+            try:
+                _subscribers.remove(ref)
+            except ValueError:
+                pass
+
+
+def is_primary(callback) -> bool:
+    """True when ``callback`` is the first LIVE subscriber — the one
+    designated to report ``<unlabeled>`` compiles. With several
+    telemetry-enabled engines in one process, every instance hears every
+    unlabeled compile; only the primary emits/warns, or a shared sink
+    would double-count them (the role falls over automatically when the
+    primary is closed or collected)."""
+    for ref in _subscribers:
+        cb = ref()
+        if cb is not None:
+            return cb == callback
+    return False
+
+
+def snapshot() -> Dict:
+    """Copy of the global counters + per-label attribution so far."""
+    with _lock:
+        return {**{k: (int(v) if isinstance(v, int) else round(v, 6))
+                   for k, v in _counts.items()},
+                "by_label": {k: dict(v) for k, v in _by_label.items()}}

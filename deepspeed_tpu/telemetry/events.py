@@ -1,0 +1,87 @@
+"""Telemetry event model.
+
+One event = one JSON-serializable dict with a fixed envelope::
+
+    {"ts": <unix seconds>, "kind": <family>, "name": <emitter>,
+     "step": <global step or None>, "rank": <process index>, "data": {...}}
+
+The four collector families the unified stream carries (plus the
+satellite families that ride the same sink):
+
+- ``compile``      — per-jitted-function compile wall time / retrace marks
+                     (compile watchdog)
+- ``step_cost``    — once-per-compile static cost model: FLOPs, collective
+                     wire bytes, executable memory analysis
+- ``memory``       — device/host memory stats sampled at step boundaries
+- ``trace_window`` — jax.profiler trace start/stop markers
+- ``step``         — step-boundary counters (samples, micro steps)
+- ``wallclock``    — wall_clock_breakdown timer means (legacy flag routed
+                     through the stream)
+- ``comm``         — facade-level collective log mirrors
+
+Everything in ``data`` must be JSON-safe; :func:`json_safe` coerces numpy
+scalars and drops device arrays (an event must never pin or sync device
+buffers — the stream is passive by contract).
+"""
+
+import json
+import time
+from typing import Any, Dict, Optional
+
+KINDS = ("compile", "step_cost", "memory", "trace_window", "step",
+         "wallclock", "comm")
+
+
+def json_safe(value: Any):
+    """Coerce ``value`` to something ``json.dumps`` accepts: numpy/jax
+    scalars via ``.item()``, sets/tuples to lists, everything else that
+    fails a probe to ``repr``. Never calls ``float()`` on a device array
+    of nonzero rank (that would be a hidden device sync on a live
+    computation) — those become their repr."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, dict):
+        return {str(k): json_safe(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple, set, frozenset)):
+        return [json_safe(v) for v in value]
+    shape = getattr(value, "shape", None)
+    if shape == () and hasattr(value, "item"):
+        try:
+            return value.item()
+        except Exception:
+            return repr(value)
+    return repr(value)
+
+
+def make_event(kind: str, name: str, step: Optional[int], rank: int,
+               data: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "ts": round(time.time(), 6),
+        "kind": kind,
+        "name": name,
+        "step": None if step is None else int(step),
+        "rank": int(rank),
+        "data": json_safe(data or {}),
+    }
+
+
+def dumps(event: Dict[str, Any]) -> str:
+    return json.dumps(event, separators=(",", ":"), sort_keys=False)
+
+
+def load_events(path: str):
+    """Parse a JSONL sink file back into event dicts (report-tool side).
+    Malformed lines — a truncated tail from a crash, or an interleaved
+    partial line from concurrent writers — are skipped, not treated as
+    end-of-file: everything parseable after them still counts."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out

@@ -1,0 +1,185 @@
+"""Watched jitted functions: compile timing + once-per-compile cost model.
+
+With telemetry enabled, the engines route their hot-path jits through
+:class:`WatchedFunction` instead of dispatching the raw ``pjit`` wrapper.
+The wrapper compiles ahead-of-time (``fn.lower(*args).compile()``) on the
+first call for each argument signature, which yields exactly the handle
+implicit dispatch never exposes: the **compiled executable**, whose
+``cost_analysis()`` (FLOPs, bytes accessed), ``memory_analysis()``
+(argument/output/temp bytes — peak HBM picture on TPU), and optimized HLO
+text (per-collective wire bytes via ``utils/hlo_inspect`` — the same
+parser the comm-quantization regression tests and ``tools/
+perf_comm_wire.py`` trust) become telemetry events. Subsequent calls
+dispatch the cached executable, so the program XLA runs is the SAME one
+the raw jit would run — the zero-overhead guard test proves the optimized
+HLO is byte-identical with telemetry on, off, and absent.
+
+A new signature after warmup is a **retrace**: the watchdog emits a
+``compile`` event with ``retrace: true`` and, past the configured
+threshold, warns loudly (a recompile storm silently eating a production
+run's step time is the #1 XLA blind spot this subsystem exists for).
+
+If AOT lowering fails for any reason the wrapper falls back to the raw
+function permanently for that instance — telemetry must never break a
+step that would otherwise run.
+"""
+
+import time
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.telemetry import compile_watch
+from deepspeed_tpu.utils.hlo_inspect import parse_collectives
+from deepspeed_tpu.utils.logging import logger
+
+
+def _signature(args, kwargs):
+    """Dispatch-cache key: treedef + per-leaf (shape, dtype, weak_type,
+    sharding). Kept deliberately cheap — this runs on every watched call,
+    so no string formatting or aval construction. Sharding is part of the
+    key because an AOT executable (unlike implicit jit, which would just
+    recompile) REJECTS inputs committed differently than it was compiled
+    for. Python scalars key by type only (jit traces every value of a
+    type to the same weak-typed aval)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((shape, dtype, getattr(leaf, "weak_type", False),
+                        getattr(leaf, "sharding", None)))
+        else:
+            sig.append(("py", type(leaf)))
+    return (treedef, tuple(sig))
+
+
+def compiled_cost_summary(compiled, hlo_text: Optional[str] = None) -> Dict:
+    """Static cost model of a compiled executable: FLOPs + bytes accessed
+    (XLA cost analysis), executable memory analysis, and per-collective
+    operand bytes read out of the optimized HLO."""
+    out: Dict[str, Any] = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            for src, dst in (("flops", "flops"),
+                             ("bytes accessed", "bytes_accessed"),
+                             ("transcendentals", "transcendentals")):
+                if src in ca:
+                    out[dst] = float(ca[src])
+    except Exception as e:  # pragma: no cover - backend-dependent
+        out["cost_analysis_error"] = str(e)[:200]
+    try:
+        ma = compiled.memory_analysis()
+        for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "alias_size_in_bytes",
+                      "generated_code_size_in_bytes"):
+            v = getattr(ma, field, None)
+            if v is not None:
+                out[field] = int(v)
+        # best per-backend peak proxy: args + temps (aliases subtracted --
+        # donated buffers are not double-counted)
+        if "temp_size_in_bytes" in out:
+            out["peak_bytes_estimate"] = (
+                out.get("argument_size_in_bytes", 0)
+                + out.get("output_size_in_bytes", 0)
+                + out["temp_size_in_bytes"]
+                - out.get("alias_size_in_bytes", 0))
+    except Exception as e:  # pragma: no cover - backend-dependent
+        out["memory_analysis_error"] = str(e)[:200]
+    if hlo_text is not None:
+        per_op: Dict[str, Dict] = {}
+        total = 0
+        for coll in parse_collectives(hlo_text):
+            entry = per_op.setdefault(
+                coll["op"], {"count": 0, "operand_bytes": 0, "dtypes": set()})
+            entry["count"] += 1
+            entry["operand_bytes"] += coll["operand_bytes"]
+            entry["dtypes"].update(d for d, _ in coll["operands"])
+            total += coll["operand_bytes"]
+        out["collectives"] = {
+            op: {"count": v["count"], "operand_bytes": v["operand_bytes"],
+                 "dtypes": sorted(v["dtypes"])}
+            for op, v in sorted(per_op.items())}
+        out["collective_operand_bytes"] = total
+    return out
+
+
+class WatchedFunction:
+    """AOT-dispatching wrapper around one jitted function (module
+    docstring). Attribute access falls through to the wrapped jit, so
+    ``.lower(...)``-style introspection keeps working."""
+
+    def __init__(self, fn, name: str, telemetry):
+        self._fn = fn
+        self.name = name
+        self._telemetry = telemetry
+        self._cache: Dict[Any, Any] = {}
+        self._fallback = False
+        self.compiles = 0
+
+    def __getattr__(self, item):
+        if item == "_fn":  # not yet in __dict__ (copy/pickle protocols)
+            raise AttributeError(item)
+        return getattr(self._fn, item)
+
+    def __call__(self, *args, **kwargs):
+        if self._fallback:
+            return self._fn(*args, **kwargs)
+        key = _signature(args, kwargs)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(args, kwargs, key)
+            if compiled is None:  # AOT unsupported here; raw jit from now on
+                return self._fn(*args, **kwargs)
+        try:
+            return compiled(*args, **kwargs)
+        except (ValueError, TypeError) as e:
+            # input-VALIDATION rejections only (raised before execution,
+            # donated buffers untouched): anything the AOT executable
+            # refuses that implicit jit would transparently recompile for
+            # (an input sharding/layout the key missed) degrades to the
+            # raw jit instead of crashing the step. Execution-time errors
+            # (XlaRuntimeError) propagate — re-running them could touch
+            # already-consumed donated buffers.
+            logger.warning(
+                f"telemetry: AOT dispatch of {self.name!r} rejected inputs "
+                f"({e}); falling back to implicit jit dispatch")
+            self._fallback = True
+            return self._fn(*args, **kwargs)
+
+    # ------------------------------------------------------------------
+    def _compile(self, args, kwargs, key):
+        tele = self._telemetry
+        try:
+            with compile_watch.label_scope(self.name):
+                t0 = time.perf_counter()
+                lowered = self._fn.lower(*args, **kwargs)
+                t1 = time.perf_counter()
+                compiled = lowered.compile()
+                t2 = time.perf_counter()
+        except Exception as e:
+            logger.warning(
+                f"telemetry: AOT compile of {self.name!r} failed ({e}); "
+                "falling back to implicit jit dispatch for this function")
+            self._fallback = True
+            return None
+        self.compiles += 1
+        self._cache[key] = compiled
+        if tele is not None:
+            # retrace accounting is family-scoped and lives in the
+            # manager: distinct WatchedFunction instances for drifting
+            # shapes (a serving engine's per-shape generate programs) must
+            # count against ONE watchdog family or a storm never trips
+            try:
+                tele.record_compile(self, trace_secs=t1 - t0,
+                                    compile_secs=t2 - t1, compiled=compiled)
+            except Exception as e:
+                # bookkeeping (sink write, as_text, cost analysis) must
+                # never abort the step the executable is about to run
+                logger.warning(f"telemetry: recording compile of "
+                               f"{self.name!r} failed ({e}); event dropped")
+        return compiled

@@ -1,0 +1,343 @@
+"""Telemetry manager — the per-engine facade over the four collectors.
+
+Construction is cheap and disabled-by-default: a disabled ``Telemetry``
+is a handful of attribute reads on the hot path (``watch_jit`` returns
+the raw jit unchanged, ``on_step_boundary`` is a single bool check), and
+the engines' compiled step programs are untouched either way (the
+zero-overhead guard test in ``tests/unit/test_telemetry.py`` asserts the
+optimized HLO is byte-identical).
+
+Collectors (tentpole contract, ISSUE 2):
+
+1. **compile watchdog** — ``compile_watch`` global listener + per-engine
+   :class:`~deepspeed_tpu.telemetry.jit_watch.WatchedFunction` wrappers;
+   warns loudly on recompile storms after warmup.
+2. **static step-cost accounting** — once per compile, FLOPs / collective
+   wire bytes / executable memory analysis from the compiled executable
+   (``jit_watch.compiled_cost_summary``), mirrored into the comms logger
+   when that is enabled.
+3. **device memory stats** — sampled at step boundaries through the
+   accelerator abstraction; passive (no added host syncs — it piggybacks
+   on the fences the step boundary already has).
+4. **trace windows** — config-driven ``jax.profiler`` start/stop around
+   exactly ``num_steps`` steps, with markers in the event stream.
+"""
+
+import contextlib
+import os
+from typing import Dict, Optional
+
+from deepspeed_tpu.telemetry import compile_watch
+from deepspeed_tpu.telemetry.events import make_event
+from deepspeed_tpu.telemetry.jit_watch import (WatchedFunction,
+                                               compiled_cost_summary)
+from deepspeed_tpu.telemetry.sink import JsonlSink, MonitorBridge
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def _as_config(config):
+    """Accept a parsed TelemetryConfig, a raw dict, or None."""
+    if config is None:
+        config = {}
+    if isinstance(config, dict):
+        from deepspeed_tpu.runtime.config import TelemetryConfig
+
+        config = TelemetryConfig(**config)
+    return config
+
+
+class Telemetry:
+    def __init__(self, config=None, monitor=None, name: str = "engine"):
+        self.config = _as_config(config)
+        self.enabled = bool(self.config.enabled)
+        self.name = name
+        self.warm = False
+        self._sink: Optional[JsonlSink] = None
+        self._bridge: Optional[MonitorBridge] = None
+        self._compile_totals: Dict[str, Dict] = {}
+        self._steps_seen = 0
+        self._peak_bytes_seen = 0
+        self._tracing = False
+        self._trace_done = False
+        self._trace_count = 0
+        self._unlabeled_after_warm = 0
+        self._storm_warned = set()
+        if not self.enabled:
+            return
+        try:
+            import jax
+
+            self._rank = jax.process_index()
+        except Exception:
+            self._rank = 0
+        if self.config.jsonl:
+            self._sink = JsonlSink(
+                os.path.join(self.config.dir, "telemetry.jsonl"))
+        self._bridge = MonitorBridge(monitor)
+        if self.config.compile_watchdog:
+            compile_watch.subscribe(self._on_global_compile)
+
+    # ------------------------------------------------------------------
+    # event plumbing
+    def emit(self, kind: str, name: str, step: Optional[int] = None,
+             data: Optional[Dict] = None, **fields):
+        if not self.enabled:
+            return
+        payload = dict(data or {})
+        payload.update(fields)
+        event = make_event(kind, name, step, getattr(self, "_rank", 0),
+                           payload)
+        if self._sink is not None:
+            self._sink.write(event)
+        if self._bridge is not None:
+            self._bridge.write(event)
+
+    # ------------------------------------------------------------------
+    # collector 1+2: compile watchdog + static step-cost accounting
+    def watch_jit(self, fn, name: str):
+        """Route a jitted hot path through the watchdog; identity when
+        telemetry (or the watchdog+cost collectors) is off."""
+        if not self.enabled or not (self.config.compile_watchdog
+                                    or self.config.hlo_cost):
+            return fn
+        # deliberately NOT retained here: the engine's reference is the
+        # only owner, so its release paths (destroy, load_checkpoint,
+        # cache clears) actually free the wrapped compiled executables
+        return WatchedFunction(fn, name, self)
+
+    @staticmethod
+    def _family(name: str) -> str:
+        """Watchdog grouping key: the program name minus any bracketed
+        shape suffix. Drifting-shape instances of one entry point (a
+        serving engine's ``inference.generate[T=...]`` programs) are
+        distinct WatchedFunctions but ONE family — without this a
+        request-shape recompile storm would never trip the watchdog,
+        because every shape's instance sees exactly one compile."""
+        return name.split("[", 1)[0]
+
+    def record_compile(self, watched: WatchedFunction, *, trace_secs: float,
+                       compile_secs: float, compiled):
+        name = watched.name
+        family = self._family(name)
+        totals = self._compile_totals.setdefault(
+            family, {"compiles": 0, "trace_secs": 0.0, "compile_secs": 0.0,
+                     "retraces_after_warm": 0})
+        retrace = totals["compiles"] > 0
+        totals["compiles"] += 1
+        totals["trace_secs"] += trace_secs
+        totals["compile_secs"] += compile_secs
+        if retrace and self.warm:
+            totals["retraces_after_warm"] += 1
+        if self.config.compile_watchdog:
+            self.emit("compile", name, step=self._steps_seen,
+                      trace_secs=round(trace_secs, 6),
+                      compile_secs=round(compile_secs, 6),
+                      n_compiles=totals["compiles"], retrace=retrace,
+                      after_warmup=self.warm)
+            if (retrace and self.warm and totals["retraces_after_warm"]
+                    >= self.config.recompile_warn_after
+                    and family not in self._storm_warned):
+                self._storm_warned.add(family)
+                logger.warning(
+                    f"telemetry: RECOMPILE STORM — {family!r} has "
+                    f"recompiled {totals['retraces_after_warm']}x after "
+                    f"warmup (latest: {name!r}, trace {trace_secs:.2f}s + "
+                    f"backend {compile_secs:.2f}s). Shapes or static "
+                    "arguments are changing across steps; every occurrence "
+                    "stalls the pipeline for the full compile time.")
+        if self.config.hlo_cost:
+            try:
+                hlo_text = compiled.as_text()
+            except Exception:
+                hlo_text = None
+            cost = compiled_cost_summary(compiled, hlo_text)
+            self.emit("step_cost", name, step=self._steps_seen, **cost)
+            self._mirror_to_comms_logger(name, cost)
+
+    def _mirror_to_comms_logger(self, name: str, cost: Dict):
+        """Compiled-HLO collectives next to the facade-level ops in
+        ``comm.log_summary()`` — the cross-reference the comms logger
+        could never make alone (it sees trace-time requests; this is what
+        XLA actually scheduled on the wire)."""
+        from deepspeed_tpu.comm.comm import comms_logger, get_world_size
+
+        if not comms_logger.enabled:
+            return
+        try:
+            world = get_world_size()
+        except Exception:
+            world = 1
+        for op, entry in (cost.get("collectives") or {}).items():
+            comms_logger.append(
+                op.replace("-", "_"), f"hlo:{name}:{op}", 0.0,
+                entry["operand_bytes"], world)
+
+    def _on_global_compile(self, label: str, duration: float):
+        if label != "<unlabeled>":
+            return  # watched fns emit their own, richer compile events
+        if not compile_watch.is_primary(self._on_global_compile):
+            return  # one reporter per process, or shared sinks double-count
+        self.emit("compile", "<unlabeled>", step=self._steps_seen,
+                  compile_secs=round(duration, 6), after_warmup=self.warm)
+        if self.warm:
+            self._unlabeled_after_warm += 1
+            if (self._unlabeled_after_warm
+                    == self.config.recompile_warn_after):
+                logger.warning(
+                    "telemetry: compiles are still happening after warmup "
+                    "outside the watched engine entry points "
+                    f"({self._unlabeled_after_warm} so far, latest "
+                    f"{duration:.2f}s) — some helper computation retraces "
+                    "every step")
+
+    # ------------------------------------------------------------------
+    # collector 3: device memory stats (passive)
+    def _sample_memory(self, step: int):
+        try:
+            from deepspeed_tpu.accelerator import get_accelerator
+
+            dev = get_accelerator().memory_stats()
+        except Exception as e:
+            self.emit("memory", self.name, step=step, error=str(e)[:200])
+            return
+        data = {k: dev[k] for k in ("bytes_in_use", "peak_bytes_in_use",
+                                    "bytes_limit", "source") if k in dev}
+        try:
+            import psutil
+
+            data["host_rss_bytes"] = int(
+                psutil.Process().memory_info().rss)
+        except Exception:
+            pass
+        self._peak_bytes_seen = max(self._peak_bytes_seen,
+                                    int(data.get("peak_bytes_in_use", 0)))
+        self.emit("memory", self.name, step=step, **data)
+
+    # ------------------------------------------------------------------
+    # collector 4: config-driven jax.profiler trace windows
+    def _maybe_trace(self, step: int):
+        """Boundary-counted window: the capture starts at the first
+        boundary with ``step >= start_step`` and stops after ``num_steps``
+        further boundaries — so exactly ``num_steps`` steps are traced
+        regardless of where in the schedule the run is observed (incl.
+        ``start_step: 0``, where boundaries are 1-indexed)."""
+        tr = self.config.trace
+        if tr.num_steps <= 0 or self._trace_done:
+            return
+        if not self._tracing and step > max(tr.start_step, 1):
+            # the configured start boundary was never observed (checkpoint
+            # resume past it, or skipped boundaries): capturing now would
+            # trace steps outside the window while the markers claim the
+            # configured one — record the miss instead
+            self._trace_done = True
+            self.emit("trace_window", self.name, step=step, action="missed",
+                      start_step=tr.start_step, num_steps=tr.num_steps)
+            return
+        if self._tracing:
+            self._trace_count += 1
+            if self._trace_count < tr.num_steps:
+                return
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+                self.emit("trace_window", self.name, step=step,
+                          action="stop", dir=tr.dir,
+                          num_steps=tr.num_steps)
+                log_dist(f"telemetry: stopped jax.profiler trace after "
+                         f"{tr.num_steps} step(s) -> {tr.dir}", ranks=[0])
+            except Exception as e:
+                self.emit("trace_window", self.name, step=step,
+                          action="stop_failed", error=str(e)[:200])
+            self._tracing = False
+            self._trace_done = True
+        elif not self._tracing and step >= tr.start_step:
+            try:
+                import jax
+
+                os.makedirs(tr.dir, exist_ok=True)
+                jax.profiler.start_trace(tr.dir)
+                self._tracing = True
+                self._trace_count = 0
+                self.emit("trace_window", self.name, step=step,
+                          action="start", dir=tr.dir,
+                          start_step=tr.start_step, num_steps=tr.num_steps)
+                log_dist(f"telemetry: jax.profiler trace started at step "
+                         f"{step} for {tr.num_steps} step(s) -> {tr.dir}",
+                         ranks=[0])
+            except Exception as e:
+                self._trace_done = True
+                self.emit("trace_window", self.name, step=step,
+                          action="start_failed", error=str(e)[:200])
+
+    def annotation(self, name: str):
+        """Profiler range for a host-side phase (the ``instrument_w_nvtx``
+        analog): visible in the XPlane trace the window captures."""
+        if not self.enabled or self.config.trace.num_steps <= 0:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+
+    # ------------------------------------------------------------------
+    # step-boundary hook (one call per optimizer step, from the engines)
+    def on_step_boundary(self, global_step: int, samples: Optional[int] = None,
+                         micro_steps: Optional[int] = None):
+        if not self.enabled:
+            return
+        step = int(global_step)
+        self._steps_seen = step
+        if not self.warm and step >= self.config.warmup_steps:
+            self.warm = True
+        self.emit("step", self.name, step=step, samples=samples,
+                  micro_steps=micro_steps)
+        if (self.config.memory
+                and step % max(1, self.config.sample_every) == 0):
+            self._sample_memory(step)
+        self._maybe_trace(step)
+
+    # ------------------------------------------------------------------
+    # wall_clock_breakdown (legacy flag routed through the stream)
+    def wallclock(self, means_ms: Dict[str, float],
+                  step: Optional[int] = None):
+        """Timer means (ms) at a report boundary. Always prints the legacy
+        rank-0 line (the ``wall_clock_breakdown`` contract predates
+        telemetry); additionally lands in the event stream when telemetry
+        is enabled."""
+        if not means_ms:
+            return
+        line = " | ".join(f"{k}: {v:.2f}" for k, v in means_ms.items())
+        log_dist(f"time (ms) | {line}", ranks=[0])
+        # data= keeps timer names (e.g. "step") out of emit's kwargs
+        self.emit("wallclock", self.name, step=step,
+                  data={k: round(float(v), 4) for k, v in means_ms.items()})
+
+    # ------------------------------------------------------------------
+    def summary(self) -> Dict:
+        """Aggregates for benches / reports: per-fn compile totals, global
+        compile counters, peak device bytes seen."""
+        return {
+            "per_function": {k: dict(v)
+                             for k, v in self._compile_totals.items()},
+            "global": compile_watch.snapshot(),
+            "peak_bytes_in_use": self._peak_bytes_seen,
+            "steps": self._steps_seen,
+        }
+
+    def flush(self):
+        if self._sink is not None:
+            self._sink.flush()
+
+    def close(self):
+        if self._tracing:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._tracing = False
+        if self.enabled and self.config.compile_watchdog:
+            compile_watch.unsubscribe(self._on_global_compile)
+        if self._sink is not None:
+            self._sink.close()

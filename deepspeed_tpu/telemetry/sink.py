@@ -1,0 +1,124 @@
+"""Telemetry sinks: rank-0-gated JSON-lines file + monitor bridge.
+
+The JSONL sink is the durable artifact ``tools/telemetry_report.py``
+consumes; the monitor bridge forwards numeric telemetry scalars into the
+existing ``MonitorMaster`` fan-out (tb/wandb/csv) so telemetry series land
+next to the training curves without a second writer stack.
+"""
+
+import os
+from typing import Optional
+
+from deepspeed_tpu.telemetry.events import dumps
+from deepspeed_tpu.utils.logging import logger
+
+
+def _rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+# sink paths already opened by THIS process: the first open of a path
+# truncates (a re-run must not append to the previous run's events —
+# telemetry_report would silently aggregate two runs into one table);
+# later opens of the same path in the same process append (several
+# engines sharing one dir produce one combined stream)
+_OPENED_PATHS = set()
+
+
+class JsonlSink:
+    """JSONL writer, active on process 0 only (the same rank-0 gating the
+    monitor writers use). Truncate-per-run (see ``_OPENED_PATHS``); opens
+    lazily and line-buffers so a crash loses at most the in-flight line."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.enabled = _rank() == 0
+        self._f = None
+        if self.enabled:
+            try:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            except OSError as e:
+                logger.warning(f"telemetry: cannot create sink dir for "
+                               f"{path!r} ({e}); JSONL sink disabled")
+                self.enabled = False
+
+    def write(self, event: dict):
+        if not self.enabled:
+            return
+        if self._f is None:
+            mode = "a" if self.path in _OPENED_PATHS else "w"
+            try:
+                self._f = open(self.path, mode, buffering=1)
+                _OPENED_PATHS.add(self.path)
+            except OSError as e:
+                logger.warning(f"telemetry: cannot open {self.path!r} "
+                               f"({e}); JSONL sink disabled")
+                self.enabled = False
+                return
+        try:
+            self._f.write(dumps(event) + "\n")
+        except OSError as e:  # disk full mid-run: disable, never raise
+            logger.warning(f"telemetry: write to {self.path!r} failed "
+                           f"({e}); JSONL sink disabled")
+            self.close()
+
+    def flush(self):
+        if self._f is not None:
+            self._f.flush()
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        # a closed sink stays closed — late events (e.g. another engine's
+        # compiles fanning out through the global watchdog) must not
+        # silently reopen the file
+        self.enabled = False
+
+
+# numeric fields worth mirroring into the monitor writers, per event kind
+# (full events always go to the JSONL sink; the monitor gets the scalar
+# series a dashboard actually plots)
+_MONITOR_FIELDS = {
+    "memory": ("bytes_in_use", "peak_bytes_in_use", "host_rss_bytes"),
+    "compile": ("compile_secs", "trace_secs"),
+    "wallclock": None,  # every timer mean
+    "step_cost": ("flops", "collective_operand_bytes",
+                  "temp_size_in_bytes"),
+}
+
+
+class MonitorBridge:
+    """Forward telemetry events to a ``MonitorMaster`` as
+    ``(tag, value, step)`` scalars under the ``Telemetry/`` namespace."""
+
+    def __init__(self, monitor):
+        self.monitor = monitor
+
+    @property
+    def enabled(self) -> bool:
+        return self.monitor is not None and getattr(self.monitor, "enabled",
+                                                    False)
+
+    def write(self, event: dict):
+        if not self.enabled or event["kind"] not in _MONITOR_FIELDS:
+            return
+        step = event.get("step")
+        if step is None:
+            return
+        fields = _MONITOR_FIELDS[event["kind"]]
+        data = event.get("data", {})
+        items = data.items() if fields is None else (
+            (k, data[k]) for k in fields if k in data)
+        out = []
+        for key, value in items:
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                out.append((f"Telemetry/{event['kind']}/{key}",
+                            float(value), step))
+        if out:
+            self.monitor.write_events(out)

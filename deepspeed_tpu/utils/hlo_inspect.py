@@ -16,11 +16,6 @@ from typing import Dict, List, Optional, Sequence
 COLLECTIVE_OPS = ("all-reduce", "all-gather", "all-to-all",
                   "reduce-scatter", "collective-permute")
 
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
-}
-
 # `u8[8,513]{1,0}` — dtype + dims (scalar shapes print as `f32[]`)
 _SHAPE_RE = re.compile(r"\b(pred|[suf]\d+|bf16)\[([\d,]*)\]")
 _OP_RE = re.compile(
@@ -28,12 +23,23 @@ _OP_RE = re.compile(
     r")(?:-start)?\(")
 
 
+def _dtype_bits(dtype: str) -> int:
+    """Bit width from the HLO dtype name: the trailing digits ARE the
+    width (s4 → 4, u8 → 8, f32 → 32, bf16 → 16), so sub-byte types a
+    future int4 wire would put in a collective never KeyError here.
+    ``pred`` packs as one byte in HLO buffers."""
+    if dtype == "pred":
+        return 8
+    m = re.search(r"(\d+)$", dtype)
+    return int(m.group(1)) if m else 32
+
+
 def _shape_bytes(dtype: str, dims: str) -> int:
     n = 1
     for d in dims.split(","):
         if d:
             n *= int(d)
-    return n * _DTYPE_BYTES[dtype]
+    return (n * _dtype_bits(dtype) + 7) // 8
 
 
 def parse_collectives(hlo_text: str) -> List[Dict]:

@@ -126,6 +126,90 @@ class TestCollectiveParity:
 
 
 # ----------------------------------------------------------------------
+# wire-true comms logging (ISSUE 2 satellite): the comms logger records
+# the PACKED sizes (uint8 + scales), not the logical f32 size, so
+# compressed and dense collectives are comparable in one log
+class TestWireTrueCommsLog:
+    def test_compressed_ops_log_wire_bytes(self):
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.parallel import topology as topo_mod
+        from deepspeed_tpu.runtime.comm.compressed import onebit_wire_bytes
+        from deepspeed_tpu.runtime.comm.quantized import int8_wire_bytes
+
+        reset_topology()
+        topo = MeshTopology(axis_sizes={"data": 8},
+                            devices=jax.devices()[:8])
+        topo_mod.set_topology(topo)
+        logger = dist.comms_logger
+        saved = (logger.enabled, logger.prof_all, dict(logger.comms_dict))
+        logger.enabled, logger.prof_all = True, True
+        logger.comms_dict.clear()
+        n = 8192
+        try:
+            def f(v, e):
+                avg = dist.quantized_all_reduce(v, group="data",
+                                                comm_dtype="int8")
+                ob, ne = dist.onebit_all_reduce(v, e, group="data")
+                return avg, ob, ne
+
+            sm = shard_map(f, mesh=topo.mesh, in_specs=(P(), P()),
+                           out_specs=(P(), P(), P()), check_vma=False)
+            jax.jit(sm).lower(jnp.ones((n,), jnp.float32),
+                              jnp.zeros((n,), jnp.float32))
+            d = dict(logger.comms_dict)
+        finally:
+            logger.enabled, logger.prof_all = saved[0], saved[1]
+            logger.comms_dict.clear()
+            logger.comms_dict.update(saved[2])
+            reset_topology()
+        q_sizes = list(d["quantized_all_reduce(traced)"])
+        assert q_sizes == [int8_wire_bytes(n, 8, group_size=1024)]
+        o_sizes = list(d["onebit_all_reduce(traced)"])
+        assert o_sizes == [onebit_wire_bytes(n)]
+        # wire-true means FAR below the logical f32 size
+        assert q_sizes[0] < n * 4 / 3
+        assert o_sizes[0] < n * 4 / 30
+
+    def test_int8_wire_formula_matches_compiled_hlo(self):
+        """The logged formula and the compiled program cannot disagree:
+        sum of ALL collective operand bytes in the int8 allreduce HLO ==
+        ``int8_wire_bytes``."""
+        from deepspeed_tpu.runtime.comm.quantized import int8_wire_bytes
+
+        n = 8192
+        mesh = _mesh()
+
+        def f(v):
+            return int8_allreduce(v.reshape(n), "data", 8, group_size=1024)
+
+        hlo = jax.jit(shard_map(f, mesh=mesh, in_specs=P("data"),
+                                out_specs=P(), check_vma=False)).lower(
+            jax.ShapeDtypeStruct((8, n), jnp.float32)).compile().as_text()
+        total = sum(c["operand_bytes"] for c in parse_collectives(hlo))
+        assert total == int8_wire_bytes(n, 8, group_size=1024)
+
+    def test_onebit_wire_formula_matches_compiled_hlo(self):
+        from deepspeed_tpu.runtime.comm.compressed import onebit_wire_bytes
+
+        n = 8192
+        mesh = _mesh()
+
+        def f(v, e):
+            avg, ne = compressed_allreduce(v.reshape(n), e.reshape(n),
+                                           "data", carrier="packed")
+            return avg, ne.reshape(1, n)
+
+        hlo = jax.jit(shard_map(f, mesh=mesh,
+                                in_specs=(P("data"), P("data")),
+                                out_specs=(P(), P("data")),
+                                check_vma=False)).lower(
+            jax.ShapeDtypeStruct((8, n), jnp.float32),
+            jax.ShapeDtypeStruct((8, n), jnp.float32)).compile().as_text()
+        total = sum(c["operand_bytes"] for c in parse_collectives(hlo))
+        assert total == onebit_wire_bytes(n)
+
+
+# ----------------------------------------------------------------------
 # bucketing
 class TestBucketing:
     def test_bucket_by_bytes_reverse_walk(self):

@@ -1,0 +1,325 @@
+"""Unified telemetry subsystem (ISSUE 2).
+
+Proof obligations:
+
+- a telemetry-enabled run emits all four collector families (compile,
+  step_cost, memory, trace_window) into the JSONL sink, and
+  ``tools/telemetry_report.py`` renders it;
+- **zero-overhead guard**: with telemetry disabled (the default) the
+  engine's compiled step HLO is byte-identical to a config with no
+  telemetry section at all AND to the telemetry-enabled engine's
+  executable (the wrapper changes dispatch, never the program), and no
+  additional host syncs are introduced;
+- the compile watchdog counts retraces and warns loudly on a post-warmup
+  recompile storm;
+- the serving tier carries the same stream.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.topology import reset_topology
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, TelemetryConfig
+from deepspeed_tpu.telemetry import Telemetry, WatchedFunction
+
+from tests.unit.simple_model import (random_dataset, simple_loss_fn,
+                                     simple_params)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    reset_topology()
+    import deepspeed_tpu.comm as dist
+
+    dist.destroy_process_group()
+    yield
+    reset_topology()
+
+
+def _engine(telemetry=None, **over):
+    cfg = {
+        "train_batch_size": 32,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.05}},
+        "steps_per_print": 10_000,
+    }
+    if telemetry is not None:
+        cfg["telemetry"] = telemetry
+    cfg.update(over)
+    reset_topology()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_loss_fn, model_parameters=simple_params(), config=cfg)
+    return engine
+
+
+def _steps(engine, n=3, batch=32):
+    x, y = random_dataset(64, 8)
+    loss = None
+    for _ in range(n):
+        loss = engine((x[:batch], y[:batch]))
+        engine.backward(loss)
+        engine.step()
+    return loss
+
+
+def _events(path):
+    with open(os.path.join(path, "telemetry.jsonl")) as f:
+        return [json.loads(line) for line in f]
+
+
+# ----------------------------------------------------------------------
+class TestConfig:
+    def test_defaults_off(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8})
+        t = cfg.telemetry_config
+        assert t.enabled is False and t.jsonl is True
+        assert t.compile_watchdog and t.hlo_cost and t.memory
+        assert t.trace.num_steps == 0
+
+    def test_validation(self):
+        with pytest.raises(Exception):
+            TelemetryConfig(sample_every=0)
+        with pytest.raises(Exception):
+            TelemetryConfig(trace={"num_steps": -1})
+        with pytest.raises(Exception):
+            TelemetryConfig(recompile_warn_after=0)
+
+    def test_parse_full_block(self):
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 8,
+            "telemetry": {"enabled": True, "dir": "/tmp/t",
+                          "sample_every": 5, "warmup_steps": 3,
+                          "trace": {"start_step": 10, "num_steps": 2,
+                                    "dir": "/tmp/tr"}}})
+        t = cfg.telemetry_config
+        assert t.enabled and t.sample_every == 5
+        assert t.trace.start_step == 10 and t.trace.num_steps == 2
+
+
+# ----------------------------------------------------------------------
+class TestEventStream:
+    def test_all_four_collector_families(self, tmp_path):
+        """Acceptance criterion: one run emits compile, step-cost/HLO,
+        memory, and trace-window events, and the report tool renders
+        them."""
+        tele_dir = str(tmp_path / "tele")
+        engine = _engine(telemetry={
+            "enabled": True, "dir": tele_dir,
+            "trace": {"start_step": 2, "num_steps": 1,
+                      "dir": str(tmp_path / "trace")}})
+        _steps(engine, 3)
+        engine.telemetry.flush()
+        events = _events(tele_dir)
+        kinds = {e["kind"] for e in events}
+        assert {"compile", "step_cost", "memory", "step",
+                "trace_window"} <= kinds, kinds
+
+        compiles = {e["name"] for e in events if e["kind"] == "compile"}
+        assert {"engine.micro_step", "engine.apply_step"} <= compiles
+        micro = next(e for e in events if e["kind"] == "compile"
+                     and e["name"] == "engine.micro_step")
+        assert micro["data"]["compile_secs"] > 0
+        assert micro["data"]["retrace"] is False
+
+        cost = next(e for e in events if e["kind"] == "step_cost"
+                    and e["name"] == "engine.micro_step")["data"]
+        assert cost["flops"] > 0
+        assert "collectives" in cost and "temp_size_in_bytes" in cost
+        # the gradient mean-reduce over the 8-way data axis is visible
+        assert cost["collective_operand_bytes"] > 0
+
+        mem = next(e for e in events if e["kind"] == "memory")["data"]
+        assert mem.get("bytes_in_use", 0) > 0
+
+        actions = [e["data"]["action"] for e in events
+                   if e["kind"] == "trace_window"]
+        assert actions == ["start", "stop"]
+
+        from tools.telemetry_report import render
+
+        report = render(os.path.join(tele_dir, "telemetry.jsonl"))
+        assert "engine.micro_step" in report
+        assert "compile watchdog" in report and "static step cost" in report
+        md = render(os.path.join(tele_dir, "telemetry.jsonl"),
+                    markdown=True)
+        assert "| program | compiles |" in md
+
+    def test_wallclock_routed_through_stream(self, tmp_path):
+        tele_dir = str(tmp_path / "tele")
+        engine = _engine(telemetry={"enabled": True, "dir": tele_dir},
+                         wall_clock_breakdown=True, steps_per_print=1)
+        _steps(engine, 2)
+        engine.telemetry.flush()
+        wallclock = [e for e in _events(tele_dir)
+                     if e["kind"] == "wallclock"]
+        assert len(wallclock) == 2
+        assert {"fwd", "bwd", "step"} <= set(wallclock[0]["data"])
+
+    def test_wallclock_legacy_flag_without_telemetry(self, capsys):
+        """The legacy flag keeps its rank-0 log line with telemetry off
+        (alias contract): output still appears, just no event sink."""
+        engine = _engine(wall_clock_breakdown=True, steps_per_print=1)
+        _steps(engine, 1)
+        assert not engine.telemetry.enabled
+        # log_dist writes via the logging handler; the timer means reset
+        # each report — the important part is it did not crash and the
+        # timers were consumed
+        assert engine.timers("fwd").elapsed_ == 0.0
+
+    def test_memory_sample_cadence(self, tmp_path):
+        tele_dir = str(tmp_path / "tele")
+        engine = _engine(telemetry={"enabled": True, "dir": tele_dir,
+                                    "sample_every": 2})
+        _steps(engine, 4)
+        engine.telemetry.flush()
+        mem_steps = [e["step"] for e in _events(tele_dir)
+                     if e["kind"] == "memory"]
+        assert mem_steps == [2, 4]
+
+
+# ----------------------------------------------------------------------
+class TestZeroOverhead:
+    def test_step_hlo_byte_identical(self):
+        """Telemetry absent / disabled / enabled: the optimized step HLO
+        is byte-identical in all three — the subsystem never touches the
+        compiled program, only (when enabled) how it is dispatched."""
+        x, y = random_dataset(64, 8)
+        batch = (x[:32], y[:32])
+
+        def step_hlo(engine):
+            fn = engine._jit_micro
+            raw = getattr(fn, "_fn", fn)  # unwrap WatchedFunction
+            return raw.lower(engine.state,
+                             engine._shard_batch(batch)).compile().as_text()
+
+        absent = _engine()
+        assert not isinstance(absent._jit_micro, WatchedFunction)
+        hlo_absent = step_hlo(absent)
+
+        disabled = _engine(telemetry={"enabled": False})
+        assert not isinstance(disabled._jit_micro, WatchedFunction)
+        hlo_disabled = step_hlo(disabled)
+
+        enabled = _engine(telemetry={"enabled": True, "jsonl": False,
+                                     "dir": "/tmp/unused"})
+        assert isinstance(enabled._jit_micro, WatchedFunction)
+        hlo_enabled = step_hlo(enabled)
+        # and the executable the watched path actually dispatches:
+        _steps(enabled, 1)
+        dispatched = list(enabled._jit_micro._cache.values())[0].as_text()
+
+        assert hlo_absent == hlo_disabled
+        assert hlo_absent == hlo_enabled
+        assert hlo_absent == dispatched
+
+    def test_no_additional_host_syncs(self, monkeypatch):
+        """Telemetry enabled adds zero ``block_until_ready``/device-sync
+        calls on warm steps (the memory sampler and step events are
+        passive by contract)."""
+        from deepspeed_tpu.utils import timer as timer_mod
+
+        counts = {"sync": 0}
+        real_sync = timer_mod._device_synchronize
+        real_block = jax.block_until_ready
+
+        def counting_sync():
+            counts["sync"] += 1
+            real_sync()
+
+        def counting_block(x):
+            counts["sync"] += 1
+            return real_block(x)
+
+        monkeypatch.setattr(timer_mod, "_device_synchronize", counting_sync)
+        monkeypatch.setattr(jax, "block_until_ready", counting_block)
+
+        def warm_steps(engine):
+            _steps(engine, 1)          # compile outside the window
+            counts["sync"] = 0
+            _steps(engine, 2)
+            return counts["sync"]
+
+        syncs_disabled = warm_steps(_engine())
+        syncs_enabled = warm_steps(_engine(
+            telemetry={"enabled": True, "jsonl": False,
+                       "dir": "/tmp/unused"}))
+        assert syncs_enabled == syncs_disabled
+
+    def test_disabled_watch_jit_is_identity(self):
+        tele = Telemetry(None)
+        fn = jax.jit(lambda v: v * 2)
+        assert tele.watch_jit(fn, "f") is fn
+
+
+# ----------------------------------------------------------------------
+class TestCompileWatchdog:
+    def test_retrace_counted_and_storm_warned(self, tmp_path):
+        import logging
+
+        from deepspeed_tpu.utils.logging import logger as ds_logger
+
+        tele_dir = str(tmp_path / "tele")
+        engine = _engine(telemetry={"enabled": True, "dir": tele_dir,
+                                    "warmup_steps": 1,
+                                    "recompile_warn_after": 1})
+        _steps(engine, 2, batch=32)           # warm
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = Capture(level=logging.WARNING)
+        ds_logger.addHandler(handler)
+        try:
+            _steps(engine, 1, batch=16)       # new shape -> retrace
+        finally:
+            ds_logger.removeHandler(handler)
+        engine.telemetry.flush()
+        assert any("RECOMPILE STORM" in m for m in records), records
+        retraces = [e for e in _events(tele_dir) if e["kind"] == "compile"
+                    and e["name"] == "engine.micro_step"
+                    and e["data"]["retrace"]]
+        assert len(retraces) == 1 and retraces[0]["data"]["after_warmup"]
+        summary = engine.telemetry.summary()
+        assert summary["per_function"]["engine.micro_step"][
+            "retraces_after_warm"] == 1
+
+    def test_watched_function_matches_raw(self, tmp_path):
+        tele = Telemetry({"enabled": True, "jsonl": False,
+                          "dir": str(tmp_path)})
+        raw = jax.jit(lambda v: (v * 2, jnp.sum(v)))
+        watched = tele.watch_jit(raw, "double")
+        v = jnp.arange(8, dtype=jnp.float32)
+        got, total = watched(v)
+        np.testing.assert_array_equal(np.asarray(got), np.arange(8) * 2.0)
+        assert float(total) == 28.0
+        assert watched.compiles == 1
+        watched(jnp.arange(4, dtype=jnp.float32))  # new shape
+        assert watched.compiles == 2
+
+
+# ----------------------------------------------------------------------
+class TestServingTelemetry:
+    @pytest.mark.heavy
+    def test_inference_engine_emits(self, tmp_path):
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+        tele_dir = str(tmp_path / "tele")
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        engine = deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype=jnp.float32,
+            telemetry={"enabled": True, "dir": tele_dir})
+        ids = np.arange(6, dtype=np.int32)[None, :] % cfg.vocab_size
+        engine.generate(ids, max_new_tokens=2)
+        engine.telemetry.flush()
+        events = _events(tele_dir)
+        kinds = {e["kind"] for e in events}
+        assert {"compile", "step_cost", "memory", "step"} <= kinds
+        names = {e["name"] for e in events if e["kind"] == "compile"}
+        assert any(n.startswith("inference.generate") for n in names)

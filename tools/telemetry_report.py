@@ -1,0 +1,203 @@
+"""Render a telemetry JSONL sink into a human-readable summary.
+
+The consumer side of ``deepspeed_tpu/telemetry``: aggregates the event
+stream a run wrote (``telemetry.jsonl``) into compile / step-cost /
+memory / trace-window / wallclock sections. Run::
+
+    python tools/telemetry_report.py path/to/telemetry.jsonl
+    python tools/telemetry_report.py path --markdown   # PERF.md tables
+    python tools/telemetry_report.py path --json       # one JSON line
+
+``render()`` is importable (the docs snippet and tests call it directly).
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.telemetry.events import load_events  # noqa: E402
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TB"
+
+
+def aggregate(events: List[Dict]) -> Dict:
+    """Collapse an event list into the per-section aggregates the report
+    renders (also the ``--json`` payload)."""
+    compile_by_name: Dict[str, Dict] = {}
+    step_cost_by_name: Dict[str, Dict] = {}
+    memory = {"samples": 0, "last": {}, "peak_bytes_in_use": 0,
+              "max_host_rss": 0}
+    trace_windows = []
+    wallclock: Dict[str, List[float]] = {}
+    steps = {"count": 0, "last": 0}
+    for e in events:
+        kind, name, data = e.get("kind"), e.get("name"), e.get("data", {})
+        if kind == "compile":
+            c = compile_by_name.setdefault(
+                name, {"compiles": 0, "trace_secs": 0.0, "compile_secs": 0.0,
+                       "retraces_after_warmup": 0})
+            c["compiles"] += 1
+            c["trace_secs"] += data.get("trace_secs", 0.0)
+            c["compile_secs"] += data.get("compile_secs", 0.0)
+            if data.get("retrace") and data.get("after_warmup"):
+                c["retraces_after_warmup"] += 1
+        elif kind == "step_cost":
+            step_cost_by_name[name] = data  # once per compile; keep latest
+        elif kind == "memory":
+            memory["samples"] += 1
+            memory["last"] = data
+            memory["peak_bytes_in_use"] = max(
+                memory["peak_bytes_in_use"],
+                data.get("peak_bytes_in_use", 0) or 0)
+            memory["max_host_rss"] = max(
+                memory["max_host_rss"], data.get("host_rss_bytes", 0) or 0)
+        elif kind == "trace_window":
+            trace_windows.append({"action": data.get("action"),
+                                  "step": e.get("step"),
+                                  "dir": data.get("dir")})
+        elif kind == "wallclock":
+            for k, v in data.items():
+                if isinstance(v, (int, float)):
+                    wallclock.setdefault(k, []).append(float(v))
+        elif kind == "step":
+            steps["count"] += 1
+            steps["last"] = max(steps["last"], e.get("step") or 0)
+    return {
+        "compile": compile_by_name,
+        "step_cost": step_cost_by_name,
+        "memory": memory,
+        "trace_windows": trace_windows,
+        "wallclock": {k: sum(v) / len(v) for k, v in wallclock.items()},
+        "steps": steps,
+    }
+
+
+def _compile_table(agg: Dict, markdown: bool) -> List[str]:
+    rows = sorted(agg["compile"].items())
+    if not rows:
+        return ["  (no compile events)"]
+    out = []
+    if markdown:
+        out.append("| program | compiles | trace s | compile s | "
+                   "retraces after warmup |")
+        out.append("|---|---|---|---|---|")
+        for name, c in rows:
+            out.append(f"| `{name}` | {c['compiles']} | "
+                       f"{c['trace_secs']:.2f} | {c['compile_secs']:.2f} | "
+                       f"{c['retraces_after_warmup']} |")
+    else:
+        out.append(f"  {'program':<44}{'compiles':>9}{'trace s':>9}"
+                   f"{'compile s':>11}{'retraces(warm)':>15}")
+        for name, c in rows:
+            out.append(f"  {name:<44}{c['compiles']:>9}"
+                       f"{c['trace_secs']:>9.2f}{c['compile_secs']:>11.2f}"
+                       f"{c['retraces_after_warmup']:>15}")
+    return out
+
+
+def _step_cost_lines(agg: Dict, markdown: bool) -> List[str]:
+    out = []
+    if not agg["step_cost"]:
+        return ["  (no step_cost events)"]
+    if markdown:
+        out.append("| program | GFLOPs | collective bytes/member | "
+                   "collectives | temp bytes |")
+        out.append("|---|---|---|---|---|")
+    for name, d in sorted(agg["step_cost"].items()):
+        colls = d.get("collectives", {}) or {}
+        coll_str = ", ".join(
+            f"{op} x{v['count']} ({'+'.join(v.get('dtypes', []))})"
+            for op, v in sorted(colls.items())) or "-"
+        flops = d.get("flops")
+        gflops = f"{flops / 1e9:.3f}" if flops is not None else "-"
+        if markdown:
+            out.append(
+                f"| `{name}` | {gflops} | "
+                f"{d.get('collective_operand_bytes', 0):,} | {coll_str} | "
+                f"{d.get('temp_size_in_bytes', 0):,} |")
+        else:
+            out.append(f"  {name}")
+            out.append(f"    flops: {gflops} GFLOP | bytes accessed: "
+                       f"{_fmt_bytes(d.get('bytes_accessed'))}")
+            out.append(
+                "    memory: args "
+                f"{_fmt_bytes(d.get('argument_size_in_bytes'))} | out "
+                f"{_fmt_bytes(d.get('output_size_in_bytes'))} | temp "
+                f"{_fmt_bytes(d.get('temp_size_in_bytes'))} | peak est "
+                f"{_fmt_bytes(d.get('peak_bytes_estimate'))}")
+            out.append(f"    collectives: {coll_str} | operand bytes/member "
+                       f"{d.get('collective_operand_bytes', 0):,}")
+    return out
+
+
+def render(path: str, markdown: bool = False) -> str:
+    events = load_events(path)
+    agg = aggregate(events)
+    lines = []
+    title = (f"Telemetry report — {os.path.basename(path)} "
+             f"({len(events)} events, {agg['steps']['count']} steps)")
+    if markdown:
+        lines.append(f"### {title}\n")
+        lines.append("Compile watchdog (per jitted program):\n")
+        lines.extend(_compile_table(agg, True))
+        lines.append("\nStatic step cost (once per compile, from the "
+                     "compiled executable):\n")
+        lines.extend(_step_cost_lines(agg, True))
+    else:
+        lines.append(title)
+        lines.append("")
+        lines.append("compile watchdog:")
+        lines.extend(_compile_table(agg, False))
+        lines.append("")
+        lines.append("static step cost:")
+        lines.extend(_step_cost_lines(agg, False))
+    mem = agg["memory"]
+    lines.append("")
+    lines.append(
+        f"{'### ' if markdown else ''}memory: {mem['samples']} samples | "
+        f"peak device {_fmt_bytes(mem['peak_bytes_in_use'])} "
+        f"({mem['last'].get('source', '?')}) | peak host RSS "
+        f"{_fmt_bytes(mem['max_host_rss'])}")
+    if agg["wallclock"]:
+        wc = " | ".join(f"{k}: {v:.2f}"
+                        for k, v in agg["wallclock"].items())
+        lines.append(f"wallclock means (ms): {wc}")
+    for w in agg["trace_windows"]:
+        lines.append(f"trace window: {w['action']} at step {w['step']}"
+                     + (f" -> {w['dir']}" if w.get("dir") else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="telemetry.jsonl file (or its directory)")
+    ap.add_argument("--markdown", action="store_true",
+                    help="emit markdown tables (for PERF.md)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON line of the aggregates")
+    args = ap.parse_args(argv)
+    path = args.path
+    if os.path.isdir(path):
+        path = os.path.join(path, "telemetry.jsonl")
+    if args.json:
+        print(json.dumps({"metric": "telemetry_report", "path": path,
+                          **aggregate(load_events(path))}, default=str))
+    else:
+        print(render(path, markdown=args.markdown))
+
+
+if __name__ == "__main__":
+    main()

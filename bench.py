@@ -185,6 +185,7 @@ def main():
     # headline is on the wire above — everything below is an OPTIONAL
     # extra series; a chip flap here can no longer zero the artifact
     _telemetry_series(warm_mark, steps)
+    _resilience_series(cfg, batch, seq, on_tpu)
     _comm_compression_series(cfg, batch, seq, on_tpu)
 
 
@@ -225,6 +226,107 @@ def _telemetry_series(warm_mark, steps):
         print(f"# telemetry series failed: {e}", file=sys.stderr, flush=True)
         emit_result({"metric": METRIC + "_telemetry", "value": None,
                      "unit": "compile_seconds", "vs_baseline": None,
+                     "error": str(e)[:300]})
+
+
+def _resilience_series(cfg, batch, seq, on_tpu, steps=5):
+    """Optional extra series: sentinel+watchdog overhead. Two proofs on
+    one JSON line — (1) with resilience DISABLED the step program XLA
+    sees is identical to a resilience-free build (the zero-overhead
+    contract, compared on the lowered step text so no extra backend
+    compile is paid); (2) with resilience ENABLED (sentinel warn policy +
+    armed watchdog) the wall-clock per step is unchanged within noise
+    (`vs_baseline` = enabled/disabled step rate, expected ~1.0 — the
+    dispatch path gains only a deque append and a lagged float())."""
+    import sys
+    import jax
+    import numpy as np_
+
+    import deepspeed_tpu
+
+    try:
+        from deepspeed_tpu.models.gpt2 import GPT2ForTraining
+
+        n_dev = jax.device_count()
+        rows = batch * n_dev
+        rng = np_.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (rows, seq)).astype(np_.int32)
+
+        def build(resilience):
+            from deepspeed_tpu.parallel.topology import reset_topology
+
+            reset_topology()
+            config = {
+                "train_micro_batch_size_per_gpu": batch,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 6e-4}},
+                "bf16": {"enabled": on_tpu},
+                "zero_optimization": {"stage": 0},
+                "steps_per_print": 10_000,
+            }
+            if resilience is not None:
+                config["resilience"] = resilience
+            engine, *_ = deepspeed_tpu.initialize(
+                model=GPT2ForTraining(cfg), config=config)
+            return engine
+
+        def step_text(engine):
+            # lowered (pre-backend-compile) text: program equality proof
+            # without paying a second XLA compile
+            return engine._jit_micro.lower(
+                engine.state, engine._shard_batch({"input_ids": ids})
+            ).as_text()
+
+        def rate(engine):
+            loss = engine({"input_ids": ids})
+            engine.backward(loss)
+            engine.step()
+            jax.block_until_ready(engine.state.params)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = engine({"input_ids": ids})
+                engine.backward(loss)
+                engine.step()
+            float(loss)
+            jax.block_until_ready(engine.state.params)
+            return steps / (time.perf_counter() - t0)
+
+        absent = build(None)
+        absent._ensure_state(absent._shard_batch({"input_ids": ids}))
+        text_absent = step_text(absent)
+        absent_rate = rate(absent)
+        absent.destroy()
+
+        disabled = build({"enabled": False})
+        disabled._ensure_state(disabled._shard_batch({"input_ids": ids}))
+        hlo_identical = step_text(disabled) == text_absent
+        disabled.destroy()
+
+        enabled = build({
+            "enabled": True,
+            "sentinel": {"policy": "warn", "sync_lag": 1},
+            "watchdog": {"timeout_secs": 3600, "abort": False}})
+        enabled_rate = rate(enabled)
+        enabled.destroy()
+
+        emit_result({
+            "metric": METRIC + "_resilience",
+            "value": round(enabled_rate, 3),
+            "unit": "steps/s",
+            "vs_baseline": round(enabled_rate / absent_rate, 4)
+            if absent_rate else None,
+            "disabled_steps_per_sec": round(absent_rate, 3),
+            "enabled_steps_per_sec": round(enabled_rate, 3),
+            "hlo_identical_when_disabled": bool(hlo_identical),
+            "sentinel_policy": "warn",
+            "watchdog_armed": True,
+            "n_dev": n_dev,
+        })
+    except Exception as e:  # noqa: BLE001 — extras never kill the headline
+        print(f"# resilience series failed: {e}", file=sys.stderr,
+              flush=True)
+        emit_result({"metric": METRIC + "_resilience", "value": None,
+                     "unit": "steps/s", "vs_baseline": None,
                      "error": str(e)[:300]})
 
 

@@ -109,11 +109,37 @@ class DSElasticAgent:
         # keep owning "latest"
         self.engine.save_checkpoint(self.save_dir, tag=PREEMPT_TAG,
                                     save_latest=False)
+        self._write_preempt_marker()
         log_dist(f"preemption checkpoint saved to {self.save_dir} "
                  f"(tag={PREEMPT_TAG!r})", ranks=[0])
         if self.on_preempt is not None:
             self.on_preempt()
         return True
+
+    def _write_preempt_marker(self):
+        """Rank-0 marker recording what the preemption save captured.
+        Written with tmp+fsync+os.replace (the same crash-safety as the
+        engine's ``latest`` pointer): a crash mid-write can never leave a
+        truncated marker that confuses the restarted job."""
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        import json
+        import time
+
+        from deepspeed_tpu.runtime.resilience.integrity import (
+            atomic_write_text)
+
+        try:
+            atomic_write_text(
+                os.path.join(self.save_dir, PREEMPT_TAG + ".meta"),
+                json.dumps({"tag": PREEMPT_TAG,
+                            "global_steps": int(getattr(
+                                self.engine, "global_steps", -1)),
+                            "ts": round(time.time(), 3)}))
+        except OSError as e:  # marker is advisory; the tag dir is truth
+            logger.warning(f"preemption marker write failed ({e})")
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -152,12 +178,41 @@ class DSElasticAgent:
                 os.path.join(self.save_dir, latest_tag)), None))
         if not candidates:
             return None
-        _, tag = max(candidates, key=lambda c: c[0])
-        loaded_tag, _ = self.engine.load_checkpoint(self.save_dir, tag=tag)
-        if loaded_tag is not None:
-            log_dist(f"elastic restore: resumed from {loaded_tag!r} at "
-                     f"step {self.engine.global_steps}", ranks=[0])
-        return loaded_tag
+        # newest first; a candidate that fails integrity verification (or
+        # lost files) must not kill the restart — the next-newest (and,
+        # via tag=None, the engine's verified-good fallback chain) still
+        # restores a working job
+        from deepspeed_tpu.runtime.resilience.integrity import (
+            CheckpointCorruptionError)
+
+        last_err = None
+        for _, tag in sorted(candidates, key=lambda c: c[0], reverse=True):
+            try:
+                loaded_tag, _ = self.engine.load_checkpoint(self.save_dir,
+                                                            tag=tag)
+            except (CheckpointCorruptionError, OSError) as e:
+                import jax
+
+                if (jax.process_count() > 1
+                        and not getattr(e, "agreed_rejection", False)):
+                    # a MID-LOAD failure on this rank only: peers may be
+                    # inside (or past) the same collective load — moving
+                    # to another candidate here would desync ranks. Crash
+                    # cleanly; the supervisor restarts the whole job.
+                    # (Pre-load rejections are broadcast from rank 0 and
+                    # raise identically everywhere — those are safe to
+                    # catch and fall through.)
+                    raise
+                last_err = e
+                logger.warning(
+                    f"elastic restore: checkpoint {tag or 'latest'!r} "
+                    f"unusable ({e}); trying the next candidate")
+                continue
+            if loaded_tag is not None:
+                log_dist(f"elastic restore: resumed from {loaded_tag!r} at "
+                         f"step {self.engine.global_steps}", ranks=[0])
+            return loaded_tag
+        raise last_err
 
     def close(self):
         for sig, prev in self._prev_handlers.items():

@@ -93,6 +93,11 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # TPU-native: unified telemetry event stream (same section shape as the
     # training config's `telemetry` block — runtime/config.TelemetryConfig)
     telemetry: Dict = {}
+    # TPU-native: fault-tolerance layer (same section shape as the training
+    # config's `resilience` block — runtime/config.ResilienceConfig). The
+    # serving tier arms the hang watchdog on request progress; sentinel/
+    # checkpoint-integrity knobs are training-side
+    resilience: Dict = {}
     tensor_parallel: DeepSpeedTPConfig = Field(DeepSpeedTPConfig(), alias="tp")
     enable_cuda_graph: bool = False  # accepted; XLA jit-cache supersedes it
     zero: Dict = {}

@@ -248,6 +248,14 @@ class InferenceEngine:
         from deepspeed_tpu.telemetry import Telemetry
 
         self.telemetry = Telemetry(config.telemetry, name="inference")
+        # resilience: the hang watchdog covers serving too — a wedged
+        # collective inside a generate step stalls request progress the
+        # same way a training stall stops step boundaries
+        from deepspeed_tpu.runtime.resilience import Resilience
+
+        self.resilience = Resilience(config.resilience,
+                                     telemetry=self.telemetry,
+                                     name="inference", serving=True)
         self._request_count = 0
         log_dist(
             f"InferenceEngine: tp={self.mp_world_size} dtype={config.dtype} "
@@ -505,6 +513,26 @@ class InferenceEngine:
         unequal length: per-row positions start at the first real token and
         padded cache slots are masked throughout decode.
         """
+        # resilience bracket: the hang-watchdog stall timer runs only
+        # while a request is in flight (idle gaps between requests are
+        # healthy); a raising request must clear its bracket or the idle
+        # server would later be judged hung
+        self.resilience.serving_request_begin()
+        try:
+            return self._generate_impl(
+                input_ids, max_new_tokens=max_new_tokens,
+                do_sample=do_sample, temperature=temperature, top_k=top_k,
+                top_p=top_p, eos_token_id=eos_token_id,
+                attention_mask=attention_mask, rng=rng, **kwargs)
+        except BaseException:
+            self.resilience.serving_request_abandon()
+            raise
+
+    def _generate_impl(self, input_ids, max_new_tokens: Optional[int] = None,
+                       do_sample: bool = False, temperature: float = 1.0,
+                       top_k: int = 0, top_p: float = 0.0,
+                       eos_token_id: int = -1, attention_mask=None, rng=None,
+                       **kwargs):
         input_ids = jnp.asarray(input_ids)
         if input_ids.ndim == 1:
             input_ids = input_ids[None]
@@ -557,6 +585,7 @@ class InferenceEngine:
         self._request_count += 1
         self.telemetry.on_step_boundary(self._request_count,
                                         samples=int(B))
+        self.resilience.serving_heartbeat(self._request_count)
         return np.concatenate([np.asarray(input_ids), np.asarray(new)], axis=1)
 
     # ------------------------------------------------------------------
@@ -582,6 +611,7 @@ class InferenceEngine:
         self._generate_cache.clear()
         self._forward_fn = None
         self._forward_last_fn = None
+        self.resilience.close()
         self.telemetry.close()
 
     def eval(self):

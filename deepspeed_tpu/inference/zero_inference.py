@@ -155,6 +155,13 @@ class ZeroInferenceEngine:
         from deepspeed_tpu.telemetry import Telemetry
 
         self.telemetry = Telemetry(config.telemetry, name="zero_inference")
+        # resilience: hang watchdog on request progress (a wedged layer
+        # stream stalls the per-token loop exactly like a training hang)
+        from deepspeed_tpu.runtime.resilience import Resilience
+
+        self.resilience = Resilience(config.resilience,
+                                     telemetry=self.telemetry,
+                                     name="zero_inference", serving=True)
         self._request_count = 0
 
         z = config.zero or {}
@@ -556,6 +563,23 @@ class ZeroInferenceEngine:
         int8) is the headline knob. ``attention_mask`` ([B, T], 0 = LEFT
         padding) batches prompts of unequal length, same contract as the
         device engine. Returns prompt + new tokens, HF-style."""
+        # resilience bracket — see InferenceEngine.generate
+        self.resilience.serving_request_begin()
+        try:
+            return self._generate_impl(
+                input_ids, max_new_tokens=max_new_tokens,
+                do_sample=do_sample, temperature=temperature, top_k=top_k,
+                top_p=top_p, eos_token_id=eos_token_id,
+                attention_mask=attention_mask, rng=rng, **kwargs)
+        except BaseException:
+            self.resilience.serving_request_abandon()
+            raise
+
+    def _generate_impl(self, input_ids, max_new_tokens: Optional[int] = None,
+                       do_sample: bool = False, temperature: float = 1.0,
+                       top_k: int = 0, top_p: float = 0.0,
+                       eos_token_id: int = -1, attention_mask=None, rng=None,
+                       **kwargs):
         ids = jnp.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None]
@@ -639,6 +663,7 @@ class ZeroInferenceEngine:
         # (np.asarray on each sampled token), so the sample is passive
         self._request_count += 1
         self.telemetry.on_step_boundary(self._request_count, samples=int(B))
+        self.resilience.serving_heartbeat(self._request_count)
         return np.concatenate(
             [np.asarray(ids)] + [tk[:, None] for tk in tokens], axis=1)
 
@@ -656,6 +681,7 @@ class ZeroInferenceEngine:
         """Release the per-shape compiled programs and close telemetry
         (stopping any open trace window)."""
         self._compiled.clear()
+        self.resilience.close()
         self.telemetry.close()
 
     def eval(self):

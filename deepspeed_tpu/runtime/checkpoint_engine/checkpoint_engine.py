@@ -18,6 +18,21 @@ import numpy as np
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
+def fsync_dir(path):
+    """Make rename/creation of directory entries durable (fsyncing file
+    contents alone does not persist the dirent on ext4/xfs). Shared by
+    the tiered engine's atomic publish and the resilience layer's
+    crash-safe pointer/manifest writes."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:  # platform without dir-fsync: best effort
+        pass
+
+
 class CheckpointEngine:
     def __init__(self, config_params=None):
         pass
@@ -331,18 +346,7 @@ class TieredCheckpointEngine(CheckpointEngine):
         self._fresh = set()
         return True
 
-    @staticmethod
-    def _fsync_dir(path):
-        """Make rename/creation of directory entries durable (fsyncing
-        file contents alone does not persist the dirent on ext4/xfs)."""
-        try:
-            fd = os.open(path, os.O_RDONLY)
-            try:
-                os.fsync(fd)
-            finally:
-                os.close(fd)
-        except OSError:  # platform without dir-fsync: best effort
-            pass
+    _fsync_dir = staticmethod(fsync_dir)
 
     # -- durable mirror -------------------------------------------------
     def _manifest(self):

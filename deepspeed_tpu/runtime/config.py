@@ -264,6 +264,130 @@ class TelemetryConfig(DeepSpeedConfigModel):
         return self
 
 
+class ResilienceCheckpointConfig(DeepSpeedConfigModel):
+    """``resilience.checkpoint``: integrity manifests + fallback chain +
+    IO retry + retention (``runtime/resilience/integrity.py``).
+
+    - ``integrity``: write a per-file sha256 manifest as the ``commit()``
+      step and record the tag verified-good.
+    - ``verify_on_load``: re-check the manifest before any bytes
+      deserialize; a mismatch raises ``CheckpointCorruptionError`` and
+      (on a ``latest`` resume) falls back down the verified-good chain.
+    - ``fallback``: enable the resume fallback chain
+      (``latest`` → previous verified-good tags, newest first).
+    - ``retries`` / ``retry_backoff_secs``: transient save/load IO errors
+      retry with exponential backoff (``backoff * 2**attempt``).
+    - ``keep_last_n``: retention over *verified* tags; ``0`` keeps all.
+      The newest verified-good tag and the elastic agent's ``preempt``
+      tag are never deleted.
+    - ``rollback_dir``: pins where ``sentinel.policy: rollback`` restores
+      from (default: the last ``save_checkpoint`` directory).
+    """
+
+    integrity: bool = True
+    verify_on_load: bool = True
+    fallback: bool = True
+    retries: int = 3
+    retry_backoff_secs: float = 0.2
+    keep_last_n: int = 0
+    rollback_dir: Optional[str] = None
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.retries < 0 or self.retry_backoff_secs < 0:
+            raise ValueError("resilience.checkpoint.retries and "
+                             "retry_backoff_secs must be >= 0")
+        if self.keep_last_n < 0:
+            raise ValueError("resilience.checkpoint.keep_last_n must be "
+                             ">= 0 (0 keeps everything)")
+        return self
+
+
+class ResilienceSentinelConfig(DeepSpeedConfigModel):
+    """``resilience.sentinel``: NaN/Inf + loss-spike detection at every
+    optimizer boundary (``runtime/resilience/sentinel.py``) — the bf16
+    protection the fp16 overflow path never covered.
+
+    - ``policy``: ``warn`` (log + fault event) | ``skip`` (compile the
+      fp16-style grads NaN/Inf check into the step: a bad step is
+      skipped exactly like an fp16 overflow) | ``abort`` (raise out of
+      ``engine.step()``) | ``rollback`` (restore the last verified-good
+      checkpoint in place).
+    - ``loss_spike_factor``: trip when loss > factor x trailing-window
+      mean (``0`` disables spike detection; nonfinite always trips).
+    - ``loss_window`` / ``min_history``: trailing window size and the
+      minimum samples before spike detection arms.
+    - ``sync_lag``: boundaries to hold each loss before the host reads it
+      (``0`` checks immediately at the cost of run-ahead).
+    - ``max_rollbacks``: rollbacks tolerated before escalating to abort
+      (``0`` = unlimited).
+    """
+
+    enabled: bool = True
+    policy: str = "warn"
+    loss_spike_factor: float = 0.0
+    loss_window: int = 32
+    min_history: int = 4
+    sync_lag: int = 1
+    max_rollbacks: int = 3
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.policy not in ("warn", "skip", "abort", "rollback"):
+            raise ValueError(
+                "resilience.sentinel.policy must be one of warn/skip/"
+                f"abort/rollback, got {self.policy!r}")
+        if self.loss_window <= 0 or self.min_history < 1:
+            raise ValueError("resilience.sentinel.loss_window must be > 0 "
+                             "and min_history >= 1")
+        if self.sync_lag < 0 or self.loss_spike_factor < 0 \
+                or self.max_rollbacks < 0:
+            raise ValueError("resilience.sentinel.sync_lag, "
+                             "loss_spike_factor and max_rollbacks must be "
+                             ">= 0")
+        return self
+
+
+class ResilienceWatchdogConfig(DeepSpeedConfigModel):
+    """``resilience.watchdog``: background stall detector
+    (``runtime/resilience/watchdog.py``). Arms at the first completed
+    optimizer step (initial compiles can never trip it); on
+    ``timeout_secs`` without step progress it dumps every Python thread's
+    stack + the telemetry event tail to ``dump_dir`` and (``abort``)
+    SIGTERMs then hard-exits with ``exit_code`` so the supervisor
+    restarts the job."""
+
+    enabled: bool = True
+    timeout_secs: float = 600.0
+    poll_secs: float = 0.0  # 0 = auto (timeout/4, capped at 10s)
+    dump_dir: str = "./resilience"
+    abort: bool = True
+    exit_code: int = 43
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.timeout_secs <= 0 or self.poll_secs < 0:
+            raise ValueError("resilience.watchdog.timeout_secs must be > 0 "
+                             "and poll_secs >= 0")
+        return self
+
+
+class ResilienceConfig(DeepSpeedConfigModel):
+    """``resilience`` section (TPU-native): the fault-tolerance layer
+    (``deepspeed_tpu/runtime/resilience/``). Off by default; with the
+    block absent or disabled the compiled train step is byte-identical
+    to a resilience-free build (same zero-overhead contract as
+    ``telemetry``)."""
+
+    enabled: bool = False
+    checkpoint: ResilienceCheckpointConfig = Field(
+        default_factory=ResilienceCheckpointConfig)
+    sentinel: ResilienceSentinelConfig = Field(
+        default_factory=ResilienceSentinelConfig)
+    watchdog: ResilienceWatchdogConfig = Field(
+        default_factory=ResilienceWatchdogConfig)
+
+
 def _resolve_batch_triangle(train_batch, micro_batch, gas, dp_world_size):
     """Resolve/validate train_batch = micro_batch * gas * dp_world.
 
@@ -363,6 +487,7 @@ class DeepSpeedConfig:
         self.comm_quantization = CommQuantizationConfig(
             **d.get("comm_quantization", {}))
         self.telemetry_config = TelemetryConfig(**d.get("telemetry", {}))
+        self.resilience_config = ResilienceConfig(**d.get("resilience", {}))
 
         if self.fp16.enabled and self.bf16.enabled:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")

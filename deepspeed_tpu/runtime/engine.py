@@ -395,12 +395,8 @@ class DeepSpeedEngine:
 
             self.checkpoint_engine = TieredCheckpointEngine(
                 self._config.nebula_config, inner=self.checkpoint_engine)
-        # host-side aux state (engine counters, offloaded optimizer moments)
-        # always travels through the consolidated npz/json format; under the
-        # tiered engine it must stage through the same atomic publish
-        self._aux_checkpoint_engine = getattr(
-            self.checkpoint_engine, "aux_engine", None) \
-            or ArrayCheckpointEngine()
+        # (the aux checkpoint engine is resolved AFTER the resilience
+        # wrap below — the integrity tier must see aux saves too)
 
         # --- counters & timers ---
         self.micro_steps = 0
@@ -426,6 +422,30 @@ class DeepSpeedEngine:
 
         self.telemetry = Telemetry(self._config.telemetry_config,
                                    monitor=self.monitor, name="engine")
+
+        # --- resilience (checkpoint integrity + fallback, step sentinel,
+        #     hang watchdog — deepspeed_tpu/runtime/resilience) ---
+        from deepspeed_tpu.runtime.resilience import Resilience
+
+        self.resilience = Resilience(self._config.resilience_config,
+                                     telemetry=self.telemetry, name="engine")
+        # policy "skip" compiles the fp16-style grads NaN/Inf check into
+        # the step (the ONLY compiled-program change resilience makes);
+        # resolved before any state build so _compile_steps sees it
+        self._sentinel_skip = self.resilience.sentinel_in_graph
+        # integrity tier wraps whatever checkpoint stack the config built
+        # (Array/Orbax/Sharded, possibly already tiered): manifest commit,
+        # verify-on-load, IO retry, retention
+        self.checkpoint_engine = self.resilience.wrap_checkpoint_engine(
+            self.checkpoint_engine)
+        # host-side aux state (engine counters, offloaded optimizer
+        # moments) always travels through the consolidated npz/json
+        # format; under the tiered engine it must stage through the same
+        # atomic publish, and under the integrity tier it rides the same
+        # retry/chaos seams
+        self._aux_checkpoint_engine = getattr(
+            self.checkpoint_engine, "aux_engine", None) \
+            or ArrayCheckpointEngine()
 
         # --- data-efficiency / PLD / eigenvalue hooks (reference
         #     engine.py:319,365,368,375 optional-feature configuration) ---
@@ -1028,6 +1048,10 @@ class DeepSpeedEngine:
         scaler_config = self._scaler_config
 
         accum_can_overflow = self._grad_accum_dtype() == jnp.float16
+        # resilience sentinel "skip" policy: run the overflow probe (and
+        # its skip-update path) even without fp16 loss scaling — a bf16
+        # NaN storm then skips steps exactly like an fp16 overflow would
+        sentinel_skip = getattr(self, "_sentinel_skip", False)
 
         def apply_math(state: TrainState, scaled_grads, lr_override):
             """Unscale → overflow check → clip → update → loss-scale update.
@@ -1041,7 +1065,8 @@ class DeepSpeedEngine:
             # an fp16 ACCUMULATOR can overflow even without fp16 loss
             # scaling — a silent inf would corrupt params with no skipped
             # step, so the check runs for either reason
-            overflow = (has_inf_or_nan(grads) if (fp16 or accum_can_overflow)
+            overflow = (has_inf_or_nan(grads)
+                        if (fp16 or accum_can_overflow or sentinel_skip)
                         else jnp.asarray(False))
             grad_norm = _global_norm(grads)
             if clip and clip > 0:
@@ -1087,9 +1112,22 @@ class DeepSpeedEngine:
             "engine.apply_step")
 
     def _shard_batch(self, batch):
+        multiproc = jax.process_count() > 1
+
         def put(x):
-            x = np.asarray(x) if not isinstance(x, jax.Array) else x
+            if isinstance(x, jax.Array):
+                x_sh = batch_sharding(self.mesh, ndim=x.ndim, shape=x.shape)
+                return jax.device_put(x, x_sh)
+            x = np.asarray(x)
             sh = batch_sharding(self.mesh, ndim=x.ndim, shape=x.shape)
+            if multiproc:
+                # a host batch bound for a process-spanning sharding:
+                # device_put would need every process's copy proven equal
+                # via a host collective (and older jax CPU backends cannot
+                # run it at all) — assemble the global array from each
+                # process's addressable shards instead, zero wire traffic
+                return jax.make_array_from_callback(
+                    x.shape, sh, lambda idx: x[idx])
             return jax.device_put(x, sh)
 
         return jax.tree_util.tree_map(put, batch)
@@ -1229,7 +1267,8 @@ class DeepSpeedEngine:
         (reference ``engine.step``, ``engine.py:2124``)."""
         if self.state is None:
             raise RuntimeError("step() called before any forward()")
-        if self.is_gradient_accumulation_boundary():
+        at_boundary = self.is_gradient_accumulation_boundary()
+        if at_boundary:
             if self.wall_clock_breakdown_:
                 self.timers(STEP_GLOBAL_TIMER).start()
             with self.telemetry.annotation("ds.optimizer_step"):
@@ -1281,6 +1320,16 @@ class DeepSpeedEngine:
         else:
             self.tput_timer.stop(global_step=False)
         self.micro_steps += 1
+        if at_boundary:
+            # resilience boundary — AFTER every counter has settled, so a
+            # sentinel rollback restores a clean state with no pending
+            # increments. Watchdog heartbeat + sentinel loss check (the
+            # loss is held for sentinel.sync_lag boundaries before the
+            # host reads it, so run-ahead survives); a trip applies the
+            # configured policy — abort raises out of step(), rollback
+            # restores the last verified-good checkpoint in place
+            self.resilience.on_step_boundary(self, self.global_steps,
+                                             loss=self._last_loss)
 
     def _host_apply(self):
         """Offload-tier optimizer boundary: grads D2H → native cpu_adam →
@@ -1293,7 +1342,7 @@ class DeepSpeedEngine:
             lr = float(self._lr_override())
         new_params, overflow, grad_norm = self._host_optimizer.apply(
             self.state.grad_acc, lr=lr, loss_scale=scale,
-            check_overflow=fp16)
+            check_overflow=fp16 or self._sentinel_skip)
         self._last_grad_norm = grad_norm
         self._last_overflow = bool(overflow)
         # identical dynamic-loss-scale semantics to the compiled apply_step
@@ -1625,6 +1674,7 @@ class DeepSpeedEngine:
         if hasattr(self, "_jit_eval"):
             del self._jit_eval
         self.state = None
+        self.resilience.close()
         self.telemetry.close()
 
     # -- thin config getters (reference engine.py:502-883 accessor zoo;
@@ -1964,6 +2014,17 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         if self.state is None:
             raise RuntimeError("no state to checkpoint (run a forward first)")
+        # judge any sentinel-pending lagged losses NOW: a still-unchecked
+        # NaN boundary must not become a verified-good checkpoint (abort
+        # raises here; rollback restores last-good and saves THAT)
+        self.resilience.drain_sentinel()
+        with self.resilience.watchdog_suspended():
+            # a large save to a slow blob store (plus manifest hashing)
+            # can legitimately outlast the step timeout — not a hang
+            return self._save_checkpoint_impl(save_dir, tag, client_state,
+                                              save_latest)
+
+    def _save_checkpoint_impl(self, save_dir, tag, client_state, save_latest):
         tag = tag or f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
         ckpt_dir = os.path.join(save_dir, str(tag))
@@ -2021,10 +2082,16 @@ class DeepSpeedEngine:
         # "latest" moves only AFTER the commit publishes the tag — a crash
         # between the two can never leave latest dangling at a
         # half-written checkpoint (the tiered engine's atomicity contract)
+        # — and the pointer write itself is tmp+fsync+os.replace, so a
+        # crash MID-WRITE can never leave a truncated latest that poisons
+        # every future resume
         if dist.get_rank() == 0 and save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
+            from deepspeed_tpu.runtime.resilience.integrity import (
+                atomic_write_text)
+
+            atomic_write_text(os.path.join(save_dir, "latest"), str(tag))
         dist.barrier()
+        self.resilience.note_save_dir(save_dir)
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
         return True
 
@@ -2058,9 +2125,40 @@ class DeepSpeedEngine:
                 raise RuntimeError(msg)
             logger.warning(msg)
 
+    @staticmethod
+    def _missing_tag_error(load_dir, tag, explicit):
+        from deepspeed_tpu.runtime.resilience.integrity import (
+            missing_tag_error)
+
+        via = (f"explicit tag {tag!r}" if explicit
+               else f"'latest' points at {tag!r}")
+        return missing_tag_error(load_dir, tag, via)
+
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False, custom_load_fn=None):
+        """Restore from ``load_dir``. ``tag=None`` resumes from the
+        ``latest`` pointer and — with resilience integrity on — walks the
+        verified-good fallback chain when the pointed-at checkpoint is
+        corrupt or missing. An explicit ``tag`` never falls back: a
+        missing/corrupt explicit tag raises, naming the tags present."""
+        with self.resilience.watchdog_suspended():
+            # restore IO (verify hashing + deserialize) may outlast the
+            # step timeout — not a hang
+            return self._load_checkpoint_resolved(
+                load_dir, tag,
+                load_optimizer_states=load_optimizer_states,
+                load_lr_scheduler_states=load_lr_scheduler_states,
+                load_module_only=load_module_only)
+
+    def _load_checkpoint_resolved(self, load_dir, tag, *,
+                                  load_optimizer_states=True,
+                                  load_lr_scheduler_states=True,
+                                  load_module_only=False):
+        from deepspeed_tpu.runtime.resilience.integrity import (
+            CheckpointCorruptionError, read_verified)
+
+        explicit = tag is not None
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             if not os.path.exists(latest):
@@ -2068,7 +2166,90 @@ class DeepSpeedEngine:
                 return None, {}
             with open(latest) as f:
                 tag = f.read().strip()
-        ckpt_dir = os.path.join(load_dir, str(tag))
+        candidates = [str(tag)]
+        if (not explicit and self.resilience.enabled
+                and self._config.resilience_config.checkpoint.fallback):
+            # resume fallback chain: previous verified-good tags, newest
+            # first (the registry the integrity commit maintains)
+            candidates += [t for t in reversed(read_verified(load_dir))
+                           if t not in candidates]
+        multiproc = jax.process_count() > 1
+        last_err = None
+        for i, t in enumerate(candidates):
+            ckpt_dir = os.path.join(load_dir, t)
+            err = None
+            if not multiproc or dist.get_rank() == 0:
+                # verify BEFORE any bytes deserialize (and before any
+                # live state is touched) so a corrupt candidate can never
+                # leave the engine half-restored. Multi-process: rank 0
+                # alone hashes the (shared-filesystem) tag dir — N hosts
+                # each re-reading the full checkpoint would multiply
+                # restore IO by the host count for identical bytes
+                if not os.path.isdir(ckpt_dir):
+                    err = self._missing_tag_error(load_dir, t, explicit)
+                elif hasattr(self.checkpoint_engine, "verify"):
+                    try:
+                        self.checkpoint_engine.verify(ckpt_dir)
+                    except CheckpointCorruptionError as e:
+                        err = e
+            if multiproc:
+                # every process must agree on the candidate BEFORE the
+                # collective load starts — ranks restoring different tags
+                # would desync weights or hang mismatched collectives
+                flag = np.asarray([0 if err is None else 1], np.int32)
+                rejected = bool(np.asarray(dist.broadcast(flag, src=0))[0])
+                if rejected and err is None:
+                    # same exception CLASS as rank 0's own verify failure:
+                    # callers catching the rejection must behave
+                    # identically on every rank
+                    err = CheckpointCorruptionError(
+                        f"checkpoint {t!r} rejected by rank 0 "
+                        "(verification failed there)")
+            if err is not None:
+                # pre-load rejection: rank 0's verdict was broadcast and
+                # every process raises a CheckpointCorruptionError/
+                # FileNotFoundError here, so callers — e.g. the elastic
+                # agent's candidate loop — may safely catch it and try
+                # another tag without desyncing ranks
+                err.agreed_rejection = True
+                last_err = err
+                if i + 1 < len(candidates):
+                    logger.warning(
+                        f"[resilience] checkpoint {t!r} unusable ({err}); "
+                        f"falling back to {candidates[i + 1]!r}")
+                    continue
+                raise err
+            try:
+                result = self._load_checkpoint_tag(
+                    ckpt_dir, t,
+                    load_optimizer_states=load_optimizer_states,
+                    load_lr_scheduler_states=load_lr_scheduler_states,
+                    load_module_only=load_module_only)
+            except (CheckpointCorruptionError, OSError) as e:
+                last_err = e
+                if multiproc or i + 1 >= len(candidates):
+                    # past the agreement point a mid-load failure must not
+                    # fall back per-process (peers are inside the same
+                    # collective load) — surface it instead
+                    raise
+                logger.warning(
+                    f"[resilience] checkpoint {t!r} failed mid-load ({e}); "
+                    f"falling back to {candidates[i + 1]!r}")
+                continue
+            if i > 0:
+                self.resilience.emit_fault(
+                    "ckpt.fallback", from_tag=candidates[0], to_tag=t,
+                    error=str(last_err)[:300])
+                logger.warning(
+                    f"[resilience] FALLBACK RESTORE: resumed from "
+                    f"verified-good {t!r} instead of {candidates[0]!r}")
+            return result
+        raise last_err  # unreachable: the loop raised or returned
+
+    def _load_checkpoint_tag(self, ckpt_dir, tag, *,
+                             load_optimizer_states=True,
+                             load_lr_scheduler_states=True,
+                             load_module_only=False):
         if getattr(self.checkpoint_engine, "supports_sharded", False):
             return self._load_checkpoint_sharded(
                 ckpt_dir, tag,
@@ -2108,7 +2289,7 @@ class DeepSpeedEngine:
         engine_state = self.checkpoint_engine.load(os.path.join(ckpt_dir, "engine"))
         client_state = self._restore_engine_aux(engine_state,
                                                 load_lr_scheduler_states)
-        log_dist(f"loaded checkpoint {tag} from {load_dir}", ranks=[0])
+        log_dist(f"loaded checkpoint {tag} from {ckpt_dir}", ranks=[0])
         return tag, client_state
 
     def _restore_host_optimizer_flat(self, flat: dict):

@@ -367,7 +367,13 @@ class PipelineEngine(DeepSpeedEngine):
         loss = self.forward(batch)
         self.backward(loss)
         self.step()
-        return float(loss)
+        loss = float(loss)
+        # this float() already paid the device sync — hand the value to
+        # the step sentinel so its lagged fetch for this boundary is
+        # superseded (no second sync, and the pipelined schedule's loss
+        # is judged the step it happened, not sync_lag boundaries later)
+        self.resilience.observe_synced_loss(self.global_steps, loss)
+        return loss
 
     def eval_batch(self, batch):
         batch = self._shard_batch(batch)

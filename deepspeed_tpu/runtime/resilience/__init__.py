@@ -1,0 +1,39 @@
+"""deepspeed_tpu.runtime.resilience — the fault-tolerance layer.
+
+ZeRO training that survives a real TPU pod: verified-good checkpoints
+with a fallback chain (``integrity``), NaN/loss-spike policy enforcement
+beyond the fp16 path (``sentinel``), stalled-collective detection with
+dump-and-abort (``watchdog``), and the deterministic fault injectors the
+test suite proves every degradation path with (``chaos``).
+
+Off by default; enable via the ``resilience`` config block
+(``runtime/config.py``)::
+
+    {"resilience": {"enabled": true,
+                    "checkpoint": {"keep_last_n": 3},
+                    "sentinel": {"policy": "rollback"},
+                    "watchdog": {"timeout_secs": 600}}}
+
+With the block absent or disabled the compiled train step is
+byte-identical to a resilience-free build (pinned in
+``tests/unit/test_resilience.py``).
+"""
+
+from deepspeed_tpu.runtime.resilience import chaos  # noqa: F401
+from deepspeed_tpu.runtime.resilience.integrity import (  # noqa: F401
+    CheckpointCorruptionError,
+    ResilientCheckpointEngine,
+    atomic_write_text,
+    read_verified,
+    verify_tag_dir,
+    write_manifest,
+)
+from deepspeed_tpu.runtime.resilience.manager import (  # noqa: F401
+    Resilience,
+    fast_forward,
+)
+from deepspeed_tpu.runtime.resilience.sentinel import (  # noqa: F401
+    SentinelAbort,
+    StepSentinel,
+)
+from deepspeed_tpu.runtime.resilience.watchdog import HangWatchdog  # noqa: F401

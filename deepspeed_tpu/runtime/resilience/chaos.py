@@ -1,0 +1,198 @@
+"""Deterministic fault injectors for the resilience test harness.
+
+Production code never fails on purpose; proving the degradation paths
+(checkpoint retry, fallback-to-last-good, sentinel trip, watchdog
+dump-and-abort) therefore needs seams where faults can be injected
+*deterministically*. This module is that seam:
+
+- :func:`io_errors` — arm transient IO failures at a named injection
+  point (the resilient checkpoint engine calls :func:`raise_if` around
+  every save/load/commit); "fail the Nth call, M times" is exact, so a
+  retry test proves the exact backoff schedule.
+- :func:`corrupt_checkpoint` — flip bytes in an already-committed
+  checkpoint file (bitrot / truncated blob-store upload), the failure
+  integrity verification exists to catch.
+- :func:`nan_batches` — wrap a batch iterator, poisoning one batch's
+  float leaves with NaN at a chosen index (a bf16 NaN storm's first
+  step, as the gradient path sees it).
+- :func:`send_sigterm` — deliver a real SIGTERM to this process (the
+  TPU preemption notice the elastic agent arms for).
+- :func:`simulate_stall` — block the calling thread past a watchdog
+  timeout (a hung collective, as the host observes it).
+
+All injectors are process-local and OFF by default; :func:`raise_if`
+costs one module-level ``if`` when nothing is armed.
+"""
+
+import os
+import signal
+import threading
+import time
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_FAULTS: Dict[str, "_IOFault"] = {}
+
+
+class ChaosIOError(OSError):
+    """The injected transient IO error (an OSError subclass so retry
+    paths treat it exactly like a real flaky filesystem/blob store)."""
+
+
+class _IOFault:
+    def __init__(self, at_call: int, times: int, exc: type):
+        self.at_call = int(at_call)   # 1-indexed call number to start failing
+        self.times = int(times)       # how many consecutive calls fail
+        self.exc = exc
+        self.calls = 0                # calls observed at this point
+        self.raised = 0               # failures actually injected
+
+    def should_raise(self) -> bool:
+        self.calls += 1
+        if self.at_call <= self.calls < self.at_call + self.times:
+            self.raised += 1
+            return True
+        return False
+
+
+def io_errors(point: str, at_call: int = 1, times: int = 1,
+              exc: type = ChaosIOError) -> "_Armed":
+    """Arm ``times`` consecutive failures at injection ``point`` starting
+    with its ``at_call``-th call (1-indexed). Returns a context manager /
+    handle; the fault also disarms process-wide via :func:`clear`.
+
+    Known points: ``"ckpt.save"``, ``"ckpt.load"``, ``"ckpt.commit"``.
+    """
+    fault = _IOFault(at_call, times, exc)
+    with _LOCK:
+        _FAULTS[point] = fault
+    return _Armed(point, fault)
+
+
+class _Armed:
+    def __init__(self, point: str, fault: _IOFault):
+        self.point = point
+        self.fault = fault
+
+    @property
+    def raised(self) -> int:
+        return self.fault.raised
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        with _LOCK:
+            if _FAULTS.get(self.point) is self.fault:
+                del _FAULTS[self.point]
+        return False
+
+
+def raise_if(point: str, detail: str = ""):
+    """Injection hook — called by the resilient checkpoint engine around
+    each IO operation. No-op unless a fault is armed at ``point``."""
+    if not _FAULTS:  # fast path: chaos never armed in production
+        return
+    with _LOCK:
+        fault = _FAULTS.get(point)
+        if fault is None:
+            return
+        fire = fault.should_raise()
+    if fire:
+        raise fault.exc(
+            f"chaos: injected IO error at {point!r}"
+            + (f" ({detail})" if detail else "")
+            + f" [call {fault.calls}]")
+
+
+def clear():
+    """Disarm every injector (test teardown)."""
+    with _LOCK:
+        _FAULTS.clear()
+
+
+# ----------------------------------------------------------------------
+# post-commit corruption (bitrot / partial upload)
+def corrupt_checkpoint(tag_dir: str, filename: Optional[str] = None,
+                       offset: int = 0, nbytes: int = 8) -> str:
+    """Flip ``nbytes`` bytes of one payload file inside a committed
+    checkpoint tag directory (the largest file when ``filename`` is not
+    given — the array payload, where silent corruption hurts most).
+    Returns the path corrupted."""
+    if filename is not None:
+        target = os.path.join(tag_dir, filename)
+    else:
+        candidates = []
+        for base, _, files in os.walk(tag_dir):
+            for fn in files:
+                if fn.startswith("."):
+                    continue  # never the integrity manifest itself
+                p = os.path.join(base, fn)
+                candidates.append((os.path.getsize(p), p))
+        if not candidates:
+            raise FileNotFoundError(f"no files to corrupt under {tag_dir}")
+        target = max(candidates)[1]
+    size = os.path.getsize(target)
+    if size == 0:
+        raise ValueError(f"cannot corrupt empty file {target}")
+    offset = min(max(0, offset), max(0, size - nbytes))
+    with open(target, "r+b") as f:
+        f.seek(offset)
+        chunk = f.read(nbytes)
+        f.seek(offset)
+        f.write(bytes((b ^ 0xFF) for b in chunk))
+        f.flush()
+        os.fsync(f.fileno())
+    return target
+
+
+def truncate_file(path: str, keep_bytes: int = 0):
+    """Simulate a crash mid-write: keep only the first ``keep_bytes``."""
+    with open(path, "r+b") as f:
+        f.truncate(int(keep_bytes))
+
+
+# ----------------------------------------------------------------------
+# NaN gradients at step K (bf16 NaN storm)
+def nan_batches(batches: Iterable, at: int, leaf_index: int = 0):
+    """Yield from ``batches``, replacing the ``at``-th batch's (0-indexed)
+    first float leaf (or ``leaf_index``-th) with NaNs. Gradients of that
+    micro-step are NaN — exactly what the step sentinel must catch."""
+    import jax
+
+    for i, batch in enumerate(batches):
+        if i == at:
+            leaves, treedef = jax.tree_util.tree_flatten(batch)
+            poisoned, float_seen = [], 0
+            for leaf in leaves:
+                arr = np.asarray(leaf)
+                if arr.dtype.kind == "f" and float_seen == leaf_index:
+                    arr = np.full_like(arr, np.nan)
+                    float_seen += 1
+                elif arr.dtype.kind == "f":
+                    float_seen += 1
+                poisoned.append(arr)
+            batch = jax.tree_util.tree_unflatten(treedef, poisoned)
+        yield batch
+
+
+def poison_batch(batch, leaf_index: int = 0):
+    """NaN-poison one batch directly (the single-batch form of
+    :func:`nan_batches`)."""
+    return next(nan_batches([batch], at=0, leaf_index=leaf_index))
+
+
+# ----------------------------------------------------------------------
+# preemption + stall
+def send_sigterm():
+    """Deliver a real SIGTERM to this process — the TPU scheduler's
+    preemption notice, as ``DSElasticAgent`` receives it."""
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def simulate_stall(seconds: float):
+    """Block the calling thread (a hung collective, as the host observes
+    it): step-boundary progress stops while the watchdog keeps polling."""
+    time.sleep(float(seconds))

@@ -816,8 +816,12 @@ class ZeroInfinityEngine:
                 for path, leaf in flatten_with_path_strings(tree)[0]:
                     flat[f"state/transformer/h/block/{path}/{key}"] = leaf
         np.savez(os.path.join(d, "infinity_state.npz"), **flat)
-        with open(os.path.join(str(save_dir), "latest"), "w") as f:
-            f.write(tag)
+        # crash-safe pointer (same contract as DeepSpeedEngine): a crash
+        # mid-write must never leave a truncated latest
+        from deepspeed_tpu.runtime.resilience.integrity import (
+            atomic_write_text)
+
+        atomic_write_text(os.path.join(str(save_dir), "latest"), tag)
         log_dist(f"saved infinity checkpoint {tag} to {d}", ranks=[0])
         return True
 
@@ -829,6 +833,12 @@ class ZeroInfinityEngine:
             with open(latest) as f:
                 tag = f.read().strip()
         fname = os.path.join(str(load_dir), tag, "infinity_state.npz")
+        if not os.path.exists(fname):
+            from deepspeed_tpu.runtime.resilience.integrity import (
+                missing_tag_error)
+
+            raise missing_tag_error(str(load_dir), tag,
+                                    f"infinity tag {tag!r}")
         with np.load(fname) as z:
             flat = {k: z[k] for k in z.files}
         self._host_opt.load_flat_state(flat)

@@ -18,6 +18,9 @@ satellite families that ride the same sink):
 - ``wallclock``    — wall_clock_breakdown timer means (legacy flag routed
                      through the stream)
 - ``comm``         — facade-level collective log mirrors
+- ``fault``        — resilience-layer faults: checkpoint retries /
+                     corruption / fallbacks / retention, sentinel trips
+                     and rollbacks, watchdog hang dumps
 
 Everything in ``data`` must be JSON-safe; :func:`json_safe` coerces numpy
 scalars and drops device arrays (an event must never pin or sync device
@@ -29,7 +32,7 @@ import time
 from typing import Any, Dict, Optional
 
 KINDS = ("compile", "step_cost", "memory", "trace_window", "step",
-         "wallclock", "comm")
+         "wallclock", "comm", "fault")
 
 
 def json_safe(value: Any):

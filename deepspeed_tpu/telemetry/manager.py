@@ -62,6 +62,11 @@ class Telemetry:
         self._trace_count = 0
         self._unlabeled_after_warm = 0
         self._storm_warned = set()
+        # in-memory tail of recent events: the post-mortem context the
+        # resilience watchdog dumps alongside the thread stacks
+        import collections
+
+        self._tail = collections.deque(maxlen=256)
         if not self.enabled:
             return
         try:
@@ -87,10 +92,16 @@ class Telemetry:
         payload.update(fields)
         event = make_event(kind, name, step, getattr(self, "_rank", 0),
                            payload)
+        self._tail.append(event)
         if self._sink is not None:
             self._sink.write(event)
         if self._bridge is not None:
             self._bridge.write(event)
+
+    def tail(self, n: int = 50):
+        """The most recent ``n`` events (empty when disabled) — consumed
+        by the resilience watchdog's hang dump."""
+        return list(self._tail)[-n:]
 
     # ------------------------------------------------------------------
     # collector 1+2: compile watchdog + static step-cost accounting

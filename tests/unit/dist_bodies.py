@@ -188,6 +188,96 @@ def save_ckpt_cross_ws():
     print(f"XWS-SAVE-OK rank={jax.process_index()}", flush=True)
 
 
+def _zero3_resilient_engine(axis_sizes):
+    """ZeRO-3 + sharded (orbax) checkpointing + resilience integrity on a
+    process-spanning mesh — the full stack the tentpole wires."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+    from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology
+
+    reset_topology()
+    topo = MeshTopology(axis_sizes=axis_sizes)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32, n_layer=2)),
+        mesh=topo,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3,
+                                      "stage3_param_persistence_threshold": 0},
+                "checkpoint": {"sharded": True},
+                "resilience": {"enabled": True,
+                               "watchdog": {"enabled": False}},
+                "steps_per_print": 10_000})
+    assert jax is not None
+    return engine
+
+
+def save_zero3_resilient():
+    """ZeRO-3 sharded save across a REAL process boundary: each host
+    writes only its addressable shards, rank 0 commits the integrity
+    manifest over the combined tag dir, and the tag lands in the
+    verified-good registry."""
+    import json
+    import os
+
+    import jax
+
+    from deepspeed_tpu.runtime.resilience.integrity import (read_verified,
+                                                            verify_tag_dir)
+
+    engine = _zero3_resilient_engine({"data": jax.device_count()})
+    ids = np.random.default_rng(0).integers(0, 256, (8, 32)).astype(np.int32)
+    for _ in range(2):
+        loss = engine({"input_ids": ids})
+        engine.backward(loss)
+        engine.step()
+    d = os.environ["DS_TEST_CKPT_DIR"]
+    engine.save_checkpoint(d, tag="z3")
+    digests = _state_digests(engine)  # collective: EVERY rank participates
+    if jax.process_index() == 0:
+        assert verify_tag_dir(os.path.join(d, "z3")) == "ok", \
+            "manifest commit must verify on the saving side"
+        assert "z3" in read_verified(d), "tag must be registered good"
+        with open(os.path.join(d, "digests.json"), "w") as f:
+            json.dump(digests, f)
+    print(f"Z3-SAVE-OK rank={jax.process_index()}", flush=True)
+
+
+def load_zero3_resilient():
+    """Restore the ZeRO-3 sharded checkpoint onto a DIFFERENT mesh layout
+    (data x model instead of pure data) across the same process count:
+    manifest verification, orbax byte-range reads, and reshard-at-load
+    all cross the process boundary; params + optimizer state must be
+    bit-identical on every rank, and training must continue."""
+    import json
+    import os
+
+    import jax
+
+    n = jax.device_count()
+    engine = _zero3_resilient_engine({"data": n // 2, "model": 2})
+    ids = np.random.default_rng(0).integers(0, 256, (8, 32)).astype(np.int32)
+    loss = engine({"input_ids": ids})  # materialize state template
+    del loss
+    d = os.environ["DS_TEST_CKPT_DIR"]
+    tag, _ = engine.load_checkpoint(d, tag="z3")
+    assert tag == "z3", tag
+    with open(os.path.join(d, "digests.json")) as f:
+        want = json.load(f)
+    got = _state_digests(engine)
+    assert got == want, (len(got), len(want),
+                         [i for i, (a, b) in enumerate(zip(got, want))
+                          if a != b][:5])
+    loss = engine({"input_ids": ids})
+    engine.backward(loss)
+    engine.step()
+    assert np.isfinite(float(jax.device_get(loss)))
+    print(f"Z3-LOAD-OK rank={jax.process_index()}", flush=True)
+
+
 def load_ckpt_cross_ws():
     """Restore the checkpoint saved at a DIFFERENT world size; every rank
     must hold bit-identical params + optimizer state, and the restored

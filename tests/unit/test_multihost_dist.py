@@ -37,6 +37,25 @@ def test_engine_training_across_processes(world_size):
 
 
 @pytest.mark.heavy
+def test_zero3_resilient_checkpoint_across_processes(tmp_path, monkeypatch):
+    """ISSUE 3 satellite (VERDICT item 7): a ZeRO-3 save→restore leg at 2
+    processes x 4 CPU devices — sharded (orbax) save, the resilience
+    layer's integrity-manifest commit, and reshard-at-load (pure-data
+    mesh → data x model mesh) all cross a REAL process boundary; params
+    and optimizer state survive bit-exactly (per-leaf sha256)."""
+    monkeypatch.setenv("DS_TEST_CKPT_DIR", str(tmp_path))
+    outs = launch("tests.unit.dist_bodies:save_zero3_resilient", 2,
+                  devices_per_proc=4)
+    for rank, out in enumerate(outs):
+        assert f"Z3-SAVE-OK rank={rank}" in out, out
+    assert (tmp_path / "z3" / ".integrity.json").exists()
+    outs = launch("tests.unit.dist_bodies:load_zero3_resilient", 2,
+                  devices_per_proc=4)
+    for rank, out in enumerate(outs):
+        assert f"Z3-LOAD-OK rank={rank}" in out, out
+
+
+@pytest.mark.heavy
 def test_checkpoint_across_world_sizes(tmp_path, monkeypatch):
     """Reference DistributedFixture pattern (tests/unit/common.py:180):
     save at world_size=2, restore at world_size=4 — params AND optimizer

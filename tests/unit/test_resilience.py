@@ -1,0 +1,784 @@
+"""Fault-tolerance layer (ISSUE 3).
+
+Proof obligations, each driven through the chaos harness
+(``runtime/resilience/chaos.py``):
+
+- a checkpoint corrupted after save is DETECTED at load (manifest
+  verify) and a ``latest`` resume falls back to the previous
+  verified-good tag;
+- a transient IO error during save is retried with backoff and succeeds;
+- an injected NaN gradient triggers the configured sentinel policy:
+  ``skip`` leaves the trajectory identical to an fp16 overflow skip
+  (params/optimizer untouched bit-exactly, ``global_step+1``,
+  ``skipped_steps+1``), ``rollback`` restores the last verified-good
+  state bit-exactly, ``abort`` raises out of ``engine.step()``;
+- an injected stall trips the hang watchdog dump within the configured
+  timeout;
+- **zero-overhead guard**: with resilience absent or disabled (the
+  default) the compiled step HLO is byte-identical; only ``policy:
+  skip`` changes the program (it compiles the fp16-style NaN check in).
+"""
+
+import json
+import logging
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.topology import reset_topology
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+    ArrayCheckpointEngine)
+from deepspeed_tpu.runtime.config import (DeepSpeedConfig, ResilienceConfig,
+                                          ResilienceSentinelConfig)
+from deepspeed_tpu.runtime.resilience import (CheckpointCorruptionError,
+                                              HangWatchdog,
+                                              ResilientCheckpointEngine,
+                                              SentinelAbort, StepSentinel,
+                                              atomic_write_text, chaos,
+                                              read_verified, verify_tag_dir,
+                                              write_manifest)
+from deepspeed_tpu.utils.logging import logger as ds_logger
+
+from tests.unit.simple_model import (random_dataset, simple_loss_fn,
+                                     simple_params)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    reset_topology()
+    chaos.clear()
+    import deepspeed_tpu.comm as dist
+
+    dist.destroy_process_group()
+    yield
+    chaos.clear()
+    reset_topology()
+
+
+# watchdog off by default in tests: an abort-armed watchdog outliving a
+# test would os._exit the pytest process
+RES = {"enabled": True, "watchdog": {"enabled": False},
+       "checkpoint": {"retry_backoff_secs": 0.01}}
+
+
+def _res(**over):
+    out = json.loads(json.dumps(RES))
+    for key, val in over.items():
+        if isinstance(val, dict):
+            out.setdefault(key, {}).update(val)
+        else:
+            out[key] = val
+    return out
+
+
+def _engine(resilience=None, **over):
+    cfg = {
+        "train_batch_size": 32,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.05}},
+        "steps_per_print": 10_000,
+    }
+    if resilience is not None:
+        cfg["resilience"] = resilience
+    cfg.update(over)
+    reset_topology()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_loss_fn, model_parameters=simple_params(), config=cfg)
+    return engine
+
+
+def _batch(n=32):
+    x, y = random_dataset(64, 8)
+    return (x[:n], y[:n])
+
+
+def _steps(engine, n=1, batch=None):
+    batch = batch if batch is not None else _batch()
+    loss = None
+    for _ in range(n):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    return loss
+
+
+def _state_host(engine):
+    s = jax.device_get(engine.state)
+    return (jax.tree_util.tree_leaves(s.params),
+            jax.tree_util.tree_leaves(s.opt_state))
+
+
+# ----------------------------------------------------------------------
+class TestConfig:
+    def test_defaults_off(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8})
+        r = cfg.resilience_config
+        assert r.enabled is False
+        assert r.checkpoint.integrity and r.checkpoint.fallback
+        assert r.sentinel.policy == "warn"
+        assert r.watchdog.enabled and r.watchdog.abort
+
+    def test_validation(self):
+        with pytest.raises(Exception):
+            ResilienceConfig(sentinel={"policy": "explode"})
+        with pytest.raises(Exception):
+            ResilienceConfig(checkpoint={"retries": -1})
+        with pytest.raises(Exception):
+            ResilienceConfig(watchdog={"timeout_secs": 0})
+        with pytest.raises(Exception):
+            ResilienceConfig(sentinel={"loss_window": 0})
+
+    def test_parse_full_block(self):
+        cfg = DeepSpeedConfig({
+            "train_batch_size": 8,
+            "resilience": {
+                "enabled": True,
+                "checkpoint": {"keep_last_n": 3, "retries": 5,
+                               "rollback_dir": "/ckpts"},
+                "sentinel": {"policy": "rollback", "loss_spike_factor": 4.0,
+                             "sync_lag": 0},
+                "watchdog": {"timeout_secs": 120, "abort": False}}})
+        r = cfg.resilience_config
+        assert r.enabled and r.checkpoint.keep_last_n == 3
+        assert r.sentinel.policy == "rollback"
+        assert r.watchdog.timeout_secs == 120 and not r.watchdog.abort
+
+
+# ----------------------------------------------------------------------
+class TestChaosInjectors:
+    def test_io_fault_is_exact(self):
+        with chaos.io_errors("ckpt.save", at_call=2, times=2) as armed:
+            chaos.raise_if("ckpt.save")          # call 1: passes
+            for _ in range(2):                   # calls 2, 3: fail
+                with pytest.raises(chaos.ChaosIOError):
+                    chaos.raise_if("ckpt.save")
+            chaos.raise_if("ckpt.save")          # call 4: passes again
+            assert armed.raised == 2
+        chaos.raise_if("ckpt.save")  # disarmed outside the context
+
+    def test_nan_batches_poisons_exactly_one(self):
+        batches = [_batch() for _ in range(3)]
+        out = list(chaos.nan_batches(batches, at=1))
+        assert not np.isnan(out[0][0]).any()
+        assert np.isnan(out[1][0]).all()
+        assert not np.isnan(out[2][0]).any()
+        # labels (the second float leaf) stay clean
+        assert not np.isnan(out[1][1]).any()
+
+    def test_corrupt_checkpoint_changes_bytes(self, tmp_path):
+        p = tmp_path / "t" / "module.npz"
+        p.parent.mkdir()
+        p.write_bytes(b"A" * 64)
+        chaos.corrupt_checkpoint(str(tmp_path / "t"))
+        assert p.read_bytes() != b"A" * 64
+
+
+# ----------------------------------------------------------------------
+class TestIntegrityUnit:
+    """Manifest / retry / retention on a bare ArrayCheckpointEngine —
+    no jit, no engine."""
+
+    def _resilient(self, **over):
+        cfg = ResilienceConfig(**_res(checkpoint=over)).checkpoint
+        events = []
+        eng = ResilientCheckpointEngine(
+            ArrayCheckpointEngine(), cfg,
+            emit=lambda name, **data: events.append((name, data)))
+        return eng, events
+
+    def _save(self, eng, root, tag, payload=None):
+        eng.create(tag)
+        eng.save(payload or {"w": np.arange(8, dtype=np.float32)},
+                 os.path.join(root, tag, "module"))
+        eng.commit(tag)
+
+    def test_manifest_written_and_verifies(self, tmp_path):
+        eng, events = self._resilient()
+        self._save(eng, str(tmp_path), "t0")
+        tag_dir = str(tmp_path / "t0")
+        assert os.path.exists(os.path.join(tag_dir, ".integrity.json"))
+        assert verify_tag_dir(tag_dir) == "ok"
+        assert read_verified(str(tmp_path)) == ["t0"]
+        assert any(n == "ckpt.verified" for n, _ in events)
+
+    def test_unverified_checkpoint_loads(self, tmp_path):
+        """Pre-resilience checkpoints (no manifest) stay loadable."""
+        plain = ArrayCheckpointEngine()
+        plain.save({"w": np.ones(4, np.float32)},
+                   str(tmp_path / "old" / "module"))
+        eng, _ = self._resilient()
+        assert verify_tag_dir(str(tmp_path / "old")) == "unverified"
+        out = eng.load(str(tmp_path / "old" / "module"))
+        assert "w" in out
+
+    def test_corruption_detected_and_names_file(self, tmp_path):
+        eng, events = self._resilient()
+        self._save(eng, str(tmp_path), "t0")
+        chaos.corrupt_checkpoint(str(tmp_path / "t0"))
+        with pytest.raises(CheckpointCorruptionError) as ei:
+            eng.load(str(tmp_path / "t0" / "module"))
+        assert "checksum mismatch" in str(ei.value)
+        assert "module.npz" in str(ei.value)
+        assert any(n == "ckpt.corrupt" for n, _ in events)
+
+    def test_truncation_detected_by_size(self, tmp_path):
+        eng, _ = self._resilient()
+        self._save(eng, str(tmp_path), "t0")
+        target = str(tmp_path / "t0" / "module.npz")
+        chaos.truncate_file(target, keep_bytes=10)
+        with pytest.raises(CheckpointCorruptionError) as ei:
+            verify_tag_dir(str(tmp_path / "t0"))
+        assert "truncated" in str(ei.value)
+
+    def test_transient_save_error_retried(self, tmp_path):
+        eng, events = self._resilient(retries=3)
+        with chaos.io_errors("ckpt.save", at_call=1, times=2) as armed:
+            self._save(eng, str(tmp_path), "t0")
+        assert armed.raised == 2
+        assert verify_tag_dir(str(tmp_path / "t0")) == "ok"
+        retries = [d for n, d in events if n == "ckpt.retry"]
+        assert [r["attempt"] for r in retries] == [1, 2]
+
+    def test_retry_exhausted_raises(self, tmp_path):
+        eng, _ = self._resilient(retries=1)
+        with chaos.io_errors("ckpt.save", at_call=1, times=5):
+            with pytest.raises(chaos.ChaosIOError):
+                self._save(eng, str(tmp_path), "t0")
+
+    def test_missing_file_is_not_retried(self, tmp_path):
+        """FileNotFoundError is an answer, not a flake — no backoff."""
+        eng, events = self._resilient(retries=3)
+        with pytest.raises(FileNotFoundError):
+            eng.load(str(tmp_path / "ghost" / "module"))
+        assert not [d for n, d in events if n == "ckpt.retry"]
+
+    def test_retention_keeps_protected_tags(self, tmp_path):
+        eng, events = self._resilient(keep_last_n=2)
+        for tag in ("t1", "preempt", "t2", "t3", "t4"):
+            self._save(eng, str(tmp_path), tag)
+        survivors = read_verified(str(tmp_path))
+        # last 2 regular tags survive; preempt is NEVER pruned
+        assert "preempt" in survivors
+        assert survivors[-2:] == ["t3", "t4"]
+        assert not (tmp_path / "t1").exists()
+        assert (tmp_path / "preempt").exists()
+        assert (tmp_path / "t3").exists() and (tmp_path / "t4").exists()
+        pruned = [d for n, d in events if n == "ckpt.prune"]
+        assert pruned and "t1" in pruned[0]["pruned"]
+
+    def test_retention_never_strands_latest(self, tmp_path):
+        eng, _ = self._resilient(keep_last_n=1)
+        self._save(eng, str(tmp_path), "a")
+        atomic_write_text(str(tmp_path / "latest"), "a")
+        for tag in ("b", "c"):
+            self._save(eng, str(tmp_path), tag)
+        # 'a' is what latest points at: protected despite keep_last_n=1
+        assert (tmp_path / "a").exists()
+        assert (tmp_path / "c").exists()
+        assert not (tmp_path / "b").exists()
+
+    def test_resave_invalidates_verify_cache(self, tmp_path):
+        """Overwriting a tag in the same process must re-verify it: the
+        cached 'ok' verdict describes bytes that no longer exist."""
+        eng, _ = self._resilient()
+        self._save(eng, str(tmp_path), "best")
+        eng.load(str(tmp_path / "best" / "module"))  # caches 'ok'
+        self._save(eng, str(tmp_path), "best",
+                   payload={"w": np.arange(16, dtype=np.float32)})
+        chaos.corrupt_checkpoint(str(tmp_path / "best"))
+        with pytest.raises(CheckpointCorruptionError):
+            eng.load(str(tmp_path / "best" / "module"))
+
+    def test_atomic_write_text(self, tmp_path):
+        p = str(tmp_path / "latest")
+        atomic_write_text(p, "tag1")
+        atomic_write_text(p, "tag2")
+        assert open(p).read() == "tag2"
+        assert not os.path.exists(p + ".tmp")
+
+
+# ----------------------------------------------------------------------
+class TestCheckpointFallback:
+    def test_corrupt_latest_falls_back_to_verified_good(self, tmp_path):
+        """THE acceptance path: corrupt the newest checkpoint after save;
+        a `latest` resume detects it and restores the previous
+        verified-good tag instead of crashing."""
+        engine = _engine(_res())
+        _steps(engine, 2)
+        engine.save_checkpoint(str(tmp_path), tag="A")
+        _steps(engine, 1)
+        engine.save_checkpoint(str(tmp_path), tag="B")
+        chaos.corrupt_checkpoint(str(tmp_path / "B"))
+
+        engine2 = _engine(_res())
+        tag, _ = engine2.load_checkpoint(str(tmp_path))
+        assert tag == "A"
+        assert engine2.global_steps == 2
+        names = [f["name"] for f in engine2.resilience.fault_tail]
+        assert "ckpt.corrupt" in names and "ckpt.fallback" in names
+        # the fallback restore must keep training
+        loss = _steps(engine2, 1)
+        assert np.isfinite(float(loss))
+
+    def test_explicit_missing_tag_lists_available(self, tmp_path):
+        engine = _engine(_res())
+        _steps(engine, 1)
+        engine.save_checkpoint(str(tmp_path), tag="have")
+        with pytest.raises(FileNotFoundError) as ei:
+            engine.load_checkpoint(str(tmp_path), tag="ghost")
+        msg = str(ei.value)
+        assert "ghost" in msg and "'have'" in msg
+
+    def test_latest_at_deleted_dir_clear_error_without_resilience(
+            self, tmp_path):
+        """Satellite: with resilience OFF (no fallback chain), a `latest`
+        pointing at a deleted dir raises a clear error naming the tags
+        actually present — not a cryptic npz exception."""
+        import shutil
+
+        engine = _engine()  # resilience absent (default)
+        _steps(engine, 1)
+        engine.save_checkpoint(str(tmp_path), tag="t1")
+        _steps(engine, 1)
+        engine.save_checkpoint(str(tmp_path), tag="t2")
+        shutil.rmtree(str(tmp_path / "t2"))
+        with pytest.raises(FileNotFoundError) as ei:
+            engine.load_checkpoint(str(tmp_path))
+        msg = str(ei.value)
+        assert "'latest' points at 't2'" in msg and "'t1'" in msg
+
+    def test_explicit_corrupt_tag_raises_no_silent_fallback(self, tmp_path):
+        engine = _engine(_res())
+        _steps(engine, 1)
+        engine.save_checkpoint(str(tmp_path), tag="A")
+        _steps(engine, 1)
+        engine.save_checkpoint(str(tmp_path), tag="B")
+        chaos.corrupt_checkpoint(str(tmp_path / "B"))
+        with pytest.raises(CheckpointCorruptionError):
+            engine.load_checkpoint(str(tmp_path), tag="B")
+
+    def test_latest_pointer_is_crash_safe(self, tmp_path):
+        engine = _engine(_res())
+        _steps(engine, 1)
+        engine.save_checkpoint(str(tmp_path), tag="t1")
+        assert (tmp_path / "latest").read_text() == "t1"
+        assert not (tmp_path / "latest.tmp").exists()
+
+
+# ----------------------------------------------------------------------
+class TestSentinelUnit:
+    def _sentinel(self, trips, **over):
+        cfg = ResilienceSentinelConfig(**{"sync_lag": 0, **over})
+        return StepSentinel(cfg, on_trip=lambda s, v, r: trips.append(
+            (s, v, r)))
+
+    def test_nonfinite_trips(self):
+        trips = []
+        s = self._sentinel(trips)
+        s.observe(1, 1.0)
+        s.observe(2, float("nan"))
+        s.observe(3, float("inf"))
+        assert [(st, r) for st, _, r in trips] == [(2, "nonfinite"),
+                                                   (3, "nonfinite")]
+
+    def test_loss_spike_needs_history(self):
+        trips = []
+        s = self._sentinel(trips, loss_spike_factor=3.0, min_history=3)
+        s.observe(1, 100.0)  # huge first loss: no history yet, no trip
+        for i, v in enumerate([1.0, 1.1, 0.9], start=2):
+            s.observe(i, v)
+        assert not trips
+        s.observe(5, 50.0)
+        assert trips == [(5, 50.0, "loss_spike")]
+        # the spike never enters the window (one bad step must not drag
+        # the baseline up)
+        s.observe(6, 1.0)
+        assert len(trips) == 1
+
+    def test_sync_lag_defers_the_check(self):
+        trips = []
+        s = self._sentinel(trips, sync_lag=2)
+        s.observe(1, float("nan"))
+        s.observe(2, 1.0)
+        assert not trips  # both still pending
+        s.observe(3, 1.0)  # step 1 crosses the lag horizon
+        assert [(st, r) for st, _, r in trips] == [(1, "nonfinite")]
+        s.drain()
+        assert len(trips) == 1
+
+    def test_observe_value_supersedes_pending(self):
+        trips = []
+        s = self._sentinel(trips, sync_lag=1)
+        s.observe(1, float("nan"))       # pending behind the lag
+        s.observe_value(1, float("nan"))  # synced path judges it NOW, once
+        s.observe(2, 1.0)
+        s.drain()
+        assert len(trips) == 1
+
+
+class TestSentinelPolicies:
+    def test_skip_matches_fp16_overflow_semantics(self):
+        """policy: skip — a NaN-gradient step is refused IN-GRAPH exactly
+        like an fp16 overflow: params AND optimizer state bit-identical,
+        global_step advances, skipped_steps increments, and the engine
+        reports the step as not applied."""
+        engine = _engine(_res(sentinel={"policy": "skip", "sync_lag": 0}))
+        _steps(engine, 2)
+        p_before, o_before = _state_host(engine)
+        _steps(engine, 1, batch=chaos.poison_batch(_batch()))
+        p_after, o_after = _state_host(engine)
+        for a, b in zip(p_before, p_after):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(o_before, o_after):
+            np.testing.assert_array_equal(a, b)
+        assert int(engine.state.global_step) == 3
+        assert engine.get_skipped_steps() == 1
+        assert not engine.was_step_applied()
+        assert engine.resilience.sentinel.trips[0][2] == "nonfinite"
+        # the next good step trains again
+        _steps(engine, 1)
+        assert engine.was_step_applied()
+        assert engine.get_skipped_steps() == 1
+
+    def test_rollback_restores_last_good_bit_exact(self, tmp_path):
+        engine = _engine(_res(sentinel={"policy": "rollback",
+                                        "sync_lag": 0}))
+        _steps(engine, 2)
+        engine.save_checkpoint(str(tmp_path), tag="good")
+        p_good, o_good = _state_host(engine)
+        _steps(engine, 1)                                    # diverge
+        replays = []
+        engine.resilience.on_rollback = replays.append
+        _steps(engine, 1, batch=chaos.poison_batch(_batch()))  # trip
+        assert engine.global_steps == 2
+        p_rb, o_rb = _state_host(engine)
+        for a, b in zip(p_good, p_rb):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(o_good, o_rb):
+            np.testing.assert_array_equal(a, b)
+        assert replays and replays[0]["steps_to_replay"] == 2
+        assert replays[0]["micro_batches_to_replay"] == 2  # gas == 1
+        assert replays[0]["restored_tag"] == "good"
+        names = [f["name"] for f in engine.resilience.fault_tail]
+        assert "sentinel.rollback" in names
+        _steps(engine, 1)  # keeps training from the restored state
+        assert engine.global_steps == 3
+
+    def test_rollback_escalates_to_abort_at_limit(self, tmp_path):
+        engine = _engine(_res(sentinel={"policy": "rollback",
+                                        "sync_lag": 0,
+                                        "max_rollbacks": 1}))
+        _steps(engine, 1)
+        engine.save_checkpoint(str(tmp_path), tag="g")
+        bad = chaos.poison_batch(_batch())
+        _steps(engine, 1, batch=bad)          # rollback #1
+        assert engine.resilience.rollbacks == 1
+        with pytest.raises(SentinelAbort, match="persistent"):
+            _steps(engine, 1, batch=bad)      # beyond the limit
+
+    def test_rollback_without_checkpoint_degrades_to_warn(self):
+        engine = _engine(_res(sentinel={"policy": "rollback",
+                                        "sync_lag": 0}))
+        _steps(engine, 1)
+        _steps(engine, 1, batch=chaos.poison_batch(_batch()))  # no raise
+        names = [f["name"] for f in engine.resilience.fault_tail]
+        assert "sentinel.rollback_unavailable" in names
+        assert engine.resilience.rollbacks == 0
+
+    def test_abort_raises_out_of_step(self):
+        engine = _engine(_res(sentinel={"policy": "abort", "sync_lag": 0}))
+        _steps(engine, 1)
+        with pytest.raises(SentinelAbort):
+            _steps(engine, 1, batch=chaos.poison_batch(_batch()))
+
+    def test_pending_loss_judged_before_save(self, tmp_path):
+        """sync_lag holds the last boundary's loss — but a checkpoint
+        save drains the queue first, so a still-unjudged NaN can never
+        become a verified-good checkpoint."""
+        engine = _engine(_res(sentinel={"policy": "abort", "sync_lag": 1}))
+        _steps(engine, 1)
+        _steps(engine, 1, batch=chaos.poison_batch(_batch()))  # lagged
+        assert not engine.resilience.sentinel.trips  # still pending
+        with pytest.raises(SentinelAbort):
+            engine.save_checkpoint(str(tmp_path), tag="poisoned")
+        assert not (tmp_path / "poisoned").exists()
+
+    def test_close_drains_pending_without_aborting(self):
+        engine = _engine(_res(sentinel={"policy": "abort", "sync_lag": 1}))
+        _steps(engine, 1)
+        _steps(engine, 1, batch=chaos.poison_batch(_batch()))
+        engine.destroy()  # must not raise; the trip is still surfaced
+        assert engine.resilience.sentinel.trips
+        names = [f["name"] for f in engine.resilience.fault_tail]
+        assert "sentinel.trip" in names
+
+    def test_warn_policy_logs_and_continues(self):
+        engine = _engine(_res(sentinel={"policy": "warn", "sync_lag": 0}))
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = Capture(level=logging.WARNING)
+        ds_logger.addHandler(handler)
+        try:
+            _steps(engine, 1, batch=chaos.poison_batch(_batch()))
+        finally:
+            ds_logger.removeHandler(handler)
+        assert any("SENTINEL TRIP" in m for m in records), records
+        _steps(engine, 1)  # continues
+
+
+# ----------------------------------------------------------------------
+class TestWatchdog:
+    def _watchdog(self, timeout=0.25, **over):
+        dumps = []
+        wd = HangWatchdog(timeout_secs=timeout, poll_secs=0.05,
+                          abort=False, name="test",
+                          on_dump=lambda d, p: dumps.append((d, p)),
+                          **over)
+        return wd, dumps
+
+    def test_fires_on_stall_within_timeout(self, tmp_path):
+        wd, dumps = self._watchdog(dump_dir=str(tmp_path))
+        wd.start()
+        wd.notify(step=7)
+        chaos.simulate_stall(0.8)
+        wd.stop()
+        assert wd.fired and dumps
+        dump, path = dumps[0]
+        assert "HANG WATCHDOG" in dump and "python stacks" in dump
+        assert "last completed step 7" in dump
+        assert path and os.path.exists(path)
+
+    def test_does_not_fire_while_progressing(self, tmp_path):
+        wd, dumps = self._watchdog(dump_dir=str(tmp_path))
+        wd.start()
+        for _ in range(6):
+            wd.notify()
+            chaos.simulate_stall(0.1)
+        wd.stop()
+        assert not wd.fired and not dumps
+
+    def test_unarmed_never_fires(self, tmp_path):
+        """No notify yet = still compiling step 1: the initial compile
+        can never trip the watchdog."""
+        wd, dumps = self._watchdog(dump_dir=str(tmp_path))
+        wd.start()
+        chaos.simulate_stall(0.8)
+        wd.stop()
+        assert not wd.fired
+
+    def test_dump_includes_event_tail(self, tmp_path):
+        wd, dumps = self._watchdog(
+            dump_dir=str(tmp_path),
+            tail_fn=lambda: [{"name": "sentinel.trip", "step": 3}])
+        wd.start()
+        wd.notify()
+        chaos.simulate_stall(0.8)
+        wd.stop()
+        assert "telemetry event tail" in dumps[0][0]
+        assert "sentinel.trip" in dumps[0][0]
+
+    def test_suspended_during_long_io(self, tmp_path):
+        """A checkpoint save that outlasts the step timeout is not a
+        hang: the engine suspends the timer around checkpoint IO."""
+        wd, dumps = self._watchdog(dump_dir=str(tmp_path))
+        wd.start()
+        wd.notify(1)
+        wd.suspend()               # engine.save_checkpoint does this
+        chaos.simulate_stall(0.8)  # slow blob store
+        wd.resume()
+        assert not wd.fired
+        chaos.simulate_stall(0.8)  # but a REAL post-save stall still fires
+        wd.stop()
+        assert wd.fired
+
+    def test_idle_ok_serving_mode(self, tmp_path):
+        """Serving engines: an idle gap between requests is healthy — the
+        stall timer only runs while a request is in flight, and a request
+        that raises clears its bracket (no leaked-busy false positives)."""
+        wd, dumps = self._watchdog(dump_dir=str(tmp_path), idle_ok=True)
+        wd.start()
+        wd.notify(1)                 # request completed; server now idle
+        chaos.simulate_stall(0.8)    # idle >> timeout: healthy
+        assert not wd.fired
+        wd.busy_begin()              # request in flight...
+        chaos.simulate_stall(0.8)    # ...and stalled: THAT is a hang
+        assert wd.fired
+        wd.stop()
+
+    def test_serving_abandoned_request_clears_bracket(self, tmp_path):
+        wd, dumps = self._watchdog(dump_dir=str(tmp_path), idle_ok=True)
+        wd.start()
+        wd.busy_begin()
+        wd.busy_end()                # the abandon path (request raised)
+        chaos.simulate_stall(0.8)
+        wd.stop()
+        assert not wd.fired
+
+    def test_engine_integration_fires_and_stops(self, tmp_path):
+        engine = _engine(_res(watchdog={
+            "enabled": True, "timeout_secs": 0.3, "abort": False,
+            "dump_dir": str(tmp_path)}))
+        fired = []
+        _steps(engine, 1)
+        engine.resilience.watchdog.on_dump = \
+            lambda d, p: fired.append(p)
+        _steps(engine, 2)
+        assert not engine.resilience.watchdog.fired
+        chaos.simulate_stall(1.0)  # the injected stall
+        assert engine.resilience.watchdog.fired and fired
+        engine.destroy()  # stops the thread
+        assert engine.resilience.watchdog._thread is None
+
+
+# ----------------------------------------------------------------------
+class TestZeroOverheadGuard:
+    def test_step_hlo_byte_identical_when_disabled(self):
+        """Resilience absent / disabled / enabled-with-warn: the compiled
+        micro AND apply step HLO is byte-identical (the layer observes,
+        it never rewrites the program). Only `policy: skip` compiles the
+        NaN check into the APPLY program — and that difference is
+        asserted REAL below, so the guard can't pass vacuously."""
+        batch = _batch()
+
+        def micro_hlo(engine):
+            fn = engine._jit_micro
+            raw = getattr(fn, "_fn", fn)
+            return raw.lower(engine.state,
+                             engine._shard_batch(batch)).compile().as_text()
+
+        def apply_hlo(engine):
+            fn = engine._jit_apply
+            raw = getattr(fn, "_fn", fn)
+            return raw.lower(engine.state,
+                             engine._lr_override()).compile().as_text()
+
+        absent = _engine()
+        disabled = _engine({"enabled": False})
+        warn = _engine(_res(sentinel={"policy": "warn"}))
+        skip = _engine(_res(sentinel={"policy": "skip"}))
+
+        m_absent, a_absent = micro_hlo(absent), apply_hlo(absent)
+        assert m_absent == micro_hlo(disabled)
+        assert a_absent == apply_hlo(disabled)
+        assert m_absent == micro_hlo(warn)
+        assert a_absent == apply_hlo(warn)
+        # `skip`: the overflow probe + skip-update path lives in the
+        # optimizer-apply program; the fwd/bwd micro program is untouched
+        assert m_absent == micro_hlo(skip)
+        assert a_absent != apply_hlo(skip)
+
+    def test_disabled_manager_is_inert(self):
+        from deepspeed_tpu.runtime.resilience import Resilience
+
+        m = Resilience(None)
+        assert not m.enabled
+        assert m.sentinel is None and m.watchdog is None
+        inner = ArrayCheckpointEngine()
+        assert m.wrap_checkpoint_engine(inner) is inner
+        m.on_step_boundary(None, 1, loss=float("nan"))  # no-op, no trip
+        m.close()
+
+    def test_default_engine_has_unwrapped_checkpoint_engine(self):
+        engine = _engine()
+        assert not isinstance(engine.checkpoint_engine,
+                              ResilientCheckpointEngine)
+        engine2 = _engine(_res())
+        assert isinstance(engine2.checkpoint_engine,
+                          ResilientCheckpointEngine)
+
+
+# ----------------------------------------------------------------------
+class TestShardedIntegrity:
+    """Integrity layer over the SHARDED (orbax) checkpoint tier — the
+    manifest must cover the per-shard tensorstore files, and verification
+    must gate ``load_sharded`` the same way it gates consolidated loads.
+    (The 2-process x 4-device leg of this path lives in
+    ``test_multihost_dist.py::test_zero3_resilient_checkpoint_across_processes``.)"""
+
+    def _engine(self):
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+        import jax.numpy as jnp
+
+        reset_topology()
+        engine, *_ = deepspeed_tpu.initialize(
+            model=GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32,
+                                                  n_layer=2)),
+            config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3,
+                                      "stage3_param_persistence_threshold": 0},
+                "checkpoint": {"sharded": True},
+                "resilience": _res(),
+                "steps_per_print": 10_000,
+            })
+        return engine
+
+    def _step(self, engine):
+        ids = np.random.default_rng(0).integers(
+            0, 256, (8, 32)).astype(np.int32)
+        loss = engine({"input_ids": ids})
+        engine.backward(loss)
+        engine.step()
+
+    @pytest.mark.heavy
+    def test_zero3_sharded_manifest_verify_and_corruption(self, tmp_path):
+        engine = self._engine()
+        self._step(engine)
+        engine.save_checkpoint(str(tmp_path), tag="z3")
+        tag_dir = str(tmp_path / "z3")
+        manifest = json.load(open(os.path.join(tag_dir, ".integrity.json")))
+        # the manifest spans the orbax shard payloads, not just aux files
+        assert any("module.orbax" in rel for rel in manifest["files"])
+        assert verify_tag_dir(tag_dir) == "ok"
+        assert read_verified(str(tmp_path)) == ["z3"]
+
+        # clean reload (verification passes, reshard-at-load works)
+        engine2 = self._engine()
+        self._step(engine2)  # materialize the state template
+        tag, _ = engine2.load_checkpoint(str(tmp_path), tag="z3")
+        assert tag == "z3" and engine2.global_steps == 1
+
+        # corruption inside an orbax shard file is detected BEFORE load
+        chaos.corrupt_checkpoint(tag_dir)
+        engine3 = self._engine()
+        self._step(engine3)
+        with pytest.raises(CheckpointCorruptionError):
+            engine3.load_checkpoint(str(tmp_path), tag="z3")
+
+
+# ----------------------------------------------------------------------
+class TestFaultTelemetry:
+    def test_fault_events_land_in_sink_and_report(self, tmp_path):
+        tele_dir = str(tmp_path / "tele")
+        engine = _engine(_res(sentinel={"policy": "warn", "sync_lag": 0}),
+                         telemetry={"enabled": True, "dir": tele_dir})
+        _steps(engine, 1)
+        with chaos.io_errors("ckpt.save", at_call=1, times=1):
+            engine.save_checkpoint(str(tmp_path / "ck"), tag="t0")
+        _steps(engine, 1, batch=chaos.poison_batch(_batch()))
+        engine.telemetry.flush()
+        with open(os.path.join(tele_dir, "telemetry.jsonl")) as f:
+            events = [json.loads(line) for line in f]
+        faults = [e for e in events if e["kind"] == "fault"]
+        names = {e["name"] for e in faults}
+        assert {"ckpt.retry", "ckpt.verified", "sentinel.trip"} <= names
+        trip = next(e for e in faults if e["name"] == "sentinel.trip")
+        assert trip["data"]["policy"] == "warn"
+        # telemetry tail feeds the watchdog dump
+        assert any(e["kind"] == "fault" for e in engine.telemetry.tail())
+
+        from tools.telemetry_report import render
+
+        report = render(os.path.join(tele_dir, "telemetry.jsonl"))
+        assert "faults (resilience layer)" in report
+        assert "sentinel.trip" in report
+        md = render(os.path.join(tele_dir, "telemetry.jsonl"),
+                    markdown=True)
+        assert "| fault | count |" in md

@@ -43,6 +43,7 @@ def aggregate(events: List[Dict]) -> Dict:
     trace_windows = []
     wallclock: Dict[str, List[float]] = {}
     steps = {"count": 0, "last": 0}
+    faults = {"by_name": {}, "recent": []}
     for e in events:
         kind, name, data = e.get("kind"), e.get("name"), e.get("data", {})
         if kind == "compile":
@@ -75,6 +76,11 @@ def aggregate(events: List[Dict]) -> Dict:
         elif kind == "step":
             steps["count"] += 1
             steps["last"] = max(steps["last"], e.get("step") or 0)
+        elif kind == "fault":
+            faults["by_name"][name] = faults["by_name"].get(name, 0) + 1
+            faults["recent"].append(
+                {"name": name, "step": e.get("step"), **data})
+            faults["recent"] = faults["recent"][-20:]
     return {
         "compile": compile_by_name,
         "step_cost": step_cost_by_name,
@@ -82,7 +88,34 @@ def aggregate(events: List[Dict]) -> Dict:
         "trace_windows": trace_windows,
         "wallclock": {k: sum(v) / len(v) for k, v in wallclock.items()},
         "steps": steps,
+        "faults": faults,
     }
+
+
+def _fault_lines(agg: Dict, markdown: bool) -> List[str]:
+    """Resilience-layer faults: checkpoint retries/fallbacks, sentinel
+    trips/rollbacks, watchdog hang dumps."""
+    faults = agg.get("faults") or {"by_name": {}, "recent": []}
+    if not faults["by_name"]:
+        return []
+    out = []
+    if markdown:
+        out.append("\nFaults (resilience layer):\n")
+        out.append("| fault | count |")
+        out.append("|---|---|")
+        for name, count in sorted(faults["by_name"].items()):
+            out.append(f"| `{name}` | {count} |")
+    else:
+        out.append("")
+        out.append("faults (resilience layer):")
+        for name, count in sorted(faults["by_name"].items()):
+            out.append(f"  {name:<44}{count:>9}")
+    for f in faults["recent"][-5:]:
+        detail = ", ".join(f"{k}={v}" for k, v in f.items()
+                           if k not in ("name", "step") and v is not None)
+        out.append(f"{'' if markdown else '  '}last: {f['name']} at step "
+                   f"{f.get('step')}" + (f" ({detail})" if detail else ""))
+    return out
 
 
 def _compile_table(agg: Dict, markdown: bool) -> List[str]:
@@ -178,6 +211,7 @@ def render(path: str, markdown: bool = False) -> str:
     for w in agg["trace_windows"]:
         lines.append(f"trace window: {w['action']} at step {w['step']}"
                      + (f" -> {w['dir']}" if w.get("dir") else ""))
+    lines.extend(_fault_lines(agg, markdown))
     return "\n".join(lines)
 
 

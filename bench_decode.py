@@ -122,6 +122,65 @@ def main():
         "batch": batch, "prompt": prompt, "new_tokens": new_tokens,
     })
 
+    # --- serving series: continuous batching under mixed arrivals.
+    # Emitted AFTER the headline JSON (window-proofing rule: an optional
+    # series crashing must never cost the headline). Mixed-arrival
+    # tokens/s counts every generated token over the drain wall-clock;
+    # TTFT p50/p95 and shed rate come from the per-request records.
+    del engine8
+    from deepspeed_tpu.parallel.topology import reset_topology
+    from deepspeed_tpu.serving import ServingEngine
+
+    reset_topology()
+    if on_tpu:
+        scfg = {"block_size": 32, "decode_slots": batch,
+                "max_queue_depth": 4 * batch}
+        n_requests, arrive_every = 4 * batch, 2
+        lens = [prompt // 2, prompt, prompt + prompt // 2]
+        srv_new = new_tokens
+    else:
+        scfg = {"block_size": 8, "decode_slots": 2, "max_queue_depth": 16}
+        n_requests, arrive_every = 6, 1
+        lens = [4, 6, 8]
+        srv_new = 4
+    srv = ServingEngine(deepspeed_tpu.init_inference(
+        GPT2LMHeadModel(cfg), dtype=cfg.dtype,
+        tensor_parallel={"tp_size": 1}, max_out_tokens=cfg.n_positions,
+        serving=scfg))
+    srv_rng = np.random.default_rng(1)
+
+    def run_mixed():
+        pending = [srv_rng.integers(0, cfg.vocab_size,
+                                    lens[i % len(lens)]).astype(np.int32)
+                   for i in range(n_requests)]
+        t0 = time.perf_counter()
+        i = 0
+        while pending or srv.pending:
+            for _ in range(arrive_every):
+                if pending:
+                    srv.submit(pending.pop(0), max_new_tokens=srv_new)
+                    i += 1
+            srv.step()
+        srv.drain()
+        return time.perf_counter() - t0
+
+    run_mixed()  # warm the bucket set + decode program
+    srv.reset_stats()  # records AND scheduler counters: the emitted
+    elapsed = run_mixed()  # series must cover only the measured window
+    st = srv.stats()
+    tokens_out = sum(r["new_tokens"] for r in srv.records
+                     if r["state"] != "shed")
+    emit_result({
+        "metric": f"{METRIC}_serving",
+        "mixed_arrival_tokens_per_sec": round(tokens_out / elapsed, 1)
+        if elapsed > 0 else None,
+        "ttft_ms_p50": st["ttft_ms_p50"],
+        "ttft_ms_p95": st["ttft_ms_p95"],
+        "shed_rate": st["shed_rate"],
+        "decode_slots": scfg["decode_slots"],
+        "requests": n_requests, "new_tokens": srv_new,
+    })
+
 
 if __name__ == "__main__":
     run_guarded(METRIC, main)

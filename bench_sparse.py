@@ -50,11 +50,11 @@ def main():
         seqs, iters = (8192, 16384), 8
         ctx = None
     else:  # CPU smoke: interpret-mode kernels at tiny shapes
-        from jax.experimental.pallas import tpu as pltpu
+        from deepspeed_tpu.utils.compat import tpu_interpret_mode
 
         B, H, D, BLOCK = 1, 2, 32, 64
         seqs, iters = (256,), 2
-        ctx = pltpu.force_tpu_interpret_mode()
+        ctx = tpu_interpret_mode()
         ctx.__enter__()
 
     results = {}

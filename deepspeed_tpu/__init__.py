@@ -119,6 +119,17 @@ def init_inference(model, config=None, **kwargs):
     return InferenceEngine(model, config=config, **kwargs)
 
 
+def init_serving(model, config=None, **kwargs):
+    """Build the continuous-batching serving runtime (paged KV cache +
+    request scheduler) over an inference engine. ``model`` may be a flax
+    model (a fresh :class:`InferenceEngine` is built from ``config`` /
+    ``kwargs``, which must carry a ``serving`` block) or an existing
+    :class:`InferenceEngine` whose config already has one."""
+    from deepspeed_tpu.serving import ServingEngine
+
+    return ServingEngine(model, config=config, **kwargs)
+
+
 def add_config_arguments(parser):
     """Add ``--deepspeed``/``--deepspeed_config`` args (reference ``:159-207``)."""
     group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")

@@ -98,6 +98,13 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # serving tier arms the hang watchdog on request progress; sentinel/
     # checkpoint-integrity knobs are training-side
     resilience: Dict = {}
+    # TPU-native: serving layer (serving/config.ServingConfig) — paged
+    # KV-cache block pool + continuous-batching scheduler, consumed by
+    # ServingEngine. None (absent) keeps this engine byte-identical:
+    # generate()'s compile-cache keying and compiled HLO are untouched.
+    # When present, generate() also pads prompt lengths up to the serving
+    # bucket set before keying its compile cache.
+    serving: Optional[Dict] = None
     tensor_parallel: DeepSpeedTPConfig = Field(DeepSpeedTPConfig(), alias="tp")
     enable_cuda_graph: bool = False  # accepted; XLA jit-cache supersedes it
     zero: Dict = {}

@@ -242,6 +242,17 @@ class InferenceEngine:
         self._forward_last_fn = None
         self._generate_cache: Dict[Any, Callable] = {}
         self._model_times = []
+        self.model_profile_enabled = False
+        # serving block (paged KV / continuous batching — consumed by
+        # ServingEngine). Absent → None: this engine's compiled HLO and
+        # generate() cache keying stay byte-identical (pinned in
+        # tests/unit/test_serving.py); present → generate() pads prompt
+        # lengths up to the serving bucket set before keying its cache
+        self._serving_cfg = None
+        if config.serving:
+            from deepspeed_tpu.serving.config import ServingConfig
+
+            self._serving_cfg = ServingConfig(**config.serving)
         # telemetry: serving-side compile watchdog / HLO cost / memory —
         # a generate-shape recompile storm is the serving analog of the
         # training engine's retrace blind spot
@@ -399,7 +410,7 @@ class InferenceEngine:
         t.start()
         out = jax.block_until_ready(self._forward_fn(self.params, input_ids))
         t.stop()
-        self._model_times.append(t.elapsed(reset=True))
+        self._record_model_time("forward", t.elapsed(reset=True))
         return out
 
     __call__ = forward
@@ -425,20 +436,41 @@ class InferenceEngine:
         out = jax.block_until_ready(
             self._forward_last_fn(self.params, input_ids))
         t.stop()
-        self._model_times.append(t.elapsed(reset=True))
+        self._record_model_time("forward_last", t.elapsed(reset=True))
         return out
 
-    def profile_model_time(self, use_cuda_events=True):
+    def profile_model_time(self, use_cuda_events=None):
         """API parity with reference ``profile_model_time``
         (inference/engine.py:140): forward latencies are ALWAYS collected
         here (each jitted forward is block_until_ready-timed — the
         device-event machinery the reference opts into is the default on
-        this path), so this only acknowledges the request."""
-        del use_cuda_events
+        this path), so this only acknowledges the request.
+
+        ``use_cuda_events`` is CUDA-era and retired: accepted for source
+        compatibility, warned about, ignored."""
+        if use_cuda_events is not None:
+            import warnings
+
+            warnings.warn(
+                "profile_model_time(use_cuda_events=...) is CUDA-era and "
+                "ignored on this backend: every jitted forward is fenced "
+                "and wall-clock timed regardless", DeprecationWarning,
+                stacklevel=2)
         self.model_profile_enabled = True
 
+    def _record_model_time(self, name: str, seconds: float):
+        """One forward/generate latency: buffered for :meth:`model_times`
+        AND mirrored into the telemetry event stream (kind
+        ``model_time``), so stream consumers see every entry even when a
+        caller never drains the buffer."""
+        self._model_times.append(seconds)
+        self.telemetry.emit("model_time", name, step=self._request_count,
+                            ms=round(1e3 * seconds, 4))
+
     def model_times(self):
-        """Per-forward latencies (reference ``inference/engine.py:140,484``)."""
+        """Per-forward latencies (reference ``inference/engine.py:140,484``).
+        Drains the buffer; the same entries ride the telemetry stream as
+        ``model_time`` events when telemetry is enabled."""
         times = self._model_times
         self._model_times = []
         return times
@@ -564,6 +596,17 @@ class InferenceEngine:
 
             attention_mask = validate_left_padded_mask(input_ids,
                                                        attention_mask)
+        # serving-bucketed compile cache (satellite of the serving layer):
+        # pad the prompt LEFT up to the bucket set so ad-hoc callers stop
+        # compiling one program per distinct prompt length. Tokens are
+        # unchanged (the padded-mask path proves parity in
+        # test_padded_generate); the pad columns are stripped on return.
+        trim = 0
+        if self._serving_cfg is not None and self._serving_cfg.enabled \
+                and self._serving_cfg.bucket_legacy_generate:
+            input_ids, attention_mask, trim = self._bucket_prompt(
+                input_ids, attention_mask, limit, max_new_tokens)
+            T += trim
         padded = attention_mask is not None
         key = (T, int(max_new_tokens), bool(do_sample), int(top_k),
                float(top_p), padded)
@@ -579,14 +622,47 @@ class InferenceEngine:
             jnp.asarray(eos_token_id, jnp.int32))
         new.block_until_ready()
         t.stop()
-        self._model_times.append(t.elapsed(reset=True))
+        self._record_model_time("generate", t.elapsed(reset=True))
         # request boundary: memory sample / trace window arming (the
         # block_until_ready above is the fence it piggybacks on)
         self._request_count += 1
         self.telemetry.on_step_boundary(self._request_count,
                                         samples=int(B))
         self.resilience.serving_heartbeat(self._request_count)
-        return np.concatenate([np.asarray(input_ids), np.asarray(new)], axis=1)
+        out = np.concatenate([np.asarray(input_ids), np.asarray(new)], axis=1)
+        return out[:, trim:] if trim else out
+
+    def _bucket_prompt(self, input_ids, attention_mask, limit,
+                       max_new_tokens):
+        """Round the prompt length up to the serving bucket set by LEFT
+        padding (plus a mask marking the pads), so ``_generate_cache``
+        keys on a small fixed set of lengths. Skipped when the padded
+        length would overflow the model window or the model lacks the
+        padded decode path — those calls keep the exact-length program."""
+        from deepspeed_tpu.serving.config import bucket_for, resolve_buckets
+
+        B, T = input_ids.shape
+        scfg = self._serving_cfg
+        max_len = int(limit or self._config.max_out_tokens)
+        buckets = resolve_buckets(scfg.prompt_buckets, max_len,
+                                  floor=scfg.block_size)
+        bT = bucket_for(T, buckets)
+        if bT is None or bT == T:
+            return input_ids, attention_mask, 0
+        if limit is not None and bT + max_new_tokens > limit:
+            return input_ids, attention_mask, 0  # pads would eat the window
+        try:
+            self._decode_module(padded=True)
+        except ValueError:
+            return input_ids, attention_mask, 0  # no padded decode support
+        pad = bT - T
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, T), jnp.int32)
+        input_ids = jnp.concatenate(
+            [jnp.zeros((B, pad), input_ids.dtype), input_ids], axis=1)
+        attention_mask = jnp.concatenate(
+            [jnp.zeros((B, pad), jnp.int32), attention_mask], axis=1)
+        return input_ids, attention_mask, pad
 
     # ------------------------------------------------------------------
     def _save_mp_checkpoint(self, path, params_host):

@@ -163,6 +163,7 @@ class ZeroInferenceEngine:
                                      telemetry=self.telemetry,
                                      name="zero_inference", serving=True)
         self._request_count = 0
+        self.model_profile_enabled = False
 
         z = config.zero or {}
         off: Dict[str, Any] = dict(z.get("offload_param") or {})
@@ -548,7 +549,7 @@ class ZeroInferenceEngine:
         x = self._stream(x, lambda l, row, h: fns["plain_block"](row, h))
         out = jax.block_until_ready(fns["logits_all"](self._top_dev, x))
         t.stop()
-        self._model_times.append(t.elapsed(reset=True))
+        self._record_model_time("forward", t.elapsed(reset=True))
         return out
 
     __call__ = forward
@@ -658,7 +659,7 @@ class ZeroInferenceEngine:
             tokens.append(nxt)
             token = jnp.asarray(nxt)
         t.stop()
-        self._model_times.append(t.elapsed(reset=True))
+        self._record_model_time("generate", t.elapsed(reset=True))
         # request boundary: the per-token host loop above already syncs
         # (np.asarray on each sampled token), so the sample is passive
         self._request_count += 1
@@ -668,13 +669,25 @@ class ZeroInferenceEngine:
             [np.asarray(ids)] + [tk[:, None] for tk in tokens], axis=1)
 
     # ------------------------------------------------------------------
+    def _record_model_time(self, name, seconds):
+        # same contract as InferenceEngine._record_model_time: buffer for
+        # model_times() AND mirror into the telemetry stream
+        self._model_times.append(seconds)
+        self.telemetry.emit("model_time", name, step=self._request_count,
+                            ms=round(1e3 * seconds, 4))
+
     def model_times(self):
         times = self._model_times
         self._model_times = []
         return times
 
-    def profile_model_time(self, use_cuda_events=True):
-        del use_cuda_events
+    def profile_model_time(self, use_cuda_events=None):
+        if use_cuda_events is not None:
+            import warnings
+
+            warnings.warn(
+                "profile_model_time(use_cuda_events=...) is CUDA-era and "
+                "ignored on this backend", DeprecationWarning, stacklevel=2)
         self.model_profile_enabled = True
 
     def destroy(self):

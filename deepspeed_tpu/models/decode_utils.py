@@ -72,8 +72,20 @@ def cache_attn_mask(S: int, idx, T: int, pad=None, window: int = 0):
     """Decode-step attention mask over the [B?, 1, T, S] cache window:
     causal bound (key slot <= query slot) plus, when ``pad`` is given, the
     per-row padded-prefix exclusion, plus an optional sliding window
-    (GPT-Neo local attention)."""
+    (GPT-Neo local attention). ``idx`` may be a scalar (one shared cache
+    index — the legacy generate() batch, which advances in lockstep) or a
+    ``[B]`` vector of per-row valid lengths (paged serving slots, each at
+    its own position)."""
     key_pos = jnp.arange(S)
+    if getattr(idx, "ndim", 0) == 1:
+        # ragged rows: query t of row b sits at slot idx[b] + t
+        q_pos = idx[:, None] + jnp.arange(T)[None]          # [B, T]
+        mask = key_pos[None, None, :] <= q_pos[:, :, None]  # [B, T, S]
+        if window:
+            mask = mask & (key_pos[None, None, :] > q_pos[:, :, None] - window)
+        if pad is not None:
+            mask = mask & (key_pos[None, None, :] >= pad[:, None, None])
+        return mask[:, None]  # [B, 1, T, S]
     q_pos = idx + jnp.arange(T)
     mask = key_pos[None, :] <= q_pos[:, None]  # [T, S]
     if window:
@@ -82,3 +94,28 @@ def cache_attn_mask(S: int, idx, T: int, pad=None, window: int = 0):
         return mask[None, None]  # [1, 1, T, S]
     mask = mask[None] & (key_pos[None, None, :] >= pad[:, None, None])
     return mask[:, None]  # [B, 1, T, S]
+
+
+def paged_positions(lengths, T: int):
+    """[B, T] absolute cache positions for a paged step: row b's input
+    token t lands at logical slot ``lengths[b] + t`` (prefill starts at
+    0; a decode step appends at the row's current length)."""
+    return lengths[:, None] + jnp.arange(T)[None]
+
+
+def paged_write_rows(block_tables, positions, num_valid, block_size: int):
+    """[B, T] flattened pool rows for a paged step's KV writes.
+
+    Real tokens (``t < num_valid[b]``) map through the row's block table:
+    ``table[b, pos // bs] * bs + pos % bs``. The padded tail of a
+    bucketed prefill (and idle serving slots, ``num_valid == 0``) routes
+    to the reserved garbage block 0 instead — pads must never overwrite
+    another sequence's blocks, and clamping them onto real rows would
+    corrupt this sequence's own prefix."""
+    B, T = positions.shape
+    mb = block_tables.shape[-1]
+    blk = jnp.clip(positions // block_size, 0, mb - 1)
+    off = positions % block_size
+    rows = jnp.take_along_axis(block_tables, blk, axis=1) * block_size + off
+    valid = jnp.arange(T)[None] < num_valid[:, None]
+    return jnp.where(valid, rows, off)

@@ -21,7 +21,9 @@ import numpy as np
 
 from deepspeed_tpu.models.decode_utils import (cache_attn_mask,
                                                decode_positions,
-                                               pad_lengths, row_positions)
+                                               pad_lengths, paged_positions,
+                                               paged_write_rows,
+                                               row_positions)
 from deepspeed_tpu.ops.attention import attention
 from deepspeed_tpu.models.remat_utils import offload_policy, saved_block_input
 
@@ -74,6 +76,15 @@ class GPT2Config:
     # prefix per row and compute per-row positions. Static so unpadded
     # serving keeps the Pallas decode kernel
     padded: bool = False
+    # paged decode (the serving layer's continuous-batching cache): KV
+    # lives in a SHARED block pool ([paged_num_blocks, paged_block_size,
+    # H, D] per layer in the "cache" collection) instead of per-batch
+    # append buffers; per-request block tables / lengths / valid counts
+    # arrive via the ``paging`` call argument, so sequences of different
+    # lengths share one allocation and advance independently
+    paged: bool = False
+    paged_num_blocks: int = 0
+    paged_block_size: int = 0
     # --- canonical-decoder knobs: this model executes the whole fused-
     # c_attn decoder family the state-dict factory normalizes to (GPT-2,
     # OPT, BLOOM — reference model_implementations/ arch classes) ---
@@ -131,6 +142,16 @@ class GPT2Config:
     def for_decode(self, padded: bool = False):
         return dataclasses.replace(self, decode=True, dropout=0.0,
                                    padded=padded)
+
+    def for_paged_decode(self, num_blocks: int, block_size: int):
+        """Serving variant: decode mode whose KV cache is a shared block
+        pool (block 0 reserved as the garbage sink — see
+        ``ops.decode_attention.GARBAGE_BLOCK``). Mutually exclusive with
+        ``padded``: ragged prompts are the block table's job here."""
+        return dataclasses.replace(self, decode=True, dropout=0.0,
+                                   padded=False, paged=True,
+                                   paged_num_blocks=int(num_blocks),
+                                   paged_block_size=int(block_size))
 
     @staticmethod
     def gpt2_125m(**kw):
@@ -262,8 +283,83 @@ class CausalSelfAttention(nn.Module):
     # attribute so each unrolled layer compiles its own mask shape
     window: int = 0
 
+    def _paged_kv_attend(self, q4, k, v, paging, B, T, head_dim):
+        """Paged decode (serving): scatter this step's KV into the shared
+        block pool, then attend — block-table gather (Pallas kernel on
+        TPU, dense gather oracle elsewhere) for decode steps; prefill
+        (``paging["prefill"]``, rows fresh at length 0) falls through to
+        the standard causal path over its own keys, the same program the
+        append-cache prefill compiles. Returns ``(q4, k4, v4, y,
+        cached_attn)``; ``y is None`` on the prefill fall-through."""
+        cfg = self.config
+        if paging is None:
+            raise ValueError(
+                "paged decode needs the `paging` call argument: "
+                '{"block_tables": [B, MB] int32, "lengths": [B] int32, '
+                '"num_valid": [B] int32, "prefill": bool}')
+        if cfg.padded:
+            raise ValueError("paged and padded decode are mutually "
+                             "exclusive: ragged prompts are the block "
+                             "table's job in paged mode")
+        nb, bs = cfg.paged_num_blocks, cfg.paged_block_size
+        if nb <= 1 or bs <= 0:
+            raise ValueError(
+                f"paged decode needs paged_num_blocks > 1 (got {nb}; "
+                f"block 0 is the reserved garbage sink) and "
+                f"paged_block_size > 0 (got {bs})")
+        tables = paging["block_tables"]
+        lengths = paging["lengths"]
+        num_valid = paging["num_valid"]
+        k4 = k.reshape(B, T, cfg.n_head, head_dim)
+        v4 = v.reshape(B, T, cfg.n_head, head_dim)
+        pool_shape = (nb, bs, cfg.n_head, head_dim)
+        ck = self.variable("cache", "key_pool", jnp.zeros, pool_shape,
+                           cfg.dtype)
+        cv = self.variable("cache", "value_pool", jnp.zeros, pool_shape,
+                           cfg.dtype)
+        pos = paged_positions(lengths, T)  # [B, T] logical slots
+        if cfg.position_embedding == "rotary":
+            # rotate by absolute position BEFORE pooling, mirroring the
+            # append cache: pooled keys are post-rotation
+            q4 = apply_rotary(q4, pos, cfg.rotary_dim, cfg.rope_theta,
+                              cfg.rotary_interleaved)
+            k4 = apply_rotary(k4, pos, cfg.rotary_dim, cfg.rope_theta,
+                              cfg.rotary_interleaved)
+        rows = paged_write_rows(tables, pos, num_valid, bs)
+        flat = (nb * bs, cfg.n_head, head_dim)
+        ck.value = ck.value.reshape(flat).at[rows.reshape(-1)].set(
+            k4.reshape(B * T, cfg.n_head, head_dim)).reshape(pool_shape)
+        cv.value = cv.value.reshape(flat).at[rows.reshape(-1)].set(
+            v4.reshape(B * T, cfg.n_head, head_dim)).reshape(pool_shape)
+        if paging.get("prefill"):
+            return q4, k4, v4, None, False
+        from deepspeed_tpu.ops.attention import use_decode_kernel
+
+        alibi = cfg.position_embedding == "alibi"
+        if use_decode_kernel() and not alibi and not self.window:
+            from deepspeed_tpu.ops.decode_attention import (
+                decode_attention_paged)
+
+            y4 = decode_attention_paged(q4, ck.value, cv.value, tables,
+                                        lengths, softmax_scale=cfg.attn_scale)
+            y = y4.transpose(0, 2, 1, 3)
+        else:
+            from deepspeed_tpu.ops.decode_attention import gather_paged_cache
+
+            S = tables.shape[-1] * bs
+            kd = gather_paged_cache(ck.value, tables).transpose(0, 2, 1, 3)
+            vd = gather_paged_cache(cv.value, tables).transpose(0, 2, 1, 3)
+            # per-row lengths: each serving slot is at its own position
+            mask = cache_attn_mask(S, lengths, T, window=self.window)
+            bias = _alibi_bias(cfg, jnp.arange(S)) if alibi else None
+            y = attention(q4.transpose(0, 2, 1, 3), kd, vd, mask=mask,
+                          bias=bias, causal=False,
+                          softmax_scale=cfg.attn_scale, use_flash=False)
+        return q4, k4, v4, y, True
+
     @nn.compact
-    def __call__(self, x, deterministic=True, attention_mask=None):
+    def __call__(self, x, deterministic=True, attention_mask=None,
+                 paging=None):
         cfg = self.config
         B, T, C = x.shape
         head_dim = cfg.n_embd // cfg.n_head
@@ -284,7 +380,12 @@ class CausalSelfAttention(nn.Module):
                              pos, cfg.rotary_dim, cfg.rope_theta,
                              cfg.rotary_interleaved).reshape(B, T, C)
         cached_attn = False
-        if cfg.decode:
+        if cfg.decode and cfg.paged:
+            # serving block-pool cache; paged prefill falls through to
+            # the standard causal path below (cached_attn stays False)
+            q4, k4, v4, y, cached_attn = self._paged_kv_attend(
+                q4, k, v, paging, B, T, head_dim)
+        elif cfg.decode:
             # KV cache: [B, n_positions, H, D] append buffer (the TPU-native
             # form of the reference's softmax_context KV workspace,
             # csrc/transformer/inference/csrc/softmax.cu). Prefill — the call
@@ -437,7 +538,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic=True, pld_theta=None, layer_frac=0.0,
-                 attention_mask=None):
+                 attention_mask=None, paging=None):
         cfg = self.config
         pld_on = cfg.pld and pld_theta is not None and not deterministic
         if pld_on:
@@ -466,7 +567,7 @@ class Block(nn.Module):
             attn_out = CausalSelfAttention(cfg, window=self.window,
                                            name="attn")(
                 h1, deterministic=deterministic,
-                attention_mask=attention_mask)
+                attention_mask=attention_mask, paging=paging)
             mlp_out = MLP(cfg, name="mlp")(h2, deterministic=deterministic)
             if pld_on:
                 attn_out, mlp_out = _gate(attn_out), _gate(mlp_out)
@@ -474,7 +575,7 @@ class Block(nn.Module):
         attn_out = CausalSelfAttention(cfg, window=self.window,
                                        name="attn")(
             ln_1(x), deterministic=deterministic,
-            attention_mask=attention_mask)
+            attention_mask=attention_mask, paging=paging)
         if pld_on:
             attn_out = _gate(attn_out)
         x = x + attn_out
@@ -492,12 +593,12 @@ class _ScanBody(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic, pld_theta, layer_frac,
-                 attention_mask):
+                 attention_mask, paging):
         cfg = self.config
         if cfg.remat:
             x = saved_block_input(x, cfg)
         x = _remat_block(cfg)(cfg, name="block")(
-            x, deterministic, pld_theta, layer_frac, attention_mask)
+            x, deterministic, pld_theta, layer_frac, attention_mask, paging)
         return x, None
 
 
@@ -510,13 +611,14 @@ class ScanBlocks(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic=True, pld_theta=None,
-                 attention_mask=None):
+                 attention_mask=None, paging=None):
         cfg = self.config
         ScannedBlock = nn.scan(
             _ScanBody,
             variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True, "dropout": True, "pld": True},
-            in_axes=(nn.broadcast, nn.broadcast, 0, nn.broadcast),
+            in_axes=(nn.broadcast, nn.broadcast, 0, nn.broadcast,
+                     nn.broadcast),
             length=cfg.n_layer,
             metadata_params={nn.meta.PARTITION_NAME: "layers"},
         )
@@ -525,7 +627,7 @@ class ScanBlocks(nn.Module):
         fracs = (jnp.arange(cfg.n_layer, dtype=jnp.float32) + 1.0) / max(
             1, cfg.n_layer)
         x, _ = ScannedBlock(cfg, name="h")(x, deterministic, pld_theta, fracs,
-                                           attention_mask)
+                                           attention_mask, paging)
         return x
 
 
@@ -534,7 +636,7 @@ class LoopBlocks(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic=True, pld_theta=None,
-                 attention_mask=None):
+                 attention_mask=None, paging=None):
         cfg = self.config
         block_cls = _remat_block(cfg)
         windows = cfg.attention_windows or (0,) * cfg.n_layer
@@ -543,7 +645,7 @@ class LoopBlocks(nn.Module):
                 x = saved_block_input(x, cfg)
             x = block_cls(cfg, window=windows[i], name=f"h_{i}")(
                 x, deterministic, pld_theta, (i + 1) / max(1, cfg.n_layer),
-                attention_mask)
+                attention_mask, paging)
         return x
 
 
@@ -558,7 +660,7 @@ class GPT2LMHeadModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, deterministic=True, return_hidden=False,
-                 pld_theta=None, attention_mask=None):
+                 pld_theta=None, attention_mask=None, paging=None):
         cfg = self.config
         B, T = input_ids.shape
         wte = self.param("wte", _dense_init(), (cfg.vocab_size, cfg.n_embd), jnp.float32)
@@ -568,7 +670,18 @@ class GPT2LMHeadModel(nn.Module):
             wpe = self.param("wpe", _dense_init(0.01),
                              (cfg.n_positions + cfg.position_offset,
                               cfg.n_embd), jnp.float32)
-            if cfg.decode:
+            if cfg.decode and cfg.paged:
+                if paging is None:
+                    raise ValueError("paged decode needs the `paging` "
+                                     "call argument")
+                # per-row positions from the paging lengths — no shared
+                # `position` cache variable: serving slots advance
+                # independently (pads read a garbage position; their
+                # outputs are never consumed)
+                pos_ids = jnp.clip(paged_positions(paging["lengths"], T),
+                                   0, cfg.n_positions - 1)
+                pos_emb = wpe[pos_ids + cfg.position_offset]  # [B, T, C]
+            elif cfg.decode:
                 # track the absolute position across prefill/decode calls
                 pos_var = self.variable("cache", "position",
                                         lambda: jnp.zeros((), jnp.int32))
@@ -622,11 +735,12 @@ class GPT2LMHeadModel(nn.Module):
                               policy=offload_policy(cfg),
                               static_argnums=(2,))
             x = blocks(cfg, name="transformer")(x, deterministic, pld_theta,
-                                                attention_mask)
+                                                attention_mask, paging)
         else:
             x = blocks(cfg, name="transformer")(x, deterministic=deterministic,
                                                 pld_theta=pld_theta,
-                                                attention_mask=attention_mask)
+                                                attention_mask=attention_mask,
+                                                paging=paging)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, name="ln_f")(x)
         if cfg.tied_head:
             head_w, head_b = wte, None

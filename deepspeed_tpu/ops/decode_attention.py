@@ -26,8 +26,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deepspeed_tpu.utils.compat import tpu_compiler_params
+
 NEG_INF = -1e30
 DEFAULT_BLOCK_K = 256
+# pool block 0 is the reserved garbage sink: block tables pad with it,
+# bucketed-prefill pad tokens scatter into it, and the masked/pl.when
+# paths guarantee it never contributes to any output
+GARBAGE_BLOCK = 0
 
 
 def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
@@ -120,6 +126,134 @@ def decode_attention(q, k_cache, v_cache, cache_index, softmax_scale=None,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, tq, heads, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
     )(idx, q, k_cache, v_cache)
+
+
+# ---------------------------------------------------------------------------
+# Paged variant: the KV cache is a SHARED block pool ([num_blocks,
+# block_size, H, D]) and each sequence owns a block table mapping its
+# logical blocks to pool blocks — the serving layer's continuous-batching
+# cache (vLLM-style paging, TPU-native via scalar-prefetch block DMA).
+# The dense append-cache kernel above is kept untouched: it serves the
+# legacy generate() path and is the correctness oracle for this one.
+# ---------------------------------------------------------------------------
+
+
+def gather_paged_cache(pool, block_tables):
+    """Assemble the dense ``[B, MB*bs, H, D]`` logical window from pool
+    blocks — the XLA fallback (CPU serving, alibi/window models) and the
+    correctness oracle the paged kernel is tested against. Gathered rows
+    land at their logical positions; table entries past a sequence's
+    allocation point at the garbage block and are masked by the caller's
+    length mask."""
+    b, mb = block_tables.shape
+    nb, bs, heads, d = pool.shape
+    return pool[block_tables].reshape(b, mb * bs, heads, d)
+
+
+def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref, m_scr,
+                  l_scr, acc_scr, *, scale, bs, tq, heads, d, num_kb):
+    bi = pl.program_id(0)
+    ji = pl.program_id(1)
+    idx = lens_ref[bi]  # this row's valid length BEFORE the step
+
+    @pl.when(ji == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # logical block ji covers key positions [ji*bs, (ji+1)*bs); anything
+    # at or past idx + tq is invalid (unallocated tables point at the
+    # garbage block — skipped here before its DMA'd bytes ever matter)
+    @pl.when(ji * bs < idx + tq)
+    def _body():
+        q = q_ref[...].reshape(tq, heads, d).transpose(1, 0, 2)   # [H,tq,d]
+        k = k_ref[...].reshape(bs, heads, d).transpose(1, 0, 2)   # [H,bs,d]
+        v = v_ref[...].reshape(bs, heads, d).transpose(1, 0, 2)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale            # [H,tq,bs]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (heads, tq, bs), 1)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (heads, tq, bs), 2) \
+            + ji * bs
+        s = jnp.where(cols <= idx + rows, s, NEG_INF)
+        m_prev = m_scr[:, :, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:, :, 0:1] + jnp.sum(p, axis=2, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)                    # [H,tq,d]
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ji == num_kb - 1)
+    def _finish():
+        l = l_scr[:, :, 0:1]
+        out = acc_scr[:] / jnp.where(l == 0.0, 1.0, l)             # [H,tq,d]
+        o_ref[...] = out.transpose(1, 0, 2).reshape(1, tq, heads, d) \
+            .astype(o_ref.dtype)
+
+
+def decode_attention_paged(q, k_pool, v_pool, block_tables, lengths,
+                           softmax_scale=None):
+    """Attend a decode step against a paged KV cache.
+
+    Args:
+      q: ``[B, T_q, H, D]`` query step (``T_q`` small: 1 for plain decode).
+      k_pool / v_pool: ``[num_blocks, block_size, H, D]`` shared block
+        pools; this step's keys must already be scattered at each row's
+        ``[lengths[b], lengths[b] + T_q)`` logical positions.
+      block_tables: ``[B, MB]`` int32 — row b's logical block j lives in
+        pool block ``block_tables[b, j]``; entries past the allocation
+        point at the reserved garbage block (their blocks skip compute).
+      lengths: ``[B]`` int32 — valid tokens per row *before* this step.
+
+    The block table and lengths are *scalar-prefetch* operands: the grid
+    is static over ``(B, MB)``, each grid step DMAs exactly the pool
+    block the table names, and blocks past ``lengths[b] + T_q`` skip both
+    the fetch's compute and the online-softmax update.
+
+    Returns ``[B, T_q, H, D]`` in the query's dtype.
+    """
+    b, tq, heads, d = q.shape
+    nb, bs, ph, pd = k_pool.shape
+    if (ph, pd) != (heads, d):
+        raise ValueError(f"pool heads/dim {(ph, pd)} != query {(heads, d)}")
+    mb = block_tables.shape[-1]
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, tq, heads, d),
+                         lambda bi, ji, tab, ln: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, bs, heads, d),
+                         lambda bi, ji, tab, ln: (tab[bi, ji], 0, 0, 0)),
+            pl.BlockSpec((1, bs, heads, d),
+                         lambda bi, ji, tab, ln: (tab[bi, ji], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tq, heads, d),
+                               lambda bi, ji, tab, ln: (bi, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((heads, tq, 128), jnp.float32),   # m
+            pltpu.VMEM((heads, tq, 128), jnp.float32),   # l
+            pltpu.VMEM((heads, tq, d), jnp.float32),     # acc
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, scale=scale, bs=bs, tq=tq,
+                               heads=heads, d=d, num_kb=mb)
+    tables = jnp.asarray(block_tables, jnp.int32)
+    lens = jnp.asarray(lengths, jnp.int32)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, tq, heads, d), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(tables, lens, q, k_pool, v_pool)

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deepspeed_tpu.utils.compat import tpu_compiler_params
+
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
@@ -183,7 +185,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((g, bq, 128), jnp.float32),   # l
             pltpu.VMEM((g, bq, d), jnp.float32),     # acc
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(q3, k3, v3)
     return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
@@ -346,7 +348,7 @@ def _flash_backward(res, g, scale, causal, block_q, block_k):
         out_specs=_spec((bq, d), lambda bhi, qi, ki: (bhi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((gg, bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(q3, k3, v3, do3, lse3, delta3)
 
@@ -372,7 +374,7 @@ def _flash_backward(res, g, scale, causal, block_q, block_k):
         ),
         scratch_shapes=[pltpu.VMEM((gg, bk, d), jnp.float32),
                         pltpu.VMEM((gg, bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(q3, k3, v3, do3, lse3, delta3)
 
@@ -554,7 +556,7 @@ def _flash_forward_bthd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((g, bq, 128), jnp.float32),
             pltpu.VMEM((g, bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(q, k, v)
     return o, lse
@@ -594,7 +596,7 @@ def _flash_backward_bthd(res, dout, scale, causal, block_q, block_k):
         out_specs=qs,
         out_shape=jax.ShapeDtypeStruct((b, sq, h, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((g, bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(q, k, v, dout, lse, delta)
 
@@ -622,7 +624,7 @@ def _flash_backward_bthd(res, dout, scale, causal, block_q, block_k):
         ),
         scratch_shapes=[pltpu.VMEM((g, bk, d), jnp.float32),
                         pltpu.VMEM((g, bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(q, k, v, dout, lse, delta)
     return dq, dk, dv

@@ -36,6 +36,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deepspeed_tpu.utils.compat import tpu_compiler_params
+
 NEG_INF = -1e30
 
 _DN_QK = (((2,), (2,)), ((0,), (0,)))   # [B,bq,d] x [B,bk,d] -> [B,bq,bk]
@@ -160,7 +162,7 @@ def _sparse_forward_impl(qh, kh, vh, qrow, kcol, cnt, scale, *, nq, nk):
             jax.ShapeDtypeStruct((h, b, sq, d), qh.dtype),
             jax.ShapeDtypeStruct((h, b, 1, sq), jnp.float32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
     )(qrow, kcol, cnt, qh, kh, vh)
     return o, lse.reshape(h, b, sq)
@@ -292,7 +294,7 @@ def _sparse_backward(qh, kh, vh, oh, lse, g, lists, scale, nq, nk):
             scratch_shapes=[pltpu.VMEM((b, bq, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((h, b, sq, d), qh.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
     )(qrow, kcol, cnt, qh, kh, vh, g, lse4, delta4)
 
@@ -328,7 +330,7 @@ def _sparse_backward(qh, kh, vh, oh, lse, g, lists, scale, nq, nk):
         ),
         out_shape=(jax.ShapeDtypeStruct((h, b, sk, d), kh.dtype),
                    jax.ShapeDtypeStruct((h, b, sk, d), vh.dtype)),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
     )(krow_t, qcol_t, cnt_t, qh, kh, vh, g, lse4, delta4)
     return dq, dk, dv

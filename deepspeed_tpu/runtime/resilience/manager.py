@@ -274,6 +274,14 @@ class Resilience:
             if wd is not None:
                 wd.resume()
 
+    def serving_step_progress(self):
+        """Serving engines: a decode step completed (tokens observed on
+        the host) without finishing any request — refresh the stall timer
+        so a server saturated with long generations is never judged hung
+        between completions. Touch only: brackets and arming untouched."""
+        if self.enabled and self.watchdog is not None:
+            self.watchdog.touch()
+
     def serving_request_abandon(self):
         """A request raised before completing: clear its busy bracket so
         the idle server is not later judged hung by a leaked counter."""

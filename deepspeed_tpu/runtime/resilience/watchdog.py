@@ -106,6 +106,15 @@ class HangWatchdog:
             self._suspended = max(0, self._suspended - 1)
             self._last_progress = time.monotonic()  # fresh window
 
+    def touch(self):
+        """Refresh the stall timer on host-observable sub-step progress
+        (a serving decode step that produced tokens without finishing any
+        request). Unlike :meth:`notify` this never ARMS the watchdog — a
+        long first-request compile must stay untripped."""
+        with self._lock:
+            if self._last_progress is not None:
+                self._last_progress = time.monotonic()
+
     def busy_begin(self):
         """Work started (a serving request was accepted): the stall timer
         runs until the matching :meth:`busy_end`. Does NOT arm an unarmed

@@ -1,0 +1,35 @@
+"""Serving layer: continuous batching over a paged KV-cache block pool.
+
+The request-level runtime in front of the inference engines — the
+TPU-native analog of the reference's kernel-injected *serving* stack
+(DeepSpeed-MII / inference-v2): a shape-bucketed continuous-batching
+scheduler over sharded executables, built on the Pallas
+``decode_attention`` kernels.
+
+Pieces:
+
+- :class:`~deepspeed_tpu.serving.blocks.BlockManager` — paged KV-cache
+  accounting: fixed-size blocks, per-sequence block tables, immediate
+  frees;
+- :class:`~deepspeed_tpu.serving.scheduler.ContinuousBatchingScheduler`
+  — admission queue -> bucketed prefill -> decode slots, with
+  backpressure (queue depth / in-flight tokens / deadlines) and a
+  shed-or-queue policy;
+- :class:`~deepspeed_tpu.serving.engine.ServingEngine` — the device
+  runtime: fixed-bucket jitted prefill + one decode-slot program, so
+  steady-state retrace count is zero;
+- :class:`~deepspeed_tpu.serving.request.Request` — one in-flight
+  generation with streaming callbacks and per-request telemetry.
+"""
+
+from deepspeed_tpu.serving.blocks import BlockManager
+from deepspeed_tpu.serving.config import (ServingConfig, bucket_for,
+                                          resolve_buckets)
+from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.request import (FINISHED, QUEUED, RUNNING, SHED,
+                                           Request)
+from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
+
+__all__ = ["BlockManager", "ContinuousBatchingScheduler", "Request",
+           "ServingConfig", "ServingEngine", "bucket_for", "resolve_buckets",
+           "QUEUED", "RUNNING", "FINISHED", "SHED"]

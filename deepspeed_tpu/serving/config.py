@@ -1,0 +1,123 @@
+"""Serving configuration (the ``serving`` block of the inference config).
+
+With the block absent the serving layer does not exist: the inference
+engines' compiled HLO is byte-identical (pinned in
+``tests/unit/test_serving.py``) and ``generate()`` keys its compile
+cache exactly as before. With it present, ``ServingEngine`` serves
+continuous-batching traffic and the legacy ``generate()`` pads prompt
+lengths up to the bucket set before keying its compile cache.
+
+This module must stay import-light (no jax, no inference imports): the
+inference config parses it lazily, and the pure-Python scheduler tests
+run without touching a device.
+"""
+
+import math
+from typing import List
+
+from pydantic import field_validator
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+SHED = "shed"
+QUEUE = "queue"
+
+
+class ServingConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    # ---- paged KV cache ----
+    # tokens per cache block; per-layer pools are [num_blocks, block_size,
+    # H, D] and block 0 is the reserved garbage sink
+    block_size: int = 16
+    # total pool blocks; 0 = garbage block + decode_slots full-length
+    # sequences (the conservative no-overcommit sizing)
+    num_blocks: int = 0
+    # longest prompt+generation the runtime admits; 0 = the model window
+    max_model_len: int = 0
+    # ---- continuous batching ----
+    # concurrent decode sequences (the decode program's static batch)
+    decode_slots: int = 4
+    # prompt-length buckets for prefill (and the legacy generate() compile
+    # cache); [] = powers of two from block_size up to max_model_len
+    prompt_buckets: List[int] = []
+    # satellite: pad legacy generate() prompts up to the bucket set before
+    # keying its compile cache (identical tokens via the left-padded mask
+    # path; one compiled program per bucket instead of per prompt length)
+    bucket_legacy_generate: bool = True
+    # ---- admission control / backpressure ----
+    max_queue_depth: int = 64
+    # cap on committed tokens (prompt + max_new over queued + running);
+    # 0 = unbounded
+    max_inflight_tokens: int = 0
+    # "shed": reject a submit that would exceed max_inflight_tokens;
+    # "queue": accept it (queue depth still bounds) and defer slot
+    # admission until running work drains below the cap
+    shed_policy: str = SHED
+    # default per-request deadline (submit -> finish), 0 = none; requests
+    # past it are shed from the queue or abandoned mid-decode
+    deadline_ms: float = 0.0
+    default_max_new_tokens: int = 64
+    # ---- sampling (engine-level; greedy default is the batch-invariance
+    # contract: tokens bit-match per-request generate()) ----
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int = 0
+
+    @field_validator("block_size", "decode_slots")
+    @classmethod
+    def _positive(cls, v, info):
+        if v <= 0:
+            raise ValueError(f"serving.{info.field_name} must be > 0, "
+                             f"got {v}")
+        return v
+
+    @field_validator("shed_policy")
+    @classmethod
+    def _policy(cls, v):
+        if v not in (SHED, QUEUE):
+            raise ValueError(
+                f"serving.shed_policy must be '{SHED}' or '{QUEUE}', "
+                f"got {v!r}")
+        return v
+
+    @field_validator("prompt_buckets")
+    @classmethod
+    def _buckets(cls, v):
+        if any(b <= 0 for b in v):
+            raise ValueError(f"serving.prompt_buckets must be positive, "
+                             f"got {v}")
+        return sorted(set(int(b) for b in v))
+
+
+def resolve_buckets(buckets, max_len: int, floor: int = 8):
+    """The prompt-length bucket set: the configured list (clipped to
+    ``max_len``), or powers of two from ``floor`` up, always ending at
+    ``max_len`` so every admissible prompt has a bucket. A small FIXED
+    set is the whole point: every jitted shape comes from it, so
+    steady-state retrace count is provably zero."""
+    max_len = int(max_len)
+    if buckets:
+        out = sorted(set(int(b) for b in buckets if int(b) <= max_len))
+    else:
+        out = []
+        b = max(1, int(floor))
+        while b < max_len:
+            out.append(b)
+            b *= 2
+    if not out or out[-1] != max_len:
+        out.append(max_len)
+    return out
+
+
+def bucket_for(n: int, buckets):
+    """Smallest bucket >= n, or None when n exceeds them all."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return None
+
+
+def blocks_for_tokens(n_tokens: int, block_size: int) -> int:
+    return max(1, math.ceil(n_tokens / block_size))

@@ -1,0 +1,395 @@
+"""Serving runtime: continuous batching over a paged KV cache.
+
+Wraps an :class:`~deepspeed_tpu.inference.engine.InferenceEngine` (its
+params, sharding, dtype/quantization and telemetry/resilience managers)
+with a request-level scheduler and exactly TWO kinds of compiled
+programs:
+
+- ``serving.prefill[T=b]`` — one per prompt bucket ``b`` (a small fixed
+  set, powers of two by default): right-pads the prompt to the bucket,
+  scatters its KV into the sequence's pool blocks (pad tail into the
+  garbage block) and returns the first sampled token;
+- ``serving.decode[slots=N]`` — ONE program for the fixed slot batch:
+  every active sequence advances one token against its own block table
+  and length; idle slots compute into the garbage block and are ignored.
+
+Finished sequences are evicted and queued requests spliced into free
+slots *between* decode steps — shapes never change, so the steady-state
+retrace count is zero (pinned by the telemetry compile watchdog in
+``tests/unit/test_serving.py``). Greedy tokens bit-match per-request
+``generate()`` output: the paged decode gathers pool blocks back into
+logical order, so the math matches the dense append-cache program
+term for term.
+
+Per-request telemetry (kind ``serving``: TTFT, queue wait, tokens/s,
+shed) rides the unified event stream; the resilience hang watchdog sees
+begin/heartbeat/abandon brackets so a wedged decode collective is a
+detected stall while an idle server is never judged hung.
+"""
+
+import collections
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.serving.blocks import BlockManager
+from deepspeed_tpu.serving.config import (ServingConfig, blocks_for_tokens,
+                                          bucket_for, resolve_buckets)
+from deepspeed_tpu.serving.request import FINISHED, Request
+from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _model_window(model_config) -> Optional[int]:
+    return (getattr(model_config, "n_positions", None)
+            or getattr(model_config, "max_position_embeddings", None))
+
+
+class ServingEngine:
+    def __init__(self, model_or_engine, config=None, **kwargs):
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+
+        self._jax, self._jnp = jax, jnp
+        if isinstance(model_or_engine, InferenceEngine):
+            if config is not None or kwargs:
+                raise ValueError(
+                    "pass config/kwargs to the InferenceEngine, not again "
+                    "to ServingEngine when wrapping one")
+            self.engine = model_or_engine
+            self._owns_engine = False
+        else:
+            self.engine = InferenceEngine(model_or_engine, config=config,
+                                          **kwargs)
+            self._owns_engine = True
+        scfg = self.engine._serving_cfg
+        if scfg is None or not scfg.enabled:
+            raise DeepSpeedConfigError(
+                "ServingEngine needs a `serving` block in the inference "
+                'config, e.g. init_inference(model, serving={"block_size": '
+                '16, "decode_slots": 4})')
+        self.config: ServingConfig = scfg
+
+        mcfg = self.engine.model_config
+        if mcfg is None or not hasattr(mcfg, "for_paged_decode"):
+            raise ValueError(
+                "serving needs a model whose config provides "
+                "for_paged_decode() — the canonical decoder family "
+                "(GPT2LMHeadModel and its OPT/BLOOM/GPT-J/NeoX variants)")
+        window = _model_window(mcfg)
+        self.max_len = int(self.config.max_model_len or window or 1024)
+        if window:
+            self.max_len = min(self.max_len, int(window))
+        bs = self.config.block_size
+        self.blocks_per_seq = blocks_for_tokens(self.max_len, bs)
+        # garbage block + conservative worst-case reservation per slot:
+        # admission never admits work the pool cannot finish
+        self.num_blocks = int(self.config.num_blocks) or (
+            1 + self.config.decode_slots * self.blocks_per_seq)
+        self.buckets = resolve_buckets(self.config.prompt_buckets,
+                                       self.max_len, floor=bs)
+        self._dmodule = type(self.engine.module)(
+            mcfg.for_paged_decode(self.num_blocks, bs))
+        self.block_mgr = BlockManager(self.num_blocks, bs,
+                                      self.blocks_per_seq)
+        self.sched = ContinuousBatchingScheduler(
+            self.config, self.block_mgr, self.max_len, self.buckets)
+        self.telemetry = self.engine.telemetry
+        self.resilience = self.engine.resilience
+
+        self.cache = self._init_cache()
+        self._tables = np.full(
+            (self.config.decode_slots, self.blocks_per_seq), 0, np.int32)
+        self._last_tokens = np.zeros((self.config.decode_slots,), np.int32)
+        self._lengths = np.zeros((self.config.decode_slots,), np.int32)
+        self._prefill_fns: Dict[int, object] = {}
+        self._decode_fn = None
+        self._rng = jax.random.PRNGKey(self.config.seed)
+        self._step_count = 0
+        self._finished_count = 0
+        # bounded retention (a long-running server must not accumulate a
+        # dead Request per served request until OOM — same contract as
+        # the telemetry manager's bounded event tail); stats() percentiles
+        # therefore cover the most recent window
+        self.finished = collections.deque(maxlen=1024)
+        self.records = collections.deque(maxlen=4096)
+        log_dist(
+            f"ServingEngine: slots={self.config.decode_slots} "
+            f"block_size={bs} num_blocks={self.num_blocks} "
+            f"buckets={self.buckets} max_len={self.max_len}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    def _init_cache(self):
+        """Zeroed per-layer KV pools, shaped by tracing the paged decode
+        module's init without running it (eval_shape: no compute, no
+        params materialized). Placed with the replicated mesh sharding
+        the compiled programs emit, so the FIRST prefill's argument
+        signature already matches steady state — a `jnp.zeros` pool
+        would carry SingleDeviceSharding and cost that bucket one
+        spurious retrace when the post-step pool comes back NamedSharded
+        (TP-sharding the pool over the model axis is the follow-up)."""
+        jax, jnp = self._jax, self._jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        pg = {"block_tables": jnp.zeros((1, self.blocks_per_seq), jnp.int32),
+              "lengths": jnp.zeros((1,), jnp.int32),
+              "num_valid": jnp.zeros((1,), jnp.int32), "prefill": True}
+        shapes = jax.eval_shape(
+            lambda: self._dmodule.init(jax.random.PRNGKey(0),
+                                       jnp.zeros((1, 1), jnp.int32),
+                                       paging=pg))
+        sharding = NamedSharding(self.engine.mesh, P())
+        return jax.tree_util.tree_map(
+            lambda s: jax.device_put(jnp.zeros(s.shape, s.dtype), sharding),
+            shapes["cache"])
+
+    def _donate(self):
+        # the old pool is dead after every call — donate it so steady-state
+        # serving holds ONE pool allocation (CPU jax warns instead of
+        # donating; skip there)
+        return (1,) if self._jax.default_backend() != "cpu" else ()
+
+    def _sample(self, logits, rng):
+        from deepspeed_tpu.inference.engine import sample_logits
+
+        sc = self.config
+        return sample_logits(logits, rng, sc.temperature, sc.do_sample,
+                             sc.top_k, sc.top_p)
+
+    def _build_prefill(self, T: int):
+        jax, jnp = self._jax, self._jnp
+        dmodule, dequant = self._dmodule, self.engine._dequantize
+        logits_of = self.engine._logits_of
+
+        def fn(qparams, cache, ids, tables, num_valid, rng):
+            params = dequant(qparams)
+            paging = {"block_tables": tables,
+                      "lengths": jnp.zeros((ids.shape[0],), jnp.int32),
+                      "num_valid": num_valid, "prefill": True}
+            out, vars_ = dmodule.apply({"params": params, "cache": cache},
+                                       ids, mutable=["cache"], paging=paging)
+            logits = logits_of(out)
+            # the request's next token depends on its LAST REAL position
+            # (right padding: index num_valid-1)
+            last = jnp.take_along_axis(
+                logits, (num_valid - 1)[:, None, None], axis=1)[:, 0]
+            return self._sample(last, rng), vars_["cache"]
+
+        return self.engine.telemetry.watch_jit(
+            jax.jit(fn, donate_argnums=self._donate()),
+            f"serving.prefill[T={T}]")
+
+    def _build_decode(self):
+        jax, jnp = self._jax, self._jnp
+        dmodule, dequant = self._dmodule, self.engine._dequantize
+        logits_of = self.engine._logits_of
+
+        def fn(qparams, cache, tokens, tables, lengths, rng):
+            params = dequant(qparams)
+            paging = {"block_tables": tables, "lengths": lengths,
+                      "num_valid": jnp.ones_like(lengths),
+                      "prefill": False}
+            out, vars_ = dmodule.apply({"params": params, "cache": cache},
+                                       tokens, mutable=["cache"],
+                                       paging=paging)
+            logits = logits_of(out)[:, -1]
+            return self._sample(logits, rng), vars_["cache"]
+
+        return self.engine.telemetry.watch_jit(
+            jax.jit(fn, donate_argnums=self._donate()),
+            f"serving.decode[slots={self.config.decode_slots}]")
+
+    def _next_rng(self):
+        self._rng, sub = self._jax.random.split(self._rng)
+        return sub
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 0, **kwargs) -> Request:
+        """Admit one request (non-blocking). Returns the Request; its
+        ``state`` is ``queued`` on success or ``shed`` (with
+        ``finish_reason``) when admission control rejected it."""
+        prompt = [int(t) for t in np.asarray(prompt).ravel()]
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      **kwargs)
+        if self.sched.submit(req):
+            self.resilience.serving_request_begin()
+            self.telemetry.emit("serving", "request.queued",
+                                step=self._step_count,
+                                request_id=req.request_id,
+                                prompt_len=req.prompt_len)
+        else:
+            self._record(req, shed=True, began=False)
+        return req
+
+    # ------------------------------------------------------------------
+    def step(self) -> List[Request]:
+        """One scheduler iteration: abandon blown deadlines, splice queued
+        requests into free slots (bucketed prefill), then advance every
+        active sequence one token. Returns requests finished this step."""
+        now = time.monotonic()
+        done: List[Request] = []
+        # deadline sweep over running work
+        for slot, req in self.sched.running():
+            if self.sched.expired(req, now):
+                self._finish(req, "deadline", now, done)
+        # splice admissions into free slots (no recompilation: bucket set)
+        admitted, shed = self.sched.admit(now)
+        for req in shed:
+            self._record(req, shed=True, began=True)
+        for slot, req, table in admitted:
+            self._prefill(slot, req, table, done)
+        # one decode step for the whole slot batch
+        if self.sched.running():
+            self._decode_step(done)
+        return done
+
+    def _prefill(self, slot: int, req: Request, table: np.ndarray,
+                 done: List[Request]):
+        jnp = self._jnp
+        T = bucket_for(req.prompt_len, self.buckets)
+        if T not in self._prefill_fns:
+            self._prefill_fns[T] = self._build_prefill(T)
+        ids = np.zeros((1, T), np.int32)
+        ids[0, :req.prompt_len] = req.prompt
+        tok, self.cache = self._prefill_fns[T](
+            self.engine.params, self.cache, jnp.asarray(ids),
+            jnp.asarray(table[None]),
+            jnp.asarray([req.prompt_len], jnp.int32), self._next_rng())
+        tok = int(np.asarray(tok)[0])
+        req.first_token_ts = time.monotonic()
+        req.length = req.prompt_len
+        self._tables[slot] = table
+        self._lengths[slot] = req.prompt_len
+        self._last_tokens[slot] = tok
+        finished = (tok == req.eos_token_id
+                    or len(req.tokens) + 1 >= req.max_new_tokens)
+        req.emit_token(tok, finished)
+        if finished:
+            reason = "eos" if tok == req.eos_token_id else "max_tokens"
+            self._finish(req, reason, time.monotonic(), done)
+
+    def _decode_step(self, done: List[Request]):
+        jnp = self._jnp
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        active = self.sched.running()
+        tokens = jnp.asarray(self._last_tokens[:, None])
+        toks, self.cache = self._decode_fn(
+            self.engine.params, self.cache, tokens,
+            jnp.asarray(self._tables), jnp.asarray(self._lengths),
+            self._next_rng())
+        toks = np.asarray(toks)  # host sync: tokens stream out every step
+        now = time.monotonic()
+        self._step_count += 1
+        self.telemetry.on_step_boundary(self._step_count,
+                                        samples=len(active))
+        # host-observed per-step token progress: a server saturated with
+        # long generations must not be judged hung between completions
+        self.resilience.serving_step_progress()
+        for slot, req in active:
+            tok = int(toks[slot])
+            req.length += 1
+            self._lengths[slot] = req.length
+            self._last_tokens[slot] = tok
+            finished = (tok == req.eos_token_id
+                        or len(req.tokens) + 1 >= req.max_new_tokens
+                        or req.length + 1 > self.max_len)
+            req.emit_token(tok, finished)
+            if finished:
+                reason = ("eos" if tok == req.eos_token_id else
+                          "max_tokens" if len(req.tokens)
+                          >= req.max_new_tokens else "window")
+                self._finish(req, reason, now, done)
+
+    def _finish(self, req: Request, reason: str, now: float,
+                done: List[Request]):
+        self.sched.finish(req, reason, now)
+        # reset the slot's host-side row: an idle slot computes into the
+        # garbage block until the next admission overwrites it
+        if 0 <= req.slot < len(self._tables):
+            self._tables[req.slot] = 0
+            self._lengths[req.slot] = 0
+            self._last_tokens[req.slot] = 0
+        self._record(req, shed=False, began=True)
+        done.append(req)
+        self.finished.append(req)
+
+    def _record(self, req: Request, shed: bool, began: bool):
+        rec = req.record()
+        self.records.append(rec)
+        self.telemetry.emit(
+            "serving", "request.shed" if shed else "request.finish",
+            step=self._step_count, **rec)
+        if not began:
+            return  # never bracketed: submit-time shed
+        if shed:
+            self.resilience.serving_request_abandon()
+        else:
+            self._finished_count += 1
+            self.resilience.serving_heartbeat(self._finished_count)
+
+    # ------------------------------------------------------------------
+    @property
+    def pending(self) -> bool:
+        return self.sched.pending
+
+    def drain(self, max_steps: Optional[int] = None) -> List[Request]:
+        """Step until queue and slots are empty (or ``max_steps``);
+        returns every request finished during the drain."""
+        out: List[Request] = []
+        steps = 0
+        while self.pending and (max_steps is None or steps < max_steps):
+            out.extend(self.step())
+            steps += 1
+        return out
+
+    def generate_batch(self, prompts, max_new_tokens: int = 0, **kwargs):
+        """Convenience: submit every prompt, drain, return each request's
+        generated tokens in submit order (None for shed requests)."""
+        reqs = [self.submit(p, max_new_tokens=max_new_tokens, **kwargs)
+                for p in prompts]
+        self.drain()
+        return [r.tokens if r.state == FINISHED else None for r in reqs]
+
+    def reset_stats(self):
+        """Clear the per-request records and scheduler counters (a bench
+        epoch boundary between warmup and the measured window); in-flight
+        requests and the cache pool are untouched."""
+        self.records.clear()
+        self.finished.clear()
+        self.sched.reset_stats()
+
+    def stats(self) -> dict:
+        """Aggregate serving metrics (the bench's ``*_serving`` series)."""
+        ttfts = [r["ttft_ms"] for r in self.records
+                 if r.get("ttft_ms") is not None]
+        rates = [r["tokens_per_sec"] for r in self.records
+                 if r.get("tokens_per_sec") is not None]
+        s = self.sched.stats
+        total = max(1, s["submitted"])
+        return {
+            "finished": s["finished"], "shed": s["shed"],
+            "shed_reasons": dict(s["shed_reasons"]),
+            "shed_rate": round(s["shed"] / total, 4),
+            "queue_peak": s["queue_peak"],
+            "decode_steps": self._step_count,
+            "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 3)
+            if ttfts else None,
+            "ttft_ms_p95": round(float(np.percentile(ttfts, 95)), 3)
+            if ttfts else None,
+            "tokens_per_sec_mean": round(float(np.mean(rates)), 2)
+            if rates else None,
+        }
+
+    def destroy(self):
+        """Drop compiled programs and the cache pool; destroys the wrapped
+        engine only when this ServingEngine constructed it."""
+        self._prefill_fns.clear()
+        self._decode_fn = None
+        self.cache = None
+        if self._owns_engine:
+            self.engine.destroy()

@@ -21,6 +21,10 @@ satellite families that ride the same sink):
 - ``fault``        — resilience-layer faults: checkpoint retries /
                      corruption / fallbacks / retention, sentinel trips
                      and rollbacks, watchdog hang dumps
+- ``serving``      — per-request serving lifecycle: queued / finish
+                     (TTFT, queue wait, tokens/s) / shed (reason)
+- ``model_time``   — inference per-forward latencies (the
+                     ``model_times()`` buffer mirrored into the stream)
 
 Everything in ``data`` must be JSON-safe; :func:`json_safe` coerces numpy
 scalars and drops device arrays (an event must never pin or sync device
@@ -32,7 +36,7 @@ import time
 from typing import Any, Dict, Optional
 
 KINDS = ("compile", "step_cost", "memory", "trace_window", "step",
-         "wallclock", "comm", "fault")
+         "wallclock", "comm", "fault", "serving", "model_time")
 
 
 def json_safe(value: Any):

@@ -7,6 +7,7 @@ knob. Every shard_map call site in the tree routes through this module so
 the fallback logic lives in exactly one place.
 """
 
+import contextlib
 import inspect
 
 
@@ -37,6 +38,48 @@ def persistent_compilation_cache_safe() -> bool:
     if version >= (0, 5):
         return True
     return jax.default_backend() != "cpu"
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` under its current name; older runtimes
+    (< 0.5) ship the same dataclass as ``TPUCompilerParams``. Every
+    Pallas kernel in the tree routes its ``compiler_params=`` through
+    here so the rename lives in exactly one place."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def tpu_interpret_mode():
+    """``pltpu.force_tpu_interpret_mode()`` where it exists (jax >= 0.5);
+    on older runtimes, an equivalent context that rewrites every
+    ``pl.pallas_call`` in its scope to ``interpret=True`` — the same
+    CPU-emulation the real context flips via jax config."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    if hasattr(pltpu, "force_tpu_interpret_mode"):
+        return pltpu.force_tpu_interpret_mode()
+    return _patched_interpret_mode()
+
+
+@contextlib.contextmanager
+def _patched_interpret_mode():
+    import jax.experimental.pallas as pl
+
+    orig = pl.pallas_call
+
+    def interpreted(*args, **kwargs):
+        kwargs.setdefault("interpret", True)
+        return orig(*args, **kwargs)
+
+    pl.pallas_call = interpreted
+    try:
+        yield
+    finally:
+        pl.pallas_call = orig
 
 
 _SM_PARAMS = None  # resolved lazily from the resolved shard_map's signature

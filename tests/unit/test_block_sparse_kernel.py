@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.experimental.pallas import tpu as pltpu
+from deepspeed_tpu.utils.compat import tpu_interpret_mode
 
 from deepspeed_tpu.ops.attention import attention_reference
 from deepspeed_tpu.ops.sparse_attention.block_sparse_kernel import (
@@ -72,7 +72,7 @@ class TestForwardParity:
     def test_matches_dense_masked(self, seed):
         q, k, v = _qkv(seed)
         layout = _rand_layout(seed)
-        with pltpu.force_tpu_interpret_mode():
+        with tpu_interpret_mode():
             o = block_sparse_attention(q, k, v, layout)
         mask = jnp.asarray(_expand(layout))[None]  # [1, H, S, S]
         ref = attention_reference(q, k, v, mask=mask, causal=False)
@@ -86,7 +86,7 @@ class TestForwardParity:
                                     num_sliding_window_blocks=3,
                                     num_global_blocks=1)
         layout = np.asarray(cfg.make_layout(S), bool)
-        with pltpu.force_tpu_interpret_mode():
+        with tpu_interpret_mode():
             o = block_sparse_attention(q, k, v, layout)
         mask = jnp.asarray(_expand(layout))[None]
         ref = attention_reference(q, k, v, mask=mask, causal=False)
@@ -100,7 +100,7 @@ class TestForwardParity:
                                   different_layout_per_head=True,
                                   num_different_global_patterns=2)
         layout = np.asarray(cfg.make_layout(S), bool)
-        with pltpu.force_tpu_interpret_mode():
+        with tpu_interpret_mode():
             o = block_sparse_attention(q, k, v, layout)
         mask = jnp.asarray(_expand(layout))[None]
         ref = attention_reference(q, k, v, mask=mask, causal=False)
@@ -121,7 +121,7 @@ class TestBackwardParity:
             return jnp.sum(
                 attention_reference(q, k, v, mask=mask, causal=False) ** 2)
 
-        with pltpu.force_tpu_interpret_mode():
+        with tpu_interpret_mode():
             gs = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
         gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
         for a, b, name in zip(gs, gr, "qkv"):

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.experimental.pallas import tpu as pltpu
+from deepspeed_tpu.utils.compat import tpu_interpret_mode
 
 from deepspeed_tpu.ops.attention import attention_reference
 from deepspeed_tpu.ops.decode_attention import decode_attention
@@ -42,7 +42,7 @@ def test_matches_dense(idx, tq):
     k_cache = jnp.asarray(k_cache)
     v_cache = jnp.asarray(v_cache)
 
-    with pltpu.force_tpu_interpret_mode():
+    with tpu_interpret_mode():
         out = decode_attention(q4, k_cache, v_cache, idx)
     ref = _dense_decode(q4, k_cache, v_cache, idx)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -56,13 +56,124 @@ def test_garbage_tail_ignored():
     k_cache = rng.normal(size=(B, S, H, D)).astype(np.float32) * 100
     v_cache = rng.normal(size=(B, S, H, D)).astype(np.float32) * 100
     q4 = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
-    with pltpu.force_tpu_interpret_mode():
+    with tpu_interpret_mode():
         out1 = decode_attention(q4, jnp.asarray(k_cache), jnp.asarray(v_cache), idx)
     k2, v2 = k_cache.copy(), v_cache.copy()
     k2[:, idx + 1:] = 9999.0
     v2[:, idx + 1:] = -9999.0
-    with pltpu.force_tpu_interpret_mode():
+    with tpu_interpret_mode():
         out2 = decode_attention(q4, jnp.asarray(k2), jnp.asarray(v2), idx)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+
+
+@pytest.mark.parametrize("idx", [63, 64, 65, 128, 192])
+def test_dense_kernel_at_block_boundaries(idx):
+    """cache_index values that land exactly on (or straddle) kernel block
+    boundaries — the skip/boundary-mask edge the paged gather inherits."""
+    B, H, D, S, bk = 1, 2, 64, 256, 64
+    rng = np.random.default_rng(idx)
+    k_cache = np.zeros((B, S, H, D), np.float32)
+    v_cache = np.zeros((B, S, H, D), np.float32)
+    k_cache[:, :idx + 1] = rng.normal(size=(B, idx + 1, H, D))
+    v_cache[:, :idx + 1] = rng.normal(size=(B, idx + 1, H, D))
+    q4 = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+    with tpu_interpret_mode():
+        out = decode_attention(q4, jnp.asarray(k_cache), jnp.asarray(v_cache),
+                               idx, block_k=bk)
+    ref = _dense_decode(q4, jnp.asarray(k_cache), jnp.asarray(v_cache), idx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# paged (block-table) variant
+# ---------------------------------------------------------------------------
+def _paged_setup(B, lengths, tq, bs, mb, H=2, D=64, seed=0):
+    """Random pool + per-row permuted block tables holding each row's
+    prefix at its logical positions (the serving layout)."""
+    from deepspeed_tpu.ops.decode_attention import GARBAGE_BLOCK
+
+    rng = np.random.default_rng(seed)
+    nb = 1 + B * mb
+    k_pool = rng.normal(size=(nb, bs, H, D)).astype(np.float32)
+    v_pool = rng.normal(size=(nb, bs, H, D)).astype(np.float32)
+    tables = np.full((B, mb), GARBAGE_BLOCK, np.int32)
+    free = list(rng.permutation(np.arange(1, nb)))
+    for b, ln in enumerate(lengths):
+        need = max(1, -(-(ln + tq) // bs))
+        tables[b, :need] = [free.pop() for _ in range(need)]
+    q4 = rng.normal(size=(B, tq, H, D)).astype(np.float32)
+    return (jnp.asarray(q4), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(tables), jnp.asarray(lengths, jnp.int32))
+
+
+def _paged_dense_ref(q4, k_pool, v_pool, tables, lengths):
+    """Oracle: gather the pool into the dense logical window, mask with
+    per-row lengths (decode_utils vector-idx form)."""
+    from deepspeed_tpu.models.decode_utils import cache_attn_mask
+    from deepspeed_tpu.ops.decode_attention import gather_paged_cache
+
+    B, tq = q4.shape[:2]
+    S = tables.shape[-1] * k_pool.shape[1]
+    kd = gather_paged_cache(k_pool, tables).transpose(0, 2, 1, 3)
+    vd = gather_paged_cache(v_pool, tables).transpose(0, 2, 1, 3)
+    mask = cache_attn_mask(S, lengths, tq)
+    y = attention_reference(q4.transpose(0, 2, 1, 3), kd, vd, mask=mask,
+                            causal=False)
+    return y.transpose(0, 2, 1, 3)
+
+
+@pytest.mark.parametrize("lengths,tq", [
+    ([0, 5], 1), ([7, 63], 1), ([64, 1], 1),       # boundary straddles
+    ([32, 16], 1),                                  # exactly on boundaries
+    ([0, 12], 4), ([60, 30], 4),                    # multi-query steps
+])
+def test_paged_matches_dense_gather(lengths, tq):
+    from deepspeed_tpu.ops.decode_attention import decode_attention_paged
+
+    args = _paged_setup(len(lengths), lengths, tq, bs=32, mb=4,
+                        seed=sum(lengths) + tq)
+    with tpu_interpret_mode():
+        out = decode_attention_paged(*args)
+    ref = _paged_dense_ref(*args)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_cache_index_exactly_on_block_boundary():
+    """lengths == k*block_size: the incoming token is the first row of a
+    fresh block — the gather edge case the block-table path adds."""
+    from deepspeed_tpu.ops.decode_attention import decode_attention_paged
+
+    for length in (32, 64, 96):
+        args = _paged_setup(1, [length], 1, bs=32, mb=4, seed=length)
+        with tpu_interpret_mode():
+            out = decode_attention_paged(*args)
+        ref = _paged_dense_ref(*args)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_paged_garbage_blocks_ignored():
+    """Unallocated table tail points at the garbage block: scribbling on
+    it (and on unowned pool blocks) must not change any output."""
+    from deepspeed_tpu.ops.decode_attention import decode_attention_paged
+
+    q4, k_pool, v_pool, tables, lengths = _paged_setup(1, [5], 1, bs=8, mb=4)
+    with tpu_interpret_mode():
+        out1 = decode_attention_paged(q4, k_pool, v_pool, tables, lengths)
+    kp = np.asarray(k_pool).copy()
+    vp = np.asarray(v_pool).copy()
+    owned = set(int(b) for b in np.asarray(tables)[0, :1])
+    for blk in range(kp.shape[0]):
+        if blk not in owned:
+            kp[blk] = 9999.0
+            vp[blk] = -9999.0
+    kp[list(owned)[0], 6:] = 4444.0  # beyond the valid prefix, same block
+    vp[list(owned)[0], 6:] = -4444.0
+    with tpu_interpret_mode():
+        out2 = decode_attention_paged(q4, jnp.asarray(kp), jnp.asarray(vp),
+                                      tables, lengths)
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
 
 
@@ -81,7 +192,7 @@ def test_model_decode_uses_kernel(monkeypatch):
 
     def run(force):
         monkeypatch.setattr(attn_mod, "_FORCE_DECODE_KERNEL", force)
-        ctx = pltpu.force_tpu_interpret_mode() if force else _null()
+        ctx = tpu_interpret_mode() if force else _null()
         outs = []
         with ctx:
             logits, vars_ = model.apply(

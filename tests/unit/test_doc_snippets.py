@@ -37,7 +37,8 @@ def _run_doc(name):
 RUN_LIST = ["getting-started.md", "parallelism.md", "inference.md",
             "zero-inference.md", "sparse-attention.md", "autotuning.md",
             "training-efficiency.md", "checkpointing.md",
-            "comm-quantization.md", "telemetry.md", "resilience.md"]
+            "comm-quantization.md", "telemetry.md", "resilience.md",
+            "serving.md"]
 
 
 @pytest.mark.heavy

@@ -131,7 +131,7 @@ class TestBthdAttentionLayout:
     headroom). Must be numerically identical to the default layout."""
 
     def test_logits_and_grads_match_default_layout(self):
-        from jax.experimental.pallas import tpu as pltpu
+        from deepspeed_tpu.utils.compat import tpu_interpret_mode
 
         ids = np.random.default_rng(0).integers(
             0, 512, (2, 256)).astype(np.int32)
@@ -142,7 +142,7 @@ class TestBthdAttentionLayout:
                              scan_layers=True, use_flash=True,
                              attn_layout=layout)
             model = GPT2ForTraining(cfg)
-            with pltpu.force_tpu_interpret_mode():
+            with tpu_interpret_mode():
                 params = model.init(jax.random.PRNGKey(0),
                                     {"input_ids": ids})["params"]
                 loss, grads = jax.value_and_grad(
@@ -156,8 +156,6 @@ class TestBthdAttentionLayout:
 
     def test_bthd_falls_back_when_masked(self):
         # attention_mask forces the standard path; must still run + match
-        from jax.experimental.pallas import tpu as pltpu
-
         ids = np.random.default_rng(1).integers(
             0, 512, (2, 64)).astype(np.int32)
         mask = np.ones((2, 64), np.int32)
@@ -166,7 +164,7 @@ class TestBthdAttentionLayout:
                          n_layer=2, n_head=4, dtype=jnp.float32,
                          attn_layout="bthd")
         model = GPT2LMHeadModel(cfg)
-        with pltpu.force_tpu_interpret_mode():
+        with tpu_interpret_mode():
             params = model.init(jax.random.PRNGKey(0), ids)["params"]
             logits = model.apply({"params": params}, ids,
                                  attention_mask=jnp.asarray(mask))

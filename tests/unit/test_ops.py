@@ -7,7 +7,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.experimental.pallas import tpu as pltpu
+from deepspeed_tpu.utils.compat import tpu_interpret_mode
 
 from deepspeed_tpu.ops.attention import attention_reference
 from deepspeed_tpu.ops.flash_attention import flash_attention
@@ -24,7 +24,7 @@ class TestFlashAttention:
     @pytest.mark.parametrize("causal", [True, False])
     def test_fwd_matches_reference(self, causal):
         q, k, v = _qkv()
-        with pltpu.force_tpu_interpret_mode():
+        with tpu_interpret_mode():
             o = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
         o_ref = attention_reference(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref),
@@ -40,7 +40,7 @@ class TestFlashAttention:
         def loss_ref(q, k, v):
             return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
 
-        with pltpu.force_tpu_interpret_mode():  # covers the custom_vjp bwd too
+        with tpu_interpret_mode():  # covers the custom_vjp bwd too
             gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
         gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(gf, gr):
@@ -60,7 +60,7 @@ class TestFlashAttention:
         q, _, _ = _qkv(T=128)
         _, k, v = _qkv(T=64, seed=1)
 
-        with pltpu.force_tpu_interpret_mode():
+        with tpu_interpret_mode():
             o = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
         o_ref = attention_reference(q, k, v, causal=True)
         # off = 64 - 128 = -64: rows 0..63 attend to nothing → zeros (the
@@ -78,7 +78,7 @@ class TestFlashAttention:
         def loss_ref(q, k, v):
             return jnp.sum(attention_reference(q, k, v, causal=True)[:, :, 64:] ** 2)
 
-        with pltpu.force_tpu_interpret_mode():
+        with tpu_interpret_mode():
             gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
             # masked rows must not leak gradient anywhere
             g_all = jax.grad(lambda q, k, v: jnp.sum(flash_attention(

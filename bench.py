@@ -187,6 +187,7 @@ def main():
     _telemetry_series(warm_mark, steps)
     _resilience_series(cfg, batch, seq, on_tpu)
     _comm_compression_series(cfg, batch, seq, on_tpu)
+    _elastic_resume_series(cfg, batch, seq, on_tpu)
 
 
 def _telemetry_series(warm_mark, steps):
@@ -401,6 +402,104 @@ def _comm_compression_series(cfg, batch, seq, on_tpu, steps=5):
               flush=True)
         emit_result({"metric": METRIC + "_comm_compression", "value": None,
                      "unit": "tokens/s", "vs_baseline": None,
+                     "error": str(e)[:300]})
+
+
+def _elastic_resume_series(cfg, batch, seq, on_tpu):
+    """Optional extra series: checkpoint restore wall time, same-mesh vs
+    reshard-at-load onto HALF the mesh (the elastic topology-shift
+    path — a checkpoint saved at N-way partitioning materialized under
+    N/2-way sharding from the saved topology manifest). One JSON line
+    emitted AFTER the headline; `vs_baseline` = reshard/same-mesh
+    restore time (~1.0 means the reshard path costs nothing extra). On
+    a single chip the reshard leg records null — the series becomes
+    meaningful on a multi-chip window."""
+    import shutil
+    import sys
+    import tempfile
+
+    import jax
+    import numpy as np_
+
+    import deepspeed_tpu
+
+    try:
+        from deepspeed_tpu.models.gpt2 import GPT2ForTraining
+        from deepspeed_tpu.parallel.topology import (MeshTopology,
+                                                     reset_topology)
+
+        n_dev = jax.device_count()
+        rows = batch * n_dev  # global batch held constant across meshes
+        rng = np_.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (rows, seq)).astype(np_.int32)
+
+        def build(ndev):
+            reset_topology()
+            topo = MeshTopology(axis_sizes={"data": ndev},
+                                devices=jax.devices()[:ndev])
+            engine, *_ = deepspeed_tpu.initialize(
+                model=GPT2ForTraining(cfg), mesh=topo,
+                config={
+                    "train_batch_size": rows,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 6e-4}},
+                    "bf16": {"enabled": on_tpu},
+                    "zero_optimization": {"stage": 0},
+                    "steps_per_print": 10_000,
+                    # arms the topology manifest on every save
+                    "elasticity": {"enabled": True,
+                                   "max_train_batch_size": rows,
+                                   "micro_batch_sizes": [batch],
+                                   "min_gpus": 1, "max_gpus": n_dev,
+                                   "version": 0.1},
+                })
+            return engine
+
+        def step(engine):
+            loss = engine({"input_ids": ids})
+            engine.backward(loss)
+            engine.step()
+            float(loss)
+            jax.block_until_ready(engine.state.params)
+
+        def timed_restore(ndev, save_dir):
+            engine = build(ndev)
+            step(engine)  # template state + compile outside the window
+            t0 = time.perf_counter()
+            engine.load_checkpoint(save_dir, tag="bench")
+            jax.block_until_ready(engine.state.params)
+            dt = time.perf_counter() - t0
+            engine.destroy()
+            return dt
+
+        save_dir = tempfile.mkdtemp(prefix="bench_elastic_")
+        try:
+            saver = build(n_dev)
+            step(saver)
+            saver.save_checkpoint(save_dir, tag="bench")
+            saver.destroy()
+            same = timed_restore(n_dev, save_dir)
+            half = (timed_restore(n_dev // 2, save_dir)
+                    if n_dev >= 2 else None)
+        finally:
+            shutil.rmtree(save_dir, ignore_errors=True)
+
+        emit_result({
+            "metric": METRIC + "_elastic_resume",
+            "value": round(same, 4),
+            "unit": "restore_seconds",
+            "vs_baseline": round(half / same, 4) if half else None,
+            "same_mesh_restore_secs": round(same, 4),
+            "reshard_restore_secs": round(half, 4) if half is not None
+            else None,
+            "saved_world": n_dev,
+            "reshard_world": n_dev // 2 if n_dev >= 2 else None,
+        })
+    except Exception as e:  # noqa: BLE001 — extras must never kill the
+        # already-emitted headline; record the failure structurally
+        print(f"# elastic_resume series failed: {e}", file=sys.stderr,
+              flush=True)
+        emit_result({"metric": METRIC + "_elastic_resume", "value": None,
+                     "unit": "restore_seconds", "vs_baseline": None,
                      "error": str(e)[:300]})
 
 

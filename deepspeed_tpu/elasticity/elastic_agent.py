@@ -41,10 +41,18 @@ class DSElasticAgent:
                  signals=(signal.SIGTERM,),
                  on_preempt: Optional[Callable] = None,
                  install_handlers: bool = True,
-                 agree_every: int = 16):
+                 agree_every: int = 16,
+                 loader=None):
         self.engine = engine
         self.save_dir = save_dir
         self.on_preempt = on_preempt
+        # data pipeline whose cursor travels with preemption checkpoints
+        # (topology manifest) and is restored/fast-forwarded on resume —
+        # the sample-exact half of an elastic restart
+        self.loader = loader
+        if loader is not None and hasattr(engine, "attach_data_loader"):
+            engine.attach_data_loader(loader)
+        self.last_restore_info = None
         # multi-host: how often (in optimizer steps) hosts agree on the
         # flag — the agreement is a host-synchronizing collective, so
         # per-step would cap run-ahead; preemption notice periods are tens
@@ -156,37 +164,75 @@ class DSElasticAgent:
         except Exception:
             return -1
 
-    def restore_if_any(self):
-        """Load the NEWEST of {preempt checkpoint, 'latest' checkpoint}
-        onto the current mesh, by comparing their recorded step counters —
-        a stale preempt tag never rolls back past a newer regular save, and
-        nothing is deleted (a crash right after restore still finds every
-        checkpoint on disk). Returns the tag restored, or None. The current
-        mesh may differ from the saving mesh — the checkpoint layer
-        reshards (test_sharded_checkpoint.py proves both directions)."""
-        if not os.path.isdir(self.save_dir):
-            return None
-        candidates = []  # (step, tag_or_None)
+    def _restore_candidates(self):
+        """[(verified, step, tag_or_None)] — every restorable candidate.
+        ``tag=None`` is the engine's ``latest`` path (with its own
+        verified-good fallback chain). With the resilience block enabled,
+        PR 3's verified-good registry joins the pool and VERIFIED tags
+        outrank unverified ones: a newest-by-mtime but unverified
+        (possibly torn) tag must not win the elastic path over a
+        verified-good one."""
+        verified_tags: list = []
+        res = getattr(self.engine, "resilience", None)
+        if (res is not None and res.enabled
+                and res.config.checkpoint.integrity):
+            from deepspeed_tpu.runtime.resilience.integrity import (
+                read_verified)
+
+            verified_tags = read_verified(self.save_dir)
+        candidates = []  # (verified, step, tag_or_None)
+        seen = set()
         preempt_dir = os.path.join(self.save_dir, PREEMPT_TAG)
         if os.path.isdir(preempt_dir):
-            candidates.append((self._tag_step(preempt_dir), PREEMPT_TAG))
+            candidates.append((PREEMPT_TAG in verified_tags,
+                               self._tag_step(preempt_dir), PREEMPT_TAG))
+            seen.add(PREEMPT_TAG)
         latest_file = os.path.join(self.save_dir, "latest")
         if os.path.exists(latest_file):
             with open(latest_file) as f:
                 latest_tag = f.read().strip()
-            candidates.append((self._tag_step(
+            candidates.append((latest_tag in verified_tags, self._tag_step(
                 os.path.join(self.save_dir, latest_tag)), None))
+            seen.add(latest_tag)
+        for t in verified_tags:
+            if t in seen or not os.path.isdir(os.path.join(self.save_dir, t)):
+                continue
+            candidates.append((True, self._tag_step(
+                os.path.join(self.save_dir, t)), t))
+        return candidates
+
+    def restore_if_any(self, loader=None):
+        """Restore the best available checkpoint onto the CURRENT mesh:
+        verified-good first (when the resilience block is enabled),
+        newest-by-recorded-step within each class — a stale preempt tag
+        never rolls back past a newer regular save, and nothing is
+        deleted (a crash right after restore still finds every
+        checkpoint on disk). Returns the tag restored, or None.
+
+        The current mesh may differ from the saving mesh — the
+        checkpoint layer reshards at load against the saved topology
+        manifest — and afterwards the elastic geometry is re-validated
+        (:func:`elastic_batch_for_world`) and the data pipeline
+        (``loader`` here, or the one attached at construction) is
+        restored to the exact global sample position, so the resumed run
+        consumes the same sample sequence the preempted one would have.
+        """
+        if not os.path.isdir(self.save_dir):
+            return None
+        candidates = self._restore_candidates()
         if not candidates:
             return None
-        # newest first; a candidate that fails integrity verification (or
-        # lost files) must not kill the restart — the next-newest (and,
-        # via tag=None, the engine's verified-good fallback chain) still
-        # restores a working job
+        # verified-good first, then newest first within each class; a
+        # candidate that fails integrity verification (or lost files)
+        # must not kill the restart — the next (and, via tag=None, the
+        # engine's verified-good fallback chain) still restores a
+        # working job
         from deepspeed_tpu.runtime.resilience.integrity import (
             CheckpointCorruptionError)
 
         last_err = None
-        for _, tag in sorted(candidates, key=lambda c: c[0], reverse=True):
+        for _, _, tag in sorted(candidates,
+                                key=lambda c: (c[0], c[1]), reverse=True):
             try:
                 loaded_tag, _ = self.engine.load_checkpoint(self.save_dir,
                                                             tag=tag)
@@ -209,10 +255,99 @@ class DSElasticAgent:
                     f"unusable ({e}); trying the next candidate")
                 continue
             if loaded_tag is not None:
+                self._after_restore(loaded_tag, loader or self.loader)
                 log_dist(f"elastic restore: resumed from {loaded_tag!r} at "
                          f"step {self.engine.global_steps}", ranks=[0])
             return loaded_tag
         raise last_err
+
+    # ------------------------------------------------------------------
+    def _after_restore(self, tag, loader):
+        """The elastic half of a restart: re-validate the micro-batch
+        geometry for the CURRENT world size (global batch held constant)
+        and fast-forward the data pipeline so the global sample sequence
+        continues exactly where the preempted run left off."""
+        from deepspeed_tpu.elasticity.config import (
+            ElasticityIncompatibleWorldSize)
+        from deepspeed_tpu.runtime.resilience.topology import (
+            read_topology_manifest)
+
+        engine = self.engine
+        manifest = read_topology_manifest(
+            os.path.join(self.save_dir, str(tag)))
+        info = {"tag": str(tag), "manifest": manifest is not None,
+                "replay": None}
+        saved_world = ((manifest or {}).get("mesh") or {}).get("world_size")
+        cur_world = int(engine.topology.world_size)
+        saved_tb = ((manifest or {}).get("batch") or {}).get(
+            "train_batch_size")
+        if saved_tb is not None:
+            if (saved_world is not None and saved_world != cur_world
+                    and getattr(engine, "elasticity_enabled",
+                                lambda: False)()):
+                # recompute the micro-batch geometry for the new world;
+                # elastic_batch_for_world REJECTS (loudly) geometries
+                # that cannot hold the global batch constant
+                batch, micro = elastic_batch_for_world(
+                    engine._config._param_dict, cur_world)
+                if batch != saved_tb:
+                    raise ElasticityIncompatibleWorldSize(
+                        f"elastic plan for world size {cur_world} picks "
+                        f"global batch {batch}, but the checkpoint was "
+                        f"trained at train_batch_size={saved_tb} — "
+                        "sample-exact resume needs the global batch held "
+                        "constant; fix the elasticity section "
+                        "(max_train_batch_size / micro_batch_sizes)")
+                info["micro_batch"] = micro
+            if int(engine.train_batch_size()) != int(saved_tb):
+                # same global batch, different gas split (the engine's
+                # micro-batch is compiled in; gas is the free variable)
+                try:
+                    engine.set_train_batch_size(int(saved_tb))
+                except Exception as e:
+                    raise ElasticityIncompatibleWorldSize(
+                        f"cannot hold the global batch at {saved_tb} on "
+                        f"world size {cur_world}: {e}") from e
+            info["train_batch_size"] = int(saved_tb)
+        # data replay — the saved cursor is exact (batch-size
+        # independent); global_samples seek is the manifest-less
+        # fallback; a plain iterator skips whole micro-batches derived
+        # from the consumed-sample count
+        if loader is not None:
+            cursor = (manifest or {}).get("data_pipeline")
+            if cursor and hasattr(loader, "load_state_dict"):
+                loader.load_state_dict(cursor)
+                info["replay"] = {"mode": "cursor", **cursor}
+            elif hasattr(loader, "fast_forward_samples"):
+                loader.fast_forward_samples(int(engine.global_samples))
+                info["replay"] = {"mode": "samples",
+                                  "samples": int(engine.global_samples)}
+            else:
+                from deepspeed_tpu.runtime.resilience.manager import (
+                    fast_forward)
+
+                # a plain iterator has no cursor, so the skip count must
+                # be derived from SAMPLES in the CURRENT geometry's
+                # units — the saved run's micro_steps counter is in the
+                # saved geometry's units and lands at the wrong offset
+                # whenever the gas split changed across the restart
+                samples = int(engine.global_samples)
+                per_micro = (int(engine.train_batch_size())
+                             // max(1, int(
+                                 engine.gradient_accumulation_steps())))
+                if per_micro <= 0 or samples % per_micro:
+                    raise ValueError(
+                        f"cannot fast-forward a plain iterator: "
+                        f"{samples} consumed samples do not divide into "
+                        f"micro-batches of {per_micro} rows under the "
+                        "current geometry — attach a cursor-capable "
+                        "loader (DeepSpeedDataLoader) for sample-exact "
+                        "elastic resume")
+                consumed = fast_forward(iter(loader),
+                                        samples // per_micro)
+                info["replay"] = {"mode": "micro_batches",
+                                  "micro_batches": consumed}
+        self.last_restore_info = info
 
     def close(self):
         for sig, prev in self._prev_handlers.items():
@@ -227,8 +362,64 @@ def elastic_batch_for_world(ds_config: dict, world_size: int):
     elasticity planner (reference ``compute_elastic_config``,
     ``elasticity/elasticity.py:287``) — the rescale half of the restart.
     ``ds_config`` is the full engine config carrying an ``elasticity``
-    section."""
-    result = compute_elastic_config(ds_config, world_size=world_size,
-                                    return_microbatch=True)
-    batch, _valid, micro = result
-    return batch, micro
+    section.
+
+    When the config also pins ``train_batch_size``, the GLOBAL batch is
+    an invariant of the elastic resume (sample-exact replay depends on
+    every world size consuming the same samples per optimizer step): the
+    returned geometry keeps it constant, and a config whose
+    ``train_batch_size`` cannot be held constant — not divisible into a
+    menu micro-batch at this (or any candidate) world size — is REJECTED
+    with a clear error instead of silently returning a geometry that
+    changes the effective batch. Opt out with
+    ``elasticity.ignore_non_elastic_batch_info``.
+    """
+    from deepspeed_tpu.elasticity.config import (
+        ElasticityConfig, ElasticityConfigError, ElasticityError,
+        ElasticityIncompatibleWorldSize)
+    from deepspeed_tpu.elasticity.elasticity import ELASTICITY
+
+    cfg = ElasticityConfig(ds_config.get(ELASTICITY, {}))
+    tb = ds_config.get("train_batch_size")
+    if tb is None or cfg.ignore_non_elastic_batch_info:
+        batch, _valid, micro = compute_elastic_config(
+            ds_config, world_size=world_size, return_microbatch=True)
+        return batch, micro
+    if not cfg.enabled:
+        raise ElasticityError("elasticity is not enabled in the config")
+    # pinned global batch: the planner's candidate choice is moot — the
+    # geometry is fully determined by tb, and the only question is the
+    # divisibility lattice: at which world sizes CAN tb split into an
+    # integer number of menu micro-batches per replica? The lattice is
+    # computed in DATA-PARALLEL units (v0.2 divides the world among
+    # model-parallel groups; v0.1 has dp == chips) and reported back to
+    # the caller in chip units — mixing the two would reject valid
+    # worlds and under-enforce max_gpus whenever mp > 1.
+    menu = sorted(cfg.micro_batch_sizes)
+    mp = (max(1, cfg.model_parallel_size)
+          if cfg.version >= 0.2 - 1e-9 else 1)
+    dp_lo = max(1, -(-cfg.min_gpus // mp))  # ceil: chips -> dp worlds
+    dp_hi = min(cfg.max_gpus // mp, tb)  # dp worlds beyond tb can't divide
+    lattice = [dp for dp in range(dp_lo, dp_hi + 1)
+               if tb % dp == 0 and any((tb // dp) % mb == 0 for mb in menu)]
+    if not lattice:
+        raise ElasticityConfigError(
+            f"train_batch_size {tb} cannot be held constant at ANY world "
+            f"size in [{cfg.min_gpus}, {cfg.max_gpus}] with micro-batch "
+            f"menu {menu}"
+            + (f" and model_parallel_size {mp}" if mp > 1 else "")
+            + ": an elastic resume would silently change the "
+            "effective global batch. Make train_batch_size divisible "
+            "into a menu micro-batch at the world sizes you expect, or "
+            "drop train_batch_size / set "
+            "elasticity.ignore_non_elastic_batch_info")
+    dp_world = world_size // mp
+    if world_size % mp or dp_world not in lattice:
+        raise ElasticityIncompatibleWorldSize(
+            f"train_batch_size {tb} is not divisible into a menu "
+            f"micro-batch at world size {world_size} (dp={dp_world}, "
+            f"mp={mp}, menu={menu}); world sizes that keep the global "
+            f"batch constant: {[dp * mp for dp in lattice]}")
+    fitting = [mb for mb in menu if (tb // dp_world) % mb == 0]
+    micro = max(fitting) if cfg.prefer_larger_batch else min(fitting)
+    return tb, micro

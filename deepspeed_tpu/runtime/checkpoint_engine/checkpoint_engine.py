@@ -33,6 +33,21 @@ def fsync_dir(path):
         pass
 
 
+def atomic_write_text(path: str, text: str):
+    """tmp file + fsync + ``os.replace``: a crash mid-write can never
+    leave a truncated file at ``path`` — either the old content survives
+    or the new content is complete. THE durable-text-write primitive:
+    sidecar manifests here, and the resilience layer's pointer/manifest/
+    registry writes (re-exported from ``resilience.integrity``)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".")
+
+
 class CheckpointEngine:
     def __init__(self, config_params=None):
         pass
@@ -45,6 +60,15 @@ class CheckpointEngine:
 
     def load(self, path: str, map_location=None) -> Dict[str, Any]:
         raise NotImplementedError
+
+    def save_text(self, path: str, text: str):
+        """Small sidecar metadata file saved into a tag directory (the
+        topology manifest). Atomic (:func:`atomic_write_text`) so a
+        crash mid-write never leaves a truncated record; staging-capable
+        engines override this so the sidecar rides their atomic
+        publish."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        atomic_write_text(path, text)
 
     def commit(self, tag):
         return True
@@ -70,6 +94,120 @@ def _flatten(tree: Any, prefix: str = "") -> Dict[str, Any]:
     else:
         out[prefix.rstrip("/")] = tree
     return out
+
+
+class LazyNpz:
+    """Slice-addressable reader over an uncompressed ``.npz``.
+
+    ``np.savez`` stores each member with ``ZIP_STORED`` (no compression),
+    so every array's bytes sit contiguously in the archive at a knowable
+    offset. This reader parses the zip + npy headers ONCE, then serves
+    ``read_slice(key, index)`` through a per-call ``np.memmap`` — only
+    the pages the slice touches are read. That is what lets the
+    reshard-at-load path materialize an M-way-sharded tensor from an
+    N-way-era checkpoint without any host reading the full file
+    (``jax.make_array_from_callback`` asks for exactly this host's shard
+    indices). Compressed/Fortran/object members degrade to a cached
+    full read of that member only.
+    """
+
+    def __init__(self, path: str):
+        import struct
+        import zipfile
+
+        self._path = path
+        # key -> (array_byte_offset, shape, dtype) | None (full-read fallback)
+        self._entries: Dict[str, Optional[tuple]] = {}
+        self._full_cache: Dict[str, Any] = {}
+        with zipfile.ZipFile(path) as zf, open(path, "rb") as raw:
+            for zinfo in zf.infolist():
+                name = zinfo.filename
+                key = name[:-4] if name.endswith(".npy") else name
+                entry = None
+                if zinfo.compress_type == zipfile.ZIP_STORED:
+                    raw.seek(zinfo.header_offset)
+                    local = raw.read(30)
+                    if len(local) == 30 and local[:4] == b"PK\x03\x04":
+                        nlen, elen = struct.unpack("<HH", local[26:30])
+                        raw.seek(zinfo.header_offset + 30 + nlen + elen)
+                        entry = self._parse_npy_header(raw)
+                self._entries[key] = entry
+
+    @staticmethod
+    def _parse_npy_header(f):
+        try:
+            version = np.lib.format.read_magic(f)
+            if version == (1, 0):
+                shape, fortran, dtype = np.lib.format.read_array_header_1_0(f)
+            elif version == (2, 0):
+                shape, fortran, dtype = np.lib.format.read_array_header_2_0(f)
+            else:
+                return None
+        except ValueError:
+            return None
+        if fortran or dtype.hasobject:
+            return None
+        return (f.tell(), tuple(shape), dtype)
+
+    def keys(self):
+        return list(self._entries)
+
+    def __contains__(self, key):
+        return key in self._entries
+
+    def shape_dtype(self, key):
+        entry = self._entries[key]
+        if entry is not None:
+            return entry[1], entry[2]
+        a = self._full(key)
+        return tuple(a.shape), a.dtype
+
+    def _full(self, key):
+        if key not in self._full_cache:
+            with np.load(self._path, allow_pickle=False) as z:
+                self._full_cache[key] = z[key]
+        return self._full_cache[key]
+
+    def read_slice(self, key: str, index=()) -> np.ndarray:
+        """Materialize ``arr[index]`` reading only those bytes (plus
+        filesystem page granularity). ``index`` is a tuple of slices —
+        exactly what ``jax.make_array_from_callback`` hands its
+        callback; ``()`` reads the whole array."""
+        entry = self._entries[key]
+        if entry is None:
+            return np.ascontiguousarray(self._full(key)[index])
+        offset, shape, dtype = entry
+        if not shape:  # 0-d member
+            mm = np.memmap(self._path, dtype=dtype, mode="r",
+                           offset=offset, shape=(1,))
+            return np.asarray(mm[0]).reshape(())
+        mm = np.memmap(self._path, dtype=dtype, mode="r",
+                       offset=offset, shape=shape)
+        return np.array(mm[index])  # copy: touches only the sliced pages
+
+    def read(self, key: str) -> np.ndarray:
+        return self.read_slice(key, ())
+
+
+def apply_npz_meta(flat: Dict[str, Any], meta: Dict[str, Any]) -> Dict[str, Any]:
+    """Decode the ``.json`` sidecar's markers over loaded npz payloads,
+    in place: ``#none`` entries restore None leaves, ``#dtype`` entries
+    re-view uint payloads back to their ml_dtypes type, everything else
+    is a scalar/string leaf. The single owner of the sidecar marker
+    semantics — regular loads and the reshard-at-load path must decode
+    identically."""
+    for k, v in meta.items():
+        if k.endswith("#none"):
+            flat[k[:-len("#none")]] = None
+        elif k.endswith("#dtype"):
+            import ml_dtypes  # noqa: F401 — registers the names
+
+            base = k[:-len("#dtype")]
+            if base in flat:
+                flat[base] = flat[base].view(np.dtype(v))
+        else:
+            flat[k] = v
+    return flat
 
 
 class ArrayCheckpointEngine(CheckpointEngine):
@@ -112,19 +250,23 @@ class ArrayCheckpointEngine(CheckpointEngine):
         if os.path.exists(path + ".json"):
             with open(path + ".json") as f:
                 meta = json.load(f)
-            for k, v in meta.items():
-                if k.endswith("#none"):
-                    flat[k[:-len("#none")]] = None
-                elif k.endswith("#dtype"):
-                    # re-view uint payloads back to their ml_dtypes type
-                    import ml_dtypes  # noqa: F401 — registers the names
-
-                    base = k[:-len("#dtype")]
-                    if base in flat:
-                        flat[base] = flat[base].view(np.dtype(v))
-                else:
-                    flat[k] = v
+            apply_npz_meta(flat, meta)
         return flat
+
+    supports_lazy = True
+
+    def load_lazy(self, path: str):
+        """``(LazyNpz, meta)`` pair for slice-addressable reads: the
+        reshard-at-load path pulls only the slices the current mesh's
+        shards need. ``meta`` is the raw sidecar json (``#none`` /
+        ``#dtype`` markers included — the caller applies them, since a
+        sliced payload must be dtype-viewed AFTER slicing)."""
+        reader = LazyNpz(path + ".npz")
+        meta: Dict[str, Any] = {}
+        if os.path.exists(path + ".json"):
+            with open(path + ".json") as f:
+                meta = json.load(f)
+        return reader, meta
 
 
 class OrbaxCheckpointEngine(CheckpointEngine):
@@ -222,6 +364,10 @@ class TieredCheckpointEngine(CheckpointEngine):
         return getattr(self._inner, "supports_sharded", False)
 
     @property
+    def supports_lazy(self):
+        return getattr(self._inner, "supports_lazy", False)
+
+    @property
     def aux_engine(self):
         """Consolidated-format engine whose saves STAGE through this
         tier: the engine's aux files (counters, host optimizer) must ride
@@ -255,27 +401,40 @@ class TieredCheckpointEngine(CheckpointEngine):
         self._roots = set()
         self._fresh = set()
 
-    def _stage(self, state_dict, path, inner):
+    def _staged_target(self, path):
+        """Resolve ``path`` into the tag's staging dir, wiping crash
+        leftovers before the round's first write: a CRASHED earlier run
+        may have left partial staging here, and a publish must only ever
+        contain this round's files (cross-process rollback — an
+        in-memory flag can't see a previous process's leftovers). Every
+        staged write — payload or sidecar — must come through here."""
         import shutil
 
         save_dir, tag, name = self._split(path)
         staged_dir = os.path.join(save_dir, ".staging", tag)
         if (save_dir, tag) not in self._fresh:
-            # a CRASHED earlier run may have left partial staging here; a
-            # publish must only ever contain this round's files, so wipe
-            # before the round's first write (cross-process rollback — an
-            # in-memory flag can't see a previous process's leftovers)
             shutil.rmtree(staged_dir, ignore_errors=True)
             self._fresh.add((save_dir, tag))
         self._roots.add(save_dir)
-        inner.save(state_dict, os.path.join(staged_dir, name))
+        return os.path.join(staged_dir, name)
+
+    def _stage(self, state_dict, path, inner):
+        inner.save(state_dict, self._staged_target(path))
 
     def save(self, state_dict, path):
         self._stage(state_dict, path, self._inner)
 
-    def _load_with_fallback(self, path, inner, map_location=None):
+    def save_text(self, path, text):
+        """Sidecar metadata (topology manifest) rides the SAME staged
+        atomic publish as the payload — written into the final tag dir
+        it would be destroyed when commit replaces that dir."""
+        CheckpointEngine.save_text(self, self._staged_target(path), text)
+
+    def _load_with_fallback(self, path, inner, map_location=None,
+                            loader=None):
+        load = loader or (lambda p: inner.load(p, map_location=map_location))
         try:
-            return inner.load(path, map_location=map_location)
+            return load(path)
         except (OSError, FileNotFoundError):
             if not self._load_mirror:
                 raise
@@ -288,7 +447,7 @@ class TieredCheckpointEngine(CheckpointEngine):
                           (self._load_path, self._persist_path) if base]
             for cand in fallbacks:
                 try:
-                    out = inner.load(cand, map_location=map_location)
+                    out = load(cand)
                     logger.warning(f"[ckpt] fast tier missing {path}; "
                                    f"restored from {cand}")
                     return out
@@ -298,6 +457,12 @@ class TieredCheckpointEngine(CheckpointEngine):
 
     def load(self, path, map_location=None):
         return self._load_with_fallback(path, self._inner, map_location)
+
+    def load_lazy(self, path):
+        """Slice-addressable load (reshard-at-load) with the same
+        mirror fallback as :meth:`load`."""
+        return self._load_with_fallback(path, self._inner,
+                                        loader=self._inner.load_lazy)
 
     def commit(self, tag):
         import shutil

@@ -404,6 +404,10 @@ class DeepSpeedEngine:
         self.global_samples = 0
         self.skipped_steps = 0
         self._last_loss = None
+        # data pipeline the elastic agent attached (topology manifests
+        # record its cursor so a topology-shift resume replays the global
+        # sample sequence exactly); falls back to training_dataloader
+        self._elastic_loader = None
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
@@ -2011,6 +2015,113 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:2706 load / :3061 save)
+    # ------------------------------------------------------------------
+    # elastic topology: manifest build + data-pipeline attachment
+    def attach_data_loader(self, loader):
+        """Attach the data pipeline whose cursor should travel with
+        checkpoints (the elastic agent calls this): topology manifests
+        record ``loader.state_dict()`` so a topology-shift resume can
+        continue the global sample sequence exactly."""
+        self._elastic_loader = loader
+
+    def _data_pipeline_state(self):
+        loader = self._elastic_loader or self.training_dataloader
+        state_fn = getattr(loader, "state_dict", None)
+        if state_fn is None:
+            return None
+        try:
+            return state_fn()
+        except Exception as e:  # a cursor is advisory; the save is not
+            logger.warning(f"data pipeline state_dict failed ({e}); the "
+                           "topology manifest carries no loader cursor")
+            return None
+
+    def describe_topology(self, include_tensors: bool = True,
+                          include_data: bool = True) -> dict:
+        """The engine's live topology manifest: mesh/world/ZeRO-stage,
+        batch geometry, counters, data-pipeline cursor, RNG, and the
+        per-tensor logical shape + dtype + partition spec of params and
+        optimizer state. Written into every checkpoint tag when
+        elasticity is enabled; also the \"current\" side of the
+        saved-vs-current diff at load (and in ``tools/ckpt_topology``)."""
+        from deepspeed_tpu.runtime.resilience.topology import (
+            TOPOLOGY_MANIFEST_VERSION)
+        from deepspeed_tpu.runtime.zero.partition import (
+            sharding_spec_entries)
+        from deepspeed_tpu.utils.pytree import flatten_with_path_strings
+
+        manifest = {
+            "version": TOPOLOGY_MANIFEST_VERSION,
+            "mesh": {
+                "axes": {a: int(s)
+                         for a, s in self.topology.axis_sizes.items()},
+                "world_size": int(self.topology.world_size),
+                "process_count": int(jax.process_count()),
+            },
+            "zero_stage": int(self.zero_optimization_stage()),
+            "batch": {
+                "train_batch_size": int(self.train_batch_size()),
+                "micro_batch_per_gpu":
+                    int(self.train_micro_batch_size_per_gpu()),
+                "gradient_accumulation_steps":
+                    int(self.gradient_accumulation_steps()),
+                "dp_world_size":
+                    int(self.topology.get_data_parallel_world_size()),
+            },
+            "counters": {
+                "global_steps": int(self.global_steps),
+                "micro_steps": int(self.micro_steps),
+                "global_samples": int(self.global_samples),
+            },
+            "format": ("sharded" if getattr(self.checkpoint_engine,
+                                            "supports_sharded", False)
+                       else "consolidated"),
+            # the load-side diff never compares the cursor; skipping it
+            # there avoids touching the live loader on every restore
+            "data_pipeline": (self._data_pipeline_state()
+                              if include_data else None),
+        }
+        if self.state is not None:
+            manifest["rng"] = [
+                int(x) for x in
+                np.asarray(jax.device_get(self.state.rng)).ravel()]
+        if include_tensors and self.state is not None:
+            tensors = {}
+            for prefix, tree, shardings in (
+                    ("params/", self.state.params,
+                     self._state_shardings.params),
+                    ("opt_state/", self.state.opt_state,
+                     self._state_shardings.opt_state)):
+                flat, _ = flatten_with_path_strings(tree)
+                flat_sh, _ = flatten_with_path_strings(shardings)
+                for (path, leaf), (_, sh) in zip(flat, flat_sh):
+                    tensors[prefix + path] = {
+                        "shape": [int(d) for d in leaf.shape],
+                        "dtype": str(leaf.dtype),
+                        "spec": sharding_spec_entries(sh),
+                    }
+            manifest["tensors"] = tensors
+        return manifest
+
+    def _emit_topology_event(self, tag, saved_manifest, diff):
+        from deepspeed_tpu.runtime.resilience.topology import (
+            topology_shifted)
+
+        saved_mesh = (saved_manifest or {}).get("mesh", {})
+        self.telemetry.emit(
+            "topology", "restore", step=self.global_steps,
+            data={
+                "tag": str(tag),
+                "saved_mesh": saved_mesh.get("axes"),
+                "saved_world": saved_mesh.get("world_size"),
+                "current_mesh": {a: int(s) for a, s in
+                                 self.topology.axis_sizes.items()},
+                "current_world": int(self.topology.world_size),
+                "resharded": bool(diff and topology_shifted(diff)),
+                "zero_stage_saved": (saved_manifest or {}).get("zero_stage"),
+                "zero_stage_current": int(self.zero_optimization_stage()),
+            })
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         if self.state is None:
             raise RuntimeError("no state to checkpoint (run a forward first)")
@@ -2078,6 +2189,18 @@ class DeepSpeedEngine:
                 self.checkpoint_engine.save(module_state, os.path.join(ckpt_dir, "module"))
                 self.checkpoint_engine.save(optim_state, os.path.join(ckpt_dir, "optimizer"))
                 self.checkpoint_engine.save(engine_state, os.path.join(ckpt_dir, "engine"))
+        if self.elasticity_enabled() and dist.get_rank() == 0:
+            # topology manifest: written BEFORE commit so the integrity
+            # layer hashes it like any payload file (and the tiered
+            # engine publishes it atomically with the tag). Gated on the
+            # elasticity block — with elasticity disabled the checkpoint
+            # bytes are byte-identical to a pre-elastic save (pinned in
+            # tests/unit/test_elastic_resume.py).
+            from deepspeed_tpu.runtime.resilience.topology import (
+                write_topology_manifest)
+
+            write_topology_manifest(self.checkpoint_engine, ckpt_dir,
+                                    self.describe_topology())
         self.checkpoint_engine.commit(tag)
         # "latest" moves only AFTER the commit publishes the tag — a crash
         # between the two can never leave latest dangling at a
@@ -2246,13 +2369,55 @@ class DeepSpeedEngine:
             return result
         raise last_err  # unreachable: the loop raised or returned
 
+    def _validate_topology_for_load(self, manifest, ckpt_dir, *,
+                                    params_only: bool):
+        """Saved-vs-current topology diff, raising a loud structured
+        :class:`TopologyShiftError` when resharding is impossible —
+        never a shape/KeyError from deep inside jax. ``params_only``
+        skips optimizer-state tensors (module-only loads may legally
+        target an engine with a different optimizer)."""
+        from deepspeed_tpu.runtime.resilience.topology import (
+            validate_reshard)
+
+        saved, current = manifest, self.describe_topology(include_data=False)
+        if params_only:
+            saved = dict(manifest)
+            saved["tensors"] = {
+                k: v for k, v in (manifest.get("tensors") or {}).items()
+                if k.startswith("params/")}
+            current["tensors"] = {
+                k: v for k, v in (current.get("tensors") or {}).items()
+                if k.startswith("params/")}
+        return validate_reshard(saved, current, ckpt_dir)
+
     def _load_checkpoint_tag(self, ckpt_dir, tag, *,
                              load_optimizer_states=True,
                              load_lr_scheduler_states=True,
                              load_module_only=False):
+        from deepspeed_tpu.runtime.resilience.topology import (
+            read_topology_manifest)
+
+        manifest = read_topology_manifest(ckpt_dir)
+        diff = None
+        if manifest is not None and self.state is not None:
+            diff = self._validate_topology_for_load(
+                manifest, ckpt_dir,
+                params_only=load_module_only or not load_optimizer_states)
         if getattr(self.checkpoint_engine, "supports_sharded", False):
             return self._load_checkpoint_sharded(
                 ckpt_dir, tag,
+                load_optimizer_states=load_optimizer_states,
+                load_lr_scheduler_states=load_lr_scheduler_states,
+                load_module_only=load_module_only,
+                manifest=manifest, topo_diff=diff)
+        if (manifest is not None and self.state is not None
+                and getattr(self.checkpoint_engine, "supports_lazy",
+                            False)):
+            # elastic checkpoint + live template: reshard-at-load (each
+            # logical tensor materialized under the CURRENT sharding,
+            # reading only the slices this host's shards need)
+            return self._load_checkpoint_reshard(
+                ckpt_dir, tag, manifest, diff,
                 load_optimizer_states=load_optimizer_states,
                 load_lr_scheduler_states=load_lr_scheduler_states,
                 load_module_only=load_module_only)
@@ -2267,6 +2432,8 @@ class DeepSpeedEngine:
             params = _unflatten_by_paths(flat_module, prefix="params/")
             self._build_state(params)
         if load_module_only:
+            if manifest is not None:
+                self._emit_topology_event(tag, manifest, diff)
             return tag, {}
         if load_optimizer_states:
             flat_opt = self.checkpoint_engine.load(os.path.join(ckpt_dir, "optimizer"))
@@ -2289,7 +2456,121 @@ class DeepSpeedEngine:
         engine_state = self.checkpoint_engine.load(os.path.join(ckpt_dir, "engine"))
         client_state = self._restore_engine_aux(engine_state,
                                                 load_lr_scheduler_states)
+        if manifest is not None:
+            self._emit_topology_event(tag, manifest, diff)
         log_dist(f"loaded checkpoint {tag} from {ckpt_dir}", ranks=[0])
+        return tag, client_state
+
+    def _lazy_fill(self, template, shardings, reader, meta, prefix):
+        """Rebuild a pytree with ``template``'s structure, materializing
+        each array leaf under its CURRENT sharding via
+        ``jax.make_array_from_callback`` — the callback reads only this
+        host's shard slices from the saved payload (``LazyNpz``)."""
+        if isinstance(template, dict):
+            return {k: self._lazy_fill(template[k], shardings[k], reader,
+                                       meta, f"{prefix}{k}/")
+                    for k in template}
+        if hasattr(template, "_fields"):  # namedtuple
+            return type(template)(*(
+                self._lazy_fill(getattr(template, f), getattr(shardings, f),
+                                reader, meta, f"{prefix}{f}/")
+                for f in template._fields))
+        if isinstance(template, (tuple, list)):
+            seq = [self._lazy_fill(v, shardings[i], reader, meta,
+                                   f"{prefix}{i}/")
+                   for i, v in enumerate(template)]
+            return type(template)(seq) if isinstance(template, list) \
+                else tuple(seq)
+        if template is None:
+            return None
+        key = prefix.rstrip("/")
+        if key in reader:
+            view_dtype = meta.get(key + "#dtype")
+
+            def cb(index, _key=key, _vd=view_dtype):
+                a = reader.read_slice(_key, index)
+                if _vd is not None:
+                    import ml_dtypes  # noqa: F401 — registers the names
+
+                    a = a.view(np.dtype(_vd))
+                return a
+
+            return jax.make_array_from_callback(
+                tuple(template.shape), shardings, cb)
+        if key + "#none" in meta:
+            return None
+        if key in meta:
+            return meta[key]
+        raise KeyError(f"checkpoint missing entry {key!r}")
+
+    @staticmethod
+    def _lazy_full_entries(reader, meta, prefix):
+        """Fully materialize every saved entry under ``prefix`` (host-side
+        state — the offloaded optimizer needs its complete moments),
+        decoding the sidecar markers with the SAME helper regular loads
+        use."""
+        from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine \
+            import apply_npz_meta
+
+        flat = {k: reader.read(k) for k in reader.keys()
+                if k.startswith(prefix)}
+        return apply_npz_meta(
+            flat, {k: v for k, v in meta.items() if k.startswith(prefix)})
+
+    def _load_checkpoint_reshard(self, ckpt_dir, tag, manifest, diff, *,
+                                 load_optimizer_states=True,
+                                 load_lr_scheduler_states=True,
+                                 load_module_only=False):
+        """Reshard-at-load for consolidated checkpoints: the saved
+        manifest already proved shapes/dtypes compatible; every logical
+        tensor is materialized under the current mesh's M-way sharding
+        by reading only the slices each shard needs — a checkpoint
+        written at N-way partitioning restores onto any compatible mesh
+        with per-tensor bit-identical values."""
+        reader, meta = self.checkpoint_engine.load_lazy(
+            os.path.join(ckpt_dir, "module"))
+        params = self._lazy_fill(self.state.params,
+                                 self._state_shardings.params,
+                                 reader, meta, "params/")
+        self.state = self.state._replace(params=params)
+        if load_module_only:
+            self._emit_topology_event(tag, manifest, diff)
+            log_dist(f"loaded checkpoint {tag} from {ckpt_dir} "
+                     "(reshard-at-load, module only)", ranks=[0])
+            return tag, {}
+        if load_optimizer_states:
+            reader_o, meta_o = self.checkpoint_engine.load_lazy(
+                os.path.join(ckpt_dir, "optimizer"))
+            opt_state = self._lazy_fill(self.state.opt_state,
+                                        self._state_shardings.opt_state,
+                                        reader_o, meta_o, "opt_state/")
+
+            def scalar(key, dtype):
+                val = reader_o.read(key) if key in reader_o else meta_o[key]
+                return jnp.asarray(val, dtype)
+
+            self.state = self.state._replace(
+                opt_state=opt_state,
+                loss_scale=self.state.loss_scale._replace(
+                    loss_scale=scalar("loss_scale", jnp.float32),
+                    good_steps=scalar("good_steps", jnp.int32),
+                    hysteresis=scalar("hysteresis", jnp.int32)),
+                global_step=scalar("global_step", jnp.int32),
+                skipped_steps=scalar("skipped_steps", jnp.int32),
+                rng=jnp.asarray(reader_o.read("rng") if "rng" in reader_o
+                                else meta_o["rng"], jnp.uint32),
+            )
+            if self._host_offload:
+                self._restore_host_optimizer_flat(
+                    self._lazy_full_entries(reader_o, meta_o,
+                                            "host_optimizer/"))
+        engine_state = self.checkpoint_engine.load(
+            os.path.join(ckpt_dir, "engine"))
+        client_state = self._restore_engine_aux(engine_state,
+                                                load_lr_scheduler_states)
+        self._emit_topology_event(tag, manifest, diff)
+        log_dist(f"loaded checkpoint {tag} from {ckpt_dir} "
+                 "(reshard-at-load)", ranks=[0])
         return tag, client_state
 
     def _restore_host_optimizer_flat(self, flat: dict):
@@ -2316,7 +2597,8 @@ class DeepSpeedEngine:
     def _load_checkpoint_sharded(self, ckpt_dir, tag, *,
                                  load_optimizer_states=True,
                                  load_lr_scheduler_states=True,
-                                 load_module_only=False):
+                                 load_module_only=False,
+                                 manifest=None, topo_diff=None):
         """Restore a sharded checkpoint directly onto the live mesh.
 
         Each leaf is restored with the CURRENT engine's sharding — the
@@ -2341,6 +2623,8 @@ class DeepSpeedEngine:
             os.path.join(ckpt_dir, "module"), abstract_module)
         self.state = self.state._replace(params=loaded["params"])
         if load_module_only:
+            if manifest is not None:
+                self._emit_topology_event(tag, manifest, topo_diff)
             return tag, {}
         if load_optimizer_states:
             s = self.state
@@ -2373,6 +2657,8 @@ class DeepSpeedEngine:
             os.path.join(ckpt_dir, "engine"))
         client_state = self._restore_engine_aux(engine_state,
                                                 load_lr_scheduler_states)
+        if manifest is not None:
+            self._emit_topology_event(tag, manifest, topo_diff)
         log_dist(f"loaded sharded checkpoint {tag} from {ckpt_dir}", ranks=[0])
         return tag, client_state
 

@@ -36,4 +36,12 @@ from deepspeed_tpu.runtime.resilience.sentinel import (  # noqa: F401
     SentinelAbort,
     StepSentinel,
 )
+from deepspeed_tpu.runtime.resilience.topology import (  # noqa: F401
+    TOPOLOGY_MANIFEST_NAME,
+    TopologyShiftError,
+    diff_topology,
+    format_topology_diff,
+    read_topology_manifest,
+    write_topology_manifest,
+)
 from deepspeed_tpu.runtime.resilience.watchdog import HangWatchdog  # noqa: F401

@@ -192,6 +192,28 @@ def send_sigterm():
     os.kill(os.getpid(), signal.SIGTERM)
 
 
+def preempt_at_step(at_step: int, deliver=send_sigterm):
+    """Arm a deterministic preemption for the elastic chaos scenarios:
+    returns a ``tick()`` to call once per optimizer step; the
+    ``at_step``-th call (1-indexed) delivers the preemption notice
+    (default: a REAL SIGTERM, exactly what the TPU scheduler sends —
+    pass ``agent.signal_preemption`` for signal-free tests). ``tick``
+    returns True on the call that fired; ``tick.state`` exposes
+    ``{"calls", "fired"}`` for assertions."""
+    state = {"calls": 0, "fired": False}
+
+    def tick() -> bool:
+        state["calls"] += 1
+        if state["calls"] == int(at_step) and not state["fired"]:
+            state["fired"] = True
+            deliver()
+            return True
+        return False
+
+    tick.state = state
+    return tick
+
+
 def simulate_stall(seconds: float):
     """Block the calling thread (a hung collective, as the host observes
     it): step-boundary progress stops while the watchdog keeps polling."""

@@ -40,6 +40,9 @@ from typing import Callable, Dict, List, Optional
 from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
     ArrayCheckpointEngine,
     CheckpointEngine,
+    atomic_write_text,  # noqa: F401 — re-exported: the resilience
+    # layer's pointer/manifest/registry writes share the engine's
+    # durable-text primitive
     fsync_dir,
 )
 from deepspeed_tpu.runtime.resilience import chaos
@@ -60,19 +63,6 @@ class CheckpointCorruptionError(RuntimeError):
 
 # ----------------------------------------------------------------------
 # crash-safe small-file writes (the `latest` pointer / preempt marker fix)
-def atomic_write_text(path: str, text: str):
-    """tmp file + fsync + ``os.replace``: a crash mid-write can never
-    leave a truncated file at ``path`` — either the old content survives
-    or the new content is complete."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        f.write(text)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    fsync_dir(os.path.dirname(path) or ".")
-
-
 def available_tags(load_dir: str) -> List[str]:
     """Checkpoint tag directories actually present in ``load_dir``
     (engine-internal dirs — staging, the resilience registry, stranded
@@ -260,6 +250,10 @@ class ResilientCheckpointEngine(CheckpointEngine):
         return getattr(self._inner, "supports_sharded", False)
 
     @property
+    def supports_lazy(self):
+        return getattr(self._inner, "supports_lazy", False)
+
+    @property
     def aux_engine(self):
         """Aux (consolidated npz/json) saves ride the same retry/chaos
         seams; staging semantics stay the inner engine's (the Tiered
@@ -334,12 +328,45 @@ class ResilientCheckpointEngine(CheckpointEngine):
     def save(self, state_dict, path):
         return self._guarded_save(self._inner, state_dict, path)
 
+    def save_text(self, path, text):
+        """Sidecar metadata (topology manifest) rides the same retry +
+        chaos seams and the same verdict-invalidation as payload saves."""
+        save_dir, tag, _ = self._split(path)
+        self._roots.add(save_dir)
+        self._verified_ok.discard(
+            os.path.realpath(os.path.join(save_dir, tag)))
+
+        def do():
+            chaos.raise_if("ckpt.save", path)
+            return self._inner.save_text(path, text)
+
+        return retry_io(do, retries=self._cfg.retries,
+                        backoff_secs=self._cfg.retry_backoff_secs,
+                        what=f"save {path!r}",
+                        on_retry=self._on_retry("save", path))
+
     def load(self, path, map_location=None):
         return self._guarded_load(self._inner, path, map_location)
 
     def load_sharded(self, path, abstract_tree):
         return self._guarded_load(self._inner, path, sharded=True,
                                   abstract_tree=abstract_tree)
+
+    def load_lazy(self, path):
+        """Slice-addressable load (reshard-at-load): verify-before-read
+        + retry on the reader OPEN. Per-slice reads after open are
+        memmap page faults — not an IO seam this layer can wrap."""
+        save_dir, tag, _ = self._split(path)
+        self.verify(os.path.join(save_dir, tag))
+
+        def do():
+            chaos.raise_if("ckpt.load", path)
+            return self._inner.load_lazy(path)
+
+        return retry_io(do, retries=self._cfg.retries,
+                        backoff_secs=self._cfg.retry_backoff_secs,
+                        what=f"load {path!r}",
+                        on_retry=self._on_retry("load", path))
 
     # -- verify ---------------------------------------------------------
     def verify(self, tag_dir: str) -> str:

@@ -160,6 +160,33 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+# ----------------------------------------------------------------------
+# PartitionSpec <-> JSON (the topology-manifest wire format: a checkpoint
+# must record how every logical tensor was partitioned at save time so a
+# restore onto a DIFFERENT mesh can validate and reshard deliberately)
+def spec_entries(spec) -> list:
+    """JSON-safe form of a PartitionSpec: one entry per dim — ``None``,
+    an axis name, or a list of axis names."""
+    if spec is None:
+        return []
+    out = []
+    for e in spec:
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            out.append([str(a) for a in e])
+        else:
+            out.append(str(e))
+    return out
+
+
+def sharding_spec_entries(sharding) -> list:
+    """JSON-safe partition spec of a (Named)Sharding; fully-replicated /
+    unknown sharding kinds serialize as ``[]``."""
+    spec = getattr(sharding, "spec", None)
+    return spec_entries(spec)
+
+
 def batch_sharding(mesh: Mesh, data_axes: Sequence[str] = (AXIS_DATA, AXIS_EXPERT),
                    ndim: int = 2, shape: Optional[Tuple[int, ...]] = None) -> NamedSharding:
     """Batch arrays: leading dim sharded over the data axes; with sequence

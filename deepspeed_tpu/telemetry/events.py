@@ -25,6 +25,8 @@ satellite families that ride the same sink):
                      (TTFT, queue wait, tokens/s) / shed (reason)
 - ``model_time``   — inference per-forward latencies (the
                      ``model_times()`` buffer mirrored into the stream)
+- ``topology``     — checkpoint restores: saved vs. current mesh/world,
+                     whether the load resharded (elastic resume)
 
 Everything in ``data`` must be JSON-safe; :func:`json_safe` coerces numpy
 scalars and drops device arrays (an event must never pin or sync device
@@ -36,7 +38,7 @@ import time
 from typing import Any, Dict, Optional
 
 KINDS = ("compile", "step_cost", "memory", "trace_window", "step",
-         "wallclock", "comm", "fault", "serving", "model_time")
+         "wallclock", "comm", "fault", "serving", "model_time", "topology")
 
 
 def json_safe(value: Any):

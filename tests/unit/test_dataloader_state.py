@@ -1,0 +1,217 @@
+"""Dataloader sample-cursor save/restore (ISSUE 5 satellite): the
+cursor + RNG identity round-trip at FIXED world size, independent of the
+elastic path — the primitive sample-exact elastic replay is built on."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              RepeatingLoader)
+
+DATA = np.arange(97, dtype=np.int64)  # non-divisible length on purpose
+
+
+def _loader(batch_size=8, shuffle=True, seed=3, drop_last=False, data=DATA):
+    return DeepSpeedDataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                               seed=seed, dataloader_drop_last=drop_last)
+
+
+def _take(it, n):
+    return [np.asarray(next(it)) for _ in range(n)]
+
+
+class TestCursorRoundTrip:
+    @pytest.mark.parametrize("shuffle", [False, True])
+    def test_resume_continues_exact_stream(self, shuffle):
+        ref = _loader(shuffle=shuffle)
+        it = iter(RepeatingLoader(ref))
+        _ = _take(it, 5)
+        expected = _take(it, 7)
+
+        # replay: consume 5, snapshot, restore into a FRESH loader
+        src = RepeatingLoader(_loader(shuffle=shuffle))
+        it2 = iter(src)
+        _take(it2, 5)
+        state = src.state_dict()
+
+        fresh = RepeatingLoader(_loader(shuffle=shuffle))
+        fresh.load_state_dict(state)
+        got = _take(iter(fresh), 7)
+        for a, b in zip(expected, got):
+            np.testing.assert_array_equal(a, b)
+
+    def test_round_trip_across_epoch_boundary(self):
+        # 97 samples / batch 8 -> 13 batches per epoch (last partial)
+        src = RepeatingLoader(_loader())
+        it = iter(src)
+        _take(it, 15)  # into epoch 1
+        state = src.state_dict()
+        assert state["epoch"] == 1
+        expected = _take(it, 4)
+
+        fresh = RepeatingLoader(_loader())
+        fresh.load_state_dict(state)
+        got = _take(iter(fresh), 4)
+        for a, b in zip(expected, got):
+            np.testing.assert_array_equal(a, b)
+
+    def test_cursor_counts_samples_not_batches(self):
+        loader = _loader(batch_size=8, shuffle=False)
+        it = iter(loader)
+        _take(it, 3)
+        assert loader.state_dict()["offset"] == 24
+
+    def test_state_includes_rng_identity(self):
+        loader = _loader(seed=11)
+        state = loader.state_dict()
+        assert state["seed"] == 11 and state["shuffle"] is True
+        assert state["dataset_len"] == len(DATA)
+
+    def test_offset_past_epoch_normalizes(self):
+        loader = _loader(shuffle=False, batch_size=10,
+                         data=np.arange(20, dtype=np.int64))
+        loader.load_state_dict({"epoch": 0, "offset": 25, "seed": 3,
+                                "shuffle": False, "dataset_len": 20})
+        assert loader.epoch == 1
+        first = next(iter(loader))
+        np.testing.assert_array_equal(first, np.arange(5, 15))
+
+
+class TestIdentityMismatchIsLoud:
+    def test_seed_mismatch_raises(self):
+        state = _loader(seed=3).state_dict()
+        with pytest.raises(ValueError, match="seed"):
+            _loader(seed=4).load_state_dict(state)
+
+    def test_shuffle_mismatch_raises(self):
+        state = _loader(shuffle=True).state_dict()
+        with pytest.raises(ValueError, match="shuffle"):
+            _loader(shuffle=False).load_state_dict(state)
+
+    def test_dataset_len_mismatch_raises(self):
+        state = _loader().state_dict()
+        with pytest.raises(ValueError, match="dataset_len"):
+            _loader(data=np.arange(10)).load_state_dict(state)
+
+
+class TestBatchSizeIndependence:
+    def test_cursor_survives_batch_size_change(self):
+        """The elastic contract: the cursor is a SAMPLE position, so a
+        resumed loader with a different batch size continues the exact
+        global sample stream."""
+        data = np.arange(96, dtype=np.int64)
+        src = _loader(batch_size=16, data=data)
+        it = iter(src)
+        consumed = np.concatenate(_take(it, 2))  # 32 samples
+        state = src.state_dict()
+
+        resumed = _loader(batch_size=8, data=data)  # world shrank: mb halved
+        resumed.load_state_dict(state)
+        rest = np.concatenate(_take(iter(RepeatingLoader(resumed)), 8))
+        # one full epoch = consumed + rest's first 64 samples
+        ref = _loader(batch_size=16, data=data)
+        full = np.concatenate([np.asarray(b) for b in ref])
+        np.testing.assert_array_equal(np.concatenate([consumed, rest[:64]]),
+                                      full)
+
+    def test_fast_forward_samples_matches_cursor(self):
+        data = np.arange(96, dtype=np.int64)
+        a = _loader(batch_size=16, data=data)
+        it = iter(a)
+        _take(it, 3)
+        state = a.state_dict()
+
+        b = _loader(batch_size=16, data=data)
+        b.fast_forward_samples(48)
+        assert b.state_dict()["offset"] == state["offset"]
+        assert b.state_dict()["epoch"] == state["epoch"]
+        np.testing.assert_array_equal(next(iter(b)), next(it))
+
+    def test_fast_forward_rejects_empty_geometry(self):
+        loader = _loader(batch_size=64, drop_last=True,
+                         data=np.arange(10, dtype=np.int64))
+        with pytest.raises(ValueError, match="fast-forward"):
+            loader.fast_forward_samples(5)
+
+
+class TestDropLast:
+    def test_drop_last_cursor_round_trip(self):
+        src = _loader(drop_last=True)
+        it = iter(RepeatingLoader(src))
+        _take(it, 14)  # 12 full batches per epoch; 14 -> into epoch 1
+        state = src.state_dict()
+        expected = _take(it, 3)
+
+        fresh = RepeatingLoader(_loader(drop_last=True))
+        fresh.load_state_dict(state)
+        got = _take(iter(fresh), 3)
+        for a, b in zip(expected, got):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestSamplerCursor:
+    """Custom data_sampler loaders: position lives in the sampler (its
+    ``consumed_samples``), and a sampler whose position is unknowable
+    must refuse the cursor API loudly — a silent no-op snapshot/restore
+    would restart the stream from the beginning."""
+
+    class _Stateful:
+        def __init__(self):
+            self.consumed_samples = 0
+            self.total_samples = 1000
+
+        def __iter__(self):
+            while True:
+                start = self.consumed_samples
+                self.consumed_samples += 4
+                yield np.arange(start, start + 4)
+
+    def test_stateful_sampler_round_trips_consumed_samples(self):
+        sampler = self._Stateful()
+        loader = DeepSpeedDataLoader(DATA, batch_size=4,
+                                     data_sampler=sampler)
+        it = iter(loader)
+        _take(it, 3)
+        state = loader.state_dict()
+        assert state["sampler_consumed_samples"] == 12
+
+        fresh_sampler = self._Stateful()
+        fresh = DeepSpeedDataLoader(DATA, batch_size=4,
+                                    data_sampler=fresh_sampler)
+        fresh.load_state_dict(state)
+        assert fresh_sampler.consumed_samples == 12
+
+    def test_opaque_sampler_refuses_cursor_api(self):
+        loader = DeepSpeedDataLoader(DATA, batch_size=4,
+                                     data_sampler=iter(()))
+        with pytest.raises(ValueError, match="consumed_samples"):
+            loader.state_dict()
+        with pytest.raises(ValueError, match="consumed_samples"):
+            loader.load_state_dict({"epoch": 0, "offset": 0})
+
+
+class TestRepeatingLoaderCapability:
+    """RepeatingLoader must look exactly as cursor-capable as what it
+    wraps: a plain-iterable wrapper exposing load_state_dict would send
+    the elastic restore down the cursor path into an AttributeError
+    instead of the micro-batch fast-forward fallback."""
+
+    def test_plain_iterable_wrapper_has_no_cursor_api(self):
+        wrapper = RepeatingLoader([np.zeros((2,)), np.ones((2,))])
+        assert not hasattr(wrapper, "state_dict")
+        assert not hasattr(wrapper, "load_state_dict")
+        assert not hasattr(wrapper, "fast_forward_samples")
+        next(iter(wrapper))  # still repeats fine
+
+    def test_capable_wrapper_delegates_and_rebuilds_iterator(self):
+        src = RepeatingLoader(_loader(shuffle=True))
+        it = iter(src)
+        _take(it, 3)
+        state = src.state_dict()
+
+        fresh = RepeatingLoader(_loader(shuffle=True))
+        _take(iter(fresh), 1)  # stale live iterator
+        fresh.load_state_dict(state)
+        a = _take(iter(src), 1)[0]
+        b = _take(iter(fresh), 1)[0]
+        np.testing.assert_array_equal(a, b)

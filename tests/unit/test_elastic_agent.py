@@ -175,3 +175,88 @@ class TestElasticRescale:
         b6, m6 = elastic_batch_for_world(cfg, 6)
         assert b8 % (8 * m8) == 0
         assert b6 % (6 * m6) == 0
+
+
+class TestDivisibilityLattice:
+    """elastic_batch_for_world with a PINNED train_batch_size: the global
+    batch is an invariant of the elastic resume, so the geometry is the
+    divisibility lattice — and worlds outside it are rejected loudly
+    instead of silently changing the effective batch (ISSUE 5
+    satellite). Pure python: runs tier-1."""
+
+    def _cfg(self, tb=16, **elastic):
+        base = {"enabled": True, "max_train_batch_size": 64,
+                "micro_batch_sizes": [1, 2, 4], "min_gpus": 1,
+                "max_gpus": 16, "version": 0.1}
+        base.update(elastic)
+        return {"train_batch_size": tb, "elasticity": base}
+
+    def test_valid_worlds_hold_the_global_batch(self):
+        cfg = self._cfg()
+        for world in (1, 2, 4, 8, 16):
+            batch, micro = elastic_batch_for_world(cfg, world)
+            assert batch == 16
+            assert (16 // world) % micro == 0
+
+    def test_prefer_larger_micro_batch(self):
+        assert elastic_batch_for_world(self._cfg(), 4) == (16, 4)
+        assert elastic_batch_for_world(
+            self._cfg(prefer_larger_batch=False), 4) == (16, 1)
+
+    def test_non_divisible_world_rejected_with_lattice(self):
+        from deepspeed_tpu.elasticity import ElasticityIncompatibleWorldSize
+
+        with pytest.raises(ElasticityIncompatibleWorldSize,
+                           match=r"world sizes that keep.*\[1, 2, 4, 8, 16\]"):
+            elastic_batch_for_world(self._cfg(), 5)
+
+    def test_menu_constrains_the_lattice(self):
+        # menu [4]: only worlds where 16/world is a multiple of 4
+        cfg = self._cfg(micro_batch_sizes=[4])
+        assert elastic_batch_for_world(cfg, 4) == (16, 4)
+        from deepspeed_tpu.elasticity import ElasticityIncompatibleWorldSize
+
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            elastic_batch_for_world(cfg, 8)  # 16/8=2 not divisible by 4
+
+    def test_impossible_config_rejected_outright(self):
+        from deepspeed_tpu.elasticity import ElasticityConfigError
+
+        # tb=7, menu [2,4]: no world in range yields a menu micro-batch
+        with pytest.raises(ElasticityConfigError,
+                           match="cannot be held constant at ANY"):
+            elastic_batch_for_world(self._cfg(tb=7,
+                                              micro_batch_sizes=[2, 4]), 7)
+
+    def test_v02_model_parallel_uses_dp_units(self):
+        from deepspeed_tpu.elasticity import ElasticityIncompatibleWorldSize
+
+        cfg = self._cfg(version=0.2, model_parallel_size=2, min_gpus=4,
+                        max_gpus=16)
+        # 4 chips = dp 2: legal (16/2=8 splits into menu micro 4)
+        assert elastic_batch_for_world(cfg, 4) == (16, 4)
+        # lattice reported in CHIP units (dp * mp)
+        with pytest.raises(ElasticityIncompatibleWorldSize,
+                           match=r"\[4, 8, 16\]"):
+            elastic_batch_for_world(cfg, 6)  # dp=3: 16 % 3 != 0
+        # a world not divisible by mp can never be a dp*mp world
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            elastic_batch_for_world(cfg, 5)
+        # max_gpus enforced in CHIP units: 32 chips = dp 16 <= tb, but
+        # 32 > max_gpus
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            elastic_batch_for_world(cfg, 32)
+
+    def test_unpinned_batch_uses_the_planner(self):
+        cfg = {"elasticity": {
+            "enabled": True, "max_train_batch_size": 512,
+            "micro_batch_sizes": [2, 4, 8], "min_gpus": 1, "max_gpus": 64,
+            "version": 0.1}}
+        batch, micro = elastic_batch_for_world(cfg, 8)
+        assert batch % (8 * micro) == 0
+
+    def test_disabled_elasticity_raises(self):
+        from deepspeed_tpu.elasticity import ElasticityError
+
+        with pytest.raises(ElasticityError):
+            elastic_batch_for_world(self._cfg(enabled=False), 8)

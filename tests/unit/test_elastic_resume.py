@@ -1,0 +1,514 @@
+"""Elastic topology-shift resume (ISSUE 5): reshard-at-load across mesh
+changes with sample-exact data replay.
+
+Proven single-process with the 8 virtual CPU devices the suite forces
+(``--xla_force_host_platform_device_count=8``): a ZeRO-1/3 run
+checkpointed on an 8-device mesh resumes on 4- and 2-device meshes with
+params + optimizer state bit-identical per logical tensor; the
+8→4→8 preempt-resume-preempt-resume loss trajectory matches an
+uninterrupted run; impossible reshard paths fail with a structured
+saved-vs-current topology diff, never a shape error from inside jax.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                    PREEMPT_TAG)
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2ForTraining
+from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              RepeatingLoader)
+from deepspeed_tpu.runtime.resilience import chaos
+from deepspeed_tpu.runtime.resilience.topology import (
+    TOPOLOGY_MANIFEST_NAME,
+    TopologyShiftError,
+    read_topology_manifest,
+)
+
+SEQ = 16
+ELASTICITY = {"enabled": True, "max_train_batch_size": 64,
+              "micro_batch_sizes": [1, 2, 4], "min_gpus": 1, "max_gpus": 16,
+              "version": 0.1}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    reset_topology()
+    chaos.clear()
+    yield
+    reset_topology()
+    chaos.clear()
+
+
+def _engine(ndev, zero_stage=1, elastic=True, n_embd=64, extra=None,
+            telemetry=False):
+    reset_topology()
+    topo = MeshTopology(axis_sizes={"data": ndev},
+                        devices=jax.devices()[:ndev])
+    model = GPT2ForTraining(GPT2Config.tiny(dtype=jnp.float32, n_layer=1,
+                                            n_embd=n_embd))
+    config = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": zero_stage,
+                              **({"stage3_param_persistence_threshold": 0}
+                                 if zero_stage >= 3 else {})},
+        "steps_per_print": 10_000,
+    }
+    if elastic:
+        config["elasticity"] = dict(ELASTICITY)
+    if telemetry:
+        config["telemetry"] = {"enabled": True, "jsonl": False}
+    config.update(extra or {})
+    engine, *_ = deepspeed_tpu.initialize(model=model, mesh=topo,
+                                          config=config)
+    return engine
+
+
+def _step(engine, seed=0, rows=16):
+    ids = np.random.default_rng(seed).integers(
+        0, 256, (rows, SEQ)).astype(np.int32)
+    loss = engine({"input_ids": ids})
+    engine.backward(loss)
+    engine.step()
+    return float(loss)
+
+
+def _host(tree):
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+
+
+def _assert_tree_equal(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(x, y), a, b)
+
+
+DATASET = np.random.default_rng(7).integers(0, 256, (64, SEQ)).astype(np.int32)
+
+
+def _loader(batch_size=16):
+    return RepeatingLoader(DeepSpeedDataLoader(DATASET,
+                                               batch_size=batch_size,
+                                               shuffle=True, seed=5))
+
+
+def _run(engine, it, n):
+    losses = []
+    for _ in range(n):
+        loss = engine({"input_ids": next(it)})
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+# `heavy` on every multi-engine leg (auto-`slow` in this uncached
+# container): the time-budgeted tier-1 gate keeps the zero-overhead pin
+# and the ckpt_topology tool smoke; cache-capable environments run all.
+# ----------------------------------------------------------------------
+class TestTopologyManifest:
+    @pytest.mark.heavy
+    def test_written_when_elasticity_enabled(self, tmp_path):
+        engine = _engine(8)
+        _step(engine)
+        engine.save_checkpoint(str(tmp_path), tag="t0")
+        manifest = read_topology_manifest(str(tmp_path / "t0"))
+        assert manifest is not None
+        assert manifest["mesh"]["axes"]["data"] == 8
+        assert manifest["mesh"]["world_size"] == 8
+        assert manifest["zero_stage"] == 1
+        assert manifest["batch"]["train_batch_size"] == 16
+        assert manifest["counters"]["global_steps"] == 1
+        assert manifest["counters"]["global_samples"] == 16
+        assert len(manifest["rng"]) >= 2
+        tensors = manifest["tensors"]
+        assert any(k.startswith("params/") for k in tensors)
+        assert any(k.startswith("opt_state/") for k in tensors)
+        # every tensor entry records logical shape + dtype + spec
+        for entry in tensors.values():
+            assert set(entry) == {"shape", "dtype", "spec"}
+        engine.destroy()
+
+    def test_zero_overhead_pin(self, tmp_path):
+        """With elasticity disabled: NO topology manifest, checkpoint
+        file set + bytes identical to a pre-elastic save, and the
+        compiled step HLO identical to an elasticity-enabled build (the
+        subsystem never touches the program — it is all load-time)."""
+        batch = {"input_ids": np.random.default_rng(0).integers(
+            0, 256, (16, SEQ)).astype(np.int32)}
+
+        def micro_text(engine):
+            engine._ensure_state(engine._shard_batch(batch))
+            fn = engine._jit_micro
+            raw = getattr(fn, "_fn", fn)
+            return raw.lower(engine.state,
+                             engine._shard_batch(batch)).as_text()
+
+        plain = _engine(8, elastic=False)
+        text_plain = micro_text(plain)
+        _step(plain)
+        plain.save_checkpoint(str(tmp_path / "plain"), tag="t0")
+        files = sorted(os.listdir(tmp_path / "plain" / "t0"))
+        assert files == ["engine.json", "engine.npz", "module.json",
+                         "module.npz", "optimizer.json", "optimizer.npz"]
+        assert not (tmp_path / "plain" / "t0"
+                    / TOPOLOGY_MANIFEST_NAME).exists()
+        plain.destroy()
+
+        elastic = _engine(8, elastic=True)
+        assert micro_text(elastic) == text_plain
+        elastic.destroy()
+
+    @pytest.mark.heavy
+    def test_manifestless_checkpoint_loads_via_legacy_path(self, tmp_path):
+        """A pre-elastic checkpoint (no manifest) restores exactly as
+        before — same mesh or not."""
+        saver = _engine(8, elastic=False)
+        _step(saver)
+        before = _host(saver.state.params)
+        saver.save_checkpoint(str(tmp_path), tag="t0")
+        saver.destroy()
+
+        loader = _engine(4, elastic=False)
+        _step(loader, seed=9)
+        tag, _ = loader.load_checkpoint(str(tmp_path), tag="t0")
+        assert tag == "t0"
+        _assert_tree_equal(before, _host(loader.state.params))
+        loader.destroy()
+
+
+# ----------------------------------------------------------------------
+@pytest.mark.heavy
+class TestReshardAtLoad:
+    @pytest.mark.parametrize("zero_stage,ndev_to",
+                             [(1, 4), (1, 2), (3, 4), (3, 2)])
+    def test_bit_identical_across_mesh_shrink(self, tmp_path, zero_stage,
+                                              ndev_to):
+        saver = _engine(8, zero_stage=zero_stage)
+        _step(saver)
+        params_before = _host(saver.state.params)
+        opt_before = _host(saver.state.opt_state)
+        step_before = int(saver.state.global_step)
+        saver.save_checkpoint(str(tmp_path), tag="t0")
+        saver.destroy()
+
+        resumed = _engine(ndev_to, zero_stage=zero_stage, telemetry=True)
+        _step(resumed, seed=99)  # diverge; restore must overwrite
+        tag, _ = resumed.load_checkpoint(str(tmp_path), tag="t0")
+        assert tag == "t0"
+        assert int(resumed.state.global_step) == step_before
+        _assert_tree_equal(params_before, _host(resumed.state.params))
+        _assert_tree_equal(opt_before, _host(resumed.state.opt_state))
+        # the restore announced itself: a `topology` event with the
+        # saved-vs-current mesh and resharded=True
+        events = [e for e in resumed.telemetry.tail(50)
+                  if e["kind"] == "topology"]
+        assert events and events[-1]["data"]["resharded"] is True
+        assert events[-1]["data"]["saved_world"] == 8
+        assert events[-1]["data"]["current_world"] == ndev_to
+        # params stay sharded per the CURRENT mesh's ZeRO policy
+        if zero_stage >= 3:
+            sharded = [l for l in
+                       jax.tree_util.tree_leaves(resumed.state.params)
+                       if l.size >= ndev_to
+                       and l.addressable_shards[0].data.size < l.size]
+            assert sharded, "ZeRO-3 restore came back replicated"
+        # training continues
+        assert np.isfinite(_step(resumed, seed=1))
+        resumed.destroy()
+
+    def test_same_mesh_elastic_load_is_bit_identical(self, tmp_path):
+        saver = _engine(8)
+        _step(saver)
+        before = _host(saver.state.params)
+        saver.save_checkpoint(str(tmp_path), tag="t0")
+        saver.destroy()
+
+        resumed = _engine(8, telemetry=True)
+        _step(resumed, seed=3)
+        resumed.load_checkpoint(str(tmp_path), tag="t0")
+        _assert_tree_equal(before, _host(resumed.state.params))
+        events = [e for e in resumed.telemetry.tail(50)
+                  if e["kind"] == "topology"]
+        assert events and events[-1]["data"]["resharded"] is False
+        resumed.destroy()
+
+
+# ----------------------------------------------------------------------
+@pytest.mark.heavy
+class TestImpossibleReshard:
+    def test_model_shape_change_fails_with_topology_diff(self, tmp_path):
+        saver = _engine(8, n_embd=64)
+        _step(saver)
+        saver.save_checkpoint(str(tmp_path), tag="t0")
+        saver.destroy()
+
+        other = _engine(4, n_embd=32)  # a DIFFERENT model
+        _step(other, seed=1)
+        with pytest.raises(TopologyShiftError) as ei:
+            other.load_checkpoint(str(tmp_path), tag="t0")
+        msg = str(ei.value)
+        assert "saved=" in msg and "current=" in msg
+        assert "shape" in msg
+        assert ei.value.diff["fatal"], "diff must carry the fatal section"
+        other.destroy()
+
+    def test_error_is_not_swallowed_by_elastic_agent(self, tmp_path):
+        """Chaos leg: preempt, then restart with an incompatible model —
+        the agent's candidate loop must surface the topology diff, not
+        fall through to nothing."""
+        saver = _engine(8, n_embd=64)
+        agent = DSElasticAgent(saver, str(tmp_path), install_handlers=False)
+        _step(saver)
+        agent.signal_preemption()
+        assert agent.step_boundary() is True
+        agent.close()
+        saver.destroy()
+
+        wrong = _engine(4, n_embd=32)
+        _step(wrong, seed=1)
+        agent2 = DSElasticAgent(wrong, str(tmp_path), install_handlers=False)
+        with pytest.raises(TopologyShiftError):
+            agent2.restore_if_any()
+        agent2.close()
+        wrong.destroy()
+
+
+# ----------------------------------------------------------------------
+@pytest.mark.heavy
+class TestElasticTrajectory:
+    def test_preempt_8_4_8_matches_uninterrupted(self, tmp_path):
+        """The headline proof: SIGTERM at step 2 → restart on 4 devices →
+        SIGTERM at step 4 → restart on 8 devices; the loss trajectory
+        (and final params) match an uninterrupted 8-device run because
+        (a) state reshards bit-exactly and (b) the data pipeline resumes
+        at the exact global sample position under the NEW micro-batch
+        geometry."""
+        ref_engine = _engine(8)
+        ref = _run(ref_engine, iter(_loader()), 6)
+        ref_params = _host(ref_engine.state.params)
+        ref_engine.destroy()
+
+        got = []
+        # leg 1: 8 devices, REAL SIGTERM delivered by the chaos injector
+        e1 = _engine(8)
+        l1 = _loader()
+        a1 = DSElasticAgent(e1, str(tmp_path), loader=l1)  # real handler
+        tick = chaos.preempt_at_step(2)
+        it1 = iter(l1)
+        for _ in range(6):
+            loss = e1({"input_ids": next(it1)})
+            e1.backward(loss)
+            e1.step()
+            got.append(float(loss))
+            tick()
+            if a1.step_boundary():
+                break
+        assert tick.state["fired"] and a1.preempted
+        assert len(got) == 2
+        a1.close()
+        e1.destroy()
+
+        # leg 2: restart on FOUR devices (micro-batch regeometried,
+        # sample stream fast-forwarded by the saved cursor)
+        e2 = _engine(4)
+        l2 = _loader()
+        _run(e2, iter(l2), 1)  # template state; overwritten by restore
+        a2 = DSElasticAgent(e2, str(tmp_path), install_handlers=False,
+                            loader=l2)
+        assert a2.restore_if_any() == PREEMPT_TAG
+        assert e2.global_steps == 2
+        assert a2.last_restore_info["replay"]["mode"] == "cursor"
+        got += _run(e2, iter(l2), 2)
+        a2.signal_preemption()
+        assert a2.step_boundary() is True
+        a2.close()
+        e2.destroy()
+
+        # leg 3: back to EIGHT devices
+        e3 = _engine(8)
+        l3 = _loader()
+        _run(e3, iter(l3), 1)
+        a3 = DSElasticAgent(e3, str(tmp_path), install_handlers=False,
+                            loader=l3)
+        assert a3.restore_if_any() == PREEMPT_TAG
+        assert e3.global_steps == 4
+        got += _run(e3, iter(l3), 2)
+
+        np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-6)
+        # params: the 4-device leg reduces gradients in a different
+        # order, so near-zero weights accumulate O(1e-6) float noise the
+        # loss tolerance never sees — atol covers that, rtol stays tight
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4,
+                                                    atol=1e-5),
+            ref_params, _host(e3.state.params))
+        e3.destroy()
+
+    def test_incompatible_world_rejected_loudly(self, tmp_path):
+        """A restart world that cannot hold the global batch constant is
+        refused with the divisibility lattice in the message."""
+        from deepspeed_tpu.elasticity.config import (
+            ElasticityIncompatibleWorldSize)
+
+        saver = _engine(8)
+        agent = DSElasticAgent(saver, str(tmp_path), install_handlers=False)
+        _step(saver)
+        agent.signal_preemption()
+        agent.step_boundary()
+        agent.close()
+        saver.destroy()
+
+        # world 5: 16 % 5 != 0 — no geometry keeps the batch at 16
+        resumed = _engine(5, extra={"train_batch_size": None,
+                                    "train_micro_batch_size_per_gpu": 2})
+        _step(resumed, seed=1, rows=10)
+        # restore the pinned-batch config context the agent validates
+        resumed._config._param_dict["train_batch_size"] = 16
+        agent2 = DSElasticAgent(resumed, str(tmp_path),
+                                install_handlers=False)
+        with pytest.raises(ElasticityIncompatibleWorldSize,
+                           match="world sizes that keep"):
+            agent2.restore_if_any()
+        agent2.close()
+        resumed.destroy()
+
+
+# ----------------------------------------------------------------------
+@pytest.mark.heavy
+class TestVerifiedGoodPreference:
+    def test_torn_newest_tag_loses_to_verified_good(self, tmp_path):
+        """Satellite: with the resilience block enabled the elastic path
+        prefers the newest VERIFIED-GOOD tag — a newest-by-step tag whose
+        integrity commit never landed (torn) must not win just for being
+        newest."""
+        from deepspeed_tpu.runtime.resilience.integrity import (
+            MANIFEST_NAME, _write_verified, read_verified)
+
+        engine = _engine(8, extra={"resilience": {
+            "enabled": True, "checkpoint": {"integrity": True}}})
+        agent = DSElasticAgent(engine, str(tmp_path), install_handlers=False)
+        _step(engine, seed=0)
+        engine.save_checkpoint(str(tmp_path), tag="good")  # verified, step 1
+        _step(engine, seed=1)
+        agent.signal_preemption()
+        assert agent.step_boundary() is True  # preempt tag, step 2
+        agent.close()
+        engine.destroy()
+
+        # tear the preempt commit: integrity manifest gone + unregistered
+        os.remove(str(tmp_path / PREEMPT_TAG / MANIFEST_NAME))
+        _write_verified(str(tmp_path),
+                        [t for t in read_verified(str(tmp_path))
+                         if t != PREEMPT_TAG])
+
+        resumed = _engine(8, extra={"resilience": {
+            "enabled": True, "checkpoint": {"integrity": True}}})
+        _step(resumed, seed=9)
+        agent2 = DSElasticAgent(resumed, str(tmp_path),
+                                install_handlers=False)
+        assert agent2.restore_if_any() == "good"
+        assert resumed.global_steps == 1
+        agent2.close()
+        resumed.destroy()
+
+    def test_verified_newest_still_wins(self, tmp_path):
+        """Control: when the newest tag IS verified-good (the normal
+        case), it wins exactly as before."""
+        engine = _engine(8, extra={"resilience": {
+            "enabled": True, "checkpoint": {"integrity": True}}})
+        agent = DSElasticAgent(engine, str(tmp_path), install_handlers=False)
+        _step(engine, seed=0)
+        engine.save_checkpoint(str(tmp_path), tag="good")
+        _step(engine, seed=1)
+        agent.signal_preemption()
+        assert agent.step_boundary() is True
+        agent.close()
+        engine.destroy()
+
+        resumed = _engine(8, extra={"resilience": {
+            "enabled": True, "checkpoint": {"integrity": True}}})
+        _step(resumed, seed=9)
+        agent2 = DSElasticAgent(resumed, str(tmp_path),
+                                install_handlers=False)
+        assert agent2.restore_if_any() == PREEMPT_TAG
+        assert resumed.global_steps == 2
+        agent2.close()
+        resumed.destroy()
+
+
+# ----------------------------------------------------------------------
+class TestCkptTopologyTool:
+    def test_print_and_json(self, tmp_path, capsys):
+        engine = _engine(8)
+        _step(engine)
+        engine.save_checkpoint(str(tmp_path), tag="t0")
+        engine.destroy()
+
+        from tools.ckpt_topology import main
+
+        assert main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "'data': 8" in out and "zero_stage:  1" in out
+
+        assert main([str(tmp_path), "--tag", "t0", "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["manifest"]["mesh"]["world_size"] == 8
+
+    def test_diff_against_resume_mesh(self, tmp_path, capsys):
+        engine = _engine(8)
+        _step(engine)
+        engine.save_checkpoint(str(tmp_path), tag="t0")
+        engine.destroy()
+
+        from tools.ckpt_topology import main
+
+        # half-mesh resume: reshard, not fatal
+        assert main([str(tmp_path), "--diff", "data=4", "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["diff"]["changed"]["mesh.world_size"] == {
+            "saved": 8, "current": 4}
+        assert not payload["diff"]["fatal"]
+
+    def test_diff_same_topology_gas_checkpoint_is_clean(self, tmp_path,
+                                                        capsys):
+        # a gas>1 checkpoint preflighted at its OWN topology must diff
+        # clean: the hypothetical micro-batch is tb/(dp*gas), not tb/dp
+        # — the latter reported a phantom micro_batch_per_gpu change
+        # (and RESHARD) for an identical resume
+        tag = tmp_path / "t0"
+        tag.mkdir()
+        (tag / TOPOLOGY_MANIFEST_NAME).write_text(json.dumps({
+            "mesh": {"axes": {"data": 4}, "world_size": 4,
+                     "process_count": 1},
+            "zero_stage": 1,
+            "batch": {"train_batch_size": 16, "micro_batch_per_gpu": 2,
+                      "gradient_accumulation_steps": 2,
+                      "dp_world_size": 4},
+        }))
+
+        from tools.ckpt_topology import main
+
+        assert main([str(tag), "--diff", "data=4", "--json"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["diff"]["changed"] == {}
+        assert payload["diff"]["fatal"] == {}
+        assert "RESHARD" not in capsys.readouterr().err
+
+    def test_missing_manifest_is_a_clear_error(self, tmp_path, capsys):
+        engine = _engine(8, elastic=False)
+        _step(engine)
+        engine.save_checkpoint(str(tmp_path), tag="t0")
+        engine.destroy()
+
+        from tools.ckpt_topology import main
+
+        assert main([str(tmp_path), "--tag", "t0"]) == 1
+        assert "no topology manifest" in capsys.readouterr().err

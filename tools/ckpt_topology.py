@@ -1,0 +1,185 @@
+"""Print or diff a checkpoint's topology manifest — the launch-script
+preflight for elastic restarts.
+
+A checkpoint saved with elasticity enabled carries ``topology.json``
+(mesh axes, world size, ZeRO stage, batch geometry, per-tensor partition
+specs, data cursor). Before pointing a restarted job at it, ask whether
+the resume topology is compatible::
+
+    python tools/ckpt_topology.py /ckpts              # latest tag, summary
+    python tools/ckpt_topology.py /ckpts --tag t0     # specific tag
+    python tools/ckpt_topology.py /ckpts --json       # machine-readable
+    python tools/ckpt_topology.py /ckpts --diff data=4,model=2
+    python tools/ckpt_topology.py /ckpts --diff data=4 --world 4 --batch 16
+
+``--diff`` compares the manifest against a hypothetical resume mesh and
+exits 2 when the shift is impossible (1 on other errors, 0 when clean or
+merely resharding) — usable directly as a launch-script gate.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _resolve_tag_dir(path: str, tag) -> str:
+    from deepspeed_tpu.runtime.resilience.topology import (
+        TOPOLOGY_MANIFEST_NAME)
+
+    if os.path.exists(os.path.join(path, TOPOLOGY_MANIFEST_NAME)):
+        return path  # already a tag dir
+    if tag is not None:
+        return os.path.join(path, str(tag))
+    latest = os.path.join(path, "latest")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            return os.path.join(path, f.read().strip())
+    # newest manifest-carrying tag dir
+    cands = []
+    try:
+        for e in os.listdir(path):
+            p = os.path.join(path, e, TOPOLOGY_MANIFEST_NAME)
+            if os.path.exists(p):
+                cands.append((os.path.getmtime(p), os.path.join(path, e)))
+    except OSError:
+        pass
+    if not cands:
+        raise FileNotFoundError(
+            f"no topology manifest found under {path!r} (saved without "
+            "elasticity enabled? pass a tag dir explicitly)")
+    return max(cands)[1]
+
+
+def _parse_axes(text: str) -> dict:
+    axes = {}
+    for part in filter(None, (p.strip() for p in text.split(","))):
+        name, _, size = part.partition("=")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+def _summary(manifest: dict) -> str:
+    mesh = manifest.get("mesh", {})
+    batch = manifest.get("batch", {})
+    counters = manifest.get("counters", {})
+    live_axes = {a: s for a, s in (mesh.get("axes") or {}).items() if s > 1}
+    tensors = manifest.get("tensors") or {}
+    n_params = sum(1 for k in tensors if k.startswith("params/"))
+    n_opt = len(tensors) - n_params
+    lines = [
+        f"mesh:        {live_axes or {'data': 1}}  "
+        f"(world={mesh.get('world_size')}, "
+        f"processes={mesh.get('process_count')})",
+        f"zero_stage:  {manifest.get('zero_stage')}",
+        f"batch:       train={batch.get('train_batch_size')} "
+        f"micro={batch.get('micro_batch_per_gpu')} "
+        f"gas={batch.get('gradient_accumulation_steps')} "
+        f"dp={batch.get('dp_world_size')}",
+        f"counters:    step={counters.get('global_steps')} "
+        f"micro={counters.get('micro_steps')} "
+        f"samples={counters.get('global_samples')}",
+        f"format:      {manifest.get('format')}",
+        f"tensors:     {n_params} param + {n_opt} optimizer-state",
+    ]
+    cursor = manifest.get("data_pipeline")
+    if cursor:
+        lines.append(f"data cursor: {cursor}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="print/diff a checkpoint's topology manifest")
+    parser.add_argument("path", help="checkpoint save_dir or tag dir")
+    parser.add_argument("--tag", default=None, help="tag within save_dir")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the manifest (and diff) as JSON")
+    parser.add_argument("--diff", default=None, metavar="AXES",
+                        help="compare against a resume mesh, e.g. "
+                        "'data=4,model=2'")
+    parser.add_argument("--world", type=int, default=None,
+                        help="resume world size (default: product of "
+                        "--diff axes)")
+    parser.add_argument("--batch", type=int, default=None,
+                        help="resume train_batch_size (default: saved)")
+    args = parser.parse_args(argv)
+
+    from deepspeed_tpu.runtime.resilience.topology import (
+        diff_topology, format_topology_diff, read_topology_manifest)
+
+    try:
+        tag_dir = _resolve_tag_dir(args.path, args.tag)
+        manifest = read_topology_manifest(tag_dir)
+    except (OSError, RuntimeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if manifest is None:
+        print(f"error: {tag_dir!r} has no topology manifest (saved "
+              "without elasticity enabled)", file=sys.stderr)
+        return 1
+
+    diff = None
+    if args.diff is not None:
+        axes = _parse_axes(args.diff)
+        world = args.world
+        if world is None:
+            world = 1
+            for s in axes.values():
+                world *= s
+        # every saved axis survives (default 1) AND every axis the user
+        # names joins the hypothetical mesh — dropping either side would
+        # preflight a different topology than the one asked about
+        saved_axes = dict(manifest.get("mesh", {}).get("axes") or {})
+        cur_axes = {**{a: 1 for a in saved_axes}, **axes}
+        current = {
+            "mesh": {"axes": cur_axes, "world_size": world,
+                     "process_count":
+                         manifest.get("mesh", {}).get("process_count")},
+            "zero_stage": manifest.get("zero_stage"),
+            "batch": dict(manifest.get("batch") or {}),
+            # tensors are mesh-independent logical shapes: a pure
+            # mesh-diff preflight keeps them identical by construction
+            "tensors": manifest.get("tensors"),
+        }
+        if args.batch is not None:
+            current["batch"]["train_batch_size"] = args.batch
+        dp = world  # preflight approximation: data-parallel world
+        current["batch"]["dp_world_size"] = dp
+        tb = current["batch"].get("train_batch_size")
+        # the accumulation split carries over from the manifest: a
+        # micro-batch is tb/(dp*gas) rows, not tb/dp — dividing by dp
+        # alone would report a phantom micro-batch change (and RESHARD)
+        # for any gas>1 checkpoint preflighted at its own topology
+        gas = int(current["batch"].get("gradient_accumulation_steps")
+                  or 1)
+        if tb and dp and gas > 0 and tb % (dp * gas) == 0:
+            current["batch"]["micro_batch_per_gpu"] = tb // (dp * gas)
+        diff = diff_topology(manifest, current)
+
+    if args.as_json:
+        out = {"tag_dir": tag_dir, "manifest": manifest}
+        if diff is not None:
+            out["diff"] = diff
+        print(json.dumps(out, indent=1, sort_keys=True))
+    else:
+        print(f"topology manifest: {tag_dir}")
+        print(_summary(manifest))
+        if diff is not None:
+            print("\ndiff vs resume topology:")
+            print(format_topology_diff(diff))
+    if diff is not None:
+        if diff["fatal"]:
+            print("\nRESULT: INCOMPATIBLE — this checkpoint cannot be "
+                  "resharded onto the given topology", file=sys.stderr)
+            return 2
+        if diff["changed"]:
+            print("RESULT: RESHARD — the load will reshard onto the "
+                  "given topology", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -181,6 +181,76 @@ def main():
         "requests": n_requests, "new_tokens": srv_new,
     })
 
+    # --- router series: the availability tier. Two replicas behind the
+    # resilient front door; the same mixed-arrival window run clean and
+    # with replica 1 crashed mid-window (deterministic chaos) — the gap
+    # between the two availability numbers is what failover with
+    # deterministic replay buys.
+    srv.destroy()
+    del srv
+    from deepspeed_tpu.runtime.resilience.chaos import ChaosReplica
+    from deepspeed_tpu.serving.router import ReplicaRouter
+
+    def build_replica():
+        reset_topology()
+        return ServingEngine(deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype=cfg.dtype,
+            tensor_parallel={"tp_size": 1}, max_out_tokens=cfg.n_positions,
+            serving=scfg))
+
+    replicas = [build_replica(), build_replica()]
+    router = ReplicaRouter(replicas, config={"max_failovers": 2})
+
+    def run_router():
+        pending = [srv_rng.integers(0, cfg.vocab_size,
+                                    lens[i % len(lens)]).astype(np.int32)
+                   for i in range(n_requests)]
+        t0 = time.perf_counter()
+        while pending or router.pending:
+            for _ in range(arrive_every):
+                if pending:
+                    router.submit(pending.pop(0), max_new_tokens=srv_new)
+            router.step()
+        return time.perf_counter() - t0
+
+    def router_window(elapsed_s):
+        rst = router.stats()
+        toks = sum(len(r.tokens) for r in router.finished
+                   if r.state == "finished")
+        return {
+            "tokens_per_sec": round(toks / elapsed_s, 1)
+            if elapsed_s > 0 else None,
+            "ttft_ms_p95": rst["ttft_ms_p95"],
+            "availability": rst["availability"],
+            "failovers": rst["failovers"],
+        }
+
+    run_router()  # warm both replicas' bucket sets + decode programs
+    for rep in replicas:
+        rep.reset_stats()
+    router.reset_stats()
+    clean = router_window(run_router())
+    # crash replica 1 a few decode steps into the measured window: its
+    # in-flight requests fail over to replica 0 and replay
+    router.replicas[1] = ChaosReplica(replicas[1],
+                                      crash_at_step=max(2, srv_new // 2))
+    for rep in replicas:
+        rep.reset_stats()
+    router.reset_stats()
+    killed = router_window(run_router())
+    emit_result({
+        "metric": f"{METRIC}_router",
+        "replicas": 2,
+        "clean_tokens_per_sec": clean["tokens_per_sec"],
+        "clean_ttft_ms_p95": clean["ttft_ms_p95"],
+        "clean_availability": clean["availability"],
+        "killed_tokens_per_sec": killed["tokens_per_sec"],
+        "killed_ttft_ms_p95": killed["ttft_ms_p95"],
+        "killed_availability": killed["availability"],
+        "killed_failovers": killed["failovers"],
+        "requests": n_requests, "new_tokens": srv_new,
+    })
+
 
 if __name__ == "__main__":
     run_guarded(METRIC, main)

@@ -119,15 +119,69 @@ def init_inference(model, config=None, **kwargs):
     return InferenceEngine(model, config=config, **kwargs)
 
 
-def init_serving(model, config=None, **kwargs):
+def init_serving(model, config=None, replicas=None, **kwargs):
     """Build the continuous-batching serving runtime (paged KV cache +
     request scheduler) over an inference engine. ``model`` may be a flax
     model (a fresh :class:`InferenceEngine` is built from ``config`` /
     ``kwargs``, which must carry a ``serving`` block) or an existing
-    :class:`InferenceEngine` whose config already has one."""
+    :class:`InferenceEngine` whose config already has one.
+
+    With a ``serving.router`` block the result is the resilient
+    multi-replica front door instead
+    (:class:`~deepspeed_tpu.serving.router.ReplicaRouter`):
+    ``serving.router.replicas`` independent engines are built from
+    ``model`` — or ``replicas`` is a pre-built list (InferenceEngines
+    are wrapped, anything already exposing the ServingEngine surface is
+    taken as-is) — behind one submit()/step()/drain() surface with
+    health-aware routing, deterministic-replay failover, and the
+    SLO-guarded degradation ladder. Without the block nothing changes:
+    the single engine is returned and its compiled programs are
+    byte-identical to previous releases."""
     from deepspeed_tpu.serving import ServingEngine
 
-    return ServingEngine(model, config=config, **kwargs)
+    # probe ONLY router presence ahead of construction (full coercion
+    # lives in ServingConfig); `replicas` alone also selects the router
+    serving = kwargs.get("serving")
+    if serving is None:
+        serving = (config.get("serving") if isinstance(config, dict)
+                   else getattr(config, "serving", None))
+    if serving is None:
+        # a prebuilt InferenceEngine carries its serving block — a
+        # router configured there must not be silently dropped
+        serving = getattr(model, "_serving_cfg", None)
+    router = (serving.get("router") if isinstance(serving, dict)
+              else getattr(serving, "router", None))
+    if router is not None and not (router.get("enabled", True)
+                                   if isinstance(router, dict)
+                                   else getattr(router, "enabled", True)):
+        router = None  # the standard config off switch: block present,
+        #                layer disabled — identical to absent
+    if router is None and replicas is None:
+        return ServingEngine(model, config=config, **kwargs)
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.serving.router import ReplicaRouter
+
+    if replicas is None or isinstance(replicas, int):
+        if isinstance(model, InferenceEngine):
+            raise ValueError(
+                "one InferenceEngine is one replica — pass the prebuilt "
+                "engines as a list via `replicas` instead of a count")
+        first = ServingEngine(model, config=config, **kwargs)
+        count = replicas if isinstance(replicas, int) else (
+            first.config.router.replicas if first.config.router else 2)
+        engines = [first] + [ServingEngine(model, config=config, **kwargs)
+                             for _ in range(count - 1)]
+    else:
+        engines = [ServingEngine(r) if isinstance(r, InferenceEngine)
+                   else r for r in replicas]
+    if router is None:  # prebuilt replicas, no explicit block: fall
+        #                 back to a router config an engine carries
+        router = next(
+            (c for c in (getattr(getattr(e, "config", None), "router",
+                                 None) for e in engines) if c is not None),
+            None)
+    return ReplicaRouter(engines, config=router)
 
 
 def add_config_arguments(parser):

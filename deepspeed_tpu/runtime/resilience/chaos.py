@@ -19,6 +19,9 @@ dump-and-abort) therefore needs seams where faults can be injected
   TPU preemption notice the elastic agent arms for).
 - :func:`simulate_stall` — block the calling thread past a watchdog
   timeout (a hung collective, as the host observes it).
+- :class:`ChaosReplica` — replica-level faults for the multi-replica
+  serving router: crash at decode step N (:class:`ReplicaCrashed`),
+  transient flaky step/submit, stall, slow decode.
 
 All injectors are process-local and OFF by default; :func:`raise_if`
 costs one module-level ``if`` when nothing is armed.
@@ -182,6 +185,87 @@ def poison_batch(batch, leaf_index: int = 0):
     """NaN-poison one batch directly (the single-batch form of
     :func:`nan_batches`)."""
     return next(nan_batches([batch], at=0, leaf_index=leaf_index))
+
+
+# ----------------------------------------------------------------------
+# replica-level injectors (multi-replica serving front door)
+class ReplicaCrashed(RuntimeError):
+    """Fatal replica death (SIGKILLed engine process, unrecoverable
+    device error). Routers treat any exception whose ``replica_dead``
+    attribute is true as unrecoverable: the replica goes DEAD instead of
+    merely tripping its breaker."""
+
+    replica_dead = True
+
+
+class ChaosReplica:
+    """Deterministic replica-level fault injection for the serving
+    router: wraps anything with the ``ServingEngine`` surface,
+    delegating transparently until the armed fault fires.
+
+    - ``crash_at_step=N`` — the Nth ``step()`` call (1-indexed, and every
+      call after it) raises :class:`ReplicaCrashed` BEFORE the wrapped
+      engine runs: the replica died mid-decode with requests in flight.
+    - ``fail_step_at=N, fail_step_times=M`` — M consecutive ``step()``
+      calls starting at the Nth raise transient :class:`ChaosIOError`
+      (a flaky interconnect: the breaker's consecutive-failure food).
+    - ``fail_submit_at=N, fail_submit_times=M`` — same, for ``submit()``
+      (a flaky admission RPC; the router retries on another replica).
+    - ``stall_at_step=N, stall_secs=S`` — the Nth step blocks for S
+      seconds before running (a wedged collective, as the router's
+      host-side stall timer observes it).
+    - ``slow_decode_secs=S`` — EVERY step takes S extra seconds (a
+      thermally-throttled or mis-sharded replica: the soft DEGRADED
+      signal, not a trip).
+
+    ``sleep`` is injectable so host-side tests drive stalls through a
+    fake clock instead of wall time.
+    """
+
+    def __init__(self, replica, crash_at_step: int = 0,
+                 fail_step_at: int = 0, fail_step_times: int = 1,
+                 fail_submit_at: int = 0, fail_submit_times: int = 1,
+                 stall_at_step: int = 0, stall_secs: float = 0.0,
+                 slow_decode_secs: float = 0.0, sleep=time.sleep):
+        self.replica = replica
+        self.crash_at_step = int(crash_at_step)
+        self.fail_step_at = int(fail_step_at)
+        self.fail_step_times = int(fail_step_times)
+        self.fail_submit_at = int(fail_submit_at)
+        self.fail_submit_times = int(fail_submit_times)
+        self.stall_at_step = int(stall_at_step)
+        self.stall_secs = float(stall_secs)
+        self.slow_decode_secs = float(slow_decode_secs)
+        self.sleep = sleep
+        self.steps = 0
+        self.submits = 0
+
+    def submit(self, *args, **kwargs):
+        self.submits += 1
+        if (self.fail_submit_at and self.fail_submit_at <= self.submits
+                < self.fail_submit_at + self.fail_submit_times):
+            raise ChaosIOError(
+                f"chaos: flaky submit [call {self.submits}]")
+        return self.replica.submit(*args, **kwargs)
+
+    def step(self):
+        self.steps += 1
+        if self.crash_at_step and self.steps >= self.crash_at_step:
+            raise ReplicaCrashed(
+                f"chaos: replica crashed at step {self.steps}")
+        if (self.fail_step_at and self.fail_step_at <= self.steps
+                < self.fail_step_at + self.fail_step_times):
+            raise ChaosIOError(f"chaos: flaky step [call {self.steps}]")
+        if self.stall_at_step and self.steps == self.stall_at_step \
+                and self.stall_secs:
+            self.sleep(self.stall_secs)
+        if self.slow_decode_secs:
+            self.sleep(self.slow_decode_secs)
+        return self.replica.step()
+
+    def __getattr__(self, name):
+        # gauges/stats/pending/buckets/telemetry/... delegate untouched
+        return getattr(self.replica, name)
 
 
 # ----------------------------------------------------------------------

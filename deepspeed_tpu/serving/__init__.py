@@ -19,17 +19,26 @@ Pieces:
   runtime: fixed-bucket jitted prefill + one decode-slot program, so
   steady-state retrace count is zero;
 - :class:`~deepspeed_tpu.serving.request.Request` — one in-flight
-  generation with streaming callbacks and per-request telemetry.
+  generation with streaming callbacks and per-request telemetry;
+- :class:`~deepspeed_tpu.serving.router.ReplicaRouter` +
+  :class:`~deepspeed_tpu.serving.health.ReplicaHealth` — the resilient
+  multi-replica front door: health-aware routing, failover with
+  deterministic replay, and an SLO-guarded degradation ladder.
 """
 
 from deepspeed_tpu.serving.blocks import BlockManager
-from deepspeed_tpu.serving.config import (ServingConfig, bucket_for,
-                                          resolve_buckets)
+from deepspeed_tpu.serving.config import (RouterConfig, ServingConfig,
+                                          bucket_for, resolve_buckets)
 from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.health import (DEAD, DEGRADED, DRAINING, HEALTHY,
+                                          TRIPPED, ReplicaHealth)
 from deepspeed_tpu.serving.request import (FINISHED, QUEUED, RUNNING, SHED,
                                            Request)
+from deepspeed_tpu.serving.router import ReplicaRouter, RouterRequest
 from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
 
-__all__ = ["BlockManager", "ContinuousBatchingScheduler", "Request",
+__all__ = ["BlockManager", "ContinuousBatchingScheduler", "ReplicaHealth",
+           "ReplicaRouter", "Request", "RouterConfig", "RouterRequest",
            "ServingConfig", "ServingEngine", "bucket_for", "resolve_buckets",
-           "QUEUED", "RUNNING", "FINISHED", "SHED"]
+           "QUEUED", "RUNNING", "FINISHED", "SHED",
+           "HEALTHY", "DEGRADED", "TRIPPED", "DEAD", "DRAINING"]

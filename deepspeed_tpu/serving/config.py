@@ -13,14 +13,88 @@ run without touching a device.
 """
 
 import math
-from typing import List
+from typing import List, Optional
 
-from pydantic import field_validator
+from pydantic import field_validator, model_validator
 
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 
 SHED = "shed"
 QUEUE = "queue"
+
+
+class RouterConfig(DeepSpeedConfigModel):
+    """The ``serving.router`` block: N replica serving engines behind one
+    submit()/drain() front door (:class:`deepspeed_tpu.serving.router.
+    ReplicaRouter`). Absent (the default) the router layer does not
+    exist — ``init_serving`` returns the plain single-engine
+    ``ServingEngine`` and nothing about its behavior or compiled
+    programs changes."""
+
+    enabled: bool = True
+    # replica engines init_serving builds when given a model (ignored
+    # when the caller passes pre-built replicas)
+    replicas: int = 2
+    # ---- per-replica health state machine / circuit breaker ----
+    # consecutive submit/step failures before the breaker trips
+    failure_threshold: int = 3
+    # half-open probe delay after a trip; doubles per trip (the same
+    # exponential series resilience.integrity.retry_io walks)
+    probe_backoff_secs: float = 0.5
+    # breaker trips before the replica is declared DEAD
+    max_trips: int = 4
+    # host-observed step wall time above this is a stall verdict (the
+    # hang-watchdog signal at router granularity); 0 = off
+    stall_timeout_secs: float = 0.0
+    # soft DEGRADED signals from the replica's own telemetry aggregates
+    # (TTFT p95 / shed rate over the bounded records window); 0 = off
+    degraded_ttft_ms: float = 0.0
+    degraded_shed_rate: float = 0.0
+    # hysteresis: DEGRADED recovers only below enter * exit_fraction
+    degraded_exit_fraction: float = 0.5
+    # ---- failover ----
+    # resubmissions per request before it is failed as replica_lost
+    max_failovers: int = 2
+    # ---- SLO-guarded degradation ladder ----
+    # overload score (aggregate queue depth / aggregate queue capacity
+    # over routable replicas; 1.0 when none are routable) thresholds:
+    # crossing enter[t] raises the tier to t+1 immediately, dropping back
+    # below exit[t] lowers it one tier AFTER ladder_dwell_steps (the
+    # hysteresis guard against tier flapping / timeout storms)
+    ladder_enter: List[float] = [0.75, 0.9, 1.0]
+    ladder_exit: List[float] = [0.5, 0.65, 0.8]
+    ladder_dwell_steps: int = 8
+    # tier 1+: clamp per-request max_new_tokens to this budget
+    clamp_max_new_tokens: int = 16
+    # tier 2+: shed submits whose priority is below this floor
+    shed_priority_floor: int = 1
+
+    @field_validator("replicas", "failure_threshold", "max_trips",
+                     "max_failovers", "ladder_dwell_steps",
+                     "clamp_max_new_tokens")
+    @classmethod
+    def _positive(cls, v, info):
+        if v <= 0:
+            raise ValueError(
+                f"serving.router.{info.field_name} must be > 0, got {v}")
+        return v
+
+    @model_validator(mode="after")
+    def _ladder(self):
+        if len(self.ladder_enter) != len(self.ladder_exit):
+            raise ValueError(
+                "serving.router.ladder_enter and ladder_exit must have the "
+                f"same length, got {self.ladder_enter} vs {self.ladder_exit}")
+        for i, (en, ex) in enumerate(zip(self.ladder_enter,
+                                         self.ladder_exit)):
+            if ex >= en:
+                raise ValueError(
+                    "serving.router ladder hysteresis needs exit < enter "
+                    f"at every tier, got exit[{i}]={ex} >= enter[{i}]={en}")
+        if sorted(self.ladder_enter) != list(self.ladder_enter):
+            raise ValueError("serving.router.ladder_enter must be "
+                             f"non-decreasing, got {self.ladder_enter}")
+        return self
 
 
 class ServingConfig(DeepSpeedConfigModel):
@@ -64,6 +138,9 @@ class ServingConfig(DeepSpeedConfigModel):
     top_k: int = 0
     top_p: float = 0.0
     seed: int = 0
+    # ---- multi-replica front door (None = the router layer does not
+    # exist; single-engine serving is exactly as before) ----
+    router: Optional[RouterConfig] = None
 
     @field_validator("block_size", "decode_slots")
     @classmethod

@@ -287,6 +287,12 @@ class ServingEngine:
         self._step_count += 1
         self.telemetry.on_step_boundary(self._step_count,
                                         samples=len(active))
+        # per-step load gauges on the event stream: the router's health
+        # signals come from here, not from private scheduler state
+        # (guarded — telemetry off must not pay the slot scan per step)
+        if self.telemetry.enabled:
+            self.telemetry.emit("serving", "step.gauges",
+                                step=self._step_count, **self.gauges())
         # host-observed per-step token progress: a server saturated with
         # long generations must not be judged hung between completions
         self.resilience.serving_step_progress()
@@ -333,6 +339,29 @@ class ServingEngine:
             self.resilience.serving_heartbeat(self._finished_count)
 
     # ------------------------------------------------------------------
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
+        """Abandon one in-flight request (queued or mid-decode): its
+        decode slot, KV blocks and token budget release immediately and
+        it is recorded as shed with ``reason``. The multi-replica router
+        calls this at failover so abandoned proxies never keep decoding
+        on a replica that later recovers."""
+        req = self.sched.cancel(request_id, reason, time.monotonic())
+        if req is None:
+            return False
+        if 0 <= req.slot < len(self._tables):
+            self._tables[req.slot] = 0
+            self._lengths[req.slot] = 0
+            self._last_tokens[req.slot] = 0
+        self._record(req, shed=True, began=True)
+        return True
+
+    def gauges(self) -> dict:
+        """Instantaneous load gauges (queue depth, busy slots, free
+        blocks): the payload of the per-step ``serving``/``step.gauges``
+        telemetry event and the numbers the multi-replica router routes
+        by — one public surface, no private-state reach-ins."""
+        return {**self.sched.gauges(), "free_blocks": self.block_mgr.num_free}
+
     @property
     def pending(self) -> bool:
         return self.sched.pending

@@ -1,0 +1,614 @@
+"""Resilient multi-replica front door: health-aware routing, failover
+with deterministic replay, and an SLO-guarded degradation ladder.
+
+Pure host-side policy (no jax imports — the tier-1 tests drive it with
+fake replicas in milliseconds). A *replica* is anything with the
+``ServingEngine`` surface: ``submit(prompt, max_new_tokens, request_id,
+eos_token_id, deadline_ms, stream)`` returning a live ``Request``,
+``step()``, ``gauges()`` and ``stats()``. The router composes N of them
+behind one ``submit()``/``step()``/``drain()`` surface:
+
+- **routing** — each submit goes to the least-loaded routable replica
+  (load = ``queue_depth + slots_busy`` from the public ``gauges()``
+  payload, the same numbers the per-step serving telemetry events
+  carry): HEALTHY replicas first, DEGRADED only when no HEALTHY peer
+  can take it, plus at most one half-open probe to a TRIPPED replica
+  whose backoff elapsed.
+- **failover with deterministic replay** — greedy decode is
+  bit-reproducible (the PR 4 batch-invariance guarantee), so when a
+  replica dies or its breaker trips the router resubmits every one of
+  its in-flight requests — full prompt, the ORIGINAL effective
+  ``max_new_tokens`` — to a survivor and dedupes the regenerated stream
+  by position: tokens the client already saw are swallowed (and checked
+  — a mismatch is a loud ``replay.divergence`` event, the greedy
+  contract broken), new positions stream exactly once. The client sees
+  one uninterrupted exactly-once token stream, not a restart.
+- **degradation ladder** — under aggregate overload (queue depth over
+  capacity across routable replicas) the router walks explicit tiers
+  instead of collapsing into timeout storms: full service -> clamp
+  ``max_new_tokens`` -> shed below-priority-floor work -> brownout
+  (smallest-bucket prompts only). Tier entry is immediate; exit needs
+  the score below the (lower) exit threshold for ``ladder_dwell_steps``
+  — the hysteresis guard.
+
+Every transition — replica state, breaker trip/probe/close, failover,
+tier — is a ``router`` telemetry event on the unified stream
+(rendered by ``tools/telemetry_report.py``).
+"""
+
+import dataclasses
+import itertools
+import math
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Set
+
+from deepspeed_tpu.serving import request as rq
+from deepspeed_tpu.serving.config import RouterConfig
+from deepspeed_tpu.serving.health import (DEAD, DEGRADED, DRAINING, HEALTHY,
+                                          TRIPPED, ReplicaHealth)
+
+_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class RouterRequest:
+    """The client's handle: mirrors ``Request`` (state / tokens / stream)
+    but survives its replica. ``tokens`` holds exactly the tokens the
+    client's stream callback saw, in order — across any number of
+    failovers, each position exactly once."""
+
+    prompt: List[int]
+    max_new_tokens: int = 0       # effective budget, pinned at first dispatch
+    request_id: str = ""
+    priority: int = 0             # ladder tier 2+ sheds below the floor
+    eos_token_id: int = -1
+    deadline_ms: float = 0.0
+    stream: Optional[Callable] = None
+
+    # ---- runtime state (owned by the router) ----
+    clamp_budget: int = 0         # tier-1 cap pending default resolution
+    state: str = rq.QUEUED
+    finish_reason: Optional[str] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    replica: int = -1             # current assignment
+    attempt: int = 0              # failovers so far
+    proxy: Optional[rq.Request] = None
+    submit_ts: float = 0.0
+    first_token_ts: float = 0.0
+    finish_ts: float = 0.0
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def done(self) -> bool:
+        return self.state in (rq.FINISHED, rq.SHED)
+
+    def record(self) -> dict:
+        return {
+            "request_id": self.request_id, "state": self.state,
+            "reason": self.finish_reason, "prompt_len": self.prompt_len,
+            "new_tokens": len(self.tokens), "failovers": self.attempt,
+            "ttft_ms": round(1e3 * (self.first_token_ts - self.submit_ts), 3)
+            if self.first_token_ts else None,
+        }
+
+
+def _pct(values, q: float):
+    if not values:
+        return None
+    vs = sorted(values)
+    k = min(len(vs) - 1, max(0, math.ceil(q / 100.0 * len(vs)) - 1))
+    return round(float(vs[k]), 3)
+
+
+class _NullTelemetry:
+    enabled = False
+
+    def emit(self, *a, **k):
+        pass
+
+
+class ReplicaRouter:
+    def __init__(self, replicas, config=None, clock=time.monotonic,
+                 telemetry=None):
+        if not replicas:
+            raise ValueError("ReplicaRouter needs at least one replica")
+        self.replicas = list(replicas)
+        if config is None:
+            config = RouterConfig()
+        elif isinstance(config, dict):
+            config = RouterConfig(**config)
+        self.config: RouterConfig = config
+        self.clock = clock
+        self.telemetry = (telemetry
+                          or getattr(self.replicas[0], "telemetry", None)
+                          or _NullTelemetry())
+        self.health = [ReplicaHealth(config, i, clock, emit=self._emit)
+                       for i in range(len(self.replicas))]
+        self.tier = 0
+        self._tier_changed_step = 0
+        self._step_count = 0
+        self.requests: Dict[str, RouterRequest] = {}   # live client requests
+        self._assigned: List[Set[str]] = [set() for _ in self.replicas]
+        self._probe_req: Dict[int, str] = {}           # replica -> request id
+        self._done_this_step: List[RouterRequest] = []
+        self.finished = deque(maxlen=1024)
+        self._counters = {"submitted": 0, "finished": 0, "shed": 0,
+                          "failovers": 0, "deduped_tokens": 0,
+                          "replay_divergence": 0, "tier_transitions": 0,
+                          "shed_reasons": {}}
+
+    # ------------------------------------------------------------------
+    def _emit(self, name: str, **data):
+        self.telemetry.emit("router", name, step=self._step_count, **data)
+
+    def _gauges(self, idx: int) -> dict:
+        try:
+            return self.replicas[idx].gauges()
+        except Exception:
+            return {}
+
+    def _load(self, idx: int) -> int:
+        g = self._gauges(idx)
+        return int(g.get("queue_depth", 0)) + int(g.get("slots_busy", 0))
+
+    def _sampling(self, idx: int) -> bool:
+        return bool(getattr(getattr(self.replicas[idx], "config", None),
+                            "do_sample", False))
+
+    def _smallest_bucket(self) -> Optional[int]:
+        sizes = [min(b) for r in self.replicas
+                 for b in [getattr(r, "buckets", None)] if b]
+        return min(sizes) if sizes else None
+
+    # ------------------------------------------------------------------
+    # routing
+    def _candidates(self, now: float, exclude=()) -> List[int]:
+        """Routable replicas in preference order — HEALTHY by load, then
+        DEGRADED by load, then TRIPPED replicas whose half-open probe
+        window is open (each takes exactly one request)."""
+        healthy, degraded, probes = [], [], []
+        for i, h in enumerate(self.health):
+            if i in exclude:
+                continue
+            if h.state == HEALTHY:
+                healthy.append(i)
+            elif h.state == DEGRADED:
+                degraded.append(i)
+            elif h.can_probe(now) and i not in self._probe_req:
+                probes.append(i)
+        return (sorted(healthy, key=self._load)
+                + sorted(degraded, key=self._load) + sorted(probes))
+
+    def submit(self, prompt, max_new_tokens: int = 0, priority: int = 0,
+               request_id: Optional[str] = None, eos_token_id: int = -1,
+               deadline_ms: float = 0.0,
+               stream: Optional[Callable] = None) -> RouterRequest:
+        """Route one request to a replica (non-blocking). The returned
+        handle's ``state`` is ``queued`` on success, or ``shed`` with a
+        ``finish_reason`` when the degradation ladder or every routable
+        replica rejected it."""
+        now = self.clock()
+        rreq = RouterRequest(
+            prompt=[int(t) for t in prompt],
+            max_new_tokens=int(max_new_tokens),
+            request_id=request_id or f"rr-{next(_ids)}",
+            priority=int(priority), eos_token_id=int(eos_token_id),
+            deadline_ms=float(deadline_ms), stream=stream)
+        rreq.submit_ts = now
+        self._counters["submitted"] += 1
+        if rreq.request_id in self.requests:
+            return self._shed(rreq, "duplicate_id")
+        # ---- degradation ladder admission ----
+        c = self.config
+        if self.tier >= 1:
+            if rreq.max_new_tokens > 0:
+                rreq.max_new_tokens = min(rreq.max_new_tokens,
+                                          c.clamp_max_new_tokens)
+            else:
+                # budget comes from the replica default — cap it at
+                # dispatch, once known: the clamp must never RAISE the
+                # decode work of a default-budget submit
+                rreq.clamp_budget = c.clamp_max_new_tokens
+        if self.tier >= 2 and rreq.priority < c.shed_priority_floor:
+            return self._shed(rreq, "tier_shed")
+        if self.tier >= 3:
+            floor = self._smallest_bucket()
+            if floor is not None and rreq.prompt_len > floor:
+                return self._shed(rreq, "brownout")
+        if self._dispatch(rreq, now):
+            self.requests[rreq.request_id] = rreq
+        return rreq
+
+    def _dispatch(self, rreq: RouterRequest, now: float,
+                  exclude=()) -> bool:
+        """Try candidates in preference order until one accepts; shed the
+        request (last replica-side reason, or ``no_replica``) when none
+        does. The effective ``max_new_tokens`` was pinned at first
+        dispatch, so a failover replays the exact same decode."""
+        last_reason = None
+        deadline_ms = rreq.deadline_ms
+        if deadline_ms:
+            # the client's deadline does not restart on failover: the
+            # survivor's scheduler stamps a fresh submit_ts, so hand it
+            # only the REMAINING budget — and shed already-over-deadline
+            # work instead of replaying it arbitrarily late
+            deadline_ms -= 1e3 * (now - rreq.submit_ts)
+            if deadline_ms <= 0:
+                self._shed(rreq, "deadline")
+                return False
+        for idx in self._candidates(now, exclude):
+            h = self.health[idx]
+            probe = h.state == TRIPPED
+            if rreq.tokens and self._sampling(idx):
+                # the dedupe-splice is only sound across bit-reproducible
+                # greedy decodes: a delivered prefix must never resume on
+                # a sampling replica (a request with nothing streamed yet
+                # is fine — there is nothing to splice)
+                last_reason = "nondeterministic_replay"
+                continue
+            budget = rreq.max_new_tokens
+            if budget <= 0 and rreq.clamp_budget:
+                # resolve the replica's default budget and cap it (real
+                # engines expose it on .config; without one, the cap
+                # itself is the degraded-mode budget)
+                default = getattr(getattr(self.replicas[idx], "config",
+                                          None),
+                                  "default_max_new_tokens", 0) or 0
+                budget = (min(int(default), rreq.clamp_budget)
+                          if default > 0 else rreq.clamp_budget)
+            try:
+                proxy = self.replicas[idx].submit(
+                    rreq.prompt, max_new_tokens=budget,
+                    request_id=f"{rreq.request_id}#a{rreq.attempt}",
+                    eos_token_id=rreq.eos_token_id,
+                    deadline_ms=deadline_ms, stream=self._shim(rreq))
+            except Exception as e:
+                if probe:
+                    # the half-open probe itself failed: it must count
+                    # as one (re-trip, backoff doubles) — not as a
+                    # first consecutive failure that leaves the probe
+                    # window open for immediate hammering
+                    h.begin_probe()
+                self._replica_failed(
+                    idx, f"submit:{type(e).__name__}",
+                    fatal=bool(getattr(e, "replica_dead", False)))
+                continue
+            if proxy.state == rq.SHED:
+                last_reason = proxy.finish_reason  # admission said no; next
+                continue
+            if probe:
+                h.begin_probe()
+                self._probe_req[idx] = rreq.request_id
+            if rreq.max_new_tokens <= 0:
+                # pin the effective budget only from an admission that
+                # ACCEPTED — the clamp-resolved cap, or the default this
+                # replica's proxy reports; a failed candidate's config
+                # must not leak into the replay budget
+                rreq.max_new_tokens = int(
+                    budget or getattr(proxy, "max_new_tokens", 0) or 0)
+            rreq.proxy, rreq.replica, rreq.state = proxy, idx, rq.QUEUED
+            self._assigned[idx].add(rreq.request_id)
+            return True
+        self._shed(rreq, last_reason or "no_replica")
+        return False
+
+    def _shim(self, rreq: RouterRequest) -> Callable:
+        """Per-token dedupe-by-position: the exactly-once guarantee. A
+        replayed position must carry the identical token (greedy decode
+        is bit-reproducible) — a mismatch is counted and shouted, never
+        silently re-streamed."""
+
+        def cb(proxy: rq.Request, tok: int, done: bool):
+            if rreq.proxy is not proxy:
+                # stale attempt: the request moved on (failed over, or
+                # already reported done) — a zombie proxy left decoding
+                # on a recovered replica must never resurrect the handle
+                # or re-invoke the client stream
+                return
+            pos = len(proxy.tokens) - 1
+            tok = int(tok)
+            if pos < len(rreq.tokens):
+                self._counters["deduped_tokens"] += 1
+                if rreq.tokens[pos] != tok:
+                    self._counters["replay_divergence"] += 1
+                    self._emit("replay.divergence",
+                               request_id=rreq.request_id, position=pos,
+                               streamed=rreq.tokens[pos], replayed=tok)
+                return
+            if not rreq.tokens:
+                rreq.first_token_ts = self.clock()
+            rreq.state = rq.RUNNING
+            rreq.tokens.append(tok)
+            if rreq.stream is not None:
+                rreq.stream(rreq, tok, bool(done))
+
+        return cb
+
+    # ------------------------------------------------------------------
+    # stepping + health
+    def step(self) -> List[RouterRequest]:
+        """One router iteration: step every replica that holds work
+        (guarded — an exception or stall verdict becomes a health signal
+        and a failover), harvest finished/shed proxies, refresh soft
+        health from telemetry aggregates, walk the degradation ladder.
+        Returns the client requests finished this step."""
+        self._step_count += 1
+        self._done_this_step = []
+        c = self.config
+        for idx in range(len(self.replicas)):
+            if not self._assigned[idx] or not self.health[idx].alive:
+                continue
+            t0 = self.clock()
+            try:
+                self.replicas[idx].step()
+            except Exception as e:
+                self._replica_failed(
+                    idx, f"step:{type(e).__name__}",
+                    fatal=bool(getattr(e, "replica_dead", False)))
+                continue
+            # harvest BEFORE the stall verdict: a slow-but-complete step
+            # delivered tokens — requests it finished must not be
+            # replayed (or worse, shed) by the failover below
+            self._harvest(idx)
+            if (c.stall_timeout_secs
+                    and self.clock() - t0 >= c.stall_timeout_secs):
+                h = self.health[idx]
+                h.record_stall("stall")
+                self._probe_req.pop(idx, None)
+                # DRAINING holds the drain-in-place contract even on a
+                # stall verdict (trip() already no-ops there) — mirror
+                # the exception path's guard in _replica_failed
+                if not h.routable and h.state != DRAINING:
+                    self._failover_replica(idx, "stall")
+            else:
+                self.health[idx].record_success()
+        self._observe_health()
+        self._evaluate_ladder()
+        # snapshot: a later submit-time shed appends to the live list
+        # and must not retroactively grow the caller's result
+        return list(self._done_this_step)
+
+    def _harvest(self, idx: int):
+        for rid in list(self._assigned[idx]):
+            rreq = self.requests.get(rid)
+            if rreq is None or rreq.proxy is None:
+                self._assigned[idx].discard(rid)
+                continue
+            st = rreq.proxy.state
+            if st == rq.FINISHED:
+                self._assigned[idx].discard(rid)
+                if self._probe_req.get(idx) == rid:
+                    del self._probe_req[idx]
+                    self.health[idx].probe_success()
+                self._finalize(rreq, rreq.proxy.finish_reason)
+            elif st == rq.SHED:
+                # replica-side policy shed (deadline/queue) — propagate,
+                # no failover: resubmitting over-deadline work would feed
+                # the very overload the shed relieved
+                self._assigned[idx].discard(rid)
+                if self._probe_req.get(idx) == rid:
+                    del self._probe_req[idx]
+                    self.health[idx].probe_inconclusive()
+                self._shed(rreq, rreq.proxy.finish_reason or "replica_shed")
+        h = self.health[idx]
+        if h.state == DRAINING and not self._assigned[idx]:
+            self._emit("replica.drained", replica=idx)
+
+    def _finalize(self, rreq: RouterRequest, reason: Optional[str]):
+        rreq.state, rreq.finish_reason = rq.FINISHED, reason
+        rreq.finish_ts = self.clock()
+        rreq.proxy = None
+        self.requests.pop(rreq.request_id, None)
+        self.finished.append(rreq)
+        self._counters["finished"] += 1
+        self._done_this_step.append(rreq)
+        self._emit("request.finish", request_id=rreq.request_id,
+                   replica=rreq.replica, failovers=rreq.attempt,
+                   new_tokens=len(rreq.tokens), reason=reason)
+
+    def _shed(self, rreq: RouterRequest, reason: str) -> RouterRequest:
+        rreq.state, rreq.finish_reason = rq.SHED, reason
+        rreq.finish_ts = self.clock()
+        rreq.proxy = None
+        # identity check: shedding a duplicate-id submit must not evict
+        # the live original that owns the slot in the registry
+        if self.requests.get(rreq.request_id) is rreq:
+            del self.requests[rreq.request_id]
+        self.finished.append(rreq)
+        self._counters["shed"] += 1
+        reasons = self._counters["shed_reasons"]
+        reasons[reason] = reasons.get(reason, 0) + 1
+        self._done_this_step.append(rreq)
+        self._emit("request.shed", request_id=rreq.request_id,
+                   reason=reason, tier=self.tier)
+        return rreq
+
+    # ------------------------------------------------------------------
+    # failure handling + failover
+    def _replica_failed(self, idx: int, reason: str, fatal: bool):
+        h = self.health[idx]
+        if fatal:
+            h.record_crash(reason)
+        else:
+            h.record_failure(reason)
+        if idx in self._probe_req and not h.probing:
+            # the probe request was in flight when the failure landed;
+            # it fails over (or dies) with the rest of the assignment
+            del self._probe_req[idx]
+        if not h.routable and h.state != DRAINING:
+            self._failover_replica(idx, reason)
+        elif (h.state == DRAINING and h.consecutive_failures
+              >= self.config.failure_threshold):
+            # a draining replica that can no longer step must yield its
+            # in-flight work: drain-in-place defers to liveness, or
+            # drain() would spin on requests that can never finish
+            self._failover_replica(idx, f"drain:{reason}")
+
+    def _failover_replica(self, idx: int, reason: str):
+        """Reroute everything in flight on a tripped/dead replica.
+        Deterministic replay makes this transparent: the survivor
+        regenerates the greedy stream from the full prompt and the shim
+        dedupes already-delivered positions."""
+        rids = sorted(self._assigned[idx])
+        self._assigned[idx].clear()
+        self._probe_req.pop(idx, None)
+        cancel = getattr(self.replicas[idx], "cancel", None)
+        now = self.clock()
+        for rid in rids:
+            rreq = self.requests.get(rid)
+            if rreq is None:
+                continue
+            if rreq.proxy is not None and cancel is not None:
+                # best-effort: release the abandoned proxy's decode slot
+                # and KV blocks so a replica that later recovers through
+                # a half-open probe is not haunted by zombie decodes
+                try:
+                    cancel(rreq.proxy.request_id, "failover")
+                except Exception:
+                    pass
+            rreq.attempt += 1
+            self._counters["failovers"] += 1
+            self._emit("failover", request_id=rid, from_replica=idx,
+                       reason=reason, attempt=rreq.attempt,
+                       delivered=len(rreq.tokens))
+            if rreq.attempt > self.config.max_failovers:
+                self._shed(rreq, "replica_lost")
+                continue
+            if rreq.tokens and self._sampling(idx):
+                # the delivered prefix was SAMPLED — no survivor can
+                # regenerate it bit-identically, so the splice contract
+                # is unsatisfiable: fail loudly instead of streaming a
+                # garbled continuation of a different sample
+                self._shed(rreq, "nondeterministic_replay")
+                continue
+            self._dispatch(rreq, now, exclude={idx})
+
+    # ------------------------------------------------------------------
+    # soft health + degradation ladder
+    def _observe_health(self):
+        c = self.config
+        if c.degraded_ttft_ms <= 0 and c.degraded_shed_rate <= 0:
+            return
+        for idx, h in enumerate(self.health):
+            if h.state not in (HEALTHY, DEGRADED):
+                continue
+            try:
+                st = self.replicas[idx].stats()
+            except Exception:
+                continue
+            h.observe(ttft_p95_ms=st.get("ttft_ms_p95"),
+                      shed_rate=st.get("shed_rate"))
+
+    def overload(self) -> float:
+        """Aggregate queue pressure over routable replicas (1.0 when none
+        are routable — total overload by definition)."""
+        depth = cap = 0
+        for idx, h in enumerate(self.health):
+            if not h.routable:
+                continue
+            g = self._gauges(idx)
+            depth += int(g.get("queue_depth", 0))
+            cap += int(g.get("queue_capacity", 0))
+        if cap <= 0:
+            return 1.0
+        return depth / cap
+
+    def _evaluate_ladder(self):
+        c = self.config
+        score = self.overload()
+        n = len(c.ladder_enter)
+        while self.tier < n and score >= c.ladder_enter[self.tier]:
+            self._set_tier(self.tier + 1, score)
+        if (self.tier > 0 and score <= c.ladder_exit[self.tier - 1]
+                and self._step_count - self._tier_changed_step
+                >= c.ladder_dwell_steps):
+            self._set_tier(self.tier - 1, score)
+
+    def _set_tier(self, tier: int, score: float):
+        old, self.tier = self.tier, tier
+        self._tier_changed_step = self._step_count
+        self._counters["tier_transitions"] += 1
+        self._emit("tier", from_tier=old, to_tier=tier,
+                   score=round(score, 4))
+
+    # ------------------------------------------------------------------
+    # rolling restarts
+    def start_drain(self, idx: int):
+        """Stop routing new work to replica ``idx``; in-flight requests
+        finish in place (a ``replica.drained`` event fires when the last
+        one does)."""
+        self.health[idx].start_drain()
+        self._probe_req.pop(idx, None)
+
+    def reactivate(self, idx: int, replica=None):
+        """Bring a drained (or replaced) replica back into rotation —
+        optionally swapping in a fresh engine object (the restarted
+        process)."""
+        if replica is not None:
+            if self._assigned[idx]:
+                # the old engine is being discarded with work still on
+                # it: fail the work over BEFORE the swap (cancel must
+                # reach the old engine) or drain() would poll orphaned
+                # proxies forever
+                self._failover_replica(idx, "reactivate")
+            self.replicas[idx] = replica
+        self.health[idx].reactivate()
+
+    # ------------------------------------------------------------------
+    @property
+    def pending(self) -> bool:
+        return bool(self.requests)
+
+    def drain(self, max_steps: Optional[int] = None) -> List[RouterRequest]:
+        out: List[RouterRequest] = []
+        steps = 0
+        while self.pending and (max_steps is None or steps < max_steps):
+            out.extend(self.step())
+            steps += 1
+        return out
+
+    def generate_batch(self, prompts, max_new_tokens: int = 0, **kwargs):
+        reqs = [self.submit(p, max_new_tokens=max_new_tokens, **kwargs)
+                for p in prompts]
+        self.drain()
+        return [r.tokens if r.state == rq.FINISHED else None for r in reqs]
+
+    def reset_stats(self):
+        """Counter epoch boundary (bench warmup -> measured window); live
+        requests and health state are untouched."""
+        self.finished.clear()
+        self._counters = {"submitted": 0, "finished": 0, "shed": 0,
+                          "failovers": 0, "deduped_tokens": 0,
+                          "replay_divergence": 0, "tier_transitions": 0,
+                          "shed_reasons": {}}
+
+    def stats(self) -> dict:
+        s = self._counters
+        total = max(1, s["submitted"])
+        ttfts = [r.record()["ttft_ms"] for r in self.finished
+                 if r.first_token_ts]
+        return {
+            "tier": self.tier,
+            "replica_states": [h.state for h in self.health],
+            "breaker_trips": sum(h.trips for h in self.health),
+            "finished": s["finished"], "shed": s["shed"],
+            "shed_reasons": dict(s["shed_reasons"]),
+            "failovers": s["failovers"],
+            "deduped_tokens": s["deduped_tokens"],
+            "replay_divergence": s["replay_divergence"],
+            "tier_transitions": s["tier_transitions"],
+            "availability": round(s["finished"] / total, 4),
+            "ttft_ms_p50": _pct(ttfts, 50),
+            "ttft_ms_p95": _pct(ttfts, 95),
+            "live": len(self.requests),
+        }
+
+    def destroy(self):
+        for r in self.replicas:
+            destroy = getattr(r, "destroy", None)
+            if destroy is not None:
+                destroy()

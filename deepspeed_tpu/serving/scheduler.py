@@ -75,6 +75,18 @@ class ContinuousBatchingScheduler:
     def running(self) -> List[Tuple[int, rq.Request]]:
         return [(i, r) for i, r in enumerate(self.slots) if r is not None]
 
+    def gauges(self) -> dict:
+        """Instantaneous load gauges — the health signals the router (and
+        the per-step ``serving`` telemetry events) consume, so no caller
+        ever needs to reach into queue/slot internals."""
+        return {
+            "queue_depth": len(self.queue),
+            "queue_capacity": int(self.config.max_queue_depth),
+            "slots_busy": sum(1 for r in self.slots if r is not None),
+            "slots_total": len(self.slots),
+            "committed_tokens": self.committed_tokens,
+        }
+
     # ------------------------------------------------------------------
     def submit(self, req: rq.Request, now: Optional[float] = None) -> bool:
         """Queue a request, or shed it (state ``shed`` + reason) when
@@ -88,7 +100,7 @@ class ContinuousBatchingScheduler:
             # a duplicate id would collide in the block manager mid-admit
             # and crash the serving loop with every other request in
             # flight — reject it at the door instead
-            return self._shed(req, "duplicate_id")
+            return self._shed(req, "duplicate_id", now)
         if (req.prompt_len < 1
                 or bucket_for(req.prompt_len, self.buckets) is None
                 or self._cost(req) > self.max_len
@@ -98,13 +110,13 @@ class ContinuousBatchingScheduler:
                 # would spin step()/drain() forever
                 or self.blocks.blocks_needed(self._cost(req))
                 > self.blocks.num_blocks - 1):
-            return self._shed(req, "too_long")
+            return self._shed(req, "too_long", now)
         if len(self.queue) >= self.config.max_queue_depth:
-            return self._shed(req, "queue_full")
+            return self._shed(req, "queue_full", now)
         cap = self.config.max_inflight_tokens
         if (cap and self.config.shed_policy != QUEUE
                 and self.committed_tokens + self._cost(req) > cap):
-            return self._shed(req, "inflight_tokens")
+            return self._shed(req, "inflight_tokens", now)
         self.committed_tokens += self._cost(req)
         self._live_ids.add(req.request_id)
         self.queue.append(req)
@@ -112,10 +124,14 @@ class ContinuousBatchingScheduler:
                                        len(self.queue))
         return True
 
-    def _shed(self, req: rq.Request, reason: str) -> bool:
+    def _shed(self, req: rq.Request, reason: str,
+              now: Optional[float] = None) -> bool:
         req.state = rq.SHED
         req.finish_reason = reason
-        req.finish_ts = self.clock()
+        # the caller's `now` keeps one timebase per event: under a fake
+        # clock a shed record must not mix injected submit/admit times
+        # with real clock reads
+        req.finish_ts = self.clock() if now is None else now
         self.stats["shed"] += 1
         reasons = self.stats["shed_reasons"]
         reasons[reason] = reasons.get(reason, 0) + 1
@@ -140,7 +156,7 @@ class ContinuousBatchingScheduler:
                 if self.expired(head, now):
                     self.committed_tokens -= self._cost(head)
                     self._live_ids.discard(head.request_id)
-                    self._shed(head, "deadline")
+                    self._shed(head, "deadline", now)
                     shed.append(head)
                     continue
                 req = head
@@ -168,6 +184,32 @@ class ContinuousBatchingScheduler:
         return admitted, shed
 
     # ------------------------------------------------------------------
+    def cancel(self, request_id: str, reason: str = "cancelled",
+               now: Optional[float] = None) -> Optional[rq.Request]:
+        """Abandon one in-flight request (queued or mid-decode), releasing
+        its slot, blocks and token budget immediately; the request is
+        marked shed with ``reason``. Returns it, or ``None`` when no live
+        request carries the id. The multi-replica router uses this at
+        failover so an abandoned proxy never haunts a replica that later
+        recovers through a half-open probe."""
+        now = self.clock() if now is None else now
+        for i, r in enumerate(self.queue):
+            if r.request_id == request_id:
+                del self.queue[i]
+                self.committed_tokens -= self._cost(r)
+                self._live_ids.discard(request_id)
+                self._shed(r, reason, now)
+                return r
+        for slot, r in self.running():
+            if r.request_id == request_id:
+                self.slots[slot] = None
+                self.blocks.release(request_id)
+                self.committed_tokens -= self._cost(r)
+                self._live_ids.discard(request_id)
+                self._shed(r, reason, now)
+                return r
+        return None
+
     def finish(self, req: rq.Request, reason: str,
                now: Optional[float] = None):
         """Release a running request's slot + blocks + token budget."""

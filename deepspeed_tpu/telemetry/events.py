@@ -27,6 +27,8 @@ satellite families that ride the same sink):
                      ``model_times()`` buffer mirrored into the stream)
 - ``topology``     — checkpoint restores: saved vs. current mesh/world,
                      whether the load resharded (elastic resume)
+- ``router``       — multi-replica front door: replica state / breaker /
+                     failover / degradation-tier transitions
 
 Everything in ``data`` must be JSON-safe; :func:`json_safe` coerces numpy
 scalars and drops device arrays (an event must never pin or sync device
@@ -38,7 +40,8 @@ import time
 from typing import Any, Dict, Optional
 
 KINDS = ("compile", "step_cost", "memory", "trace_window", "step",
-         "wallclock", "comm", "fault", "serving", "model_time", "topology")
+         "wallclock", "comm", "fault", "serving", "model_time", "topology",
+         "router")
 
 
 def json_safe(value: Any):

@@ -1,0 +1,1314 @@
+"""Resilient multi-replica serving front door.
+
+Four tiers, the first three pure host-side (tier-1 fast — fake replicas
++ a fake clock, no jax):
+
+- :class:`ReplicaHealth` state machine: breaker thresholds, exponential
+  half-open backoff, crash/stall verdicts, soft-degrade hysteresis,
+  drain/reactivate;
+- :class:`ReplicaRouter`: least-loaded routing, failover with
+  deterministic replay (the exactly-once acceptance proof, driven by
+  the chaos injectors), the SLO degradation ladder, probes, telemetry;
+- tooling: the ``router`` section of ``tools/telemetry_report.py`` and
+  the AST import-hygiene pin (serving policy modules never pull jax);
+- heavy: real two-replica ServingEngines behind the router — killing
+  one mid-decode leaves greedy token streams bit-identical to an
+  unfaulted run — plus the init_serving wiring and the HLO pin.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.resilience.chaos import (ChaosIOError,
+                                                    ChaosReplica,
+                                                    ReplicaCrashed)
+from deepspeed_tpu.serving import request as rq
+from deepspeed_tpu.serving.config import RouterConfig
+from deepspeed_tpu.serving.health import (DEAD, DEGRADED, DRAINING, HEALTHY,
+                                          TRIPPED, ReplicaHealth,
+                                          probe_backoff)
+from deepspeed_tpu.serving.router import ReplicaRouter
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, secs):
+        self.t += secs
+
+
+def _greedy(prompt, pos):
+    """The fake replicas' shared deterministic decode: same prompt ->
+    same token at every position, on every replica (the bit-reproducible
+    greedy contract the real engines pin in test_serving.py)."""
+    return (31 * sum(int(t) for t in prompt) + 7 * pos) % 997
+
+
+class FakeReplica:
+    """Minimal ServingEngine surface: bounded queue -> slots -> one
+    deterministic token per running request per step()."""
+
+    def __init__(self, slots=2, queue_cap=8, buckets=(8, 16),
+                 ttft_p95=None, shed_rate=None):
+        self.slots = slots
+        self.queue_cap = queue_cap
+        self.buckets = list(buckets)
+        self.queue = []
+        self.running = []
+        self._ttft = ttft_p95
+        self._shed = shed_rate
+        self.submits = 0
+        self.steps = 0
+
+    def submit(self, prompt, max_new_tokens=0, request_id=None,
+               eos_token_id=-1, deadline_ms=0.0, stream=None):
+        self.submits += 1
+        req = rq.Request(prompt=[int(t) for t in prompt],
+                         max_new_tokens=int(max_new_tokens) or 4,
+                         request_id=request_id or f"f-{self.submits}",
+                         eos_token_id=eos_token_id,
+                         deadline_ms=deadline_ms, stream=stream)
+        if len(self.queue) >= self.queue_cap:
+            req.state, req.finish_reason = rq.SHED, "queue_full"
+            return req
+        req.state = rq.QUEUED
+        self.queue.append(req)
+        return req
+
+    def _token(self, req, pos):
+        return _greedy(req.prompt, pos)
+
+    def step(self):
+        self.steps += 1
+        while self.queue and len(self.running) < self.slots:
+            head = self.queue.pop(0)
+            head.state = rq.RUNNING
+            self.running.append(head)
+        for req in list(self.running):
+            pos = len(req.tokens)
+            tok = self._token(req, pos)
+            done = (tok == req.eos_token_id
+                    or pos + 1 >= req.max_new_tokens)
+            req.emit_token(tok, done)
+            if done:
+                req.state = rq.FINISHED
+                req.finish_reason = ("eos" if tok == req.eos_token_id
+                                     else "max_tokens")
+                self.running.remove(req)
+
+    def gauges(self):
+        return {"queue_depth": len(self.queue),
+                "queue_capacity": self.queue_cap,
+                "slots_busy": len(self.running),
+                "slots_total": self.slots, "free_blocks": 99}
+
+    def stats(self):
+        return {"ttft_ms_p95": self._ttft, "shed_rate": self._shed}
+
+
+class GaugeStub(FakeReplica):
+    """Queue-pressure dial for the degradation-ladder tests."""
+
+    def __init__(self, depth=0, cap=10, **kw):
+        super().__init__(**kw)
+        self.depth, self.cap = depth, cap
+
+    def gauges(self):
+        g = super().gauges()
+        g["queue_depth"], g["queue_capacity"] = self.depth, self.cap
+        return g
+
+
+class FakeTelemetry:
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, name, step=None, **data):
+        self.events.append({"kind": kind, "name": name, "step": step,
+                            "data": data})
+
+    def of(self, name):
+        return [e for e in self.events if e["name"] == name]
+
+
+def _router(replicas, clock=None, telemetry=None, **cfg):
+    cfg.setdefault("probe_backoff_secs", 0.5)
+    return ReplicaRouter(replicas, config=RouterConfig(**cfg),
+                         clock=clock or _Clock(),
+                         telemetry=telemetry or FakeTelemetry())
+
+
+# ---------------------------------------------------------------------------
+# health state machine
+# ---------------------------------------------------------------------------
+class TestProbeBackoff:
+    def test_retry_io_series(self):
+        assert probe_backoff(0.5, 1) == 0.5
+        assert probe_backoff(0.5, 2) == 1.0
+        assert probe_backoff(0.5, 3) == 2.0
+        assert probe_backoff(0.5, 0) == 0.5  # floor, never negative power
+
+
+class TestReplicaHealth:
+    def _health(self, clk=None, **cfg):
+        events = []
+        cfg.setdefault("failure_threshold", 3)
+        cfg.setdefault("max_trips", 2)
+        h = ReplicaHealth(RouterConfig(**cfg), replica_id=0,
+                          clock=clk or _Clock(),
+                          emit=lambda name, **d: events.append((name, d)))
+        return h, events
+
+    def test_consecutive_failures_trip_and_success_resets(self):
+        h, events = self._health()
+        h.record_failure()
+        h.record_failure()
+        h.record_success()  # resets the count
+        h.record_failure()
+        h.record_failure()
+        assert h.state == HEALTHY
+        h.record_failure()  # third consecutive
+        assert h.state == TRIPPED and h.trips == 1
+        assert ("replica.state", {"replica": 0, "from_state": "healthy",
+                                  "to_state": "tripped",
+                                  "reason": "failure"}) in events
+
+    def test_stall_trips_immediately(self):
+        h, _ = self._health()
+        h.record_stall("stall")
+        assert h.state == TRIPPED and not h.routable
+
+    def test_crash_is_dead_until_reactivate(self):
+        h, _ = self._health()
+        h.record_crash("crash")
+        assert h.state == DEAD and not h.alive
+        h.record_failure()  # no resurrection by accident
+        assert h.state == DEAD
+        h.reactivate()
+        assert h.state == HEALTHY and h.trips == 0
+
+    def test_probe_window_and_close(self):
+        clk = _Clock()
+        h, events = self._health(clk)
+        h.record_stall()
+        assert not h.can_probe(clk())  # backoff not elapsed
+        clk.advance(0.5)
+        assert h.can_probe(clk())
+        h.begin_probe()
+        assert not h.can_probe(clk())  # one probe in flight max
+        h.probe_success()
+        assert h.state == HEALTHY
+        assert h.trip_streak == 0   # backoff series resets on close...
+        assert h.trips == 1         # ...the lifetime count survives
+        assert [n for n, _ in events if n == "breaker.close"] == \
+            ["breaker.close"]
+
+    def test_probe_failure_doubles_backoff(self):
+        clk = _Clock()
+        h, _ = self._health(clk)
+        h.record_stall()          # trip 1: next probe at +0.5
+        clk.advance(0.5)
+        h.begin_probe()
+        h.record_failure()        # probing failure trips immediately
+        assert h.state == TRIPPED and h.trips == 2
+        assert h.next_probe_ts == pytest.approx(clk() + 1.0)  # doubled
+
+    def test_every_trip_emits_breaker_trip_event(self):
+        """Re-trips while already TRIPPED (a failed half-open probe)
+        change no state, so the dedicated breaker.trip event — not the
+        replica.state stream — is the true trip count."""
+        clk = _Clock()
+        h, events = self._health(clk)
+        h.record_stall()          # trip 1
+        clk.advance(0.5)
+        h.begin_probe()
+        h.record_failure()        # probe failed: trip 2, state unchanged
+        trips = [d for n, d in events if n == "breaker.trip"]
+        assert [t["trips"] for t in trips] == [1, 2] == [1, h.trips]
+        assert sum(1 for n, d in events if n == "replica.state"
+                   and d["to_state"] == TRIPPED) == 1
+
+    def test_max_trips_is_dead(self):
+        h, _ = self._health()     # max_trips=2
+        h.record_stall()
+        h.record_stall()
+        assert h.state == TRIPPED
+        h.record_stall()          # third trip > max_trips
+        assert h.state == DEAD
+        assert h.last_reason.startswith("max_trips:")
+
+    def test_degraded_hysteresis(self):
+        h, _ = self._health(degraded_ttft_ms=100.0,
+                            degraded_exit_fraction=0.5)
+        h.observe(ttft_p95_ms=150.0)
+        assert h.state == DEGRADED and h.routable
+        h.observe(ttft_p95_ms=80.0)   # below enter, above exit*enter
+        assert h.state == DEGRADED    # hysteresis holds
+        h.observe(ttft_p95_ms=40.0)   # below 100*0.5
+        assert h.state == HEALTHY
+
+    def test_shed_rate_signal(self):
+        h, _ = self._health(degraded_shed_rate=0.2)
+        h.observe(shed_rate=0.5)
+        assert h.state == DEGRADED
+
+    def test_drain_and_reactivate(self):
+        h, _ = self._health()
+        h.start_drain()
+        assert h.state == DRAINING and not h.routable and h.alive
+        h.record_failure()  # draining never trips
+        h.record_failure()
+        h.record_failure()
+        assert h.state == DRAINING
+        h.reactivate()
+        assert h.state == HEALTHY
+
+
+# ---------------------------------------------------------------------------
+# chaos injectors
+# ---------------------------------------------------------------------------
+class TestChaosReplica:
+    def test_fault_taxonomy(self):
+        assert ReplicaCrashed.replica_dead is True  # fatal, not transient
+        assert issubclass(ChaosIOError, OSError)
+        assert not getattr(ChaosIOError, "replica_dead", False)
+
+    def test_transparent_delegation_until_armed(self):
+        base = FakeReplica()
+        wrap = ChaosReplica(base)  # nothing armed: a pass-through
+        r = wrap.submit([1], max_new_tokens=2)
+        wrap.step()
+        wrap.step()
+        assert r.state == rq.FINISHED
+        assert wrap.gauges() == base.gauges()
+        assert wrap.buckets == base.buckets  # __getattr__ delegation
+
+    def test_crash_persists_after_first_fire(self):
+        wrap = ChaosReplica(FakeReplica(), crash_at_step=2)
+        wrap.step()
+        with pytest.raises(ReplicaCrashed):
+            wrap.step()
+        with pytest.raises(ReplicaCrashed):  # dead stays dead
+            wrap.step()
+
+    def test_flaky_window_is_exact(self):
+        wrap = ChaosReplica(FakeReplica(), fail_step_at=2,
+                            fail_step_times=2)
+        wrap.step()
+        with pytest.raises(ChaosIOError):
+            wrap.step()
+        with pytest.raises(ChaosIOError):
+            wrap.step()
+        wrap.step()  # window over: healthy again
+
+
+# ---------------------------------------------------------------------------
+# router: routing, failover, replay
+# ---------------------------------------------------------------------------
+class TestRouting:
+    def test_least_loaded_wins(self):
+        a, b = FakeReplica(), FakeReplica()
+        router = _router([a, b])
+        r0 = router.submit([1, 2], max_new_tokens=4)
+        r1 = router.submit([3, 4], max_new_tokens=4)
+        assert r0.replica == 0 and r1.replica == 1  # load balanced
+        r2 = router.submit([5], max_new_tokens=4)
+        assert r2.replica == 0  # tie again -> first
+
+    def test_degraded_only_after_healthy(self):
+        a, b = FakeReplica(), FakeReplica()
+        router = _router([a, b], degraded_ttft_ms=100.0)
+        router.health[0].observe(ttft_p95_ms=500.0)
+        assert router.health[0].state == DEGRADED
+        for i in range(3):
+            assert router.submit([i + 1], max_new_tokens=2).replica == 1
+
+    def test_duplicate_id_shed(self):
+        router = _router([FakeReplica()])
+        orig = router.submit([1], max_new_tokens=8, request_id="x")
+        dup = router.submit([2], max_new_tokens=8, request_id="x")
+        assert dup.state == rq.SHED and dup.finish_reason == "duplicate_id"
+        # shedding the duplicate must NOT evict the live original from
+        # the registry: it still drains and finishes
+        assert router.requests["x"] is orig and router.pending
+        router.drain(max_steps=20)
+        assert orig.state == rq.FINISHED and len(orig.tokens) == 8
+
+    def test_no_routable_replica_sheds(self):
+        router = _router([FakeReplica()])
+        router.health[0].record_crash()
+        r = router.submit([1], max_new_tokens=2)
+        assert r.state == rq.SHED and r.finish_reason == "no_replica"
+
+    def test_replica_admission_shed_propagates(self):
+        router = _router([FakeReplica(queue_cap=1)])
+        router.submit([1], max_new_tokens=4)
+        r = router.submit([2], max_new_tokens=4)
+        assert r.state == rq.SHED and r.finish_reason == "queue_full"
+
+    def test_finish_and_stats(self):
+        router = _router([FakeReplica()])
+        r = router.submit([1, 2, 3], max_new_tokens=3)
+        done = router.drain(max_steps=10)
+        assert r in done and r.state == rq.FINISHED
+        assert r.tokens == [_greedy([1, 2, 3], p) for p in range(3)]
+        st = router.stats()
+        assert st["finished"] == 1 and st["availability"] == 1.0
+        assert st["failovers"] == 0 and st["live"] == 0
+        assert st["replica_states"] == [HEALTHY]
+
+    def test_generate_batch(self):
+        router = _router([FakeReplica(), FakeReplica()])
+        out = router.generate_batch([[1, 2], [3], [4, 5, 6]],
+                                    max_new_tokens=2)
+        assert out == [[_greedy(p, 0), _greedy(p, 1)]
+                       for p in ([1, 2], [3], [4, 5, 6])]
+
+
+class TestFailoverDeterministicReplay:
+    PROMPTS = ([1, 2, 3], [4, 5], [6], [7, 8, 9, 10])
+    NEWS = (6, 5, 6, 4)
+
+    def _run(self, make_replicas):
+        streams = {i: [] for i in range(len(self.PROMPTS))}
+        router = _router(make_replicas(), max_failovers=2)
+        reqs = []
+        for i, (p, n) in enumerate(zip(self.PROMPTS, self.NEWS)):
+            cb = (lambda idx: lambda r, t, d: streams[idx].append((t, d)))(i)
+            reqs.append(router.submit(p, max_new_tokens=n, stream=cb))
+        done = router.drain(max_steps=100)
+        return router, reqs, streams, done
+
+    def test_crash_mid_decode_bit_identical_exactly_once(self):
+        """THE acceptance proof: killing a replica mid-decode reroutes
+        every in-flight request to the survivor; greedy streams are
+        bit-identical to an unfaulted run and each token is delivered
+        exactly once — no duplicate, no gap — across the failover."""
+        _, clean_reqs, clean_streams, _ = self._run(
+            lambda: [FakeReplica(), FakeReplica()])
+        router, reqs, streams, done = self._run(
+            lambda: [FakeReplica(),
+                     ChaosReplica(FakeReplica(), crash_at_step=2)])
+        assert router.stats()["failovers"] > 0
+        assert router.health[1].state == DEAD
+        for i, (req, clean) in enumerate(zip(reqs, clean_reqs)):
+            assert req.state == rq.FINISHED, (i, req.finish_reason)
+            # bit-identical to the unfaulted run AND to the closed form
+            assert req.tokens == clean.tokens == \
+                [_greedy(self.PROMPTS[i], p) for p in range(self.NEWS[i])]
+            # exactly-once delivery: the stream saw each position once,
+            # in order, done exactly on the last token
+            assert [t for t, _ in streams[i]] == req.tokens
+            assert [d for _, d in streams[i]] == \
+                [False] * (self.NEWS[i] - 1) + [True]
+            assert streams[i] == clean_streams[i]
+        # the crashed replica's in-flight work was replayed: positions
+        # already streamed were regenerated and swallowed
+        assert router.stats()["deduped_tokens"] > 0
+        assert router.stats()["replay_divergence"] == 0
+
+    def test_flaky_submit_retries_on_peer(self):
+        flaky = ChaosReplica(FakeReplica(), fail_submit_at=1,
+                             fail_submit_times=1)
+        router = _router([flaky, FakeReplica()])
+        r = router.submit([1, 2], max_new_tokens=2)
+        assert r.state == rq.QUEUED and r.replica == 1
+        assert router.health[0].consecutive_failures == 1
+        router.drain(max_steps=10)
+        assert r.state == rq.FINISHED
+
+    def test_flaky_steps_trip_breaker_and_fail_over(self):
+        flaky = ChaosReplica(FakeReplica(), fail_step_at=1,
+                             fail_step_times=3)
+        router = _router([flaky, FakeReplica()], failure_threshold=3)
+        r = router.submit([1, 2], max_new_tokens=3)
+        assert r.replica == 0  # tie -> first replica, the flaky one
+        other = router.submit([9], max_new_tokens=2)
+        assert other.replica == 1
+        router.drain(max_steps=50)
+        assert router.health[0].state == TRIPPED
+        assert r.state == rq.FINISHED  # failed over and replayed
+        assert r.tokens == [_greedy([1, 2], p) for p in range(3)]
+        assert r.attempt == 1
+
+    def test_max_failovers_exhausted_is_replica_lost(self):
+        router = _router(
+            [ChaosReplica(FakeReplica(), crash_at_step=1),
+             ChaosReplica(FakeReplica(), crash_at_step=1)],
+            max_failovers=1)
+        r = router.submit([1], max_new_tokens=4)
+        router.drain(max_steps=10)
+        assert r.state == rq.SHED and r.finish_reason == "replica_lost"
+        assert [h.state for h in router.health] == [DEAD, DEAD]
+
+    def test_stall_verdict_fails_over(self):
+        clk = _Clock()
+        stalled = ChaosReplica(FakeReplica(), stall_at_step=1,
+                               stall_secs=2.0, sleep=clk.advance)
+        router = _router([stalled, FakeReplica()], clock=clk,
+                         stall_timeout_secs=1.0)
+        r = router.submit([1, 2], max_new_tokens=3)
+        assert r.replica == 0
+        router.drain(max_steps=20)
+        assert router.health[0].state == TRIPPED
+        assert router.health[0].last_reason == "stall"
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert r.tokens == [_greedy([1, 2], p) for p in range(3)]
+
+    def test_default_budget_pinned_at_first_dispatch(self):
+        """A submit with max_new_tokens=0 takes the FIRST replica's
+        default budget and keeps it across failover — survivors with a
+        different default must not truncate or extend the replay."""
+
+        class BigDefault(FakeReplica):
+            def submit(self, prompt, max_new_tokens=0, **kw):
+                return super().submit(
+                    prompt, max_new_tokens=int(max_new_tokens) or 9, **kw)
+
+        router = _router([ChaosReplica(FakeReplica(), crash_at_step=3),
+                          BigDefault()])
+        r = router.submit([1, 2])          # replica 0's default: 4
+        assert r.max_new_tokens == 4       # pinned at first dispatch
+        router.drain(max_steps=30)
+        assert r.state == rq.FINISHED and r.attempt == 1
+        assert r.tokens == [_greedy([1, 2], p) for p in range(4)]
+
+    def test_failover_cancels_proxies_on_failed_replica(self):
+        """Failover releases the abandoned proxies' slots/blocks on the
+        failed replica (best-effort cancel): a TRIPPED replica that later
+        recovers through a probe is not haunted by zombie decodes."""
+
+        class CancelReplica(FakeReplica):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.cancelled = []
+
+            def cancel(self, request_id, reason="cancelled"):
+                self.cancelled.append((request_id, reason))
+                self.queue = [r for r in self.queue
+                              if r.request_id != request_id]
+                self.running = [r for r in self.running
+                                if r.request_id != request_id]
+                return True
+
+        flaky_inner = CancelReplica()
+        flaky = ChaosReplica(flaky_inner, fail_step_at=1,
+                             fail_step_times=3)
+        router = _router([flaky, FakeReplica()], failure_threshold=3)
+        r = router.submit([1, 2], max_new_tokens=3)
+        assert r.replica == 0
+        router.drain(max_steps=50)
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert flaky_inner.cancelled == \
+            [(f"{r.request_id}#a0", "failover")]
+        assert not flaky_inner.running and not flaky_inner.queue
+
+    def test_zombie_proxy_never_resurrects_done_handle(self):
+        """A replica with no cancel API keeps its abandoned proxy
+        decoding after recovery; the router's stream shim must drop the
+        stale attempt's callbacks — a handle already reported shed can
+        never flip back to running or re-invoke the client stream."""
+        clk = _Clock()
+        seen = []
+        # single replica: failover has no survivor, so the request sheds
+        flaky = ChaosReplica(FakeReplica(), fail_step_at=2,
+                             fail_step_times=3)
+        router = _router([flaky], clock=clk, failure_threshold=3)
+        r = router.submit([1, 2], max_new_tokens=6,
+                          stream=lambda _r, t, d: seen.append(t))
+        router.step()                       # one token streams
+        assert len(r.tokens) == 1
+        for _ in range(3):                  # flaky window trips breaker
+            router.step()
+        assert r.state == rq.SHED and r.finish_reason == "no_replica"
+        tokens_at_shed = list(r.tokens)
+        # breaker half-opens; the probe's step also advances the zombie
+        # (priority above the floor: with no routable replica the ladder
+        # is at its top tier, which sheds priority-0 work)
+        clk.advance(0.6)
+        probe = router.submit([9], max_new_tokens=2, priority=5)
+        assert probe.replica == 0
+        router.drain(max_steps=10)
+        assert probe.state == rq.FINISHED
+        # the zombie's extra tokens were dropped, not delivered
+        assert r.state == rq.SHED
+        assert r.tokens == tokens_at_shed and seen == tokens_at_shed
+
+    def test_stalled_step_harvests_before_failing_over(self):
+        """A slow-but-complete step delivered tokens; requests it
+        FINISHED must be harvested, not replayed on a survivor."""
+        clk = _Clock()
+        stalled = ChaosReplica(FakeReplica(), stall_at_step=2,
+                               stall_secs=2.0, sleep=clk.advance)
+        survivor = FakeReplica()
+        for _ in range(2):  # pre-load: both submits route to replica 0
+            survivor.submit([99], max_new_tokens=1)
+        router = _router([stalled, survivor], clock=clk,
+                         stall_timeout_secs=1.0)
+        short = router.submit([1, 2], max_new_tokens=2)  # done at step 2
+        long = router.submit([3], max_new_tokens=5)
+        assert short.replica == 0 and long.replica == 0
+        router.drain(max_steps=30)
+        # the stalled step finished `short` — delivered in place, no
+        # redundant replay; only `long` failed over
+        assert short.state == rq.FINISHED and short.attempt == 0
+        assert long.state == rq.FINISHED and long.attempt == 1
+        assert long.replica == 1
+        assert router.stats()["failovers"] == 1
+
+    def test_draining_replica_that_cannot_step_yields_its_work(self):
+        """Drain-in-place defers to liveness: a DRAINING replica whose
+        step keeps failing fails its work over after failure_threshold
+        instead of spinning drain() forever."""
+        flaky = ChaosReplica(FakeReplica(), fail_step_at=1,
+                             fail_step_times=10_000)
+        router = _router([flaky, FakeReplica()], failure_threshold=3)
+        r = router.submit([1, 2], max_new_tokens=3)
+        assert r.replica == 0
+        router.start_drain(0)
+        done = router.drain(max_steps=30)   # must terminate
+        assert r.state == rq.FINISHED and r.replica == 1 and r in done
+        assert r.tokens == [_greedy([1, 2], p) for p in range(3)]
+        assert router.health[0].state == DRAINING  # verdict unchanged
+
+    def test_probe_submit_exception_counts_as_failed_probe(self):
+        """A half-open probe whose submit raises is a failed probe: the
+        breaker re-trips and the backoff doubles — the broken replica is
+        not hammered on every submit."""
+        clk = _Clock()
+        flaky = ChaosReplica(FakeReplica(), fail_submit_at=1,
+                             fail_submit_times=10_000)
+        router = _router([flaky], clock=clk, failure_threshold=1)
+        router.health[0].record_stall()     # trip 1: probe at +0.5
+        clk.advance(0.6)
+        r = router.submit([1], max_new_tokens=2, priority=5)
+        assert r.state == rq.SHED           # probe submit raised
+        h = router.health[0]
+        assert h.trips == 2                 # the probe counted
+        assert not h.can_probe(clk())       # backoff doubled: no hammer
+        assert h.next_probe_ts == pytest.approx(clk() + 1.0)
+
+    def test_replay_divergence_detected_not_restreamed(self):
+        class EvilReplica(FakeReplica):
+            def _token(self, req, pos):
+                return super()._token(req, pos) + 1  # broken determinism
+
+        telem = FakeTelemetry()
+        router = _router(
+            [ChaosReplica(FakeReplica(), crash_at_step=2), EvilReplica()],
+            telemetry=telem)
+        seen = []
+        r = router.submit([1, 2], max_new_tokens=4,
+                          stream=lambda _r, t, d: seen.append(t))
+        router.drain(max_steps=20)
+        assert router.stats()["replay_divergence"] > 0
+        assert telem.of("replay.divergence")
+        # already-streamed positions kept their original tokens: the
+        # divergent replay was counted and swallowed, never re-streamed
+        assert seen[:1] == [_greedy([1, 2], 0)]
+        assert r.tokens[:1] == seen[:1]
+
+    def test_failover_hands_survivor_remaining_deadline_only(self):
+        """The client's deadline does not restart on failover: the
+        survivor's scheduler stamps a fresh submit_ts, so it must be
+        handed only the remaining budget."""
+
+        class RecordingReplica(FakeReplica):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.deadlines = []
+
+            def submit(self, prompt, **kw):
+                self.deadlines.append(kw.get("deadline_ms"))
+                return super().submit(prompt, **kw)
+
+        clk = _Clock()
+        survivor = RecordingReplica()
+        router = _router([ChaosReplica(FakeReplica(), crash_at_step=1),
+                          survivor], clock=clk)
+        r = router.submit([1, 2], max_new_tokens=3, deadline_ms=100.0)
+        assert r.replica == 0
+        clk.advance(0.04)                   # 40ms of the budget burned
+        router.step()                       # crash -> failover
+        assert r.replica == 1
+        assert survivor.deadlines == [pytest.approx(60.0)]
+        router.drain(max_steps=10)
+        assert r.state == rq.FINISHED
+
+    def test_over_deadline_work_sheds_instead_of_replaying(self):
+        """A request already past its deadline when its replica dies is
+        shed as 'deadline' — never replayed (1+max_failovers)x late."""
+        clk = _Clock()
+        router = _router([ChaosReplica(FakeReplica(), crash_at_step=1),
+                          FakeReplica()], clock=clk)
+        r = router.submit([1, 2], max_new_tokens=3, deadline_ms=100.0)
+        clk.advance(0.2)                    # 200ms > the 100ms budget
+        router.step()                       # crash -> failover path
+        assert r.state == rq.SHED and r.finish_reason == "deadline"
+
+    def test_sampled_prefix_never_spliced_on_failover(self):
+        """With do_sample enabled the replay is not bit-reproducible: a
+        request that already streamed tokens sheds loudly on failover
+        instead of delivering a garbled splice of two samples."""
+
+        class SamplingReplica(FakeReplica):
+            class config:
+                do_sample = True
+
+        seen = []
+        router = _router([ChaosReplica(SamplingReplica(), crash_at_step=2),
+                          FakeReplica()])
+        r = router.submit([1, 2], max_new_tokens=4,
+                          stream=lambda _r, t, d: seen.append(t))
+        router.drain(max_steps=10)
+        assert r.state == rq.SHED
+        assert r.finish_reason == "nondeterministic_replay"
+        # the client saw exactly the pre-crash prefix, nothing spliced
+        assert seen == r.tokens and len(seen) == 1
+
+    def test_sampling_survivor_skipped_for_delivered_prefix(self):
+        """A greedy request with a delivered prefix must not resume on a
+        SAMPLING survivor (the splice contract needs greedy on both
+        sides); with no greedy survivor it sheds loudly."""
+
+        class SamplingReplica(FakeReplica):
+            class config:
+                do_sample = True
+
+        router = _router([ChaosReplica(FakeReplica(), crash_at_step=2),
+                          SamplingReplica()])
+        r = router.submit([1, 2], max_new_tokens=4)
+        router.drain(max_steps=10)
+        assert r.state == rq.SHED
+        assert r.finish_reason == "nondeterministic_replay"
+
+    def test_sampling_failover_ok_with_nothing_streamed(self):
+        """A sampling request with NO tokens delivered yet fails over
+        fine — a fresh sample has nothing to splice."""
+
+        class SamplingReplica(FakeReplica):
+            class config:
+                do_sample = True
+
+        router = _router([ChaosReplica(SamplingReplica(), crash_at_step=1),
+                          SamplingReplica()])
+        r = router.submit([1, 2], max_new_tokens=3)
+        router.drain(max_steps=10)
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert r.attempt == 1
+
+
+class TestBreakerProbes:
+    def test_half_open_probe_closes_breaker(self):
+        clk = _Clock()
+        tripped = FakeReplica()
+        router = _router([tripped, FakeReplica(queue_cap=0)], clock=clk)
+        router.health[0].record_stall()
+        # backoff not elapsed + peer full: nothing routable
+        lost = router.submit([1], max_new_tokens=2)
+        assert lost.state == rq.SHED
+        clk.advance(0.6)  # past probe_backoff_secs=0.5
+        probe = router.submit([2], max_new_tokens=2)
+        assert probe.state == rq.QUEUED and probe.replica == 0
+        assert router.health[0].probing
+        # only ONE probe at a time
+        second = router.submit([3], max_new_tokens=2)
+        assert second.state == rq.SHED
+        router.drain(max_steps=10)
+        assert probe.state == rq.FINISHED
+        assert router.health[0].state == HEALTHY
+        assert router.health[0].trip_streak == 0  # backoff reset
+        assert router.health[0].trips == 1        # lifetime count kept
+
+    def test_probe_shed_by_replica_is_inconclusive(self):
+        clk = _Clock()
+        router = _router([FakeReplica(queue_cap=0),
+                          FakeReplica(queue_cap=0)], clock=clk)
+        router.health[0].record_stall()
+        clk.advance(0.6)
+        probe = router.submit([1], max_new_tokens=2)
+        # replica-side queue_full: no verdict either way
+        assert probe.state == rq.SHED
+        assert router.health[0].state == TRIPPED
+        assert not router.health[0].probing  # another probe may run
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder
+# ---------------------------------------------------------------------------
+def _ladder_router(depth, **cfg):
+    stub = GaugeStub(depth=depth, cap=10, queue_cap=100)
+    cfg.setdefault("ladder_enter", [0.5, 0.8, 1.0])
+    cfg.setdefault("ladder_exit", [0.2, 0.4, 0.6])
+    cfg.setdefault("ladder_dwell_steps", 3)
+    cfg.setdefault("clamp_max_new_tokens", 3)
+    telem = FakeTelemetry()
+    return _router([stub], telemetry=telem, **cfg), stub, telem
+
+
+class TestDegradationLadder:
+    def test_tier_entry_is_immediate_and_clamps(self):
+        router, stub, telem = _ladder_router(depth=6)  # score 0.6
+        router.step()
+        assert router.tier == 1
+        r = router.submit([1, 2], max_new_tokens=100)
+        assert r.max_new_tokens == 3  # clamped at tier 1
+        assert r.proxy.max_new_tokens == 3
+        assert telem.of("tier")[0]["data"]["to_tier"] == 1
+
+    def test_step_result_not_mutated_by_later_shed(self):
+        """step() hands back a snapshot: a submit-time shed after the
+        step must not retroactively grow the caller's result list."""
+        router, _, _ = _ladder_router(depth=9, shed_priority_floor=1)
+        done = router.step()               # tier 2 now
+        before = len(done)
+        shed = router.submit([1], max_new_tokens=2, priority=0)
+        assert shed.state == rq.SHED
+        assert len(done) == before         # caller's list untouched
+
+    def test_tier1_clamp_never_raises_default_budget(self):
+        """A default-budget submit under tier 1 resolves to
+        min(replica default, clamp): degraded mode must never hand a
+        request MORE decode work than full service would."""
+
+        class SmallDefault(GaugeStub):
+            class config:
+                default_max_new_tokens = 2
+
+        stub = SmallDefault(depth=6, cap=10, queue_cap=100)
+        router = _router([stub], ladder_enter=[0.5, 0.8, 1.0],
+                         ladder_exit=[0.2, 0.4, 0.6],
+                         clamp_max_new_tokens=5)
+        router.step()
+        assert router.tier == 1
+        r = router.submit([1, 2])          # no explicit budget
+        assert r.max_new_tokens == 2       # replica default < clamp
+        big = router.submit([3], max_new_tokens=100)
+        assert big.max_new_tokens == 5     # explicit budgets still clamp
+
+    def test_clamp_budget_not_pinned_from_failed_candidate(self):
+        """The tier-1 resolved budget pins only from the admission that
+        ACCEPTED: a candidate whose submit raises must not leak its
+        default into the request the next candidate serves."""
+
+        class DefaultA(GaugeStub):
+            class config:
+                default_max_new_tokens = 8
+
+        class DefaultB(GaugeStub):
+            class config:
+                default_max_new_tokens = 32
+
+        flaky = ChaosReplica(DefaultA(depth=6, cap=10, queue_cap=100),
+                             fail_submit_at=1, fail_submit_times=1)
+        router = _router([flaky, DefaultB(depth=6, cap=10, queue_cap=100)],
+                         ladder_enter=[0.5, 0.8, 1.0],
+                         ladder_exit=[0.2, 0.4, 0.6],
+                         clamp_max_new_tokens=16)
+        router.step()
+        assert router.tier == 1
+        r = router.submit([1, 2])          # default budget
+        assert r.replica == 1
+        assert r.max_new_tokens == 16      # min(B's 32, clamp 16), not 8
+
+    def test_tier2_sheds_below_priority_floor(self):
+        router, _, _ = _ladder_router(depth=9, shed_priority_floor=1)
+        router.step()
+        assert router.tier == 2
+        low = router.submit([1], max_new_tokens=2, priority=0)
+        assert low.state == rq.SHED and low.finish_reason == "tier_shed"
+        high = router.submit([2], max_new_tokens=2, priority=1)
+        assert high.state == rq.QUEUED
+
+    def test_tier3_brownout_smallest_bucket_only(self):
+        router, _, _ = _ladder_router(depth=10)  # score 1.0 -> tier 3
+        router.step()
+        assert router.tier == 3
+        long = router.submit([1] * 9, max_new_tokens=2, priority=5)
+        assert long.state == rq.SHED and long.finish_reason == "brownout"
+        short = router.submit([1] * 8, max_new_tokens=2, priority=5)
+        assert short.state == rq.QUEUED  # fits the smallest bucket (8)
+
+    def test_exit_needs_dwell_hysteresis(self):
+        router, stub, _ = _ladder_router(depth=6)
+        router.step()
+        assert router.tier == 1
+        stub.depth = 1  # score 0.1, below exit[0]=0.2
+        router.step()
+        router.step()
+        assert router.tier == 1  # dwell=3 not yet served
+        router.step()
+        assert router.tier == 0
+        assert router.stats()["tier_transitions"] == 2
+
+    def test_borderline_score_never_flaps(self):
+        router, stub, telem = _ladder_router(depth=6)
+        router.step()
+        for depth in (4, 6, 4, 6, 4, 6):  # oscillates between thresholds
+            stub.depth = depth
+            router.step()
+        assert router.tier == 1  # entered once, never exited
+        assert len(telem.of("tier")) == 1
+
+    def test_total_outage_is_full_overload(self):
+        router = _router([FakeReplica()])
+        router.health[0].record_crash()
+        assert router.overload() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# rolling restarts + telemetry stream
+# ---------------------------------------------------------------------------
+class TestRollingRestart:
+    def test_drain_finishes_in_flight_then_reactivate_swaps(self):
+        a, b = FakeReplica(), FakeReplica()
+        telem = FakeTelemetry()
+        router = _router([a, b], telemetry=telem)
+        r = router.submit([1, 2], max_new_tokens=3)
+        assert r.replica == 0
+        router.start_drain(0)
+        fresh = router.submit([3], max_new_tokens=2)
+        assert fresh.replica == 1  # draining takes no new work
+        router.drain(max_steps=10)
+        assert r.state == rq.FINISHED  # in-flight finished in place
+        assert telem.of("replica.drained")
+        replacement = FakeReplica()
+        router.reactivate(0, replica=replacement)
+        assert router.replicas[0] is replacement
+        assert router.health[0].state == HEALTHY
+        nxt = router.submit([4], max_new_tokens=2)
+        assert nxt.replica == 0  # back in rotation, least loaded
+
+    def test_stall_while_draining_finishes_in_place(self):
+        """The drain-in-place contract holds even on a stall verdict:
+        a slow step on a DRAINING replica must not yank its in-flight
+        work to a survivor (mirrors _replica_failed's DRAINING guard)."""
+        clk = _Clock()
+        telem = FakeTelemetry()
+        slow = ChaosReplica(FakeReplica(), stall_at_step=1,
+                            stall_secs=2.0, sleep=clk.advance)
+        router = _router([slow, FakeReplica()], clock=clk,
+                         telemetry=telem, stall_timeout_secs=1.0)
+        r = router.submit([1, 2], max_new_tokens=3)
+        assert r.replica == 0
+        router.start_drain(0)
+        router.drain(max_steps=20)
+        assert r.state == rq.FINISHED and r.replica == 0  # in place
+        assert router.stats()["failovers"] == 0
+        assert router.health[0].state == DRAINING
+        assert telem.of("replica.drained")
+
+    def test_reactivate_with_work_still_assigned_fails_it_over(self):
+        """Swapping in a fresh engine while the old one still holds
+        in-flight work must fail that work over first — orphaned proxies
+        on a discarded engine would hang drain() forever."""
+        a, b = FakeReplica(), FakeReplica()
+        router = _router([a, b])
+        r = router.submit([1, 2], max_new_tokens=3)
+        assert r.replica == 0
+        router.start_drain(0)
+        router.reactivate(0, replica=FakeReplica())  # before drained
+        assert r.replica == 1 and r.attempt == 1     # failed over
+        done = router.drain(max_steps=20)
+        assert r.state == rq.FINISHED and r in done
+        assert r.tokens == [_greedy([1, 2], p) for p in range(3)]
+        assert router.health[0].state == HEALTHY
+
+    def test_router_events_on_stream(self):
+        telem = FakeTelemetry()
+        router = _router(
+            [ChaosReplica(FakeReplica(), crash_at_step=1), FakeReplica()],
+            telemetry=telem)
+        router.submit([1], max_new_tokens=2)
+        router.drain(max_steps=10)
+        names = {e["name"] for e in telem.events}
+        assert {"replica.state", "failover", "request.finish"} <= names
+        states = telem.of("replica.state")
+        assert states[0]["data"]["to_state"] == DEAD
+        fo = telem.of("failover")[0]["data"]
+        assert fo["from_replica"] == 0 and fo["attempt"] == 1
+
+
+class TestRouterConfigValidation:
+    def test_prebuilt_replicas_honor_explicit_router_block(self):
+        """init_serving with a prebuilt replica list must apply the
+        caller's serving.router block, not silently fall back to
+        defaults when the replicas carry no config of their own."""
+        import deepspeed_tpu
+
+        router = deepspeed_tpu.init_serving(
+            None, serving={"router": {"max_failovers": 5,
+                                      "failure_threshold": 7}},
+            replicas=[FakeReplica(), FakeReplica()])
+        assert isinstance(router, ReplicaRouter)
+        assert router.config.max_failovers == 5
+        assert router.config.failure_threshold == 7
+
+    def test_ladder_shape_and_hysteresis(self):
+        with pytest.raises(ValueError):
+            RouterConfig(ladder_enter=[0.5], ladder_exit=[0.2, 0.3])
+        with pytest.raises(ValueError):
+            RouterConfig(ladder_enter=[0.5, 0.8], ladder_exit=[0.6, 0.4])
+        with pytest.raises(ValueError):
+            RouterConfig(ladder_enter=[0.9, 0.5], ladder_exit=[0.2, 0.1])
+        with pytest.raises(ValueError):
+            RouterConfig(replicas=0)
+        with pytest.raises(ValueError):
+            RouterConfig(max_failovers=0)
+
+    def test_router_accepts_dict_config(self):
+        router = ReplicaRouter([FakeReplica()],
+                               config={"max_failovers": 5})
+        assert router.config.max_failovers == 5
+
+    def test_router_needs_a_replica(self):
+        with pytest.raises(ValueError):
+            ReplicaRouter([])
+
+
+# ---------------------------------------------------------------------------
+# tooling: telemetry report + import hygiene
+# ---------------------------------------------------------------------------
+class TestTelemetryReportRouterSection:
+    def _write_events(self, tmp_path):
+        from deepspeed_tpu.telemetry.events import dumps, make_event
+
+        telem = FakeTelemetry()
+        router = _router(
+            [ChaosReplica(FakeReplica(), crash_at_step=2), FakeReplica()],
+            telemetry=telem)
+        router.submit([1, 2], max_new_tokens=4)
+        router.submit([3], max_new_tokens=4)
+        router.drain(max_steps=20)
+        path = tmp_path / "telemetry.jsonl"
+        with open(path, "w") as f:
+            for e in telem.events:
+                f.write(dumps(make_event("router", e["name"], e["step"], 0,
+                                         e["data"])) + "\n")
+        return str(path)
+
+    def test_aggregate_and_render(self, tmp_path):
+        from tools.telemetry_report import aggregate, render
+
+        from deepspeed_tpu.telemetry.events import load_events
+
+        path = self._write_events(tmp_path)
+        agg = aggregate(load_events(path))["router"]
+        assert agg["failovers"] >= 1
+        assert agg["finished"] == 2
+        assert agg["replica_states"]["0"][0]["to"] == "dead"
+        text = render(path)
+        assert "router:" in text and "failovers" in text
+        assert "replica 0: dead" in text
+        md = render(path, markdown=True)
+        assert "### router:" in md and "| replica | transitions |" in md
+
+    def test_breaker_trips_counted_from_trip_events(self, tmp_path):
+        """Trip count comes from dedicated breaker.trip events: re-trips
+        while already TRIPPED (failed probes) emit no state change, and
+        a max_trips death transitions to dead — counting 'tripped'
+        states would undercount both."""
+        from tools.telemetry_report import aggregate, render
+
+        from deepspeed_tpu.telemetry.events import (dumps, load_events,
+                                                    make_event)
+
+        path = tmp_path / "telemetry.jsonl"
+        evs = ([make_event("router", "breaker.trip", i, 0,
+                           {"replica": 0, "trips": i + 1, "reason": "s"})
+                for i in range(3)]
+               + [make_event("router", "replica.state", 0, 0,
+                             {"replica": 0, "from_state": "healthy",
+                              "to_state": "tripped", "reason": "s"}),
+                  make_event("router", "replica.state", 9, 0,
+                             {"replica": 0, "from_state": "tripped",
+                              "to_state": "dead", "reason": "max_trips"})])
+        path.write_text("\n".join(dumps(e) for e in evs) + "\n")
+        agg = aggregate(load_events(str(path)))["router"]
+        assert agg["breaker"]["trips"] == 3
+        assert "3 breaker trips" in render(str(path))
+
+    def test_empty_stream_renders_no_router_section(self, tmp_path):
+        from tools.telemetry_report import render
+
+        path = tmp_path / "telemetry.jsonl"
+        path.write_text("")
+        assert "router" not in render(str(path))
+
+
+class TestServingPolicyImportHygiene:
+    def test_policy_modules_never_import_jax(self):
+        """Tier-1 pin: the serving policy modules (scheduler, router,
+        health) and their intra-package module-level import closure stay
+        jax-free, so host-side routing/scheduling tests run in
+        milliseconds. The walk follows real module files (the lazy
+        package roots are exempt — their jax pulls are behind function
+        boundaries and ``__getattr__``)."""
+        import ast
+        import os
+
+        import deepspeed_tpu
+
+        pkg_root = os.path.dirname(deepspeed_tpu.__file__)
+
+        def mod_file(name):
+            rel = name.split(".")[1:]
+            path = os.path.join(pkg_root, *rel)
+            if os.path.isfile(path + ".py"):
+                return path + ".py"
+            if os.path.isdir(path):
+                return os.path.join(path, "__init__.py")
+            return None
+
+        start = ["deepspeed_tpu.serving.scheduler",
+                 "deepspeed_tpu.serving.router",
+                 "deepspeed_tpu.serving.health"]
+        seen, stack, offenders = set(), list(start), []
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            top = name.split(".")[0]
+            if top in ("jax", "jaxlib", "flax"):
+                offenders.append(name)
+                continue
+            if top != "deepspeed_tpu":
+                continue  # numpy/pydantic/stdlib: fine
+            path = mod_file(name)
+            if path is None or path.endswith("__init__.py"):
+                # package roots are lazy by contract; their submodules
+                # are followed only when explicitly imported
+                continue
+            tree = ast.parse(open(path).read(), path)
+            for node in tree.body:  # MODULE level only, by design
+                if isinstance(node, ast.Import):
+                    stack.extend(a.name for a in node.names)
+                elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                    stack.append(node.module)
+                    # `from pkg import mod` pulls pkg.mod when that is
+                    # a module file — follow it too
+                    for a in node.names:
+                        child = f"{node.module}.{a.name}"
+                        if child.startswith("deepspeed_tpu") \
+                                and mod_file(child):
+                            stack.append(child)
+        assert not offenders, (
+            f"serving policy modules reached jax at import time via "
+            f"{offenders} — host-side routing must stay device-free")
+        # the walk actually covered the policy surface
+        assert {"deepspeed_tpu.serving.config",
+                "deepspeed_tpu.serving.request"} <= seen
+
+
+# ---------------------------------------------------------------------------
+# heavy: real two-replica engines behind the router
+# ---------------------------------------------------------------------------
+def _tiny_engine(seed=0, serving=None):
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.parallel.topology import reset_topology
+
+    reset_topology()
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    return cfg, deepspeed_tpu.init_inference(
+        GPT2LMHeadModel(cfg), dtype="fp32", seed=seed,
+        serving=serving or {"block_size": 8, "decode_slots": 2,
+                            "default_max_new_tokens": 4})
+
+
+@pytest.mark.heavy
+class TestRouterOverRealEngines:
+    def test_replica_killed_mid_decode_bit_identical(self):
+        """Acceptance on the real substrate: two ServingEngines with
+        identical params behind the router; replica 1 crashes mid-decode
+        and every stream finishes bit-identical to the clean run."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, e0 = _tiny_engine()
+        _, e1 = _tiny_engine()
+        e1.params = e0.params
+        srv0, srv1 = ServingEngine(e0), ServingEngine(e1)
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(1, 256, n) for n in (5, 9, 3, 12)]
+        news = [5, 4, 6, 3]
+
+        def run(replicas):
+            router = ReplicaRouter(replicas,
+                                   config={"max_failovers": 2})
+            streams = {i: [] for i in range(len(prompts))}
+            reqs = []
+            for i, (p, n) in enumerate(zip(prompts, news)):
+                cb = (lambda ix: lambda r, t, d:
+                      streams[ix].append(t))(i)
+                reqs.append(router.submit(p, max_new_tokens=n, stream=cb))
+            router.drain(max_steps=200)
+            return router, reqs, streams
+
+        _, clean_reqs, clean_streams = run([srv0, srv1])
+        # fresh engines for the chaos leg (the clean leg consumed state)
+        _, f0 = _tiny_engine()
+        _, f1 = _tiny_engine()
+        f1.params = f0.params
+        router, reqs, streams = run(
+            [ServingEngine(f0),
+             ChaosReplica(ServingEngine(f1), crash_at_step=2)])
+        assert router.stats()["failovers"] > 0
+        for i, (req, clean) in enumerate(zip(reqs, clean_reqs)):
+            assert req.state == rq.FINISHED, (i, req.finish_reason)
+            assert req.tokens == clean.tokens
+            assert streams[i] == clean_streams[i] == req.tokens
+        assert router.stats()["replay_divergence"] == 0
+
+    def test_init_serving_builds_router_from_config(self):
+        import deepspeed_tpu
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        from deepspeed_tpu.parallel.topology import reset_topology
+
+        reset_topology()
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        router = deepspeed_tpu.init_serving(
+            GPT2LMHeadModel(cfg), dtype="fp32",
+            serving={"block_size": 8, "decode_slots": 2,
+                     "router": {"replicas": 2, "max_failovers": 1}})
+        assert isinstance(router, ReplicaRouter)
+        assert len(router.replicas) == 2
+        assert router.config.max_failovers == 1
+        out = router.generate_batch([[5, 6, 7], [9, 10]],
+                                    max_new_tokens=2)
+        assert all(t is not None and len(t) == 2 for t in out)
+        # replicas share one param init (same seed): greedy agreement
+        ref = router.replicas[1].generate_batch([[5, 6, 7]],
+                                                max_new_tokens=2)
+        assert ref[0] == out[0]
+        router.destroy()
+
+    def test_init_serving_without_router_is_single_engine(self):
+        from deepspeed_tpu.serving import ServingEngine
+
+        import deepspeed_tpu
+
+        _, engine = _tiny_engine()
+        srv = deepspeed_tpu.init_serving(engine)
+        assert isinstance(srv, ServingEngine)
+
+    def test_init_serving_engine_carried_router_block_not_dropped(self):
+        """A prebuilt InferenceEngine whose own serving config carries a
+        router block must not silently get single-engine serving: one
+        engine cannot be N replicas, so the call raises with guidance."""
+        import deepspeed_tpu
+
+        _, engine = _tiny_engine(
+            serving={"block_size": 8, "decode_slots": 2,
+                     "router": {"replicas": 2}})
+        with pytest.raises(ValueError,
+                           match="one InferenceEngine is one replica"):
+            deepspeed_tpu.init_serving(engine)
+
+    def test_init_serving_router_enabled_false_is_single_engine(self):
+        """The standard config off switch: a router block with
+        enabled=false is identical to no block at all."""
+        import deepspeed_tpu
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        from deepspeed_tpu.parallel.topology import reset_topology
+        from deepspeed_tpu.serving import ServingEngine
+
+        reset_topology()
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        srv = deepspeed_tpu.init_serving(
+            GPT2LMHeadModel(cfg), dtype="fp32",
+            serving={"block_size": 8, "decode_slots": 2,
+                     "router": {"enabled": False, "replicas": 2}})
+        assert isinstance(srv, ServingEngine)
+        srv.destroy()
+
+    def test_engine_cancel_releases_slot_and_blocks(self):
+        """ServingEngine.cancel (the router's failover seam): a
+        mid-decode abandon releases the slot, KV blocks and token budget
+        and records the request as shed."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, eng = _tiny_engine()
+        srv = ServingEngine(eng)
+        free0 = srv.gauges()["free_blocks"]
+        keep = srv.submit([5, 6, 7], max_new_tokens=6)
+        drop = srv.submit([9, 10], max_new_tokens=6)
+        srv.step()  # both admitted, decoding
+        assert srv.gauges()["slots_busy"] == 2
+        assert srv.cancel(drop.request_id, "failover")
+        assert drop.state == rq.SHED
+        assert drop.finish_reason == "failover"
+        assert srv.gauges()["slots_busy"] == 1
+        assert not srv.cancel(drop.request_id)  # already gone
+        srv.drain()
+        assert keep.state == rq.FINISHED and len(keep.tokens) == 6
+        assert srv.gauges()["free_blocks"] == free0
+        assert srv.stats()["shed_reasons"] == {"failover": 1}
+        srv.destroy()
+
+    def test_router_block_leaves_decode_hlo_byte_identical(self):
+        """Zero-overhead pin (the PR 2-5 convention): the router is pure
+        host-side policy — a serving config WITH a router block compiles
+        the exact same decode program as one without."""
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.serving import ServingEngine
+
+        texts = []
+        for extra in ({}, {"router": {"replicas": 2}}):
+            _, eng = _tiny_engine(serving={"block_size": 8,
+                                           "decode_slots": 2, **extra})
+            srv = ServingEngine(eng)
+            fn = srv._build_decode()
+            lowered = fn.lower(
+                eng.params, srv.cache,
+                jnp.zeros((2, 1), jnp.int32),
+                jnp.asarray(srv._tables), jnp.asarray(srv._lengths),
+                srv._next_rng())
+            texts.append(lowered.compile().as_text())
+            srv.destroy()
+        assert texts[0] == texts[1]
+
+    def test_step_gauges_on_event_stream(self):
+        """Satellite: per-step serving telemetry carries the load gauges
+        the router routes by — queue_depth / slots_busy / free_blocks
+        from the public surface, not private scheduler state."""
+        import deepspeed_tpu
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        from deepspeed_tpu.parallel.topology import reset_topology
+        from deepspeed_tpu.serving import ServingEngine
+
+        reset_topology()
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        engine = deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype="fp32",
+            serving={"block_size": 8, "decode_slots": 2},
+            telemetry={"enabled": True, "jsonl": False, "memory": False,
+                       "compile_watchdog": False})
+        srv = ServingEngine(engine)
+        srv.submit([5, 6, 7], max_new_tokens=3)
+        srv.drain()
+        gauges = [e for e in engine.telemetry.tail(100)
+                  if e["kind"] == "serving" and e["name"] == "step.gauges"]
+        assert gauges, "no step.gauges events on the stream"
+        for e in gauges:
+            assert {"queue_depth", "queue_capacity", "slots_busy",
+                    "slots_total", "free_blocks",
+                    "committed_tokens"} <= set(e["data"])
+        # post-drain gauges match the live surface: all idle
+        assert srv.gauges()["slots_busy"] == 0
+        assert srv.gauges()["free_blocks"] == srv.num_blocks - 1

@@ -145,6 +145,26 @@ class TestScheduler:
         assert not sched.submit(over)
         assert over.finish_reason == "too_long"
 
+    def test_cancel_releases_queued_and_running(self):
+        clk = _Clock()
+        sched, blocks = _sched(clk)
+        a = Request(prompt=[1] * 4, request_id="a")   # will be running
+        b = Request(prompt=[1] * 4, request_id="b")
+        c = Request(prompt=[1] * 4, request_id="c")   # stays queued
+        assert all(sched.submit(r) for r in (a, b, c))
+        sched.admit()
+        free_before = blocks.num_free
+        assert sched.cancel("a", "failover") is a
+        assert a.state == SHED and a.finish_reason == "failover"
+        assert sched.slots[a.slot] is None            # slot returned
+        assert blocks.num_free > free_before          # blocks returned
+        assert sched.cancel("c", "failover") is c     # queued leg
+        assert c.state == SHED and "c" not in sched._live_ids
+        assert sched.cancel("a", "failover") is None  # already gone
+        assert sched.committed_tokens == \
+            b.prompt_len + b.max_new_tokens
+        assert sched.stats["shed_reasons"]["failover"] == 2
+
     def test_inflight_tokens_shed_policy(self):
         clk = _Clock()
         sched, _ = _sched(clk, max_inflight_tokens=20, shed_policy="shed")
@@ -258,6 +278,106 @@ class TestScheduler:
         assert sched.committed_tokens == 0
         assert sched.stats["submitted"] == sched.stats["finished"] == 1
         assert not sched.pending
+
+    def test_shed_timestamps_use_callers_timebase(self):
+        """A shed under an injected `now` must stamp finish_ts from that
+        same timebase — never from a live clock read that would mix
+        fake-clock and wall-clock times in one record."""
+        clk = _Clock()
+        sched, _ = _sched(clk, max_queue_depth=1, deadline_ms=100.0)
+        clk.t = 50.0  # a drifted live clock the shed must NOT consult
+        a = Request(prompt=[1] * 4)
+        assert sched.submit(a, now=2.0)
+        b = Request(prompt=[1] * 4)
+        assert not sched.submit(b, now=2.5)  # queue_full
+        assert b.finish_ts == 2.5 and b.submit_ts == 2.5
+        _, shed = sched.admit(now=3.0)  # a's 100ms deadline blew at 2.1
+        assert shed == [a] and a.finish_ts == 3.0
+
+    def test_gauges_track_queue_slots_and_commitment(self):
+        clk = _Clock()
+        sched, _ = _sched(clk)
+        assert sched.gauges() == {
+            "queue_depth": 0, "queue_capacity": 64, "slots_busy": 0,
+            "slots_total": 2, "committed_tokens": 0}
+        reqs = [Request(prompt=[1] * 4, max_new_tokens=4)
+                for _ in range(3)]
+        for r in reqs:
+            sched.submit(r)
+        assert sched.gauges()["queue_depth"] == 3
+        assert sched.gauges()["committed_tokens"] == 24
+        sched.admit()
+        g = sched.gauges()
+        assert g["queue_depth"] == 1 and g["slots_busy"] == 2
+        sched.finish(reqs[0], "eos")
+        g = sched.gauges()
+        assert g["slots_busy"] == 1 and g["committed_tokens"] == 16
+
+
+class TestSchedulerAccountingFuzz:
+    """Satellite: randomized submit/admit/finish/shed sequences keep
+    `committed_tokens`, `_live_ids`, and the block-pool free list
+    mutually consistent — the admission state machine can never leak a
+    token budget, a request id, or a cache block."""
+
+    def _invariants(self, sched, blocks):
+        live = list(sched.queue) + [r for r in sched.slots if r is not None]
+        assert sched.committed_tokens == sum(
+            r.prompt_len + r.max_new_tokens for r in live)
+        assert sched._live_ids == {r.request_id for r in live}
+        # every allocated block belongs to a RUNNING request, exactly
+        allocated = blocks.num_blocks - 1 - blocks.num_free
+        assert allocated == sum(
+            blocks.blocks_needed(r.prompt_len + r.max_new_tokens)
+            for r in sched.slots if r is not None)
+
+    def test_random_walk_conserves_accounting(self):
+        rng = np.random.default_rng(42)
+        clk = _Clock()
+        sched, blocks = _sched(clk, max_queue_depth=6, num_blocks=9,
+                               max_inflight_tokens=80, deadline_ms=200.0)
+        next_id = 0
+        for step in range(600):
+            op = rng.choice(["submit", "admit", "finish", "cancel",
+                             "tick"])
+            if op == "submit":
+                if rng.random() < 0.15 and sched._live_ids:
+                    rid = sorted(sched._live_ids)[0]  # duplicate id
+                else:
+                    rid, next_id = f"z-{next_id}", next_id + 1
+                req = Request(
+                    prompt=[1] * int(rng.integers(1, 80)),
+                    max_new_tokens=int(rng.integers(1, 12)),
+                    request_id=rid,
+                    deadline_ms=float(rng.choice([0.0, 50.0, 500.0])))
+                sched.submit(req, now=clk.t)
+            elif op == "admit":
+                sched.admit(now=clk.t)
+            elif op == "finish":
+                running = [r for r in sched.slots if r is not None]
+                if running:
+                    pick = running[int(rng.integers(len(running)))]
+                    sched.finish(pick, "eos", now=clk.t)
+            elif op == "cancel":
+                if sched._live_ids:  # queued or running, either works
+                    ids = sorted(sched._live_ids)
+                    sched.cancel(ids[int(rng.integers(len(ids)))],
+                                 "cancelled", now=clk.t)
+            else:
+                clk.t += float(rng.random() * 0.2)
+            self._invariants(sched, blocks)
+        # drain everything: accounting returns to zero
+        clk.t += 10.0
+        for _ in range(50):
+            sched.admit(now=clk.t)
+            for r in [r for r in sched.slots if r is not None]:
+                sched.finish(r, "eos", now=clk.t)
+        assert not sched.pending
+        assert sched.committed_tokens == 0 and not sched._live_ids
+        assert blocks.num_free == blocks.num_blocks - 1
+        s = sched.stats
+        assert s["submitted"] == s["finished"] + s["shed"] + \
+            len(sched.queue)
 
 
 class TestWatchdogTouch:

@@ -44,6 +44,11 @@ def aggregate(events: List[Dict]) -> Dict:
     wallclock: Dict[str, List[float]] = {}
     steps = {"count": 0, "last": 0}
     faults = {"by_name": {}, "recent": []}
+    router = {"replica_states": {}, "breaker": {"trips": 0, "probes": 0,
+                                                "closes": 0},
+              "failovers": 0, "tier_transitions": [], "last_tier": 0,
+              "finished": 0, "shed": 0, "shed_reasons": {},
+              "replay_divergence": 0, "events": 0}
     for e in events:
         kind, name, data = e.get("kind"), e.get("name"), e.get("data", {})
         if kind == "compile":
@@ -81,6 +86,36 @@ def aggregate(events: List[Dict]) -> Dict:
             faults["recent"].append(
                 {"name": name, "step": e.get("step"), **data})
             faults["recent"] = faults["recent"][-20:]
+        elif kind == "router":
+            router["events"] += 1
+            if name == "replica.state":
+                rep = str(data.get("replica"))
+                router["replica_states"].setdefault(rep, []).append(
+                    {"step": e.get("step"),
+                     "to": data.get("to_state"),
+                     "reason": data.get("reason")})
+            elif name == "breaker.trip":
+                router["breaker"]["trips"] += 1
+            elif name == "breaker.probe":
+                router["breaker"]["probes"] += 1
+            elif name == "breaker.close":
+                router["breaker"]["closes"] += 1
+            elif name == "failover":
+                router["failovers"] += 1
+            elif name == "tier":
+                router["tier_transitions"].append(
+                    {"step": e.get("step"), "from": data.get("from_tier"),
+                     "to": data.get("to_tier"), "score": data.get("score")})
+                router["last_tier"] = data.get("to_tier", 0)
+            elif name == "request.finish":
+                router["finished"] += 1
+            elif name == "request.shed":
+                router["shed"] += 1
+                reason = data.get("reason") or "?"
+                router["shed_reasons"][reason] = \
+                    router["shed_reasons"].get(reason, 0) + 1
+            elif name == "replay.divergence":
+                router["replay_divergence"] += 1
     return {
         "compile": compile_by_name,
         "step_cost": step_cost_by_name,
@@ -89,7 +124,46 @@ def aggregate(events: List[Dict]) -> Dict:
         "wallclock": {k: sum(v) / len(v) for k, v in wallclock.items()},
         "steps": steps,
         "faults": faults,
+        "router": router,
     }
+
+
+def _router_lines(agg: Dict, markdown: bool) -> List[str]:
+    """Multi-replica front door: replica state transitions, breaker
+    activity, failovers, degradation-tier walks."""
+    r = agg.get("router") or {}
+    if not r.get("events"):
+        return []
+    out = [""]
+    head = (f"router: {r['finished']} finished, {r['shed']} shed, "
+            f"{r['failovers']} failovers, "
+            f"{r['breaker']['trips']} breaker trips "
+            f"({r['breaker']['probes']} probes, "
+            f"{r['breaker']['closes']} closes), "
+            f"tier {r['last_tier']}")
+    out.append(("### " if markdown else "") + head)
+    if r["replay_divergence"]:
+        out.append(f"{'**' if markdown else '  '}REPLAY DIVERGENCE x"
+                   f"{r['replay_divergence']} — greedy bit-reproducibility "
+                   f"broken{'**' if markdown else ''}")
+    if r["shed_reasons"]:
+        sheds = ", ".join(f"{k}: {v}"
+                          for k, v in sorted(r["shed_reasons"].items()))
+        out.append(f"{'' if markdown else '  '}shed reasons: {sheds}")
+    if markdown and r["replica_states"]:
+        out.append("\n| replica | transitions |")
+        out.append("|---|---|")
+        for rep, ts in sorted(r["replica_states"].items()):
+            chain = " -> ".join(f"{t['to']}({t['reason']})" for t in ts)
+            out.append(f"| {rep} | {chain} |")
+    elif r["replica_states"]:
+        for rep, ts in sorted(r["replica_states"].items()):
+            chain = " -> ".join(f"{t['to']}({t['reason']})" for t in ts)
+            out.append(f"  replica {rep}: {chain}")
+    for t in r["tier_transitions"][-5:]:
+        out.append(f"{'' if markdown else '  '}tier {t['from']} -> "
+                   f"{t['to']} at step {t['step']} (score {t['score']})")
+    return out
 
 
 def _fault_lines(agg: Dict, markdown: bool) -> List[str]:
@@ -212,6 +286,7 @@ def render(path: str, markdown: bool = False) -> str:
         lines.append(f"trace window: {w['action']} at step {w['step']}"
                      + (f" -> {w['dir']}" if w.get("dir") else ""))
     lines.extend(_fault_lines(agg, markdown))
+    lines.extend(_router_lines(agg, markdown))
     return "\n".join(lines)
 
 

@@ -181,13 +181,142 @@ def main():
         "requests": n_requests, "new_tokens": srv_new,
     })
 
+    # --- serving fast-path series: the throughput tier. Three scenarios
+    # over the same tiny/125M model, still after the headline JSON:
+    # (a) shared system prompt — N requests share a multi-block system
+    # prefix under the radix prefix cache; the first request prefills
+    # it, the rest map the blocks by refcount and prefill only their
+    # tails (prefix hit rate + drain tokens/s);
+    # (b) long-prompt mix — short requests queued behind one long
+    # prompt, whole-prompt prefill vs chunked prefill: the short
+    # requests' TTFT p95 is what the chunk budget buys;
+    # (c) KV capacity — live pool bytes per sequence for f32 vs int8
+    # KV, i.e. max concurrent sequences at a fixed HBM pool budget.
+    srv.destroy()
+    del srv
+
+    def build_serving(extra):
+        reset_topology()
+        return ServingEngine(deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype=cfg.dtype,
+            tensor_parallel={"tp_size": 1}, max_out_tokens=cfg.n_positions,
+            serving={**scfg, **extra}))
+
+    def drain_all(eng, prompts, new_tok):
+        t0 = time.perf_counter()
+        for p in prompts:
+            eng.submit(p, max_new_tokens=new_tok)
+        while eng.pending:
+            eng.step()
+        eng.drain()
+        return time.perf_counter() - t0
+
+    bs = scfg["block_size"]
+    if on_tpu:
+        sys_len, tail_len, n_shared = 4 * bs, bs, 2 * batch
+        long_len, n_short = 8 * bs, batch
+    else:
+        sys_len, tail_len, n_shared = 2 * bs, 4, 6
+        long_len, n_short = 4 * bs, 3
+
+    # (a) shared system prompt under the prefix cache. Warm run compiles
+    # the chunk/decode programs on a throwaway system prompt; the
+    # measured window uses a FRESH system prompt so its first request is
+    # the genuine cold miss and the rest are genuine hits.
+    def shared_prompts():
+        sys_ids = srv_rng.integers(0, cfg.vocab_size, sys_len)
+        return [np.concatenate([
+            sys_ids, srv_rng.integers(0, cfg.vocab_size, tail_len)]
+        ).astype(np.int32) for _ in range(n_shared)]
+
+    pfx = build_serving({"prefix_cache": True})
+    drain_all(pfx, shared_prompts(), srv_new)  # warm programs
+    pfx.reset_stats()
+    pfx_elapsed = drain_all(pfx, shared_prompts(), srv_new)
+    pst = pfx.stats()
+    pfx_tokens = sum(r["new_tokens"] for r in pfx.records
+                     if r["state"] != "shed")
+    prefix_series = {
+        "prefix_hit_rate": pst["prefix_cache"]["window_hit_rate"],
+        "shared_tokens_per_sec": round(pfx_tokens / pfx_elapsed, 1)
+        if pfx_elapsed > 0 else None,
+        "shared_ttft_ms_p50": pst["ttft_ms_p50"],
+        "cached_blocks": pst["prefix_cache"]["cached_blocks"],
+    }
+    pfx.destroy()
+    del pfx
+
+    # (b) short requests behind a long prompt, whole-prompt vs chunked
+    # prefill. Same arrival order both times: the long prompt submits
+    # first, the shorts immediately after — chunking bounds how long the
+    # long prefill can hold the step loop before a short's first token.
+    def short_ttft_p95(eng):
+        prompts = [srv_rng.integers(0, cfg.vocab_size,
+                                    long_len).astype(np.int32)]
+        prompts += [srv_rng.integers(0, cfg.vocab_size,
+                                     lens[i % len(lens)]).astype(np.int32)
+                    for i in range(n_short)]
+        drain_all(eng, prompts, srv_new)  # warm
+        eng.reset_stats()
+        drain_all(eng, prompts, srv_new)
+        ttfts = [r["ttft_ms"] for r in eng.records
+                 if r["state"] != "shed" and r["prompt_len"] < long_len
+                 and r["ttft_ms"] is not None]
+        return float(np.percentile(ttfts, 95)) if ttfts else None
+
+    whole = build_serving({})
+    whole_p95 = short_ttft_p95(whole)
+    whole.destroy()
+    del whole
+    chunked = build_serving({"prefill_chunk_tokens": bs})
+    chunked_p95 = short_ttft_p95(chunked)
+    chunked.destroy()
+    del chunked
+    prefix_series.update({
+        "short_ttft_ms_p95_whole_prefill": round(whole_p95, 2)
+        if whole_p95 is not None else None,
+        "short_ttft_ms_p95_chunked_prefill": round(chunked_p95, 2)
+        if chunked_p95 is not None else None,
+        "prefill_chunk_tokens": bs, "long_prompt_len": long_len,
+    })
+
+    # (c) KV bytes per concurrent sequence, read off the LIVE pool
+    # arrays (int8 includes its scale side pools), and the max
+    # concurrent sequences a fixed pool budget holds — the budget is
+    # pinned to what the f32 pool actually costs here.
+    def kv_bytes_per_seq(eng):
+        import jax as _jax
+        total = sum(leaf.nbytes
+                    for leaf in _jax.tree_util.tree_leaves(eng.cache))
+        return total // eng.num_blocks * eng.blocks_per_seq
+
+    f32_eng = build_serving({})
+    f32_bytes = kv_bytes_per_seq(f32_eng)
+    f32_eng.destroy()
+    del f32_eng
+    int8_eng = build_serving({"kv_cache_dtype": "int8"})
+    int8_bytes = kv_bytes_per_seq(int8_eng)
+    int8_eng.destroy()
+    del int8_eng
+    pool_budget = f32_bytes * scfg["decode_slots"]
+    prefix_series.update({
+        "kv_bytes_per_seq_f32": int(f32_bytes),
+        "kv_bytes_per_seq_int8": int(int8_bytes),
+        "max_concurrent_seqs_f32": int(pool_budget // f32_bytes),
+        "max_concurrent_seqs_int8": int(pool_budget // int8_bytes),
+    })
+    emit_result({
+        "metric": f"{METRIC}_serving_fastpath",
+        **prefix_series,
+        "requests_shared": n_shared, "system_prompt_len": sys_len,
+        "new_tokens": srv_new,
+    })
+
     # --- router series: the availability tier. Two replicas behind the
     # resilient front door; the same mixed-arrival window run clean and
     # with replica 1 crashed mid-window (deterministic chaos) — the gap
     # between the two availability numbers is what failover with
     # deterministic replay buys.
-    srv.destroy()
-    del srv
     from deepspeed_tpu.runtime.resilience.chaos import ChaosReplica
     from deepspeed_tpu.serving.router import ReplicaRouter
 

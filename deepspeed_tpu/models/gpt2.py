@@ -85,6 +85,11 @@ class GPT2Config:
     paged: bool = False
     paged_num_blocks: int = 0
     paged_block_size: int = 0
+    # paged-KV pool dtype: "" stores blocks in the compute dtype; "int8"
+    # quantizes K/V per pool row (ops.quantizer.quantize_rowwise — one
+    # f32 scale per token x head in a side pool indexed by the same
+    # block table) for 2-4x more concurrent sequences per HBM byte
+    paged_kv_dtype: str = ""
     # --- canonical-decoder knobs: this model executes the whole fused-
     # c_attn decoder family the state-dict factory normalizes to (GPT-2,
     # OPT, BLOOM — reference model_implementations/ arch classes) ---
@@ -143,15 +148,19 @@ class GPT2Config:
         return dataclasses.replace(self, decode=True, dropout=0.0,
                                    padded=padded)
 
-    def for_paged_decode(self, num_blocks: int, block_size: int):
+    def for_paged_decode(self, num_blocks: int, block_size: int,
+                         kv_dtype: str = ""):
         """Serving variant: decode mode whose KV cache is a shared block
         pool (block 0 reserved as the garbage sink — see
         ``ops.decode_attention.GARBAGE_BLOCK``). Mutually exclusive with
-        ``padded``: ragged prompts are the block table's job here."""
+        ``padded``: ragged prompts are the block table's job here.
+        ``kv_dtype="int8"`` stores the pool quantized per row with a
+        scale side pool (the serving ``kv_cache_dtype`` knob)."""
         return dataclasses.replace(self, decode=True, dropout=0.0,
                                    padded=False, paged=True,
                                    paged_num_blocks=int(num_blocks),
-                                   paged_block_size=int(block_size))
+                                   paged_block_size=int(block_size),
+                                   paged_kv_dtype=str(kv_dtype))
 
     @staticmethod
     def gpt2_125m(**kw):
@@ -310,13 +319,28 @@ class CausalSelfAttention(nn.Module):
         tables = paging["block_tables"]
         lengths = paging["lengths"]
         num_valid = paging["num_valid"]
+        if cfg.paged_kv_dtype not in ("", "int8"):
+            raise ValueError(f"paged_kv_dtype must be '' or 'int8', got "
+                             f"{cfg.paged_kv_dtype!r}")
+        quant = cfg.paged_kv_dtype == "int8"
         k4 = k.reshape(B, T, cfg.n_head, head_dim)
         v4 = v.reshape(B, T, cfg.n_head, head_dim)
         pool_shape = (nb, bs, cfg.n_head, head_dim)
+        pool_dtype = jnp.int8 if quant else cfg.dtype
         ck = self.variable("cache", "key_pool", jnp.zeros, pool_shape,
-                           cfg.dtype)
+                           pool_dtype)
         cv = self.variable("cache", "value_pool", jnp.zeros, pool_shape,
-                           cfg.dtype)
+                           pool_dtype)
+        if quant:
+            # per-row scale side pools (one f32 scale per token x head),
+            # scattered through the SAME flattened row indices as the
+            # int8 pools so the block table stays the single source of
+            # placement truth
+            scale_shape = (nb, bs, cfg.n_head, 1)
+            cks = self.variable("cache", "key_scale", jnp.zeros,
+                                scale_shape, jnp.float32)
+            cvs = self.variable("cache", "value_scale", jnp.zeros,
+                                scale_shape, jnp.float32)
         pos = paged_positions(lengths, T)  # [B, T] logical slots
         if cfg.position_embedding == "rotary":
             # rotate by absolute position BEFORE pooling, mirroring the
@@ -327,28 +351,63 @@ class CausalSelfAttention(nn.Module):
                               cfg.rotary_interleaved)
         rows = paged_write_rows(tables, pos, num_valid, bs)
         flat = (nb * bs, cfg.n_head, head_dim)
-        ck.value = ck.value.reshape(flat).at[rows.reshape(-1)].set(
-            k4.reshape(B * T, cfg.n_head, head_dim)).reshape(pool_shape)
-        cv.value = cv.value.reshape(flat).at[rows.reshape(-1)].set(
-            v4.reshape(B * T, cfg.n_head, head_dim)).reshape(pool_shape)
+        if quant:
+            from deepspeed_tpu.ops.quantizer import quantize_rowwise
+
+            kq, ks = quantize_rowwise(k4)   # int8 [B,T,H,D], f32 [B,T,H,1]
+            vq, vs = quantize_rowwise(v4)
+            sflat = (nb * bs, cfg.n_head, 1)
+            ck.value = ck.value.reshape(flat).at[rows.reshape(-1)].set(
+                kq.reshape(B * T, cfg.n_head, head_dim)).reshape(pool_shape)
+            cv.value = cv.value.reshape(flat).at[rows.reshape(-1)].set(
+                vq.reshape(B * T, cfg.n_head, head_dim)).reshape(pool_shape)
+            cks.value = cks.value.reshape(sflat).at[rows.reshape(-1)].set(
+                ks.reshape(B * T, cfg.n_head, 1)).reshape(scale_shape)
+            cvs.value = cvs.value.reshape(sflat).at[rows.reshape(-1)].set(
+                vs.reshape(B * T, cfg.n_head, 1)).reshape(scale_shape)
+        else:
+            ck.value = ck.value.reshape(flat).at[rows.reshape(-1)].set(
+                k4.reshape(B * T, cfg.n_head, head_dim)).reshape(pool_shape)
+            cv.value = cv.value.reshape(flat).at[rows.reshape(-1)].set(
+                v4.reshape(B * T, cfg.n_head, head_dim)).reshape(pool_shape)
         if paging.get("prefill"):
             return q4, k4, v4, None, False
         from deepspeed_tpu.ops.attention import use_decode_kernel
 
         alibi = cfg.position_embedding == "alibi"
         if use_decode_kernel() and not alibi and not self.window:
-            from deepspeed_tpu.ops.decode_attention import (
-                decode_attention_paged)
+            if quant:
+                from deepspeed_tpu.ops.decode_attention import (
+                    decode_attention_paged_int8)
 
-            y4 = decode_attention_paged(q4, ck.value, cv.value, tables,
-                                        lengths, softmax_scale=cfg.attn_scale)
+                y4 = decode_attention_paged_int8(
+                    q4, ck.value, cv.value, cks.value, cvs.value, tables,
+                    lengths, softmax_scale=cfg.attn_scale)
+            else:
+                from deepspeed_tpu.ops.decode_attention import (
+                    decode_attention_paged)
+
+                y4 = decode_attention_paged(q4, ck.value, cv.value, tables,
+                                            lengths,
+                                            softmax_scale=cfg.attn_scale)
             y = y4.transpose(0, 2, 1, 3)
         else:
-            from deepspeed_tpu.ops.decode_attention import gather_paged_cache
+            from deepspeed_tpu.ops.decode_attention import (
+                gather_paged_cache, gather_paged_cache_int8)
 
             S = tables.shape[-1] * bs
-            kd = gather_paged_cache(ck.value, tables).transpose(0, 2, 1, 3)
-            vd = gather_paged_cache(cv.value, tables).transpose(0, 2, 1, 3)
+            if quant:
+                kd = gather_paged_cache_int8(
+                    ck.value, cks.value, tables,
+                    cfg.dtype).transpose(0, 2, 1, 3)
+                vd = gather_paged_cache_int8(
+                    cv.value, cvs.value, tables,
+                    cfg.dtype).transpose(0, 2, 1, 3)
+            else:
+                kd = gather_paged_cache(ck.value,
+                                        tables).transpose(0, 2, 1, 3)
+                vd = gather_paged_cache(cv.value,
+                                        tables).transpose(0, 2, 1, 3)
             # per-row lengths: each serving slot is at its own position
             mask = cache_attn_mask(S, lengths, T, window=self.window)
             bias = _alibi_bias(cfg, jnp.arange(S)) if alibi else None

@@ -153,6 +153,20 @@ def gather_paged_cache(pool, block_tables):
     return pool[block_tables].reshape(b, mb * bs, heads, d)
 
 
+def gather_paged_cache_int8(pool, scales, block_tables, dtype=jnp.float32):
+    """Dense-dequantize an int8 pool through a block table: the XLA
+    fallback (CPU serving) and the correctness oracle for the int8 paged
+    kernel. ``pool`` is ``[nb, bs, H, D]`` int8, ``scales`` the
+    ``[nb, bs, H, 1]`` f32 side pool written by the same
+    ``paged_write_rows`` scatter. Returns the ``[B, MB*bs, H, D]``
+    logical window in ``dtype``."""
+    b, mb = block_tables.shape
+    nb, bs, heads, d = pool.shape
+    q = pool[block_tables].reshape(b, mb * bs, heads, d).astype(jnp.float32)
+    s = scales[block_tables].reshape(b, mb * bs, heads, 1)
+    return (q * s).astype(dtype)
+
+
 def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref, m_scr,
                   l_scr, acc_scr, *, scale, bs, tq, heads, d, num_kb):
     bi = pl.program_id(0)
@@ -257,3 +271,123 @@ def decode_attention_paged(q, k_pool, v_pool, block_tables, lengths,
         compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
     )(tables, lens, q, k_pool, v_pool)
+
+
+# ---------------------------------------------------------------------------
+# int8 paged variant: the pools hold per-row symmetric int8 KV
+# (ops.quantizer.quantize_rowwise — one f32 scale per token x head in a
+# side pool indexed by the SAME block table), and the kernel dequantizes
+# inside the block DMA's compute step. Attention math is unchanged and
+# stays fp32-accumulated; gather_paged_cache_int8 above is the dense
+# oracle this kernel is tested against with a pinned tolerance.
+# ---------------------------------------------------------------------------
+
+
+def _paged_int8_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, ks_ref,
+                       vs_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, bs,
+                       tq, heads, d, num_kb):
+    bi = pl.program_id(0)
+    ji = pl.program_id(1)
+    idx = lens_ref[bi]
+
+    @pl.when(ji == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(ji * bs < idx + tq)
+    def _body():
+        q = q_ref[...].reshape(tq, heads, d).transpose(1, 0, 2) \
+            .astype(jnp.float32)                                   # [H,tq,d]
+        # dequantize in-register: int8 rows x the side-pool scales
+        ks = ks_ref[...].reshape(bs, heads, 1).transpose(1, 0, 2)  # [H,bs,1]
+        vs = vs_ref[...].reshape(bs, heads, 1).transpose(1, 0, 2)
+        k = k_ref[...].reshape(bs, heads, d).transpose(1, 0, 2) \
+            .astype(jnp.float32) * ks                              # [H,bs,d]
+        v = v_ref[...].reshape(bs, heads, d).transpose(1, 0, 2) \
+            .astype(jnp.float32) * vs
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale            # [H,tq,bs]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (heads, tq, bs), 1)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (heads, tq, bs), 2) \
+            + ji * bs
+        s = jnp.where(cols <= idx + rows, s, NEG_INF)
+        m_prev = m_scr[:, :, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:, :, 0:1] + jnp.sum(p, axis=2, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)                    # [H,tq,d]
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ji == num_kb - 1)
+    def _finish():
+        l = l_scr[:, :, 0:1]
+        out = acc_scr[:] / jnp.where(l == 0.0, 1.0, l)             # [H,tq,d]
+        o_ref[...] = out.transpose(1, 0, 2).reshape(1, tq, heads, d) \
+            .astype(o_ref.dtype)
+
+
+def decode_attention_paged_int8(q, k_pool, v_pool, k_scale, v_scale,
+                                block_tables, lengths, softmax_scale=None):
+    """Attend a decode step against an int8-quantized paged KV cache.
+
+    Same contract as :func:`decode_attention_paged`, except ``k_pool`` /
+    ``v_pool`` are ``[num_blocks, block_size, H, D]`` int8 and
+    ``k_scale`` / ``v_scale`` are their ``[num_blocks, block_size, H,
+    1]`` f32 per-row scales (one scale per token x head —
+    ``ops.quantizer.quantize_rowwise``). The scale side pools ride the
+    same scalar-prefetch block table: each grid step DMAs the named pool
+    block *and* its scale rows, dequantizes in-register, and runs the
+    identical fp32 online-softmax update.
+    """
+    b, tq, heads, d = q.shape
+    nb, bs, ph, pd = k_pool.shape
+    if (ph, pd) != (heads, d):
+        raise ValueError(f"pool heads/dim {(ph, pd)} != query {(heads, d)}")
+    if k_scale.shape != (nb, bs, heads, 1):
+        raise ValueError(
+            f"scale pool shape {k_scale.shape} != {(nb, bs, heads, 1)} "
+            f"(one f32 scale per pool row x head)")
+    mb = block_tables.shape[-1]
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, tq, heads, d),
+                         lambda bi, ji, tab, ln: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, bs, heads, d),
+                         lambda bi, ji, tab, ln: (tab[bi, ji], 0, 0, 0)),
+            pl.BlockSpec((1, bs, heads, d),
+                         lambda bi, ji, tab, ln: (tab[bi, ji], 0, 0, 0)),
+            pl.BlockSpec((1, bs, heads, 1),
+                         lambda bi, ji, tab, ln: (tab[bi, ji], 0, 0, 0)),
+            pl.BlockSpec((1, bs, heads, 1),
+                         lambda bi, ji, tab, ln: (tab[bi, ji], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tq, heads, d),
+                               lambda bi, ji, tab, ln: (bi, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((heads, tq, 128), jnp.float32),   # m
+            pltpu.VMEM((heads, tq, 128), jnp.float32),   # l
+            pltpu.VMEM((heads, tq, d), jnp.float32),     # acc
+        ],
+    )
+    kernel = functools.partial(_paged_int8_kernel, scale=scale, bs=bs,
+                               tq=tq, heads=heads, d=d, num_kb=mb)
+    tables = jnp.asarray(block_tables, jnp.int32)
+    lens = jnp.asarray(lengths, jnp.int32)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, tq, heads, d), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(tables, lens, q, k_pool, v_pool, k_scale, v_scale)

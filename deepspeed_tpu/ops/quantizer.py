@@ -80,6 +80,30 @@ def dequantize_chunks(q, scales, group_size: int = 1024, size=None,
     return flat if size is None else flat[:size]
 
 
+def quantize_rowwise(x, axis: int = -1):
+    """Symmetric int8 quantization with one f32 scale per row along
+    ``axis`` — the paged-KV block codec (one scale per token x head,
+    riding a side pool indexed by the same block table the int8 pool
+    uses). Same absmax/127 chunk-scale formula as
+    :func:`quantize_chunks`, shaped for in-place pool scatters instead
+    of a flat wire. All-zero rows keep scale 1 so they round-trip to
+    exact zeros (the garbage block stays inert).
+
+    Returns ``(q int8 like x, scale f32 with axis collapsed to 1)``.
+    """
+    f = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(f), axis=axis, keepdims=True)
+    scale = jnp.where(absmax == 0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rowwise(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_rowwise` (``scale`` broadcasts over
+    the collapsed axis)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
 def fake_quantize(x, num_groups: int = 1, num_bits: int = 8, symmetric: bool = True):
     """Quantize→dequantize in one step with a straight-through gradient

@@ -30,6 +30,7 @@ from deepspeed_tpu.serving.blocks import BlockManager
 from deepspeed_tpu.serving.config import (RouterConfig, ServingConfig,
                                           bucket_for, resolve_buckets)
 from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.health import (DEAD, DEGRADED, DRAINING, HEALTHY,
                                           TRIPPED, ReplicaHealth)
 from deepspeed_tpu.serving.request import (FINISHED, QUEUED, RUNNING, SHED,
@@ -37,7 +38,8 @@ from deepspeed_tpu.serving.request import (FINISHED, QUEUED, RUNNING, SHED,
 from deepspeed_tpu.serving.router import ReplicaRouter, RouterRequest
 from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
 
-__all__ = ["BlockManager", "ContinuousBatchingScheduler", "ReplicaHealth",
+__all__ = ["BlockManager", "ContinuousBatchingScheduler", "PrefixCache",
+           "ReplicaHealth",
            "ReplicaRouter", "Request", "RouterConfig", "RouterRequest",
            "ServingConfig", "ServingEngine", "bucket_for", "resolve_buckets",
            "QUEUED", "RUNNING", "FINISHED", "SHED",

@@ -114,6 +114,24 @@ class ServingConfig(DeepSpeedConfigModel):
     # prompt-length buckets for prefill (and the legacy generate() compile
     # cache); [] = powers of two from block_size up to max_model_len
     prompt_buckets: List[int] = []
+    # ---- serving fast path (each key absent/zero = feature does not
+    # exist and nothing about the compiled programs changes) ----
+    # radix prefix cache: admissions match the longest cached prompt
+    # prefix, map its blocks read-only (copy-on-write for a partial last
+    # block) and prefill only the tail; released blocks park on an LRU
+    # evictable ladder instead of freeing
+    prefix_cache: bool = False
+    # chunked prefill: prompts prefill in fixed chunks of this many
+    # tokens, interleaved into the decode loop under the same per-step
+    # token budget — long prompts stop monopolizing the program and the
+    # power-of-two bucket ladder collapses to ONE chunk program. 0 = off
+    # (whole-prompt bucketed prefill, exactly as before)
+    prefill_chunk_tokens: int = 0
+    # paged KV block dtype: "" = the model compute dtype; "int8"
+    # quantizes K/V per block row (one scale per token x head, riding a
+    # side pool indexed by the same block table) for 2-4x more concurrent
+    # sequences per HBM byte
+    kv_cache_dtype: str = ""
     # satellite: pad legacy generate() prompts up to the bucket set before
     # keying its compile cache (identical tokens via the left-padded mask
     # path; one compiled program per bucket instead of per prompt length)
@@ -166,6 +184,24 @@ class ServingConfig(DeepSpeedConfigModel):
             raise ValueError(f"serving.prompt_buckets must be positive, "
                              f"got {v}")
         return sorted(set(int(b) for b in v))
+
+    @field_validator("prefill_chunk_tokens")
+    @classmethod
+    def _chunk(cls, v):
+        if v < 0:
+            raise ValueError(
+                f"serving.prefill_chunk_tokens must be >= 0 (0 = whole-"
+                f"prompt bucketed prefill), got {v}")
+        return v
+
+    @field_validator("kv_cache_dtype")
+    @classmethod
+    def _kv_dtype(cls, v):
+        if v not in ("", "int8"):
+            raise ValueError(
+                f"serving.kv_cache_dtype must be '' (model dtype) or "
+                f"'int8', got {v!r}")
+        return v
 
 
 def resolve_buckets(buckets, max_len: int, floor: int = 8):

@@ -2,7 +2,7 @@
 
 Wraps an :class:`~deepspeed_tpu.inference.engine.InferenceEngine` (its
 params, sharding, dtype/quantization and telemetry/resilience managers)
-with a request-level scheduler and exactly TWO kinds of compiled
+with a request-level scheduler and a small FIXED set of compiled
 programs:
 
 - ``serving.prefill[T=b]`` — one per prompt bucket ``b`` (a small fixed
@@ -11,7 +11,17 @@ programs:
   garbage block) and returns the first sampled token;
 - ``serving.decode[slots=N]`` — ONE program for the fixed slot batch:
   every active sequence advances one token against its own block table
-  and length; idle slots compute into the garbage block and are ignored.
+  and length; idle slots compute into the garbage block and are ignored;
+- ``serving.chunk[T=c]`` — the serving fast path's third program
+  (compiled only when ``prefill_chunk_tokens`` or ``prefix_cache`` is
+  on): writes ``c`` prompt tokens at the sequence's current length and
+  attends them against the pool — the program behind both *chunked
+  prefill* (long prompts advance one budgeted chunk per step instead of
+  monopolizing a whole-prompt program, collapsing the bucket ladder to
+  one shape) and *prefix-cache tail prefill* (a request whose prompt
+  prefix is already pooled writes only the unmatched tail);
+- ``serving.cow`` — copy one pool block's rows to another (every cache
+  leaf, scales included): the device half of partial-tail copy-on-write.
 
 Finished sequences are evicted and queued requests spliced into free
 slots *between* decode steps — shapes never change, so the steady-state
@@ -19,7 +29,12 @@ retrace count is zero (pinned by the telemetry compile watchdog in
 ``tests/unit/test_serving.py``). Greedy tokens bit-match per-request
 ``generate()`` output: the paged decode gathers pool blocks back into
 logical order, so the math matches the dense append-cache program
-term for term.
+term for term. With ``prefix_cache`` on, a request admitting behind an
+identical system prompt maps those blocks read-only (a
+:class:`~deepspeed_tpu.serving.blocks.BlockManager` refcount bump) and
+prefills only its tail; with ``kv_cache_dtype: "int8"`` the pools store
+per-row-quantized KV at a quarter of the bytes. All three knobs default
+off, and off means byte-identical compiled programs.
 
 Per-request telemetry (kind ``serving``: TTFT, queue wait, tokens/s,
 shed) rides the unified event stream; the resilience hang watchdog sees
@@ -36,6 +51,7 @@ import numpy as np
 from deepspeed_tpu.serving.blocks import BlockManager
 from deepspeed_tpu.serving.config import (ServingConfig, blocks_for_tokens,
                                           bucket_for, resolve_buckets)
+from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.request import FINISHED, Request
 from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
 from deepspeed_tpu.utils.logging import log_dist
@@ -92,12 +108,21 @@ class ServingEngine:
             1 + self.config.decode_slots * self.blocks_per_seq)
         self.buckets = resolve_buckets(self.config.prompt_buckets,
                                        self.max_len, floor=bs)
-        self._dmodule = type(self.engine.module)(
-            mcfg.for_paged_decode(self.num_blocks, bs))
+        if self.config.kv_cache_dtype:
+            dcfg = mcfg.for_paged_decode(self.num_blocks, bs,
+                                         kv_dtype=self.config.kv_cache_dtype)
+        else:
+            # keyword omitted on purpose: a model family predating the
+            # kv_dtype knob keeps serving exactly as before
+            dcfg = mcfg.for_paged_decode(self.num_blocks, bs)
+        self._dmodule = type(self.engine.module)(dcfg)
         self.block_mgr = BlockManager(self.num_blocks, bs,
                                       self.blocks_per_seq)
+        self.prefix = (PrefixCache(self.block_mgr)
+                       if self.config.prefix_cache else None)
         self.sched = ContinuousBatchingScheduler(
-            self.config, self.block_mgr, self.max_len, self.buckets)
+            self.config, self.block_mgr, self.max_len, self.buckets,
+            prefix_cache=self.prefix)
         self.telemetry = self.engine.telemetry
         self.resilience = self.engine.resilience
 
@@ -108,6 +133,16 @@ class ServingEngine:
         self._lengths = np.zeros((self.config.decode_slots,), np.int32)
         self._prefill_fns: Dict[int, object] = {}
         self._decode_fn = None
+        # chunked / prefix-continued prefill state: a slot mid-prefill is
+        # NOT in the decode batch (its row of self._tables stays pointed
+        # at the garbage block) until its whole prompt is written
+        self.chunk_tokens = int(self.config.prefill_chunk_tokens)
+        self._prefilling: Dict[int, Request] = {}
+        self._pf_tables: Dict[int, np.ndarray] = {}
+        self._pf_pos: Dict[int, int] = {}
+        self._pf_next = 0  # round-robin cursor over prefilling slots
+        self._chunk_fns: Dict[int, object] = {}
+        self._cow_fn = None
         self._rng = jax.random.PRNGKey(self.config.seed)
         self._step_count = 0
         self._finished_count = 0
@@ -203,6 +238,54 @@ class ServingEngine:
             jax.jit(fn, donate_argnums=self._donate()),
             f"serving.decode[slots={self.config.decode_slots}]")
 
+    def _build_chunk(self, T: int):
+        """One prefill chunk: write ``num_valid`` prompt tokens at the
+        sequence's current pool length and attend them against everything
+        already pooled (shared prefix blocks included) plus themselves,
+        causally. The sampled token at the last REAL position is
+        meaningful only on the final chunk — it is the request's first
+        generated token."""
+        jax, jnp = self._jax, self._jnp
+        dmodule, dequant = self._dmodule, self.engine._dequantize
+        logits_of = self.engine._logits_of
+
+        def fn(qparams, cache, ids, tables, lengths, num_valid, rng):
+            params = dequant(qparams)
+            paging = {"block_tables": tables, "lengths": lengths,
+                      "num_valid": num_valid, "prefill": False}
+            out, vars_ = dmodule.apply({"params": params, "cache": cache},
+                                       ids, mutable=["cache"], paging=paging)
+            logits = logits_of(out)
+            last = jnp.take_along_axis(
+                logits, (num_valid - 1)[:, None, None], axis=1)[:, 0]
+            return self._sample(last, rng), vars_["cache"]
+
+        return self.engine.telemetry.watch_jit(
+            jax.jit(fn, donate_argnums=self._donate()),
+            f"serving.chunk[T={T}]")
+
+    def _build_cow(self):
+        """Copy one pool block's rows onto another across every cache
+        leaf (key/value pools and, under int8 KV, their scale side
+        pools) — the device half of partial-tail copy-on-write. Pool
+        leaves all end in ``[num_blocks, block_size, H, *]`` (with an
+        optional leading scanned-layer axis), so the block axis is
+        always ``ndim - 4``."""
+        jax = self._jax
+
+        def fn(cache, src, dst):
+            def copy(p):
+                ax = p.ndim - 4
+                row = jax.lax.dynamic_index_in_dim(p, src, axis=ax,
+                                                   keepdims=False)
+                return jax.lax.dynamic_update_index_in_dim(p, row, dst, ax)
+
+            return jax.tree_util.tree_map(copy, cache)
+
+        donate = (0,) if self._jax.default_backend() != "cpu" else ()
+        return self.engine.telemetry.watch_jit(
+            jax.jit(fn, donate_argnums=donate), "serving.cow")
+
     def _next_rng(self):
         self._rng, sub = self._jax.random.split(self._rng)
         return sub
@@ -228,8 +311,9 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def step(self) -> List[Request]:
         """One scheduler iteration: abandon blown deadlines, splice queued
-        requests into free slots (bucketed prefill), then advance every
-        active sequence one token. Returns requests finished this step."""
+        requests into free slots, advance mid-prefill prompts one budgeted
+        chunk, then advance every decode-ready sequence one token. Returns
+        requests finished this step."""
         now = time.monotonic()
         done: List[Request] = []
         # deadline sweep over running work
@@ -241,11 +325,35 @@ class ServingEngine:
         for req in shed:
             self._record(req, shed=True, began=True)
         for slot, req, table in admitted:
-            self._prefill(slot, req, table, done)
-        # one decode step for the whole slot batch
-        if self.sched.running():
+            self._begin(slot, req, table, done)
+        self._prefill_chunks(done)
+        # one decode step for the whole slot batch (mid-prefill slots are
+        # idle decode rows: garbage table, outputs ignored)
+        if any(slot not in self._prefilling
+               for slot, _ in self.sched.running()):
             self._decode_step(done)
         return done
+
+    def _begin(self, slot: int, req: Request, table: np.ndarray,
+               done: List[Request]):
+        """Route a fresh admission: legacy whole-prompt bucketed prefill
+        (the zero-feature path, program-identical to PR 4), or the
+        chunked/prefix-continued path when the request has pooled prefix
+        tokens to skip or chunking is on."""
+        if req.cow is not None:
+            # partial-tail copy-on-write: the matched block will be
+            # appended to, so the request's own fresh block receives a
+            # device copy of its rows before anything else runs; the
+            # source unpins once the copy is in flight
+            self._cow_copy(*req.cow)
+            self.block_mgr.cow_done(req.request_id)
+        if not self.chunk_tokens and req.cached_len == 0:
+            self._prefill(slot, req, table, done)
+            return
+        self._prefilling[slot] = req
+        self._pf_tables[slot] = table
+        self._pf_pos[slot] = req.cached_len
+        req.length = req.cached_len
 
     def _prefill(self, slot: int, req: Request, table: np.ndarray,
                  done: List[Request]):
@@ -259,12 +367,74 @@ class ServingEngine:
             self.engine.params, self.cache, jnp.asarray(ids),
             jnp.asarray(table[None]),
             jnp.asarray([req.prompt_len], jnp.int32), self._next_rng())
-        tok = int(np.asarray(tok)[0])
+        req.prefill_chunks = 1
+        self._slot_live(slot, req, table, int(np.asarray(tok)[0]), done)
+
+    # ------------------------------------------------------------------
+    def _prefill_chunks(self, done: List[Request]):
+        """Advance mid-prefill prompts. With chunking on, at most
+        ``prefill_chunk_tokens`` prompt tokens are processed per step
+        (round-robin over slots, so a long prompt never starves a later
+        short one — the TTFT bound); with chunking off (prefix-cache
+        tails) each pending tail completes now in one bucketed chunk."""
+        if not self._prefilling:
+            return
+        budget = self.chunk_tokens or None
+        spent = 0
+        slots = sorted(self._prefilling)
+        start = next((i for i, s in enumerate(slots)
+                      if s >= self._pf_next), 0)
+        for slot in slots[start:] + slots[:start]:
+            req = self._prefilling.get(slot)
+            if req is None:
+                continue
+            table = self._pf_tables[slot]
+            pos = self._pf_pos[slot]
+            remaining = req.prompt_len - pos
+            step_len = (min(self.chunk_tokens, remaining)
+                        if self.chunk_tokens else remaining)
+            T = self.chunk_tokens or bucket_for(remaining, self.buckets)
+            tok = self._chunk_call(req, table, pos, step_len, T)
+            self._pf_pos[slot] = pos + step_len
+            req.length = pos + step_len
+            req.prefill_chunks += 1
+            if pos + step_len >= req.prompt_len:
+                del self._prefilling[slot]
+                self._pf_tables.pop(slot, None)
+                self._pf_pos.pop(slot, None)
+                self._slot_live(slot, req, table, tok, done)
+            if budget is not None:
+                spent += step_len
+                if spent >= budget:
+                    self._pf_next = slot + 1
+                    return
+
+    def _chunk_call(self, req: Request, table: np.ndarray, pos: int,
+                    step_len: int, T: int) -> int:
+        jnp = self._jnp
+        if T not in self._chunk_fns:
+            self._chunk_fns[T] = self._build_chunk(T)
+        ids = np.zeros((1, T), np.int32)
+        ids[0, :step_len] = req.prompt[pos:pos + step_len]
+        tok, self.cache = self._chunk_fns[T](
+            self.engine.params, self.cache, jnp.asarray(ids),
+            jnp.asarray(table[None]), jnp.asarray([pos], jnp.int32),
+            jnp.asarray([step_len], jnp.int32), self._next_rng())
+        return int(np.asarray(tok)[0])
+
+    def _slot_live(self, slot: int, req: Request, table: np.ndarray,
+                   tok: int, done: List[Request]):
+        """Prompt fully pooled: index the prompt for future prefix hits,
+        join the decode batch, and emit the first sampled token."""
         req.first_token_ts = time.monotonic()
         req.length = req.prompt_len
         self._tables[slot] = table
         self._lengths[slot] = req.prompt_len
         self._last_tokens[slot] = tok
+        if self.prefix is not None:
+            # BEFORE any finish: insertion must precede release so a
+            # one-token request's blocks park evictable, not freed
+            self.prefix.insert(req.prompt, table)
         finished = (tok == req.eos_token_id
                     or len(req.tokens) + 1 >= req.max_new_tokens)
         req.emit_token(tok, finished)
@@ -272,11 +442,19 @@ class ServingEngine:
             reason = "eos" if tok == req.eos_token_id else "max_tokens"
             self._finish(req, reason, time.monotonic(), done)
 
+    def _cow_copy(self, src: int, dst: int):
+        jnp = self._jnp
+        if self._cow_fn is None:
+            self._cow_fn = self._build_cow()
+        self.cache = self._cow_fn(self.cache, jnp.asarray(src, jnp.int32),
+                                  jnp.asarray(dst, jnp.int32))
+
     def _decode_step(self, done: List[Request]):
         jnp = self._jnp
         if self._decode_fn is None:
             self._decode_fn = self._build_decode()
-        active = self.sched.running()
+        active = [(s, r) for s, r in self.sched.running()
+                  if s not in self._prefilling]
         tokens = jnp.asarray(self._last_tokens[:, None])
         toks, self.cache = self._decode_fn(
             self.engine.params, self.cache, tokens,
@@ -320,6 +498,9 @@ class ServingEngine:
             self._tables[req.slot] = 0
             self._lengths[req.slot] = 0
             self._last_tokens[req.slot] = 0
+            self._prefilling.pop(req.slot, None)
+            self._pf_tables.pop(req.slot, None)
+            self._pf_pos.pop(req.slot, None)
         self._record(req, shed=False, began=True)
         done.append(req)
         self.finished.append(req)
@@ -352,6 +533,9 @@ class ServingEngine:
             self._tables[req.slot] = 0
             self._lengths[req.slot] = 0
             self._last_tokens[req.slot] = 0
+            self._prefilling.pop(req.slot, None)
+            self._pf_tables.pop(req.slot, None)
+            self._pf_pos.pop(req.slot, None)
         self._record(req, shed=True, began=True)
         return True
 
@@ -360,7 +544,10 @@ class ServingEngine:
         blocks): the payload of the per-step ``serving``/``step.gauges``
         telemetry event and the numbers the multi-replica router routes
         by — one public surface, no private-state reach-ins."""
-        return {**self.sched.gauges(), "free_blocks": self.block_mgr.num_free}
+        g = {**self.sched.gauges(), "free_blocks": self.block_mgr.num_free}
+        if self.prefix is not None:
+            g["cached_blocks"] = self.block_mgr.num_cached
+        return g
 
     @property
     def pending(self) -> bool:
@@ -398,9 +585,22 @@ class ServingEngine:
                  if r.get("ttft_ms") is not None]
         rates = [r["tokens_per_sec"] for r in self.records
                  if r.get("tokens_per_sec") is not None]
+        prefix_stats = None
+        if self.prefix is not None:
+            finished = [r for r in self.records if r["state"] != "shed"]
+            prompt_toks = sum(r["prompt_len"] for r in finished)
+            hit_toks = sum(r.get("prefix_hit_tokens", 0) for r in finished)
+            prefix_stats = {
+                **self.prefix.stats,
+                "cached_blocks": self.block_mgr.num_cached,
+                "evictions": self.block_mgr.evictions,
+                "window_hit_rate": round(hit_toks / prompt_toks, 4)
+                if prompt_toks else 0.0,
+            }
         s = self.sched.stats
         total = max(1, s["submitted"])
         return {
+            "prefix_cache": prefix_stats,
             "finished": s["finished"], "shed": s["shed"],
             "shed_reasons": dict(s["shed_reasons"]),
             "shed_rate": round(s["shed"] / total, 4),
@@ -418,7 +618,9 @@ class ServingEngine:
         """Drop compiled programs and the cache pool; destroys the wrapped
         engine only when this ServingEngine constructed it."""
         self._prefill_fns.clear()
+        self._chunk_fns.clear()
         self._decode_fn = None
+        self._cow_fn = None
         self.cache = None
         if self._owns_engine:
             self.engine.destroy()

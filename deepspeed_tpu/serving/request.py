@@ -45,6 +45,15 @@ class Request:
     finish_ts: float = 0.0
     slot: int = -1
     length: int = 0                   # tokens currently in the KV cache
+    # ---- prefix-cache / chunked-prefill accounting (set at admit by
+    # the scheduler, advanced by the engine's prefill path) ----
+    cached_len: int = 0               # prompt tokens already in the pool
+    prefix_hit_tokens: int = 0        # matched cached prefix length
+    blocks_shared: int = 0            # physical blocks mapped read-only
+    prefill_chunks: int = 0           # chunk-program calls this prefill
+    # (src, dst) pool blocks: dst must receive a device copy of src's
+    # rows before any append (partial-tail copy-on-write), or None
+    cow: Optional[tuple] = None
 
     @property
     def prompt_len(self) -> int:
@@ -75,4 +84,7 @@ class Request:
             if self.first_token_ts else None,
             "tokens_per_sec": round(len(self.tokens) / gen_secs, 2)
             if len(self.tokens) > 1 and gen_secs > 0 else None,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "blocks_shared": self.blocks_shared,
+            "prefill_chunks": self.prefill_chunks,
         }

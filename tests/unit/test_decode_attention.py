@@ -177,6 +177,141 @@ def test_paged_garbage_blocks_ignored():
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
 
 
+def _aliased_setup(bs=8, mb=4, H=2, D=64, seed=0):
+    """Two sequences whose block tables ALIAS the same physical prefix
+    blocks (a shared system prompt mapped read-only by the prefix cache)
+    plus private tails — the copy-on-write serving layout."""
+    from deepspeed_tpu.ops.decode_attention import GARBAGE_BLOCK
+
+    rng = np.random.default_rng(seed)
+    nb = 1 + 6
+    k_pool = rng.normal(size=(nb, bs, H, D)).astype(np.float32)
+    v_pool = rng.normal(size=(nb, bs, H, D)).astype(np.float32)
+    # rows share physical blocks 1,2 (16 shared prefix tokens); row 0
+    # owns private block 3, row 1 owns private blocks 4,5
+    tables = np.asarray([[1, 2, 3, GARBAGE_BLOCK],
+                         [1, 2, 4, 5]], np.int32)
+    lengths = np.asarray([19, 27], np.int32)
+    q4 = rng.normal(size=(2, 1, H, D)).astype(np.float32)
+    return (jnp.asarray(q4), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(tables), jnp.asarray(lengths))
+
+
+def test_paged_aliased_tables_match_dense():
+    """Satellite: block tables that alias the same physical blocks (a
+    shared prefix) stay bit-consistent with the dense gather oracle —
+    sharing is pure indirection, never a math change."""
+    from deepspeed_tpu.ops.decode_attention import decode_attention_paged
+
+    args = _aliased_setup()
+    with tpu_interpret_mode():
+        out = decode_attention_paged(*args)
+    ref = _paged_dense_ref(*args)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_aliased_garbage_isolation():
+    """Scribbling on unowned pool blocks, and past both rows' valid
+    prefixes inside their PRIVATE tail blocks, changes nothing — shared
+    blocks only ever contribute their fully-valid rows."""
+    from deepspeed_tpu.ops.decode_attention import decode_attention_paged
+
+    q4, k_pool, v_pool, tables, lengths = _aliased_setup()
+    with tpu_interpret_mode():
+        out1 = decode_attention_paged(q4, k_pool, v_pool, tables, lengths)
+    kp = np.asarray(k_pool).copy()
+    vp = np.asarray(v_pool).copy()
+    kp[6] = 9999.0          # unowned block
+    vp[6] = -9999.0
+    kp[3, 4:] = 4444.0      # row 0 private tail: valid rows [0, 19-16+1)
+    vp[3, 4:] = -4444.0
+    kp[5, 4:] = 4444.0      # row 1 private tail: valid rows [0, 27-24+1)
+    vp[5, 4:] = -4444.0
+    with tpu_interpret_mode():
+        out2 = decode_attention_paged(q4, jnp.asarray(kp), jnp.asarray(vp),
+                                      tables, lengths)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+
+
+# ---------------------------------------------------------------------------
+# int8 paged variant (the serving kv_cache_dtype: "int8" codec)
+# ---------------------------------------------------------------------------
+def _int8_pools(k_pool, v_pool):
+    from deepspeed_tpu.ops.quantizer import quantize_rowwise
+
+    kq, ks = quantize_rowwise(jnp.asarray(k_pool))
+    vq, vs = quantize_rowwise(jnp.asarray(v_pool))
+    return kq, vq, ks, vs
+
+
+@pytest.mark.parametrize("lengths,tq", [([0, 5], 1), ([7, 63], 1),
+                                        ([60, 30], 4)])
+def test_paged_int8_kernel_matches_dequant_oracle(lengths, tq):
+    """The int8 kernel dequantizes inside the block DMA; the dense
+    gather-dequantize oracle must agree to fp32 round-off — both read
+    the SAME int8 rows and scales, so this pins the kernel's dequant
+    placement, not quantization error."""
+    from deepspeed_tpu.models.decode_utils import cache_attn_mask
+    from deepspeed_tpu.ops.decode_attention import (
+        decode_attention_paged_int8, gather_paged_cache_int8)
+
+    q4, k_pool, v_pool, tables, lens = _paged_setup(
+        len(lengths), lengths, tq, bs=32, mb=4, seed=sum(lengths) + tq)
+    kq, vq, ks, vs = _int8_pools(k_pool, v_pool)
+    with tpu_interpret_mode():
+        out = decode_attention_paged_int8(q4, kq, vq, ks, vs, tables, lens)
+    B = q4.shape[0]
+    S = tables.shape[-1] * k_pool.shape[1]
+    kd = gather_paged_cache_int8(kq, ks, tables).transpose(0, 2, 1, 3)
+    vd = gather_paged_cache_int8(vq, vs, tables).transpose(0, 2, 1, 3)
+    mask = cache_attn_mask(S, lens, tq)
+    ref = attention_reference(q4.transpose(0, 2, 1, 3), kd, vd, mask=mask,
+                              causal=False).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_int8_error_vs_f32_pinned():
+    """Pinned quantization-error budget: int8 KV attention vs the exact
+    f32 paged path. Per-row symmetric int8 on unit-normal KV keeps the
+    attention output within a few percent — regressions in the codec
+    (wrong scale axis, asymmetric drift) blow straight through this."""
+    from deepspeed_tpu.ops.decode_attention import decode_attention_paged
+
+    args = _paged_setup(2, [17, 40], 1, bs=32, mb=4, seed=7)
+    q4, k_pool, v_pool, tables, lens = args
+    ref = _paged_dense_ref(*args)
+    from deepspeed_tpu.models.decode_utils import cache_attn_mask
+    from deepspeed_tpu.ops.decode_attention import gather_paged_cache_int8
+
+    kq, vq, ks, vs = _int8_pools(k_pool, v_pool)
+    S = tables.shape[-1] * k_pool.shape[1]
+    kd = gather_paged_cache_int8(kq, ks, tables).transpose(0, 2, 1, 3)
+    vd = gather_paged_cache_int8(vq, vs, tables).transpose(0, 2, 1, 3)
+    mask = cache_attn_mask(S, lens, 1)
+    out = attention_reference(q4.transpose(0, 2, 1, 3), kd, vd, mask=mask,
+                              causal=False).transpose(0, 2, 1, 3)
+    err = np.max(np.abs(np.asarray(out) - np.asarray(ref)))
+    assert err < 0.05, f"int8 KV attention error {err} past the pinned budget"
+
+
+def test_quantize_rowwise_roundtrip():
+    from deepspeed_tpu.ops.quantizer import dequantize_rowwise, quantize_rowwise
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(3, 5, 4, 64)).astype(np.float32))
+    q, s = quantize_rowwise(x)
+    assert q.dtype == jnp.int8 and s.shape == (3, 5, 4, 1)
+    back = dequantize_rowwise(q, s)
+    assert float(jnp.max(jnp.abs(back - x))) < float(jnp.max(jnp.abs(x))) / 100
+    # all-zero rows (the garbage block) round-trip to exact zeros
+    z = jnp.zeros((1, 2, 2, 8), jnp.float32)
+    qz, sz = quantize_rowwise(z)
+    assert (np.asarray(qz) == 0).all() and (np.asarray(sz) == 1.0).all()
+    assert (np.asarray(dequantize_rowwise(qz, sz)) == 0).all()
+
+
 @pytest.mark.heavy
 def test_model_decode_uses_kernel(monkeypatch):
     """End-to-end: GPT-2 decode with the kernel matches the dense path."""

@@ -1068,7 +1068,13 @@ class TestServingPolicyImportHygiene:
 
         start = ["deepspeed_tpu.serving.scheduler",
                  "deepspeed_tpu.serving.router",
-                 "deepspeed_tpu.serving.health"]
+                 "deepspeed_tpu.serving.health",
+                 # the admission fast path: block refcounting/COW and the
+                 # radix prefix cache are pure host bookkeeping — a jax
+                 # import here would put device-library latency inside
+                 # every admit()
+                 "deepspeed_tpu.serving.blocks",
+                 "deepspeed_tpu.serving.prefix_cache"]
         seen, stack, offenders = set(), list(start), []
         while stack:
             name = stack.pop()
@@ -1104,7 +1110,9 @@ class TestServingPolicyImportHygiene:
             f"{offenders} — host-side routing must stay device-free")
         # the walk actually covered the policy surface
         assert {"deepspeed_tpu.serving.config",
-                "deepspeed_tpu.serving.request"} <= seen
+                "deepspeed_tpu.serving.request",
+                "deepspeed_tpu.serving.blocks",
+                "deepspeed_tpu.serving.prefix_cache"} <= seen
 
 
 # ---------------------------------------------------------------------------

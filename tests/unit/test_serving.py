@@ -55,6 +55,15 @@ class TestBuckets:
             ServingConfig(prompt_buckets=[0, 8])
         assert ServingConfig(prompt_buckets=[16, 8, 8]).prompt_buckets == \
             [8, 16]
+        with pytest.raises(ValueError):
+            ServingConfig(prefill_chunk_tokens=-1)
+        with pytest.raises(ValueError):
+            ServingConfig(kv_cache_dtype="fp8")
+        cfg = ServingConfig()
+        # the serving fast path defaults OFF: absent keys mean the PR 4
+        # programs, byte-identical
+        assert not cfg.prefix_cache and cfg.prefill_chunk_tokens == 0
+        assert cfg.kv_cache_dtype == ""
 
 
 class TestBlockManager:
@@ -91,14 +100,160 @@ class TestBlockManager:
             BlockManager(8, 8, 2).allocate("c", 100)
 
 
+class TestBlockSharing:
+    """Refcounted copy-on-write pool: the prefix-cache substrate."""
+
+    def test_shared_blocks_release_by_refcount(self):
+        mgr = BlockManager(num_blocks=8, block_size=8, max_blocks_per_seq=4)
+        ta = mgr.allocate("a", 24)                      # 3 blocks
+        mgr.allocate("b", 24, shared=list(ta[:2]))      # shares 2, takes 1
+        assert mgr.ref_count(ta[0]) == 2 and mgr.is_shared(ta[0])
+        assert mgr.num_free == 8 - 1 - 4                # 4 physical blocks
+        assert mgr.release("a") == 3
+        # shared blocks survive their co-owner; a's private tail frees
+        assert mgr.ref_count(ta[0]) == 1
+        assert mgr.num_free == 8 - 1 - 3
+        assert mgr.release("b") == 3
+        assert mgr.num_free == 8 - 1
+
+    def test_cached_blocks_park_evictable_and_recycle_lru(self):
+        evicted = []
+        mgr = BlockManager(num_blocks=4, block_size=8, max_blocks_per_seq=3)
+        mgr.on_evict = evicted.append
+        t = mgr.allocate("a", 24)
+        for b in t[:3]:
+            mgr.mark_cached(b)
+        mgr.release("a")
+        # cached blocks are reclaimable-but-warm: counted free, not freed
+        assert mgr.num_free == 3 and mgr.num_cached == 3
+        mgr.touch([t[0]])  # LRU hit: t[0] becomes most recent
+        # release parks deepest-first, so eviction recycles the chain
+        # tail before its parents: t[2] then t[1]
+        t2 = mgr.allocate("b", 16)
+        assert evicted == [t[2], t[1]]
+        assert set(t2[:2]) == {t[1], t[2]}
+        assert mgr.num_cached == 1  # t[0] survived as the warmest
+
+    def test_cow_pins_source_until_done(self):
+        mgr = BlockManager(num_blocks=5, block_size=8, max_blocks_per_seq=4)
+        t = mgr.allocate("a", 10)              # blocks for 10 tokens: 2
+        mgr.mark_cached(t[0])
+        mgr.mark_cached(t[1])
+        mgr.release("a")
+        tb = mgr.allocate("b", 20, shared=[int(t[0])], cow_src=int(t[1]))
+        # the pending copy holds the source alive: not evictable, ref 1
+        assert mgr.ref_count(t[1]) == 1
+        assert tb[0] == t[0] and tb[1] not in (t[0], t[1])
+        mgr.cow_done("b")
+        assert mgr.ref_count(t[1]) == 0
+        mgr.release("b")
+        # release with a pending COW unpins too (cancel mid-admit)
+        tc = mgr.allocate("c", 20, shared=[int(t[0])], cow_src=int(t[1]))
+        assert tc is not None and mgr.ref_count(t[1]) == 1
+        mgr.release("c")
+        assert mgr.ref_count(t[1]) == 0
+        assert mgr.num_free == 4
+
+    def test_can_allocate_shared_discounts_pinned_evictables(self):
+        mgr = BlockManager(num_blocks=3, block_size=8, max_blocks_per_seq=2)
+        t = mgr.allocate("a", 16)
+        for b in t[:2]:
+            mgr.mark_cached(b)
+        mgr.release("a")
+        assert mgr.num_free == 2
+        # sharing BOTH evictable blocks leaves nothing to take fresh
+        assert not mgr.can_allocate_shared(17, shared=[int(t[0]),
+                                                       int(t[1])])
+        assert mgr.can_allocate_shared(16, shared=[int(t[0])])
+
+    def test_drop_cached_returns_evictable_to_free_list(self):
+        mgr = BlockManager(num_blocks=3, block_size=8, max_blocks_per_seq=2)
+        t = mgr.allocate("a", 8)
+        mgr.mark_cached(t[0])
+        mgr.release("a")
+        assert len(mgr._free) == 1 and len(mgr._evictable) == 1
+        mgr.drop_cached(t[0])
+        assert len(mgr._free) == 2 and mgr.num_cached == 0
+
+
+class TestPrefixCache:
+    def _pair(self, num_blocks=12, bs=4):
+        from deepspeed_tpu.serving.prefix_cache import PrefixCache
+
+        mgr = BlockManager(num_blocks, bs, max_blocks_per_seq=8)
+        return mgr, PrefixCache(mgr)
+
+    def test_match_caps_at_prompt_minus_one(self):
+        mgr, pc = self._pair()
+        prompt = list(range(8))  # exactly 2 full blocks
+        t = mgr.allocate("a", 10)
+        pc.insert(prompt, t)
+        # an identical prompt must keep >= 1 tail token to prefill, so
+        # only the FIRST block may match
+        shared, cow, matched = pc.match(prompt)
+        assert shared == [int(t[0])] and cow is None and matched == 4
+        # an extended prompt matches both full blocks
+        shared, cow, matched = pc.match(prompt + [9])
+        assert shared == [int(t[0]), int(t[1])] and matched == 8
+
+    def test_partial_tail_matches_as_cow(self):
+        mgr, pc = self._pair()
+        prompt = list(range(6))  # 1 full block + 2-token tail
+        t = mgr.allocate("a", 8)
+        pc.insert(prompt, t)
+        shared, cow, matched = pc.match(prompt + [9, 10])
+        assert shared == [int(t[0])]
+        assert cow == int(t[1]) and matched == 6
+        # a diverging tail shares only the full block
+        shared, cow, matched = pc.match(list(range(4)) + [99, 98, 97])
+        assert shared == [int(t[0])] and cow is None and matched == 4
+
+    def test_eviction_prunes_subtree(self):
+        mgr, pc = self._pair(num_blocks=6, bs=4)
+        prompt = list(range(12))  # 3 full blocks
+        t = mgr.allocate("a", 13)
+        pc.insert(prompt, t)
+        mgr.release("a")
+        assert mgr.num_cached == 3
+        mgr.allocate("b", 8)        # drains the free list, no eviction
+        assert len(pc) == 3
+        # make the chain's ROOT the LRU victim: its eviction orphans the
+        # two descendant blocks, which must leave the trie AND return
+        # their storage to the free list immediately
+        mgr.touch([t[1], t[2]])
+        mgr.allocate("c", 4)        # forces one eviction: the root block
+        assert len(pc) == 0 and mgr.num_cached == 0
+        assert mgr.owned("c") == [int(t[0])]
+        assert set(mgr._free) == {int(t[1]), int(t[2])}
+        shared, cow, matched = pc.match(prompt + [99])
+        assert not shared and cow is None and matched == 0
+
+    def test_insert_dedups_existing_chunks(self):
+        mgr, pc = self._pair()
+        p = list(range(8))
+        ta = mgr.allocate("a", 9)
+        pc.insert(p, ta)
+        tb = mgr.allocate("b", 9)  # same prompt prefilled unshared
+        added = pc.insert(p, tb)
+        assert added == 0  # existing physical blocks keep the index
+        shared, _, _ = pc.match(p + [1])
+        assert shared == [int(ta[0]), int(ta[1])]
+
+
 def _sched(clock, **kw):
     kw.setdefault("block_size", 8)
     kw.setdefault("decode_slots", 2)
     kw.setdefault("default_max_new_tokens", 4)
     cfg = ServingConfig(**kw)
     blocks = BlockManager(kw.get("num_blocks", 17), cfg.block_size, 8)
+    prefix = None
+    if kw.get("prefix_cache"):
+        from deepspeed_tpu.serving.prefix_cache import PrefixCache
+
+        prefix = PrefixCache(blocks)
     return ContinuousBatchingScheduler(cfg, blocks, max_len=64,
-                                       clock=clock), blocks
+                                       clock=clock,
+                                       prefix_cache=prefix), blocks
 
 
 class _Clock:
@@ -380,6 +535,111 @@ class TestSchedulerAccountingFuzz:
             len(sched.queue)
 
 
+class TestPrefixCowFuzz:
+    """Satellite: the PR 6 accounting fuzz extended with COW ops —
+    shared-prefix admits, release-with-refcount, LRU evictions under
+    pool pressure — pinning refcount / free-list / `committed_tokens`
+    mutual consistency. Host-only, tier-1."""
+
+    def _invariants(self, sched, blocks, prefix):
+        live = list(sched.queue) + [r for r in sched.slots if r is not None]
+        assert sched.committed_tokens == sum(
+            r.prompt_len + r.max_new_tokens for r in live)
+        assert sched._live_ids == {r.request_id for r in live}
+        # every physical block is in EXACTLY one state: free, parked
+        # evictable, or live-referenced
+        free = set(blocks._free)
+        evictable = set(blocks._evictable)
+        referenced = set(blocks._ref)
+        assert not (free & evictable) and not (free & referenced) \
+            and not (evictable & referenced)
+        assert free | evictable | referenced == \
+            set(range(1, blocks.num_blocks))
+        # refcount == holders: owners listing the block + pending COW pins
+        expect = {}
+        for blocks_list in blocks._owned.values():
+            for b in blocks_list:
+                expect[b] = expect.get(b, 0) + 1
+        for b in blocks._cow_pending.values():
+            expect[b] = expect.get(b, 0) + 1
+        assert blocks._ref == expect
+        # evictable blocks are all cached; nothing cached sits on the
+        # free list (a freed block must be unindexed)
+        assert evictable <= blocks._cached
+        assert not (free & blocks._cached)
+        # the trie indexes exactly the cached blocks
+        assert set(prefix._by_block) == blocks._cached
+        # only RUNNING sequences own blocks
+        assert set(blocks._owned) == {
+            r.request_id for r in sched.slots if r is not None}
+
+    def test_random_walk_with_prefix_sharing(self):
+        rng = np.random.default_rng(7)
+        clk = _Clock()
+        sched, blocks = _sched(clk, max_queue_depth=6, num_blocks=12,
+                               deadline_ms=200.0, prefix_cache=True)
+        prefix = sched.prefix
+        # prompt families with long common prefixes drive real sharing
+        families = [list(rng.integers(1, 99, 40)) for _ in range(3)]
+        next_id = 0
+        pending_cow = {}  # request_id -> admitted but engine not done
+        for step in range(800):
+            op = rng.choice(["submit", "admit", "finish", "cancel", "tick"])
+            if op == "submit":
+                fam = families[int(rng.integers(len(families)))]
+                cut = int(rng.integers(1, len(fam)))
+                prompt = fam[:cut] + list(rng.integers(100, 200, int(
+                    rng.integers(0, 6))))
+                rid, next_id = f"z-{next_id}", next_id + 1
+                sched.submit(Request(
+                    prompt=prompt,
+                    max_new_tokens=int(rng.integers(1, 10)),
+                    request_id=rid,
+                    deadline_ms=float(rng.choice([0.0, 50.0, 500.0]))),
+                    now=clk.t)
+            elif op == "admit":
+                admitted, _ = sched.admit(now=clk.t)
+                for _, r, table in admitted:
+                    if rng.random() < 0.25:
+                        # engine "crashed" between admit and prefill:
+                        # the COW pin stays until finish/cancel releases
+                        pending_cow[r.request_id] = table
+                    else:
+                        blocks.cow_done(r.request_id)
+                        prefix.insert(r.prompt, table)
+            elif op == "finish":
+                running = [r for r in sched.slots if r is not None]
+                if running:
+                    pick = running[int(rng.integers(len(running)))]
+                    pending_cow.pop(pick.request_id, None)
+                    sched.finish(pick, "eos", now=clk.t)
+            elif op == "cancel":
+                if sched._live_ids:
+                    ids = sorted(sched._live_ids)
+                    rid = ids[int(rng.integers(len(ids)))]
+                    pending_cow.pop(rid, None)
+                    sched.cancel(rid, "cancelled", now=clk.t)
+            else:
+                clk.t += float(rng.random() * 0.2)
+            self._invariants(sched, blocks, prefix)
+        # drain everything: live accounting returns to zero, and the
+        # pool partitions into free + warm evictable cache
+        clk.t += 10.0
+        for _ in range(60):
+            admitted, _ = sched.admit(now=clk.t)
+            for _, r, table in admitted:
+                blocks.cow_done(r.request_id)
+                prefix.insert(r.prompt, table)
+            for r in [r for r in sched.slots if r is not None]:
+                sched.finish(r, "eos", now=clk.t)
+        assert not sched.pending
+        assert sched.committed_tokens == 0 and not sched._live_ids
+        assert not blocks._ref and not blocks._cow_pending
+        assert blocks.num_free == blocks.num_blocks - 1
+        assert len(blocks._free) + len(blocks._evictable) == \
+            blocks.num_blocks - 1
+
+
 class TestWatchdogTouch:
     def test_touch_refreshes_only_when_armed(self):
         """Per-decode-step progress keeps a saturated server alive
@@ -613,6 +873,219 @@ class TestServingEngine:
 
 
 # ---------------------------------------------------------------------------
+# serving fast path: prefix cache + chunked prefill + int8 KV (heavy)
+# ---------------------------------------------------------------------------
+@pytest.mark.heavy
+class TestServingFastPath:
+    def _ref_tokens(self, engine, prompt, n):
+        import jax.numpy as jnp
+
+        _, ref = _tiny_serving()
+        ref.params = engine.params
+        out = ref.generate(jnp.asarray(np.asarray(prompt)[None]),
+                           max_new_tokens=n, do_sample=False)
+        return [int(t) for t in out[0, len(prompt):]]
+
+    def test_shared_prefix_physical_sharing_and_bitmatch(self):
+        """Acceptance: two sequences sharing a system prompt physically
+        share prefix blocks (asserted on BlockManager state), the second
+        request prefills only the tail, and greedy output bit-matches an
+        unshared run."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, engine = _tiny_serving(serving={**_SERVING,
+                                           "prefix_cache": True})
+        srv = ServingEngine(engine)
+        rng = np.random.default_rng(0)
+        system = rng.integers(1, 256, 16)  # exactly 2 full blocks
+        p_a = np.concatenate([system, rng.integers(1, 256, 5)])
+        p_b = np.concatenate([system, rng.integers(1, 256, 7)])
+        p_c = np.concatenate([system, rng.integers(1, 256, 3)])
+        a = srv.submit(p_a, max_new_tokens=4)
+        srv.drain()  # populates the radix cache with a's prompt blocks
+        sys_blocks = srv.block_mgr.owned(a.request_id)  # gone after drain
+        b = srv.submit(p_b, max_new_tokens=4)
+        c = srv.submit(p_c, max_new_tokens=4)
+        srv.step()  # both admit + prefill their tails
+        owned_b = srv.block_mgr.owned(b.request_id)
+        owned_c = srv.block_mgr.owned(c.request_id)
+        shared = set(owned_b) & set(owned_c)
+        assert len(shared) == 2, (owned_b, owned_c)  # the 2 system blocks
+        for blk in shared:
+            assert srv.block_mgr.ref_count(blk) == 2  # both rows hold it
+        # the second request's prefill processed ONLY the tail tokens
+        assert b.prefix_hit_tokens == 16 and b.cached_len == 16
+        assert b.blocks_shared == 2 and b.prefill_chunks == 1
+        # tail chunks ran through the small chunk bucket, never a
+        # whole-prompt program for the full 23-token prompt
+        assert set(srv._chunk_fns) <= {8, 16}
+        srv.drain()
+        for req, p in ((a, p_a), (b, p_b), (c, p_c)):
+            assert req.state == FINISHED
+            assert req.tokens == self._ref_tokens(engine, p, 4), \
+                req.request_id
+        # released shared blocks parked warm (evictable), not freed
+        assert srv.block_mgr.num_free == srv.num_blocks - 1
+        assert srv.block_mgr.num_cached > 0
+        assert not sys_blocks  # a's ownership ended at its finish
+
+    def test_partial_tail_copy_on_write_bitmatch(self):
+        """A prompt extending a cached prompt's partial last block maps
+        it via COW: the copy is private, the donor's cached rows stay
+        intact, and tokens bit-match the unshared run."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, engine = _tiny_serving(serving={**_SERVING,
+                                           "prefix_cache": True})
+        srv = ServingEngine(engine)
+        rng = np.random.default_rng(1)
+        p1 = rng.integers(1, 256, 18)                  # 2 blocks + 2 tail
+        p2 = np.concatenate([p1, rng.integers(1, 256, 6)])
+        a = srv.submit(p1, max_new_tokens=3)
+        srv.drain()
+        b = srv.submit(p2, max_new_tokens=3)
+        srv.drain()
+        # full blocks shared + the partial tail block copied-on-write
+        assert b.prefix_hit_tokens == 18
+        assert b.blocks_shared == 3 and b.cow is not None
+        assert b.tokens == self._ref_tokens(engine, p2, 3)
+        # the donor prompt still matches its own cache entries afterward
+        c = srv.submit(np.concatenate([p1, rng.integers(1, 256, 2)]),
+                       max_new_tokens=3)
+        srv.drain()
+        assert c.prefix_hit_tokens == 18
+
+    def test_chunked_prefill_bitmatch_and_interleave(self):
+        """Chunked prefill: a long prompt advances one budgeted chunk
+        per step while decodes continue; a short request admitted behind
+        it reaches its first token BEFORE the long prefill completes
+        (the TTFT bound), and every token bit-matches generate()."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, engine = _tiny_serving(serving={**_SERVING, "decode_slots": 2,
+                                           "prefill_chunk_tokens": 8})
+        srv = ServingEngine(engine)
+        rng = np.random.default_rng(2)
+        long_p = rng.integers(1, 256, 33)   # 5 chunks of 8
+        short_p = rng.integers(1, 256, 5)   # 1 chunk
+        a = srv.submit(long_p, max_new_tokens=3)
+        b = srv.submit(short_p, max_new_tokens=3)
+        short_first_step, steps = None, 0
+        while srv.pending and steps < 64:
+            srv.step()
+            steps += 1
+            if short_first_step is None and b.tokens:
+                short_first_step = steps
+                assert not a.tokens  # long prompt still mid-prefill
+        assert a.prefill_chunks == 5 and b.prefill_chunks == 1
+        assert short_first_step is not None and short_first_step < steps
+        assert a.tokens == self._ref_tokens(engine, long_p, 3)
+        assert b.tokens == self._ref_tokens(engine, short_p, 3)
+        # ONE chunk program serves every prompt length
+        assert set(srv._chunk_fns) == {8}
+        assert len(srv._prefill_fns) == 0  # the bucket ladder is gone
+
+    def test_chunked_prefill_zero_steady_state_retraces(self):
+        """Acceptance: steady-state chunked-prefill serving holds the
+        zero-retrace compile-watchdog pin — chunk + decode programs warm
+        once, then arbitrary mixed traffic compiles nothing."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, engine = _tiny_serving(
+            serving={**_SERVING, "prefix_cache": True,
+                     "prefill_chunk_tokens": 8},
+            telemetry={"enabled": True, "compile_watchdog": True,
+                       "jsonl": False, "memory": False, "warmup_steps": 1})
+        srv = ServingEngine(engine)
+        rng = np.random.default_rng(3)
+        base = rng.integers(1, 256, 20)
+        # warmup: fresh prompt, shared-prefix admit (drives the COW
+        # program too), chunked long prompt
+        srv.submit(base, max_new_tokens=2)
+        srv.drain()
+        srv.submit(np.concatenate([base, rng.integers(1, 256, 4)]),
+                   max_new_tokens=2)
+        srv.submit(rng.integers(1, 256, 40), max_new_tokens=2)
+        srv.drain()
+        warm = {k: dict(v) for k, v in
+                engine.telemetry.summary()["per_function"].items()}
+        assert "serving.chunk" in warm and "serving.decode" in warm
+        assert "serving.cow" in warm
+        for i, n in enumerate((3, 21, 9, 40, 33, 6)):
+            srv.submit(rng.integers(1, 256, n), max_new_tokens=3)
+            srv.submit(np.concatenate([base[:16],
+                                       rng.integers(1, 256, i + 1)]),
+                       max_new_tokens=2)
+            srv.step()
+        srv.drain()
+        after = engine.telemetry.summary()["per_function"]
+        for fam in ("serving.chunk", "serving.decode", "serving.cow"):
+            assert after[fam]["compiles"] == warm[fam]["compiles"], \
+                (fam, warm[fam], after[fam])
+            assert after[fam]["retraces_after_warm"] == \
+                warm[fam]["retraces_after_warm"]
+
+    def test_decode_hlo_byte_identical_with_fast_path_off(self):
+        """Acceptance (zero-overhead pin, PR 2-6 convention): with the
+        prefix_cache / kv_cache_dtype keys absent, the compiled decode
+        program is byte-identical to one built by a prefix-cache-enabled
+        engine (the cache is pure host bookkeeping), and the chunk/COW
+        programs simply do not exist."""
+        import jax
+
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.serving import ServingEngine
+
+        texts = []
+        for extra in ({}, {"prefix_cache": True}):
+            _, engine = _tiny_serving(serving={**_SERVING, **extra})
+            srv = ServingEngine(engine)
+            fn = srv._build_decode()
+            tokens = jnp.zeros((srv.config.decode_slots, 1), jnp.int32)
+            tables = jnp.zeros((srv.config.decode_slots,
+                                srv.blocks_per_seq), jnp.int32)
+            lengths = jnp.zeros((srv.config.decode_slots,), jnp.int32)
+            lowered = fn.lower(engine.params, srv.cache, tokens, tables,
+                               lengths, jax.random.PRNGKey(0))
+            texts.append(lowered.compile().as_text())
+            srv.destroy()
+        assert texts[0] == texts[1]
+        # feature-off serving never touches the fast-path programs
+        _, engine = _tiny_serving(serving=_SERVING)
+        srv = ServingEngine(engine)
+        srv.submit(np.arange(1, 10), max_new_tokens=3)
+        srv.drain()
+        assert srv._chunk_fns == {} and srv._cow_fn is None
+        assert srv.prefix is None
+
+    def test_int8_kv_greedy_agreement_short_decode(self):
+        """Satellite: int8 KV blocks vs f32 KV — greedy tokens agree on
+        short decodes (quantization noise stays under every argmax
+        margin at this scale), and the int8 cache pytree carries the
+        scale side pools."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        import jax
+
+        _, engine = _tiny_serving(serving=_SERVING)
+        srv = ServingEngine(engine)
+        _, engine8 = _tiny_serving(serving={**_SERVING,
+                                            "kv_cache_dtype": "int8"})
+        engine8.params = engine.params
+        srv8 = ServingEngine(engine8)
+        leaves = jax.tree_util.tree_leaves_with_path(srv8.cache)
+        names = {jax.tree_util.keystr(p) for p, _ in leaves}
+        assert any("key_scale" in n for n in names)
+        assert any("value_scale" in n for n in names)
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(1, 256, n) for n in (5, 11, 17)]
+        toks = srv.generate_batch(prompts, max_new_tokens=4)
+        toks8 = srv8.generate_batch(prompts, max_new_tokens=4)
+        assert toks == toks8, (toks, toks8)
+
+
+# ---------------------------------------------------------------------------
 # legacy generate() bucketing satellite + zero-drift guard
 # ---------------------------------------------------------------------------
 @pytest.mark.heavy
@@ -706,3 +1179,57 @@ class TestLegacyGenerateBucketing:
                   if e["kind"] == "model_time"]
         assert [e["name"] for e in events] == ["forward", "generate"]
         assert engine.model_times() == []  # drained
+
+
+# ---------------------------------------------------------------------------
+# tooling: serving / prefix-cache section of the telemetry report
+# ---------------------------------------------------------------------------
+class TestTelemetryReportServingSection:
+    def _write_events(self, tmp_path):
+        from deepspeed_tpu.telemetry.events import dumps, make_event
+
+        evs = [
+            make_event("serving", "request.finish", 1, 0,
+                       {"prompt_len": 20, "prefix_hit_tokens": 0,
+                        "blocks_shared": 0, "prefill_chunks": 3}),
+            make_event("serving", "request.finish", 2, 0,
+                       {"prompt_len": 20, "prefix_hit_tokens": 16,
+                        "blocks_shared": 2, "prefill_chunks": 1}),
+            make_event("serving", "request.shed", 3, 0,
+                       {"reason": "queue_full"}),
+            make_event("serving", "step.gauges", 4, 0,
+                       {"free_blocks": 5, "cached_blocks": 3,
+                        "queue_depth": 0}),
+        ]
+        path = tmp_path / "telemetry.jsonl"
+        path.write_text("\n".join(dumps(e) for e in evs) + "\n")
+        return str(path)
+
+    def test_aggregate_and_render(self, tmp_path):
+        from tools.telemetry_report import aggregate, render
+
+        from deepspeed_tpu.telemetry.events import load_events
+
+        path = self._write_events(tmp_path)
+        agg = aggregate(load_events(path))["serving"]
+        assert agg["finished"] == 2 and agg["shed"] == 1
+        assert agg["prefix_hit_tokens"] == 16
+        assert agg["prompt_tokens"] == 40
+        assert agg["hit_requests"] == 1
+        assert agg["blocks_shared"] == 2
+        assert agg["prefill_chunks"] == 4
+        assert agg["last_gauges"]["cached_blocks"] == 3
+        text = render(path)
+        assert "serving: 2 finished, 1 shed, 4 prefill chunks" in text
+        assert "1/2 requests hit" in text
+        assert "16/40 prompt tokens served from cache (40.0%)" in text
+        assert "5 free blocks, 3 cached" in text
+        md = render(path, markdown=True)
+        assert "### serving:" in md
+
+    def test_empty_stream_renders_no_serving_section(self, tmp_path):
+        from tools.telemetry_report import render
+
+        path = tmp_path / "telemetry.jsonl"
+        path.write_text("")
+        assert "prefix cache" not in render(str(path))

@@ -49,6 +49,9 @@ def aggregate(events: List[Dict]) -> Dict:
               "failovers": 0, "tier_transitions": [], "last_tier": 0,
               "finished": 0, "shed": 0, "shed_reasons": {},
               "replay_divergence": 0, "events": 0}
+    serving = {"events": 0, "finished": 0, "shed": 0, "prompt_tokens": 0,
+               "prefix_hit_tokens": 0, "hit_requests": 0, "blocks_shared": 0,
+               "prefill_chunks": 0, "last_gauges": {}}
     for e in events:
         kind, name, data = e.get("kind"), e.get("name"), e.get("data", {})
         if kind == "compile":
@@ -116,6 +119,21 @@ def aggregate(events: List[Dict]) -> Dict:
                     router["shed_reasons"].get(reason, 0) + 1
             elif name == "replay.divergence":
                 router["replay_divergence"] += 1
+        elif kind == "serving":
+            serving["events"] += 1
+            if name == "request.finish":
+                serving["finished"] += 1
+                serving["prompt_tokens"] += data.get("prompt_len") or 0
+                hit = data.get("prefix_hit_tokens") or 0
+                serving["prefix_hit_tokens"] += hit
+                if hit:
+                    serving["hit_requests"] += 1
+                serving["blocks_shared"] += data.get("blocks_shared") or 0
+                serving["prefill_chunks"] += data.get("prefill_chunks") or 0
+            elif name == "request.shed":
+                serving["shed"] += 1
+            elif name == "step.gauges":
+                serving["last_gauges"] = data
     return {
         "compile": compile_by_name,
         "step_cost": step_cost_by_name,
@@ -125,7 +143,34 @@ def aggregate(events: List[Dict]) -> Dict:
         "steps": steps,
         "faults": faults,
         "router": router,
+        "serving": serving,
     }
+
+
+def _serving_lines(agg: Dict, markdown: bool) -> List[str]:
+    """Serving fast path: prefix-cache hit rate, block sharing, chunked
+    prefill — the per-request ``serving`` event aggregates."""
+    s = agg.get("serving") or {}
+    if not s.get("events"):
+        return []
+    out = [""]
+    head = (f"serving: {s['finished']} finished, {s['shed']} shed, "
+            f"{s['prefill_chunks']} prefill chunks")
+    out.append(("### " if markdown else "") + head)
+    pad = "" if markdown else "  "
+    if s["prompt_tokens"]:
+        rate = s["prefix_hit_tokens"] / s["prompt_tokens"]
+        out.append(
+            f"{pad}prefix cache: {s['hit_requests']}/{s['finished']} "
+            f"requests hit, {s['prefix_hit_tokens']}/{s['prompt_tokens']} "
+            f"prompt tokens served from cache ({100 * rate:.1f}%), "
+            f"{s['blocks_shared']} blocks mapped shared")
+    g = s.get("last_gauges") or {}
+    if "cached_blocks" in g or "free_blocks" in g:
+        out.append(f"{pad}pool at last step: "
+                   f"{g.get('free_blocks', '?')} free blocks, "
+                   f"{g.get('cached_blocks', 0)} cached")
+    return out
 
 
 def _router_lines(agg: Dict, markdown: bool) -> List[str]:
@@ -286,6 +331,7 @@ def render(path: str, markdown: bool = False) -> str:
         lines.append(f"trace window: {w['action']} at step {w['step']}"
                      + (f" -> {w['dir']}" if w.get("dir") else ""))
     lines.extend(_fault_lines(agg, markdown))
+    lines.extend(_serving_lines(agg, markdown))
     lines.extend(_router_lines(agg, markdown))
     return "\n".join(lines)
 

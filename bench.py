@@ -183,11 +183,15 @@ def main():
                         " PaLM appx B); vs_baseline = mfu / 0.40"),
     })
     # headline is on the wire above — everything below is an OPTIONAL
-    # extra series; a chip flap here can no longer zero the artifact
-    _telemetry_series(warm_mark, steps)
-    _resilience_series(cfg, batch, seq, on_tpu)
-    _comm_compression_series(cfg, batch, seq, on_tpu)
-    _elastic_resume_series(cfg, batch, seq, on_tpu)
+    # extra series; a chip flap here can no longer zero the artifact.
+    # Each series function RETURNS its payload (importable through
+    # run_series — the live autotuner calls them in-process); the CLI
+    # emits them here, in the same order as always
+    emit_result(_telemetry_series(warm_mark, steps))
+    emit_result(_resilience_series(cfg, batch, seq, on_tpu))
+    emit_result(_comm_compression_series(cfg, batch, seq, on_tpu))
+    emit_result(_elastic_resume_series(cfg, batch, seq, on_tpu))
+    emit_result(_startup_series(cfg, batch, seq, on_tpu))
 
 
 def _telemetry_series(warm_mark, steps):
@@ -209,7 +213,7 @@ def _telemetry_series(warm_mark, steps):
             mem = get_accelerator().memory_stats()
         except Exception:
             mem = {}
-        emit_result({
+        return {
             "metric": METRIC + "_telemetry",
             "value": round(snap["backend_compile_secs"], 3),
             "unit": "compile_seconds",
@@ -222,12 +226,12 @@ def _telemetry_series(warm_mark, steps):
             "peak_bytes_in_use": mem.get("peak_bytes_in_use"),
             "bytes_in_use": mem.get("bytes_in_use"),
             "memory_source": mem.get("source"),
-        })
+        }
     except Exception as e:  # noqa: BLE001 — extras never kill the headline
         print(f"# telemetry series failed: {e}", file=sys.stderr, flush=True)
-        emit_result({"metric": METRIC + "_telemetry", "value": None,
-                     "unit": "compile_seconds", "vs_baseline": None,
-                     "error": str(e)[:300]})
+        return {"metric": METRIC + "_telemetry", "value": None,
+                "unit": "compile_seconds", "vs_baseline": None,
+                "error": str(e)[:300]}
 
 
 def _resilience_series(cfg, batch, seq, on_tpu, steps=5):
@@ -310,7 +314,7 @@ def _resilience_series(cfg, batch, seq, on_tpu, steps=5):
         enabled_rate = rate(enabled)
         enabled.destroy()
 
-        emit_result({
+        return {
             "metric": METRIC + "_resilience",
             "value": round(enabled_rate, 3),
             "unit": "steps/s",
@@ -322,13 +326,13 @@ def _resilience_series(cfg, batch, seq, on_tpu, steps=5):
             "sentinel_policy": "warn",
             "watchdog_armed": True,
             "n_dev": n_dev,
-        })
+        }
     except Exception as e:  # noqa: BLE001 — extras never kill the headline
         print(f"# resilience series failed: {e}", file=sys.stderr,
               flush=True)
-        emit_result({"metric": METRIC + "_resilience", "value": None,
-                     "unit": "steps/s", "vs_baseline": None,
-                     "error": str(e)[:300]})
+        return {"metric": METRIC + "_resilience", "value": None,
+                "unit": "steps/s", "vs_baseline": None,
+                "error": str(e)[:300]}
 
 
 def _comm_compression_series(cfg, batch, seq, on_tpu, steps=5):
@@ -386,7 +390,7 @@ def _comm_compression_series(cfg, batch, seq, on_tpu, steps=5):
         dense_tps, _ = rate(None)
         int8_tps, int8_active = rate(
             {"enabled": True, "dtype": "int8"})
-        emit_result({
+        return {
             "metric": METRIC + "_comm_compression",
             "value": round(int8_tps, 1),
             "unit": "tokens/s",
@@ -395,14 +399,14 @@ def _comm_compression_series(cfg, batch, seq, on_tpu, steps=5):
             "int8_wire_active": bool(int8_active),
             "n_dev": n_dev,
             "vs_baseline": round(int8_tps / dense_tps, 4) if dense_tps else None,
-        })
+        }
     except Exception as e:  # noqa: BLE001 — extras must never kill the
         # already-emitted headline; record the failure structurally
         print(f"# comm_compression series failed: {e}", file=sys.stderr,
               flush=True)
-        emit_result({"metric": METRIC + "_comm_compression", "value": None,
-                     "unit": "tokens/s", "vs_baseline": None,
-                     "error": str(e)[:300]})
+        return {"metric": METRIC + "_comm_compression", "value": None,
+                "unit": "tokens/s", "vs_baseline": None,
+                "error": str(e)[:300]}
 
 
 def _elastic_resume_series(cfg, batch, seq, on_tpu):
@@ -483,7 +487,7 @@ def _elastic_resume_series(cfg, batch, seq, on_tpu):
         finally:
             shutil.rmtree(save_dir, ignore_errors=True)
 
-        emit_result({
+        return {
             "metric": METRIC + "_elastic_resume",
             "value": round(same, 4),
             "unit": "restore_seconds",
@@ -493,14 +497,336 @@ def _elastic_resume_series(cfg, batch, seq, on_tpu):
             else None,
             "saved_world": n_dev,
             "reshard_world": n_dev // 2 if n_dev >= 2 else None,
-        })
+        }
     except Exception as e:  # noqa: BLE001 — extras must never kill the
         # already-emitted headline; record the failure structurally
         print(f"# elastic_resume series failed: {e}", file=sys.stderr,
               flush=True)
-        emit_result({"metric": METRIC + "_elastic_resume", "value": None,
-                     "unit": "restore_seconds", "vs_baseline": None,
-                     "error": str(e)[:300]})
+        return {"metric": METRIC + "_elastic_resume", "value": None,
+                "unit": "restore_seconds", "vs_baseline": None,
+                "error": str(e)[:300]}
+
+
+def _train_step_series(cfg, batch, seq, on_tpu, steps=3, ds_overrides=None,
+                       tunables=None):
+    """Importable, parameterized train-step measurement — the live
+    autotuner's training-side hook (``run_series("train_step", ...)``).
+    Builds a telemetry-enabled engine with the candidate's ds-config
+    overrides (and, for tile axes, temporarily-installed kernel
+    tunables), then reports the telemetry-stream objectives next to the
+    step rate: compile seconds, retraces INSIDE the timed window, and
+    the compiled step's collective wire bytes (the step_cost events) —
+    a candidate that is fast but retraces every step must lose."""
+    import jax
+    import numpy as np_
+
+    import deepspeed_tpu
+    from deepspeed_tpu.autotuning import runtime_tunables
+    from deepspeed_tpu.models.gpt2 import GPT2ForTraining
+    from deepspeed_tpu.parallel.topology import reset_topology
+
+    n_dev = jax.device_count()
+    rows = batch * n_dev
+    rng = np_.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (rows, seq)).astype(np_.int32)
+    config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4}},
+        "bf16": {"enabled": on_tpu},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 10_000,
+        "telemetry": {"enabled": True, "jsonl": False, "memory": False},
+    }
+    for k, v in (ds_overrides or {}).items():
+        if isinstance(v, dict):
+            config[k] = {**config.get(k, {}), **v}
+        else:
+            config[k] = v
+    token = runtime_tunables.install(dict(tunables)) if tunables else None
+    engine = None
+    try:
+        reset_topology()
+        engine, *_ = deepspeed_tpu.initialize(model=GPT2ForTraining(cfg),
+                                              config=config)
+        loss = engine({"input_ids": ids})
+        engine.backward(loss)
+        engine.step()
+        jax.block_until_ready(engine.state.params)
+        warm = engine.telemetry.summary()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine({"input_ids": ids})
+            engine.backward(loss)
+            engine.step()
+        float(loss)
+        jax.block_until_ready(engine.state.params)
+        dt = time.perf_counter() - t0
+        summary = engine.telemetry.summary()
+        wire = max((e["data"].get("collective_operand_bytes") or 0
+                    for e in engine.telemetry.tail(200)
+                    if e["kind"] == "step_cost"), default=0)
+    finally:
+        # a failed candidate is tuner EVIDENCE, not a crash — the next
+        # candidate must not measure against this one's leaked engine
+        # (live telemetry, still-allocated device arrays), and even a
+        # RAISING destroy() must not leave this candidate's tunables
+        # installed for every later trial
+        try:
+            if engine is not None:
+                engine.destroy()
+        finally:
+            runtime_tunables.uninstall(token)
+    compiles = {k: v["compiles"] for k, v in summary["per_function"].items()}
+    warm_compiles = sum(v["compiles"] for v in warm["per_function"].values())
+    retraces = sum(compiles.values()) - warm_compiles
+    return {
+        "metric": METRIC + "_train_step",
+        "steps_per_sec": round(steps / dt, 4),
+        "tokens_per_sec": round(steps * rows * seq / dt / n_dev, 1),
+        "compile_secs": round(sum(v["compile_secs"] for v in
+                                  summary["per_function"].values()), 3),
+        "retraces_in_timed_window": int(retraces),
+        "collective_wire_bytes": int(wire),
+        "n_dev": n_dev, "batch": batch, "seq": seq, "steps": steps,
+        "ds_overrides": ds_overrides or {},
+        "tunables": dict(tunables or {}),
+    }
+
+
+def _startup_series(cfg, batch, seq, on_tpu, steps=3):
+    """Optional extra series (after the headline JSON): what the AOT
+    program cache buys on restart. One engine (telemetry + aot enabled)
+    trains briefly and saves a checkpoint carrying its compiled
+    programs; a FRESH same-topology engine then resumes — its
+    time-to-first-step and in-window backend-compile count are the
+    warm numbers (zero compiles where the backend supports executable
+    deserialization; compat-gated environments record the loud
+    fallback instead). Plus tuned-vs-default steady-state step rate
+    when a tuned.json artifact is present."""
+    import shutil
+    import sys
+    import tempfile
+
+    import jax
+    import numpy as np_
+
+    import deepspeed_tpu
+    from deepspeed_tpu.telemetry import compile_watch
+    from deepspeed_tpu.utils.compat import aot_serialization_safe
+
+    try:
+        from deepspeed_tpu.models.gpt2 import GPT2ForTraining
+        from deepspeed_tpu.parallel.topology import reset_topology
+
+        n_dev = jax.device_count()
+        rows = batch * n_dev
+        rng = np_.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (rows, seq)).astype(np_.int32)
+
+        def build(tuning=False):
+            reset_topology()
+            config = {
+                "train_micro_batch_size_per_gpu": batch,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 6e-4}},
+                "bf16": {"enabled": on_tpu},
+                "zero_optimization": {"stage": 0},
+                "steps_per_print": 10_000,
+                "telemetry": {"enabled": True, "jsonl": False,
+                              "memory": False},
+                "aot": {"enabled": True},
+            }
+            if tuning:
+                config["tuning"] = {"enabled": True}
+            engine, *_ = deepspeed_tpu.initialize(
+                model=GPT2ForTraining(cfg), config=config)
+            return engine
+
+        def first_step_secs(engine):
+            t0 = time.perf_counter()
+            loss = engine({"input_ids": ids})
+            engine.backward(loss)
+            engine.step()
+            float(loss)
+            jax.block_until_ready(engine.state.params)
+            return time.perf_counter() - t0
+
+        save_dir = tempfile.mkdtemp(prefix="bench_aot_")
+        try:
+            saver = build()
+            cold_tffs = first_step_secs(saver)
+            saver.save_checkpoint(save_dir, tag="startup")
+            aot_events = [e["name"] for e in saver.telemetry.tail(50)
+                          if e["kind"] == "aot"]
+            saver.destroy()
+
+            resumed = build()
+            resumed.load_checkpoint(save_dir, tag="startup")
+            mark = compile_watch.snapshot()["backend_compiles"]
+            warm_tffs = first_step_secs(resumed)
+            warm_compiles = (compile_watch.snapshot()["backend_compiles"]
+                             - mark)
+            resumed.destroy()
+        finally:
+            shutil.rmtree(save_dir, ignore_errors=True)
+
+        # tuned-vs-default steady-state step rate (only when the live
+        # autotuner has written an artifact for THIS topology)
+        tuned_rate = default_rate = None
+        tuned_path = os.path.join("autotuning_results", "tuned.json")
+        if os.path.exists(tuned_path):
+            def rate(tuning):
+                engine = build(tuning=tuning)
+                first_step_secs(engine)  # compile outside the window
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    loss = engine({"input_ids": ids})
+                    engine.backward(loss)
+                    engine.step()
+                float(loss)
+                jax.block_until_ready(engine.state.params)
+                dt = time.perf_counter() - t0
+                engine.destroy()
+                return steps / dt
+
+            try:
+                default_rate = rate(False)
+                tuned_rate = rate(True)
+            except Exception as e:  # noqa: BLE001 — stale artifact
+                # (other topology) must not kill the startup numbers
+                print(f"# startup tuned-vs-default skipped: {e}",
+                      file=sys.stderr, flush=True)
+
+        return {
+            "metric": METRIC + "_startup",
+            "value": round(warm_tffs, 3),
+            "unit": "warm_restart_first_step_seconds",
+            "vs_baseline": round(warm_tffs / cold_tffs, 4)
+            if cold_tffs else None,
+            "cold_first_step_secs": round(cold_tffs, 3),
+            "warm_first_step_secs": round(warm_tffs, 3),
+            "warm_backend_compiles": int(warm_compiles),
+            "aot_supported": aot_serialization_safe(),
+            "aot_save_events": aot_events,
+            "tuned_steps_per_sec": round(tuned_rate, 3)
+            if tuned_rate else None,
+            "default_steps_per_sec": round(default_rate, 3)
+            if default_rate else None,
+            "n_dev": n_dev,
+        }
+    except Exception as e:  # noqa: BLE001 — extras never kill the headline
+        print(f"# startup series failed: {e}", file=sys.stderr, flush=True)
+        return {"metric": METRIC + "_startup", "value": None,
+                "unit": "warm_restart_first_step_seconds",
+                "vs_baseline": None, "error": str(e)[:300]}
+
+
+# ---------------------------------------------------------------------------
+# importable series registry: run_series(name, config) -> payload dict.
+# The live autotuner (autotuning/measure.py) drives these in-process
+# instead of shelling out; the CLI keeps emitting the same JSON lines in
+# the same order (headline first) as before.
+def _series_context(config=None):
+    """Model/batch/seq defaults shared by every importable series. The
+    in-process callers never subprocess-probe the backend — whatever
+    platform jax already initialized is the measurement platform."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+
+    config = dict(config or {})
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=768,
+                         n_layer=12, n_head=12, dtype=jnp.bfloat16,
+                         scan_layers=True)
+        batch, seq, steps = 16, 1024, 5
+    else:
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        batch, seq, steps = 4, 32, 2
+    return {
+        "cfg": config.get("model_config") or cfg,
+        "batch": int(config.get("batch", batch)),
+        "seq": int(config.get("seq", seq)),
+        "steps": int(config.get("steps", steps)),
+        "on_tpu": on_tpu,
+        "ds_overrides": config.get("ds_config") or {},
+        "tunables": config.get("tunables") or {},
+    }
+
+
+def run_series(name, config=None):
+    """Run ONE bench series in-process and return its payload dict
+    (never emits). ``config`` keys: ``model_config`` (a GPT2Config),
+    ``batch``/``seq``/``steps``, ``ds_config`` (overrides merged into
+    the engine config), ``tunables`` (kernel-registry values installed
+    for the measurement window only)."""
+    ctx = _series_context(config)
+    cfg, batch, seq = ctx["cfg"], ctx["batch"], ctx["seq"]
+    on_tpu = ctx["on_tpu"]
+    if name == "train_step":
+        return _train_step_series(cfg, batch, seq, on_tpu,
+                                  steps=ctx["steps"],
+                                  ds_overrides=ctx["ds_overrides"],
+                                  tunables=ctx["tunables"])
+    if name == "startup":
+        return _startup_series(cfg, batch, seq, on_tpu, steps=ctx["steps"])
+    if name == "telemetry":
+        import numpy as np_
+
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import GPT2ForTraining
+        from deepspeed_tpu.parallel.topology import reset_topology
+        from deepspeed_tpu.telemetry import compile_watch
+
+        # a standalone invocation needs its own measured window (the
+        # CLI couples this series to the headline's timed steps): warm
+        # one step, snapshot, then run the window — a retrace inside it
+        # is actually reportable
+        compile_watch.install()
+        reset_topology()
+        engine, *_ = deepspeed_tpu.initialize(
+            model=GPT2ForTraining(cfg),
+            config={"train_micro_batch_size_per_gpu": batch,
+                    "gradient_accumulation_steps": 1,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 6e-4}},
+                    "bf16": {"enabled": on_tpu},
+                    "zero_optimization": {"stage": 0},
+                    "steps_per_print": 10_000})
+        import jax as _jax
+
+        rows = batch * _jax.device_count()
+        ids = np_.random.default_rng(0).integers(
+            0, cfg.vocab_size, (rows, seq)).astype(np_.int32)
+        try:
+            loss = engine({"input_ids": ids})
+            engine.backward(loss)
+            engine.step()
+            _jax.block_until_ready(engine.state.params)
+            warm_mark = compile_watch.snapshot()
+            for _ in range(ctx["steps"]):
+                loss = engine({"input_ids": ids})
+                engine.backward(loss)
+                engine.step()
+            float(loss)
+            _jax.block_until_ready(engine.state.params)
+        finally:
+            engine.destroy()
+        return _telemetry_series(warm_mark, ctx["steps"])
+    if name == "resilience":
+        return _resilience_series(cfg, batch, seq, on_tpu)
+    if name == "comm_compression":
+        return _comm_compression_series(cfg, batch, seq, on_tpu)
+    if name == "elastic_resume":
+        return _elastic_resume_series(cfg, batch, seq, on_tpu)
+    raise KeyError(f"unknown bench series {name!r}; available: "
+                   f"{sorted(SERIES)}")
+
+
+SERIES = ("train_step", "startup", "telemetry", "resilience",
+          "comm_compression", "elastic_resume")
 
 
 if __name__ == "__main__":

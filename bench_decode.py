@@ -11,6 +11,11 @@ TTFT = prefill latency on the prompt (first compiled forward after warmup);
 decode tokens/s = steady-state autoregressive rate through the jitted
 scanned decode loop with the Pallas decode-attention kernel on the KV
 cache. On CPU a tiny proxy keeps the script runnable anywhere.
+
+Every series is an importable ``run_series(name, config) -> dict`` (the
+live autotuner drives ``decode_attention`` and ``serving_chunk``
+in-process instead of shelling out); the CLI emits the same JSON lines
+in the same order as always, headline first.
 """
 
 import time
@@ -25,25 +30,63 @@ from deepspeed_tpu.utils.chip_probe import (assert_platform, emit_result,
 METRIC = resolve_metric("gpt2_125m_decode", "gpt2_decode_cpu_smoke")
 
 
-def main():
-    platform = require_backend(METRIC)
-
+def _decode_context(config=None, on_tpu=None):
+    """Model + serving defaults shared by every series (one source: the
+    CLI main and the importable run_series must measure the same
+    shapes)."""
     import jax
     import jax.numpy as jnp
 
-    import deepspeed_tpu
-    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.models.gpt2 import GPT2Config
 
-    assert_platform(METRIC, platform)
-    on_tpu = is_tpu(platform)
+    config = dict(config or {})
+    if on_tpu is None:
+        on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=768,
                          n_layer=12, n_head=12, dtype=jnp.bfloat16,
                          scan_layers=True)
         batch, prompt, new_tokens, reps = 8, 128, 128, 5
+        scfg = {"block_size": 32, "decode_slots": batch,
+                "max_queue_depth": 4 * batch}
+        n_requests, arrive_every = 4 * batch, 2
+        lens = [prompt // 2, prompt, prompt + prompt // 2]
+        srv_new = new_tokens
     else:
         cfg = GPT2Config.tiny(dtype=jnp.float32)
         batch, prompt, new_tokens, reps = 2, 8, 8, 2
+        scfg = {"block_size": 8, "decode_slots": 2, "max_queue_depth": 16}
+        n_requests, arrive_every = 6, 1
+        lens = [4, 6, 8]
+        srv_new = 4
+    ctx = {
+        "cfg": config.get("model_config") or cfg,
+        "on_tpu": on_tpu,
+        "batch": int(config.get("batch", batch)),
+        "prompt": int(config.get("prompt", prompt)),
+        "new_tokens": int(config.get("new_tokens", new_tokens)),
+        "reps": int(config.get("reps", reps)),
+        "scfg": {**scfg, **(config.get("serving") or {})},
+        "n_requests": int(config.get("n_requests", n_requests)),
+        "arrive_every": arrive_every,
+        "lens": lens,
+        "srv_new": int(config.get("srv_new", srv_new)),
+        "srv_rng": np.random.default_rng(1),
+    }
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# headline: TTFT + steady-state decode rate (bf16 and int8 weight-only)
+def _headline_series(ctx):
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+
+    cfg = ctx["cfg"]
+    batch, prompt = ctx["batch"], ctx["prompt"]
+    new_tokens, reps = ctx["new_tokens"], ctx["reps"]
 
     engine = deepspeed_tpu.init_inference(
         GPT2LMHeadModel(cfg),
@@ -109,9 +152,10 @@ def main():
         GPT2LMHeadModel(cfg), dtype="int8", tensor_parallel={"tp_size": 1},
         max_out_tokens=cfg.n_positions)
     per_token_s8 = per_token(engine8)
+    del engine8
 
     bf16, int8 = rate(per_token_s), rate(per_token_s8)
-    emit_result({
+    return {
         "metric": METRIC,
         "ttft_ms_p50": round(ttft_p50, 2),
         "ttft_serving_ms_p50": round(ttft_serving_p50, 2),
@@ -120,46 +164,50 @@ def main():
         "int8_decode_tokens_per_sec": int8["tokens_per_sec"],
         "int8_per_token_ms": int8["per_token_ms"],
         "batch": batch, "prompt": prompt, "new_tokens": new_tokens,
-    })
+    }
 
-    # --- serving series: continuous batching under mixed arrivals.
-    # Emitted AFTER the headline JSON (window-proofing rule: an optional
-    # series crashing must never cost the headline). Mixed-arrival
-    # tokens/s counts every generated token over the drain wall-clock;
-    # TTFT p50/p95 and shed rate come from the per-request records.
-    del engine8
+
+# ---------------------------------------------------------------------------
+# serving: continuous batching under mixed arrivals
+def _build_serving(ctx, extra=None, telemetry=False):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
     from deepspeed_tpu.parallel.topology import reset_topology
     from deepspeed_tpu.serving import ServingEngine
 
+    cfg = ctx["cfg"]
     reset_topology()
-    if on_tpu:
-        scfg = {"block_size": 32, "decode_slots": batch,
-                "max_queue_depth": 4 * batch}
-        n_requests, arrive_every = 4 * batch, 2
-        lens = [prompt // 2, prompt, prompt + prompt // 2]
-        srv_new = new_tokens
-    else:
-        scfg = {"block_size": 8, "decode_slots": 2, "max_queue_depth": 16}
-        n_requests, arrive_every = 6, 1
-        lens = [4, 6, 8]
-        srv_new = 4
-    srv = ServingEngine(deepspeed_tpu.init_inference(
+    kwargs = {}
+    if telemetry:
+        # tuner series read compile counts off the telemetry stream;
+        # the headline/serving series keep the exact build they always
+        # had (no watch layer in the measured window)
+        kwargs["telemetry"] = {"enabled": True, "jsonl": False,
+                               "memory": False}
+    return ServingEngine(deepspeed_tpu.init_inference(
         GPT2LMHeadModel(cfg), dtype=cfg.dtype,
         tensor_parallel={"tp_size": 1}, max_out_tokens=cfg.n_positions,
-        serving=scfg))
-    srv_rng = np.random.default_rng(1)
+        serving={**ctx["scfg"], **(extra or {})}, **kwargs))
+
+
+def _serving_series(ctx):
+    """Mixed-arrival tokens/s + TTFT p50/p95 + shed rate under
+    continuous batching (per-request records over the measured window
+    only)."""
+    cfg, scfg = ctx["cfg"], ctx["scfg"]
+    n_requests, arrive_every = ctx["n_requests"], ctx["arrive_every"]
+    lens, srv_new, srv_rng = ctx["lens"], ctx["srv_new"], ctx["srv_rng"]
+    srv = _build_serving(ctx)
 
     def run_mixed():
         pending = [srv_rng.integers(0, cfg.vocab_size,
                                     lens[i % len(lens)]).astype(np.int32)
                    for i in range(n_requests)]
         t0 = time.perf_counter()
-        i = 0
         while pending or srv.pending:
             for _ in range(arrive_every):
                 if pending:
                     srv.submit(pending.pop(0), max_new_tokens=srv_new)
-                    i += 1
             srv.step()
         srv.drain()
         return time.perf_counter() - t0
@@ -170,7 +218,7 @@ def main():
     st = srv.stats()
     tokens_out = sum(r["new_tokens"] for r in srv.records
                      if r["state"] != "shed")
-    emit_result({
+    payload = {
         "metric": f"{METRIC}_serving",
         "mixed_arrival_tokens_per_sec": round(tokens_out / elapsed, 1)
         if elapsed > 0 else None,
@@ -179,28 +227,22 @@ def main():
         "shed_rate": st["shed_rate"],
         "decode_slots": scfg["decode_slots"],
         "requests": n_requests, "new_tokens": srv_new,
-    })
-
-    # --- serving fast-path series: the throughput tier. Three scenarios
-    # over the same tiny/125M model, still after the headline JSON:
-    # (a) shared system prompt — N requests share a multi-block system
-    # prefix under the radix prefix cache; the first request prefills
-    # it, the rest map the blocks by refcount and prefill only their
-    # tails (prefix hit rate + drain tokens/s);
-    # (b) long-prompt mix — short requests queued behind one long
-    # prompt, whole-prompt prefill vs chunked prefill: the short
-    # requests' TTFT p95 is what the chunk budget buys;
-    # (c) KV capacity — live pool bytes per sequence for f32 vs int8
-    # KV, i.e. max concurrent sequences at a fixed HBM pool budget.
+    }
     srv.destroy()
-    del srv
+    return payload
 
-    def build_serving(extra):
-        reset_topology()
-        return ServingEngine(deepspeed_tpu.init_inference(
-            GPT2LMHeadModel(cfg), dtype=cfg.dtype,
-            tensor_parallel={"tp_size": 1}, max_out_tokens=cfg.n_positions,
-            serving={**scfg, **extra}))
+
+# ---------------------------------------------------------------------------
+# serving fast path: prefix cache / chunked prefill / int8 KV
+def _serving_fastpath_series(ctx):
+    """Three scenarios over the same model, one payload: (a) shared
+    system prompt under the radix prefix cache (hit rate + drain
+    tokens/s); (b) short requests behind one long prompt, whole-prompt
+    vs chunked prefill (short TTFT p95); (c) KV bytes per sequence f32
+    vs int8 (max concurrent sequences at a fixed pool budget)."""
+    cfg, scfg = ctx["cfg"], ctx["scfg"]
+    on_tpu, batch = ctx["on_tpu"], ctx["batch"]
+    lens, srv_new, srv_rng = ctx["lens"], ctx["srv_new"], ctx["srv_rng"]
 
     def drain_all(eng, prompts, new_tok):
         t0 = time.perf_counter()
@@ -229,7 +271,7 @@ def main():
             sys_ids, srv_rng.integers(0, cfg.vocab_size, tail_len)]
         ).astype(np.int32) for _ in range(n_shared)]
 
-    pfx = build_serving({"prefix_cache": True})
+    pfx = _build_serving(ctx, {"prefix_cache": True})
     drain_all(pfx, shared_prompts(), srv_new)  # warm programs
     pfx.reset_stats()
     pfx_elapsed = drain_all(pfx, shared_prompts(), srv_new)
@@ -264,11 +306,11 @@ def main():
                  and r["ttft_ms"] is not None]
         return float(np.percentile(ttfts, 95)) if ttfts else None
 
-    whole = build_serving({})
+    whole = _build_serving(ctx)
     whole_p95 = short_ttft_p95(whole)
     whole.destroy()
     del whole
-    chunked = build_serving({"prefill_chunk_tokens": bs})
+    chunked = _build_serving(ctx, {"prefill_chunk_tokens": bs})
     chunked_p95 = short_ttft_p95(chunked)
     chunked.destroy()
     del chunked
@@ -290,11 +332,11 @@ def main():
                     for leaf in _jax.tree_util.tree_leaves(eng.cache))
         return total // eng.num_blocks * eng.blocks_per_seq
 
-    f32_eng = build_serving({})
+    f32_eng = _build_serving(ctx)
     f32_bytes = kv_bytes_per_seq(f32_eng)
     f32_eng.destroy()
     del f32_eng
-    int8_eng = build_serving({"kv_cache_dtype": "int8"})
+    int8_eng = _build_serving(ctx, {"kv_cache_dtype": "int8"})
     int8_bytes = kv_bytes_per_seq(int8_eng)
     int8_eng.destroy()
     del int8_eng
@@ -305,29 +347,29 @@ def main():
         "max_concurrent_seqs_f32": int(pool_budget // f32_bytes),
         "max_concurrent_seqs_int8": int(pool_budget // int8_bytes),
     })
-    emit_result({
+    return {
         "metric": f"{METRIC}_serving_fastpath",
         **prefix_series,
         "requests_shared": n_shared, "system_prompt_len": sys_len,
         "new_tokens": srv_new,
-    })
+    }
 
-    # --- router series: the availability tier. Two replicas behind the
-    # resilient front door; the same mixed-arrival window run clean and
-    # with replica 1 crashed mid-window (deterministic chaos) — the gap
-    # between the two availability numbers is what failover with
-    # deterministic replay buys.
+
+# ---------------------------------------------------------------------------
+# router: two replicas behind the resilient front door
+def _router_series(ctx):
+    """The availability tier: the same mixed-arrival window run clean
+    and with replica 1 crashed mid-window (deterministic chaos) — the
+    gap between the two availability numbers is what failover with
+    deterministic replay buys."""
     from deepspeed_tpu.runtime.resilience.chaos import ChaosReplica
     from deepspeed_tpu.serving.router import ReplicaRouter
 
-    def build_replica():
-        reset_topology()
-        return ServingEngine(deepspeed_tpu.init_inference(
-            GPT2LMHeadModel(cfg), dtype=cfg.dtype,
-            tensor_parallel={"tp_size": 1}, max_out_tokens=cfg.n_positions,
-            serving=scfg))
+    cfg = ctx["cfg"]
+    n_requests, arrive_every = ctx["n_requests"], ctx["arrive_every"]
+    lens, srv_new, srv_rng = ctx["lens"], ctx["srv_new"], ctx["srv_rng"]
 
-    replicas = [build_replica(), build_replica()]
+    replicas = [_build_serving(ctx), _build_serving(ctx)]
     router = ReplicaRouter(replicas, config={"max_failovers": 2})
 
     def run_router():
@@ -367,7 +409,7 @@ def main():
         rep.reset_stats()
     router.reset_stats()
     killed = router_window(run_router())
-    emit_result({
+    return {
         "metric": f"{METRIC}_router",
         "replicas": 2,
         "clean_tokens_per_sec": clean["tokens_per_sec"],
@@ -378,7 +420,150 @@ def main():
         "killed_availability": killed["availability"],
         "killed_failovers": killed["failovers"],
         "requests": n_requests, "new_tokens": srv_new,
-    })
+    }
+
+
+# ---------------------------------------------------------------------------
+# tuner series: the live autotuner's decode-side measurement hooks
+def _decode_attention_series(ctx, block_k=None, reps=None):
+    """Microbench of the dense decode-attention kernel at one ``block_k``
+    candidate. On TPU the real Pallas kernel runs; on CPU the interpret-
+    mode emulation runs (relative ranking only — same plumbing, honest
+    ``backend`` field). The tuned value feeds the kernel-default
+    registry (``ops.decode_attention.block_k``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.decode_attention import decode_attention
+    from deepspeed_tpu.utils.compat import tpu_interpret_mode
+
+    on_tpu = ctx["on_tpu"]
+    reps = reps or (20 if on_tpu else 3)
+    b, heads, d = (8, 12, 64) if on_tpu else (2, 2, 8)
+    s_len = 1024 if on_tpu else 512
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(b, 1, heads, d)), jnp.float32)
+    k_cache = jnp.asarray(rng.normal(size=(b, s_len, heads, d)), jnp.float32)
+    v_cache = jnp.asarray(rng.normal(size=(b, s_len, heads, d)), jnp.float32)
+    idx = jnp.asarray(s_len // 2, jnp.int32)
+
+    import contextlib
+    interp = contextlib.nullcontext() if on_tpu else tpu_interpret_mode()
+    with interp:
+        fn = jax.jit(lambda q, k, v, i: decode_attention(
+            q, k, v, i, block_k=block_k))
+        out = fn(q, k_cache, v_cache, idx)
+        jax.block_until_ready(out)  # compile outside the window
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(q, k_cache, v_cache, idx)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+    return {
+        "metric": f"{METRIC}_decode_attention",
+        "per_call_ms": round(1e3 * dt / reps, 4),
+        "block_k": block_k,
+        "cache_len": s_len, "batch": b, "heads": heads, "head_dim": d,
+        "backend": "tpu" if on_tpu else "cpu_interpret",
+        "reps": reps,
+    }
+
+
+def _serving_chunk_series(ctx, serving_overrides=None):
+    """Serving-shape measurement for the chunk-size / bucket-set axes:
+    one long prompt ahead of short requests, reporting the short
+    requests' TTFT p95 (what a chunk budget buys), drain tokens/s, and
+    the telemetry-side compile count of the window's programs."""
+    cfg, scfg = ctx["cfg"], ctx["scfg"]
+    lens, srv_new, srv_rng = ctx["lens"], ctx["srv_new"], ctx["srv_rng"]
+    bs = scfg["block_size"]
+    long_len = (8 if ctx["on_tpu"] else 4) * bs
+    n_short = ctx["batch"] if ctx["on_tpu"] else 3
+
+    eng = _build_serving(ctx, serving_overrides or {}, telemetry=True)
+
+    def drain_all(prompts):
+        t0 = time.perf_counter()
+        for p in prompts:
+            eng.submit(p, max_new_tokens=srv_new)
+        while eng.pending:
+            eng.step()
+        eng.drain()
+        return time.perf_counter() - t0
+
+    def window():
+        prompts = [srv_rng.integers(0, cfg.vocab_size,
+                                    long_len).astype(np.int32)]
+        prompts += [srv_rng.integers(0, cfg.vocab_size,
+                                     lens[i % len(lens)]).astype(np.int32)
+                    for i in range(n_short)]
+        return drain_all(prompts)
+
+    window()  # warm the programs
+    eng.reset_stats()
+    elapsed = window()
+    ttfts = [r["ttft_ms"] for r in eng.records
+             if r["state"] != "shed" and r["prompt_len"] < long_len
+             and r["ttft_ms"] is not None]
+    tokens_out = sum(r["new_tokens"] for r in eng.records
+                     if r["state"] != "shed")
+    summary = eng.telemetry.summary()
+    payload = {
+        "metric": f"{METRIC}_serving_chunk",
+        "short_ttft_ms_p95": round(float(np.percentile(ttfts, 95)), 2)
+        if ttfts else None,
+        "tokens_per_sec": round(tokens_out / elapsed, 1)
+        if elapsed > 0 else None,
+        "compiled_programs": sum(v["compiles"] for v in
+                                 summary["per_function"].values()),
+        "long_prompt_len": long_len, "n_short": n_short,
+        "serving_overrides": dict(serving_overrides or {}),
+    }
+    eng.destroy()
+    return payload
+
+
+# ---------------------------------------------------------------------------
+def run_series(name, config=None):
+    """Run ONE decode-bench series in-process and return its payload
+    dict (never emits). ``config`` keys: ``serving`` (overrides merged
+    into the serving block), ``block_k`` (decode_attention series),
+    ``batch``/``prompt``/``new_tokens``/``reps``."""
+    config = dict(config or {})
+    ctx = _decode_context(config)
+    if name == "headline":
+        return _headline_series(ctx)
+    if name == "serving":
+        return _serving_series(ctx)
+    if name == "serving_fastpath":
+        return _serving_fastpath_series(ctx)
+    if name == "router":
+        return _router_series(ctx)
+    if name == "decode_attention":
+        return _decode_attention_series(ctx, block_k=config.get("block_k"))
+    if name == "serving_chunk":
+        return _serving_chunk_series(ctx,
+                                     serving_overrides=config.get("serving"))
+    raise KeyError(f"unknown decode series {name!r}; available: "
+                   f"{sorted(SERIES)}")
+
+
+SERIES = ("headline", "serving", "serving_fastpath", "router",
+          "decode_attention", "serving_chunk")
+
+
+def main():
+    platform = require_backend(METRIC)
+    assert_platform(METRIC, platform)
+    on_tpu = is_tpu(platform)
+    ctx = _decode_context(on_tpu=on_tpu)
+
+    # headline FIRST (window-proofing rule: an optional series crashing
+    # must never cost the headline)
+    emit_result(_headline_series(ctx))
+    emit_result(_serving_series(ctx))
+    emit_result(_serving_fastpath_series(ctx))
+    emit_result(_router_series(ctx))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,156 @@
+"""Engine-facing AOT capture and restore.
+
+Capture walks the telemetry layer's live
+:class:`~deepspeed_tpu.telemetry.jit_watch.WatchedFunction` instances —
+the AOT dispatch caches already hold exactly the steady-state compiled
+executables a restart would otherwise recompile — and serializes every
+cache entry into a bundle (``bundle.py``) written into the checkpoint
+tag directory through the ``CheckpointEngine.save_bytes``/``save_text``
+seams (so it stages under the tiered engine's atomic publish and rides
+the integrity layer's hashing and retry/chaos seams).
+
+Restore arms an :class:`AOTStore` on the telemetry manager: when a
+watched function misses its dispatch cache, it consults the store by
+``(program name, signature hash)`` BEFORE paying ``lower().compile()``.
+A hit deserializes the shipped executable (hash-verified first) and the
+compile watchdog records zero compiles for that program — the
+warm-restart contract. Any store failure (corrupt blob, deserialize
+error) logs, emits an ``aot`` event, and returns None so the normal
+compile path runs; AOT must never break a step that would otherwise
+run.
+"""
+
+import os
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.aot.bundle import (AOT_MANIFEST_NAME,
+                                      BundleReader, blob_name,
+                                      build_manifest, deserialize_compiled,
+                                      read_bundle, serialize_compiled)
+from deepspeed_tpu.utils.fingerprint import (fingerprint_hash,
+                                             topology_fingerprint)
+from deepspeed_tpu.utils.logging import logger
+
+
+def current_bundle_identity(mesh_axes: Optional[Dict[str, int]] = None,
+                            tuned_hash: str = "none") -> Dict:
+    """The live runtime's side of the bundle cache key."""
+    fp = topology_fingerprint(mesh_axes=mesh_axes or {})
+    return {"fingerprint": fp, "fingerprint_hash": fingerprint_hash(fp),
+            "tuned_hash": tuned_hash}
+
+
+# ----------------------------------------------------------------------
+# capture
+def capture_entries(telemetry) -> List[Dict]:
+    """Serialize every cached executable of every live watched function
+    into ``[{"name", "sig_hash", "blob"}]``. A program that refuses to
+    serialize (host callbacks, backend quirks) is skipped with a
+    warning — a partial bundle still saves every program it does carry."""
+    from deepspeed_tpu.telemetry.jit_watch import signature_fingerprint
+
+    entries: List[Dict] = []
+    for wf in telemetry.watched_functions():
+        for key, compiled in list(getattr(wf, "_cache", {}).items()):
+            try:
+                blob = serialize_compiled(compiled)
+            except Exception as e:  # noqa: BLE001 — skip, don't kill save
+                logger.warning(f"[aot] serialize of {wf.name!r} failed "
+                               f"({e}); program left out of the bundle")
+                continue
+            entries.append({"name": wf.name,
+                            "sig_hash": signature_fingerprint(key),
+                            "blob": blob})
+    return entries
+
+
+def save_bundle(checkpoint_engine, tag_dir: str, entries: List[Dict],
+                identity: Dict) -> Optional[Dict]:
+    """Write a bundle (``aot_``-prefixed files, flat) into ``tag_dir``
+    through the checkpoint engine seams. Returns the manifest (None when
+    there was nothing to capture — an empty bundle would pin a restart
+    to nothing)."""
+    import hashlib
+    import json
+
+    if not entries:
+        return None
+    bundle_dir = tag_dir
+    programs = []
+    for e in entries:
+        fname = blob_name(e["blob"])
+        checkpoint_engine.save_bytes(os.path.join(bundle_dir, fname),
+                                     e["blob"])
+        programs.append({
+            "name": e["name"], "sig_hash": e["sig_hash"], "file": fname,
+            "sha256": hashlib.sha256(e["blob"]).hexdigest(),
+            "size": len(e["blob"]),
+        })
+    manifest = build_manifest(programs, identity["fingerprint"],
+                              identity["fingerprint_hash"],
+                              identity["tuned_hash"])
+    checkpoint_engine.save_text(
+        os.path.join(bundle_dir, AOT_MANIFEST_NAME),
+        json.dumps(manifest, indent=1, sort_keys=True))
+    return manifest
+
+
+def load_bundle(tag_dir: str) -> Optional[BundleReader]:
+    """The bundle shipped with a checkpoint tag, or None."""
+    bundle_dir = tag_dir
+    manifest = read_bundle(bundle_dir)
+    if manifest is None:
+        return None
+    return BundleReader(bundle_dir, manifest)
+
+
+# ----------------------------------------------------------------------
+# restore
+class AOTStore:
+    """Armed on a :class:`~deepspeed_tpu.telemetry.manager.Telemetry`;
+    consulted by ``WatchedFunction._compile`` on every dispatch-cache
+    miss. Deserializes lazily (a restart typically replays a handful of
+    the bundle's programs before steady state) and caches the loaded
+    executable so repeated signatures pay the deserialize once."""
+
+    def __init__(self, reader: BundleReader, emit=None):
+        self._reader = reader
+        self._loaded: Dict[tuple, object] = {}
+        # (name, sig_hash) that already failed: retrying a corrupt blob
+        # on every miss would log-spam the step loop
+        self._failed = set()
+        # ``emit(**data)`` -> an "aot" telemetry event
+        self._emit = emit or (lambda **data: None)
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self):
+        return len(self._reader)
+
+    @property
+    def manifest(self):
+        return self._reader.manifest
+
+    def lookup(self, name: str, sig_hash: str):
+        """The shipped executable for one program signature, or None
+        (unknown signature, or a blob that failed to load)."""
+        key = (name, sig_hash)
+        if key in self._loaded:
+            return self._loaded[key]
+        if key in self._failed or not self._reader.contains(name, sig_hash):
+            self.misses += 1
+            return None
+        try:
+            blob = self._reader.read_blob(name, sig_hash)
+            compiled = deserialize_compiled(blob)
+        except Exception as e:  # noqa: BLE001 — fall back to compilation
+            self._failed.add(key)
+            self.misses += 1
+            logger.warning(f"[aot] load of {name!r} [{sig_hash}] failed "
+                           f"({e}); compiling normally")
+            self._emit(action="load_failed", program=name,
+                       sig_hash=sig_hash, error=str(e)[:200])
+            return None
+        self._loaded[key] = compiled
+        self.hits += 1
+        return compiled

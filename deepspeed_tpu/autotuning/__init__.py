@@ -15,8 +15,18 @@ or ``python -m deepspeed_tpu.autotuning`` for the bench model (the tuned
 config feeds ``bench.py``).
 """
 
+from deepspeed_tpu.autotuning import runtime_tunables
+from deepspeed_tpu.autotuning.artifact import (TunedArtifactError,
+                                               artifact_hash,
+                                               make_artifact,
+                                               read_tuned_artifact,
+                                               verify_fingerprint,
+                                               write_tuned_artifact)
 from deepspeed_tpu.autotuning.autotuner import Autotuner, profile_model
 from deepspeed_tpu.autotuning.config import AutotuningConfig
+from deepspeed_tpu.autotuning.live import (LiveAxis, all_axes, default_axes,
+                                           get_axis, register_axis)
+from deepspeed_tpu.autotuning.measure import LiveTuner
 from deepspeed_tpu.autotuning.cost_model import (ChipSpec, predict_step_time,
                                                  predict_throughput,
                                                  xla_cost_analysis)
@@ -27,7 +37,11 @@ from deepspeed_tpu.autotuning.tuner import (GridSearchTuner, ModelBasedTuner,
 
 __all__ = [
     "Autotuner", "AutotuningConfig", "Candidate", "ChipSpec",
-    "GridSearchTuner", "ModelBasedTuner", "ModelProfile", "RandomTuner",
-    "build_space", "estimate_hbm_bytes", "get_tuner", "predict_step_time",
-    "predict_throughput", "profile_model", "xla_cost_analysis",
+    "GridSearchTuner", "LiveAxis", "LiveTuner", "ModelBasedTuner",
+    "ModelProfile", "RandomTuner", "TunedArtifactError", "all_axes",
+    "artifact_hash", "build_space", "default_axes", "estimate_hbm_bytes",
+    "get_axis", "get_tuner", "make_artifact", "predict_step_time",
+    "predict_throughput", "profile_model", "read_tuned_artifact",
+    "register_axis", "runtime_tunables", "verify_fingerprint",
+    "write_tuned_artifact", "xla_cost_analysis",
 ]

@@ -11,16 +11,20 @@ import json
 
 import jax
 
-# Pin the parent to CPU BEFORE any backend touch: the TPU is a
-# single-client device, and a parent holding the libtpu client would make
-# every trial subprocess fail with "TPU already in use". Param counting
-# (jax.eval_shape) is host-side and doesn't need the chip; chip identity is
-# probed in a throwaway subprocess instead.
-jax.config.update("jax_platforms", "cpu")
-
-from deepspeed_tpu.autotuning import Autotuner, AutotuningConfig  # noqa: E402
-from deepspeed_tpu.autotuning.cost_model import (ChipSpec,  # noqa: E402
+from deepspeed_tpu.autotuning import Autotuner, AutotuningConfig
+from deepspeed_tpu.autotuning.cost_model import (ChipSpec,
                                                  probe_devices_subprocess)
+
+
+def _pin_parent_to_cpu():
+    # Pin the parent to CPU BEFORE any backend touch: the TPU is a
+    # single-client device, and a parent holding the libtpu client would
+    # make every trial subprocess fail with "TPU already in use". Param
+    # counting (jax.eval_shape) is host-side and doesn't need the chip;
+    # chip identity is probed in a throwaway subprocess instead. (The
+    # --live path does the opposite on purpose: its measurements run
+    # in-process on whatever backend the operator launched with.)
+    jax.config.update("jax_platforms", "cpu")
 
 _PRESETS = {
     "gpt2-125m": {"n_layer": 12, "n_embd": 768, "n_head": 12,
@@ -51,8 +55,34 @@ def main(argv=None):
                         "(default: probed from the chip)")
     p.add_argument("--in-process", action="store_true",
                    help="no subprocess isolation (debug only)")
+    p.add_argument("--live", action="store_true",
+                   help="measured live-tunable search instead of the "
+                        "offline launch-config search: walk the axis "
+                        "registry (Pallas tiles, reduction bucket bytes, "
+                        "collective tier, serving prefill shape) on the "
+                        "in-process bench harness and write "
+                        "<results-dir>/tuned.json (consumed by the "
+                        "`tuning` config block)")
+    p.add_argument("--axes", default=None,
+                   help="--live only: comma list of axis names "
+                        "(default: the full registry)")
     args = p.parse_args(argv)
 
+    if args.live:
+        from deepspeed_tpu.autotuning.measure import LiveTuner
+
+        names = args.axes.split(",") if args.axes else None
+        artifact = LiveTuner(results_dir=args.results_dir).tune(
+            axis_names=names)
+        print(json.dumps({
+            "results_dir": args.results_dir,
+            "fingerprint_hash": artifact["fingerprint_hash"],
+            "chosen": {n: a["value"] for n, a in artifact["axes"].items()
+                       if a["value"] is not None},
+        }))
+        return
+
+    _pin_parent_to_cpu()
     model_cfg = _PRESETS[args.model]
     seq = args.seq_len or model_cfg.get("n_positions", 1024)
     platform, kind, n_dev, hbm_bytes = probe_devices_subprocess()

@@ -1,0 +1,248 @@
+"""The tuned-config artifact (``tuned.json``): measured choices for the
+live tunables, with evidence, pinned to the topology that measured them.
+
+Produced by :class:`deepspeed_tpu.autotuning.measure.LiveTuner`;
+consumed at engine build by ``runtime/config.py`` (training knobs:
+reduction bucket bytes, collective tier) and ``inference/engine.py``
+(serving knobs: prefill chunk tokens, prompt buckets), with Pallas tile
+choices installed into :mod:`~deepspeed_tpu.autotuning.runtime_tunables`.
+
+Contracts (pinned in ``tests/unit/test_live_tuning.py``):
+
+- **versioned + deterministic** — the serialized artifact is canonical
+  (sorted keys, no timestamps): the same measurements produce a
+  byte-identical ``tuned.json``, so artifact diffs in CI are real
+  changes, never noise;
+- **evidence-carrying** — every chosen value records the trial
+  measurements that chose it (and the trials that were skipped or
+  failed, with reasons): a tuned config nobody can audit is a config
+  nobody should trust;
+- **fingerprint-pinned** — consuming an artifact on a different
+  topology raises :class:`TunedArtifactError` listing saved-vs-current
+  fields (jax/jaxlib version drift alone warns: tile choices usually
+  survive an upgrade, mesh/chip changes never do);
+- **precedence** — an explicit user config key always beats the
+  artifact, the artifact beats the built-in default.
+"""
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional
+
+from deepspeed_tpu.utils.fingerprint import (diff_fingerprint,
+                                             fingerprint_hash,
+                                             topology_fingerprint)
+from deepspeed_tpu.utils.logging import logger
+
+TUNED_ARTIFACT_VERSION = 1
+TUNED_ARTIFACT_NAME = "tuned.json"
+
+# fingerprint fields whose drift only warns (everything else raises)
+_SOFT_FINGERPRINT_FIELDS = ("jax_version", "jaxlib_version")
+
+
+class TunedArtifactError(RuntimeError):
+    """Structured artifact rejection: carries the saved and current
+    fingerprints plus the per-field diff so launch tooling can render
+    exactly what changed."""
+
+    def __init__(self, message: str, saved: Optional[Dict] = None,
+                 current: Optional[Dict] = None,
+                 diff: Optional[Dict] = None):
+        super().__init__(message)
+        self.saved = saved or {}
+        self.current = current or {}
+        self.diff = diff or {}
+
+
+# ----------------------------------------------------------------------
+# build / serialize
+def make_artifact(axes: Dict[str, Dict],
+                  fingerprint: Optional[Dict] = None) -> Dict:
+    """Assemble the artifact dict. ``axes`` maps axis name ->
+    ``{"target": <config path>, "value": <choice>, "objective": <key>,
+    "minimize": bool, "evidence": [trial dicts]}`` (``value`` may be
+    None when no trial succeeded — the axis is recorded, not applied)."""
+    fp = fingerprint or topology_fingerprint()
+    return {
+        "version": TUNED_ARTIFACT_VERSION,
+        "fingerprint": fp,
+        "fingerprint_hash": fingerprint_hash(fp),
+        "axes": axes,
+    }
+
+
+def dumps_artifact(artifact: Dict) -> str:
+    """Canonical serialization — byte-identical for equal content."""
+    return json.dumps(artifact, indent=1, sort_keys=True) + "\n"
+
+
+def artifact_hash(artifact: Optional[Dict]) -> str:
+    """Identity of the tuned config an engine was built with — one of
+    the AOT bundle's cache-key components (a bundle compiled under one
+    set of tuned tiles must not pre-populate dispatch under another)."""
+    if artifact is None:
+        return "none"
+    return hashlib.sha256(dumps_artifact(artifact).encode()).hexdigest()[:16]
+
+
+def write_tuned_artifact(path: str, artifact: Dict) -> str:
+    from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+        atomic_write_text)
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    atomic_write_text(path, dumps_artifact(artifact))
+    return path
+
+
+def read_tuned_artifact(path: str) -> Dict:
+    with open(path) as f:
+        artifact = json.load(f)
+    version = artifact.get("version")
+    if version != TUNED_ARTIFACT_VERSION:
+        raise TunedArtifactError(
+            f"tuned artifact {path!r} has version {version!r}; this "
+            f"runtime reads version {TUNED_ARTIFACT_VERSION}")
+    return artifact
+
+
+# ----------------------------------------------------------------------
+# verify
+def verify_fingerprint(artifact: Dict, current: Optional[Dict] = None,
+                       where: str = "tuned artifact") -> None:
+    """Raise :class:`TunedArtifactError` when the artifact was measured
+    on a different topology (module docstring: version drift warns)."""
+    saved = artifact.get("fingerprint") or {}
+    current = current if current is not None else topology_fingerprint()
+    diff = diff_fingerprint(saved, current)
+    soft = {k: v for k, v in diff.items() if k in _SOFT_FINGERPRINT_FIELDS}
+    hard = {k: v for k, v in diff.items()
+            if k not in _SOFT_FINGERPRINT_FIELDS}
+    if soft and not hard:
+        drift = ", ".join(f"{k}: {v['saved']} -> {v['current']}"
+                          for k, v in soft.items())
+        logger.warning(f"{where}: runtime version drift ({drift}); tuned "
+                       "values applied anyway — re-tune to refresh them")
+    if hard:
+        lines = "\n".join(
+            f"  {k}: saved={v['saved']} -> current={v['current']}"
+            for k, v in hard.items())
+        raise TunedArtifactError(
+            f"{where} was measured on a different topology — refusing to "
+            f"apply its choices here:\n{lines}\n(re-run the live "
+            "autotuner on THIS topology, or drop the `tuning` config "
+            "block)", saved=saved, current=current, diff=hard)
+
+
+# ----------------------------------------------------------------------
+# consume (precedence: explicit user key > artifact > default)
+def chosen_values(artifact: Dict) -> Dict[str, object]:
+    """``{target path: chosen value}`` over axes that chose a value."""
+    out = {}
+    for name, axis in sorted((artifact.get("axes") or {}).items()):
+        target, value = axis.get("target"), axis.get("value")
+        if target and value is not None:
+            out[target] = value
+    return out
+
+
+# section-level virtual targets: one measured choice that expands into
+# several section keys. "comm_quantization.tier" owns the ENABLE
+# decision because its grid measured the machinery-off default too —
+# the consumption side must never switch reduction machinery the tuner
+# did not actually compare against the default.
+def _expand_section_target(section: str, key: str, value):
+    if section == "comm_quantization" and key == "tier":
+        return ({"enabled": False} if value == "off"
+                else {"enabled": True, "dtype": value})
+    return {key: value}
+
+
+def section_choices(artifact: Dict, section: str) -> Dict[str, object]:
+    """Chosen values under one config section, keyed by the remaining
+    path (virtual targets expanded) — e.g.
+    ``section_choices(a, "comm_quantization")`` ->
+    ``{"bucket_bytes": 4194304, "enabled": True, "dtype": "int8"}``."""
+    prefix = section + "."
+    out: Dict[str, object] = {}
+    for t, v in chosen_values(artifact).items():
+        if t.startswith(prefix):
+            out.update(_expand_section_target(section, t[len(prefix):], v))
+    return out
+
+
+# paired-axis targets: one measured choice that expands into several
+# registry keys (searching the members independently would measure
+# noise, but the kernels resolve per-key)
+_PAIRED_OPS_TARGETS = {
+    "ops.flash_attention.tiles": ("ops.flash_attention.block_q",
+                                  "ops.flash_attention.block_k"),
+}
+
+
+def ops_choices(artifact: Dict) -> Dict[str, object]:
+    """Chosen values for the kernel-default registry (``ops.*`` targets,
+    returned with their full path keys; paired targets expanded into
+    the per-key form the kernels resolve)."""
+    out: Dict[str, object] = {}
+    for target, value in chosen_values(artifact).items():
+        if not target.startswith("ops."):
+            continue
+        keys = _PAIRED_OPS_TARGETS.get(target)
+        if keys is not None:
+            if not isinstance(value, (list, tuple)) \
+                    or len(value) != len(keys):
+                raise TunedArtifactError(
+                    f"tuned artifact: paired axis {target!r} must carry "
+                    f"{len(keys)} values, got {value!r}")
+            out.update(zip(keys, value))
+        else:
+            out[target] = value
+    return out
+
+
+def apply_section(user_section: Optional[Dict], artifact: Dict,
+                  section: str) -> Dict:
+    """Merge one config section with the artifact's choices for it: a
+    key the user wrote explicitly is untouched; a key only the artifact
+    carries is filled in (the returned dict is a copy)."""
+    merged = dict(user_section or {})
+    applied = {}
+    for key, value in section_choices(artifact, section).items():
+        if key not in merged:
+            merged[key] = value
+            applied[key] = value
+    if applied:
+        logger.info(f"[tuning] {section}: applied "
+                    + ", ".join(f"{k}={v}" for k, v in sorted(
+                        applied.items())))
+    return merged
+
+
+def resolve_artifact_path(tuning_section: Dict,
+                          default_dir: str = "autotuning_results") -> str:
+    """The artifact path a ``tuning`` config block points at: an
+    explicit ``artifact`` key, else ``<default_dir>/tuned.json``."""
+    return (tuning_section or {}).get("artifact") \
+        or os.path.join(default_dir, TUNED_ARTIFACT_NAME)
+
+
+def load_for_config(tuning_section: Dict,
+                    where: str = "tuned artifact") -> Dict:
+    """The one consumption entry point for a ``tuning`` config block
+    (training and inference engines both build through here, so the
+    missing-artifact guidance and the fingerprint gate cannot drift
+    apart): resolve the path, refuse a missing artifact with the
+    run-the-tuner hint, read, and fingerprint-verify."""
+    section = tuning_section or {}
+    path = resolve_artifact_path(
+        section, section.get("results_dir") or "autotuning_results")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"tuning.enabled but no tuned artifact at {path!r} — run the "
+            "live autotuner first (python -m deepspeed_tpu.autotuning "
+            "--live) or point tuning.artifact at an existing tuned.json")
+    artifact = read_tuned_artifact(path)
+    verify_fingerprint(artifact, where=f"{where} {path!r}")
+    return artifact

@@ -1,0 +1,82 @@
+"""Process-level registry of *live* tunable values.
+
+Some tuned knobs are plain config keys (reduction bucket bytes, serving
+chunk size) and flow through the normal config precedence in
+``runtime/config.py`` / ``serving/config.py``. Pallas tile sizes are
+not: the kernels are called deep inside the model family
+(``models/gpt2.py`` → ``ops/decode_attention.py``) where threading a
+config object through every call site would contaminate every model
+signature. Instead the kernels resolve their *defaults* through this
+registry: an explicit ``block_k=`` argument always wins, an installed
+tuned value beats the built-in default, and with nothing installed the
+built-in default is returned — so with no ``tuning`` config block the
+traced program is exactly what it was before this module existed (the
+zero-overhead contract).
+
+Installation is engine-scoped and token-based: ``install`` returns a
+token the engine keeps and hands back to ``uninstall`` at ``destroy()``.
+Overlapping installers (a ReplicaRouter's replicas, or two engines
+tuned from different artifacts) compose correctly: per key, the
+youngest *surviving* install's value is in effect, so destroying one
+engine never strips — or swaps in the wrong — value for a survivor.
+
+Deliberately import-light (no jax): the artifact/plumbing tests run
+without touching a device.
+"""
+
+import itertools
+from typing import Dict, Optional
+
+# token -> {key: value}, insertion-ordered (dict guarantees it): the
+# effective value per key is the youngest surviving install's
+_INSTALLS: Dict[int, Dict[str, object]] = {}
+_TOKENS = itertools.count(1)
+_TUNED: Dict[str, object] = {}
+
+
+def _recompute() -> None:
+    _TUNED.clear()
+    for values in _INSTALLS.values():
+        _TUNED.update(values)
+
+
+def install(values: Dict[str, object]) -> int:
+    """Install tuned values (e.g. ``{"ops.decode_attention.block_k":
+    512}``); returns the token ``uninstall`` takes. While several
+    installs are alive, the youngest wins key-by-key."""
+    token = next(_TOKENS)
+    _INSTALLS[token] = dict(values)
+    _TUNED.update(values)
+    return token
+
+
+def uninstall(token: Optional[int]) -> None:
+    """Remove one install by its token (idempotent; None is a no-op).
+    Surviving installs' values are restored per key."""
+    if token is None or token not in _INSTALLS:
+        return
+    del _INSTALLS[token]
+    _recompute()
+
+
+def clear() -> None:
+    _INSTALLS.clear()
+    _TUNED.clear()
+
+
+def get(key: str, default=None):
+    """The installed tuned value for ``key``, else ``default``."""
+    return _TUNED.get(key, default)
+
+
+def resolve(explicit, key: str, default):
+    """The kernel-side precedence in one place: an explicit (non-None)
+    caller argument wins, then an installed tuned value, then the
+    built-in default."""
+    if explicit is not None:
+        return explicit
+    return _TUNED.get(key, default)
+
+
+def snapshot() -> Dict[str, object]:
+    return dict(_TUNED)

@@ -105,6 +105,13 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # When present, generate() also pads prompt lengths up to the serving
     # bucket set before keying its compile cache.
     serving: Optional[Dict] = None
+    # TPU-native: consume a measured tuned-config artifact (same section
+    # shape as the training config's `tuning` block —
+    # runtime/config.TuningConfig). Applied to the serving block with
+    # explicit-user-key > artifact > default precedence, and installs
+    # the artifact's Pallas tile choices (decode-attention block_k) for
+    # this engine. Absent => nothing is read and nothing changes.
+    tuning: Dict = {}
     tensor_parallel: DeepSpeedTPConfig = Field(DeepSpeedTPConfig(), alias="tp")
     enable_cuda_graph: bool = False  # accepted; XLA jit-cache supersedes it
     zero: Dict = {}

@@ -249,10 +249,28 @@ class InferenceEngine:
         # tests/unit/test_serving.py); present → generate() pads prompt
         # lengths up to the serving bucket set before keying its cache
         self._serving_cfg = None
-        if config.serving:
+        # live tuned config (`tuning` block): serving knobs (prefill
+        # chunk tokens, prompt buckets) fill in where the user's serving
+        # dict left them unset, and the artifact's decode-kernel tile
+        # choices install for this engine's lifetime (removed at
+        # destroy). Fingerprint-verified loudly before anything applies.
+        self._tuned_install = None
+        serving_dict = dict(config.serving) if config.serving else None
+        tuned_ops = {}
+        if (config.tuning or {}).get("enabled"):
+            from deepspeed_tpu.autotuning.artifact import (apply_section,
+                                                           load_for_config,
+                                                           ops_choices)
+
+            artifact = load_for_config(config.tuning)
+            if serving_dict is not None:
+                serving_dict = apply_section(serving_dict, artifact,
+                                             "serving")
+            tuned_ops = ops_choices(artifact)
+        if serving_dict is not None:
             from deepspeed_tpu.serving.config import ServingConfig
 
-            self._serving_cfg = ServingConfig(**config.serving)
+            self._serving_cfg = ServingConfig(**serving_dict)
         # telemetry: serving-side compile watchdog / HLO cost / memory —
         # a generate-shape recompile storm is the serving analog of the
         # training engine's retrace blind spot
@@ -268,6 +286,15 @@ class InferenceEngine:
                                      telemetry=self.telemetry,
                                      name="inference", serving=True)
         self._request_count = 0
+        if tuned_ops:
+            # the LAST construction step (same ordering contract as the
+            # training engine): tiles resolve at trace time, and an
+            # install before any later-raising validation (ServingConfig,
+            # Telemetry, Resilience) would leak process-wide with
+            # destroy() forever unreachable
+            from deepspeed_tpu.autotuning import runtime_tunables
+
+            self._tuned_install = runtime_tunables.install(tuned_ops)
         log_dist(
             f"InferenceEngine: tp={self.mp_world_size} dtype={config.dtype} "
             f"kernel_inject={config.replace_with_kernel_inject}", ranks=[0])
@@ -687,6 +714,11 @@ class InferenceEngine:
         self._generate_cache.clear()
         self._forward_fn = None
         self._forward_last_fn = None
+        if getattr(self, "_tuned_install", None) is not None:
+            from deepspeed_tpu.autotuning import runtime_tunables
+
+            runtime_tunables.uninstall(self._tuned_install)
+            self._tuned_install = None
         self.resilience.close()
         self.telemetry.close()
 

@@ -82,8 +82,14 @@ def _kernel(idx_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
 
 def decode_attention(q, k_cache, v_cache, cache_index, softmax_scale=None,
-                     block_k=DEFAULT_BLOCK_K):
+                     block_k=None):
     """Attend a decode step against the valid prefix of an append KV cache.
+
+    ``block_k=None`` resolves through the live-tunable registry
+    (``autotuning/runtime_tunables``, key
+    ``ops.decode_attention.block_k``): an explicit argument wins, a
+    tuned-artifact value beats the built-in default, and with nothing
+    installed this traces exactly as before (zero-overhead contract).
 
     Args:
       q: ``[B, T_q, H, D]`` query step (``T_q`` small: 1 for plain decode).
@@ -95,6 +101,10 @@ def decode_attention(q, k_cache, v_cache, cache_index, softmax_scale=None,
 
     Returns ``[B, T_q, H, D]`` in the query's dtype.
     """
+    from deepspeed_tpu.autotuning import runtime_tunables
+
+    block_k = runtime_tunables.resolve(
+        block_k, "ops.decode_attention.block_k", DEFAULT_BLOCK_K)
     b, tq, heads, d = q.shape
     s_len = k_cache.shape[1]
     bk = min(block_k, s_len)

@@ -630,9 +630,24 @@ def _flash_backward_bthd(res, dout, scale, causal, block_q, block_k):
     return dq, dk, dv
 
 
+def _resolved_tiles(block_q, block_k):
+    """Tile defaults through the live-tunable registry (explicit arg >
+    tuned artifact > built-in default). Runs at trace time only; with
+    nothing installed the traced program is byte-identical to the
+    pre-registry kernel (zero-overhead contract). Resolved inside each
+    custom_vjp leg because the vjp machinery forwards the call-site
+    (possibly None) values to fwd and bwd."""
+    from deepspeed_tpu.autotuning import runtime_tunables
+
+    return (runtime_tunables.resolve(block_q, "ops.flash_attention.block_q",
+                                     DEFAULT_BLOCK_Q),
+            runtime_tunables.resolve(block_k, "ops.flash_attention.block_k",
+                                     DEFAULT_BLOCK_K))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention_bthd(q, k, v, causal=True, softmax_scale=None,
-                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                         block_q=None, block_k=None):
     """Flash attention over the projection-natural layout.
 
     q, k, v: [batch, seq, heads, head_dim] — the shape a fused QKV
@@ -641,6 +656,7 @@ def flash_attention_bthd(q, k, v, causal=True, softmax_scale=None,
     around the custom-call).
     """
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    block_q, block_k = _resolved_tiles(block_q, block_k)
     o, _ = _flash_forward_bthd(q, k, v, scale, causal, block_q, block_k)
     return o
 
@@ -649,6 +665,7 @@ def _fab_fwd(q, k, v, causal, softmax_scale, block_q, block_k):
     from jax.ad_checkpoint import checkpoint_name
 
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    block_q, block_k = _resolved_tiles(block_q, block_k)
     q = checkpoint_name(q, "flash_q")
     k = checkpoint_name(k, "flash_k")
     v = checkpoint_name(v, "flash_v")
@@ -661,6 +678,7 @@ def _fab_fwd(q, k, v, causal, softmax_scale, block_q, block_k):
 def _fab_bwd(causal, softmax_scale, block_q, block_k, res, g):
     q = res[0]
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    block_q, block_k = _resolved_tiles(block_q, block_k)
     return _flash_backward_bthd(res, g, scale, causal, block_q, block_k)
 
 
@@ -671,9 +689,14 @@ flash_attention_bthd.defvjp(_fab_fwd, _fab_bwd)
 # public op
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal=True, softmax_scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """Tiled online-softmax attention. q,k,v: [batch, heads, seq, head_dim]."""
+                    block_q=None, block_k=None):
+    """Tiled online-softmax attention. q,k,v: [batch, heads, seq, head_dim].
+
+    ``block_q``/``block_k`` default through the live-tunable registry
+    (``ops.flash_attention.block_q``/``block_k`` — see
+    :func:`_resolved_tiles`)."""
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    block_q, block_k = _resolved_tiles(block_q, block_k)
     o, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k)
     return o
 
@@ -682,6 +705,7 @@ def _fa_fwd(q, k, v, causal, softmax_scale, block_q, block_k):
     from jax.ad_checkpoint import checkpoint_name
 
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    block_q, block_k = _resolved_tiles(block_q, block_k)
     # name the residuals so activation-checkpointing policies can keep them:
     # under remat with e.g. checkpoint_dots + save_only_these_names(
     # "flash_q","flash_k","flash_v","flash_o","flash_lse"), the backward pass
@@ -699,6 +723,7 @@ def _fa_fwd(q, k, v, causal, softmax_scale, block_q, block_k):
 def _fa_bwd(causal, softmax_scale, block_q, block_k, res, g):
     q = res[0]
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    block_q, block_k = _resolved_tiles(block_q, block_k)
     dq, dk, dv = _flash_backward(res, g, scale, causal, block_q, block_k)
     return dq, dk, dv
 

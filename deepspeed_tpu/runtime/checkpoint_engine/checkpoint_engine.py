@@ -33,19 +33,27 @@ def fsync_dir(path):
         pass
 
 
-def atomic_write_text(path: str, text: str):
+def atomic_write_bytes(path: str, blob: bytes):
     """tmp file + fsync + ``os.replace``: a crash mid-write can never
     leave a truncated file at ``path`` — either the old content survives
-    or the new content is complete. THE durable-text-write primitive:
-    sidecar manifests here, and the resilience layer's pointer/manifest/
-    registry writes (re-exported from ``resilience.integrity``)."""
+    or the new content is complete. THE durable-write primitive (one
+    implementation on purpose — the crash-safety sequence must not fork):
+    AOT program blobs directly, sidecar manifests and the resilience
+    layer's pointer/manifest/registry writes via
+    :func:`atomic_write_text`."""
     tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        f.write(text)
+    with open(tmp, "wb") as f:
+        f.write(blob)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
     fsync_dir(os.path.dirname(path) or ".")
+
+
+def atomic_write_text(path: str, text: str):
+    """Text face of :func:`atomic_write_bytes` (re-exported from
+    ``resilience.integrity``)."""
+    atomic_write_bytes(path, text.encode())
 
 
 class CheckpointEngine:
@@ -69,6 +77,13 @@ class CheckpointEngine:
         publish."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         atomic_write_text(path, text)
+
+    def save_bytes(self, path: str, blob: bytes):
+        """Binary sidecar saved into a tag directory (the AOT program
+        bundle's executable blobs). Same atomicity and staging contract
+        as :meth:`save_text`."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        atomic_write_bytes(path, blob)
 
     def commit(self, tag):
         return True
@@ -429,6 +444,10 @@ class TieredCheckpointEngine(CheckpointEngine):
         atomic publish as the payload — written into the final tag dir
         it would be destroyed when commit replaces that dir."""
         CheckpointEngine.save_text(self, self._staged_target(path), text)
+
+    def save_bytes(self, path, blob):
+        """Binary sidecars (the AOT program bundle) stage identically."""
+        CheckpointEngine.save_bytes(self, self._staged_target(path), blob)
 
     def _load_with_fallback(self, path, inner, map_location=None,
                             loader=None):

@@ -264,6 +264,59 @@ class TelemetryConfig(DeepSpeedConfigModel):
         return self
 
 
+class TuningConfig(DeepSpeedConfigModel):
+    """``tuning`` section (TPU-native): consume a measured tuned-config
+    artifact (``autotuning/artifact.py``) at engine build.
+
+    - ``artifact``: path to ``tuned.json`` (default:
+      ``<results_dir>/tuned.json``) — written by the live autotuner
+      (``python -m deepspeed_tpu.autotuning --live`` or
+      :class:`~deepspeed_tpu.autotuning.measure.LiveTuner`).
+    - Precedence is explicit-user-key > artifact > default: a key this
+      config file sets is never overridden by the artifact.
+    - The artifact is fingerprint-pinned: consuming it on a different
+      topology raises a structured
+      :class:`~deepspeed_tpu.autotuning.artifact.TunedArtifactError`
+      listing saved-vs-current fields.
+
+    With the block absent nothing changes anywhere: no artifact is read,
+    no kernel default is overridden, and the compiled step HLO is
+    byte-identical (zero-overhead contract, pinned in
+    ``tests/unit/test_live_tuning.py``).
+    """
+
+    enabled: bool = False
+    artifact: Optional[str] = None
+    results_dir: str = "autotuning_results"
+
+
+class AOTConfig(DeepSpeedConfigModel):
+    """``aot`` section (TPU-native): ship the engine's steady-state
+    compiled executables with every checkpoint (``deepspeed_tpu/aot``)
+    and pre-populate dispatch on resume, so a same-topology restart
+    reaches its first step without recompiling the world.
+
+    Requires ``telemetry.enabled`` (with the compile watchdog or HLO
+    cost collector on): the telemetry ``WatchedFunction`` layer is what
+    holds the compiled executables. Enabling ``aot`` without it is a
+    config error, not a silent no-op.
+
+    - ``fail_on_mismatch``: a shipped bundle whose identity (jaxlib
+      version, topology fingerprint, tuned-config hash) mismatches the
+      live runtime raises instead of warning + compiling normally.
+      (Not named ``strict``: the base config model's constructor
+      consumes that kwarg for auto-value handling.)
+
+    Environments where executable deserialization is known-crashy
+    (jaxlib < 0.5 multi-device CPU — ``utils/compat.
+    aot_serialization_safe``) skip capture/restore with a loud
+    ``aot``/``disabled`` telemetry event and compile normally.
+    """
+
+    enabled: bool = False
+    fail_on_mismatch: bool = False
+
+
 class ResilienceCheckpointConfig(DeepSpeedConfigModel):
     """``resilience.checkpoint``: integrity manifests + fallback chain +
     IO retry + retention (``runtime/resilience/integrity.py``).
@@ -484,10 +537,53 @@ class DeepSpeedConfig:
         self.checkpoint_config = CheckpointConfig(**d.get(C.CHECKPOINT, {}))
         self.nebula_config = NebulaConfig(**d.get("nebula", {}))
         self.data_types_config = DataTypesConfig(**d.get(C.DATA_TYPES, {}))
-        self.comm_quantization = CommQuantizationConfig(
-            **d.get("comm_quantization", {}))
+        # --- live tuned-config artifact (``tuning`` block) ---
+        # loaded BEFORE the sections it feeds parse, so precedence is
+        # uniform: a key the user wrote in this config wins, a key only
+        # the artifact carries fills in, everything else defaults
+        self.tuning_config = TuningConfig(**d.get("tuning", {}))
+        self.tuned_artifact = None
+        self.tuned_ops: Dict[str, Any] = {}
+        cq_raw = d.get("comm_quantization", {})
+        if self.tuning_config.enabled:
+            from deepspeed_tpu.autotuning.artifact import (apply_section,
+                                                           load_for_config,
+                                                           ops_choices)
+
+            try:
+                # shared consumption entry point (inference uses the
+                # same one): missing-artifact guidance + the loud,
+                # structured fingerprint gate live in exactly one place
+                self.tuned_artifact = load_for_config(
+                    {"artifact": self.tuning_config.artifact,
+                     "results_dir": self.tuning_config.results_dir})
+            except FileNotFoundError as e:
+                raise DeepSpeedConfigError(str(e))
+            # the comm.tier axis owns the section's `enabled` decision
+            # (its grid measured the machinery-off default too, so
+            # enabling here is a MEASURED choice — see
+            # artifact._expand_section_target); a bucket-bytes-only
+            # artifact fills bucket_bytes without flipping the section
+            # on, and an explicit user `enabled` key always wins
+            cq_raw = apply_section(cq_raw, self.tuned_artifact,
+                                   "comm_quantization")
+            # Pallas tile choices: the engine installs these into the
+            # kernel-default registry at build (and removes them at
+            # destroy) — kernels resolve explicit arg > tuned > default
+            self.tuned_ops = ops_choices(self.tuned_artifact)
+        self.comm_quantization = CommQuantizationConfig(**cq_raw)
         self.telemetry_config = TelemetryConfig(**d.get("telemetry", {}))
         self.resilience_config = ResilienceConfig(**d.get("resilience", {}))
+        self.aot_config = AOTConfig(**d.get("aot", {}))
+        if self.aot_config.enabled and not (
+                self.telemetry_config.enabled
+                and (self.telemetry_config.compile_watchdog
+                     or self.telemetry_config.hlo_cost)):
+            raise DeepSpeedConfigError(
+                "aot.enabled requires telemetry.enabled (with the "
+                "compile_watchdog or hlo_cost collector on): the "
+                "telemetry WatchedFunction layer is what holds the "
+                "compiled executables the AOT bundle ships")
 
         if self.fp16.enabled and self.bf16.enabled:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
@@ -575,6 +671,14 @@ class DeepSpeedConfig:
         self.use_node_local_storage = self.checkpoint_config.use_node_local_storage
 
     # ------------------------------------------------------------------
+    @property
+    def tuned_artifact_hash(self) -> str:
+        """Identity of the tuned config this engine was built under —
+        one component of the AOT bundle cache key ("none" untuned)."""
+        from deepspeed_tpu.autotuning.artifact import artifact_hash
+
+        return artifact_hash(self.tuned_artifact)
+
     @property
     def zero_enabled(self) -> bool:
         return self.zero_config.stage > 0

@@ -427,6 +427,7 @@ class DeepSpeedEngine:
         self.telemetry = Telemetry(self._config.telemetry_config,
                                    monitor=self.monitor, name="engine")
 
+
         # --- resilience (checkpoint integrity + fallback, step sentinel,
         #     hang watchdog — deepspeed_tpu/runtime/resilience) ---
         from deepspeed_tpu.runtime.resilience import Resilience
@@ -554,6 +555,26 @@ class DeepSpeedEngine:
                  f"gas={self.gradient_accumulation_steps()}"
                  + (f" comm_quantization={self._comm_quant}"
                     if self._comm_quant else ""), ranks=[0])
+
+        # --- live tuned config (``tuning`` block): install the
+        #     artifact's Pallas tile choices into the kernel-default
+        #     registry for this engine's lifetime (explicit kernel args
+        #     and user config keys still win — runtime_tunables).
+        #     Deliberately the LAST construction step: tiles resolve at
+        #     trace time (first forward), and installing any earlier
+        #     would leak them process-wide if a later validation raised
+        #     before destroy() could ever run ---
+        self._tuned_install = None
+        if self._config.tuned_ops:
+            from deepspeed_tpu.autotuning import runtime_tunables
+
+            self._tuned_install = runtime_tunables.install(
+                self._config.tuned_ops)
+        if self._config.tuning_config.enabled:
+            self.telemetry.emit(
+                "tuning", "applied",
+                data={"ops": dict(self._config.tuned_ops),
+                      "tuned_hash": self._config.tuned_artifact_hash})
 
     # ------------------------------------------------------------------
     # model / loss contract
@@ -1678,6 +1699,14 @@ class DeepSpeedEngine:
         if hasattr(self, "_jit_eval"):
             del self._jit_eval
         self.state = None
+        if getattr(self, "_tuned_install", None) is not None:
+            # engine-scoped tunables: a later engine built WITHOUT a
+            # tuning block must trace with the built-in defaults again
+            # (token-based: overlapping tuned engines keep their values)
+            from deepspeed_tpu.autotuning import runtime_tunables
+
+            runtime_tunables.uninstall(self._tuned_install)
+            self._tuned_install = None
         self.resilience.close()
         self.telemetry.close()
 
@@ -2122,6 +2151,105 @@ class DeepSpeedEngine:
                 "zero_stage_current": int(self.zero_optimization_stage()),
             })
 
+    # ------------------------------------------------------------------
+    # AOT program bundle (deepspeed_tpu/aot): ship the steady-state
+    # compiled executables with the checkpoint; pre-populate dispatch on
+    # resume so a same-topology restart never recompiles them
+    def _aot_identity(self):
+        from deepspeed_tpu.aot import current_bundle_identity
+
+        return current_bundle_identity(
+            mesh_axes={a: int(s)
+                       for a, s in self.topology.axis_sizes.items()},
+            tuned_hash=self._config.tuned_artifact_hash)
+
+    def _aot_supported(self, what: str) -> bool:
+        """The hard compat gate, loudly: jaxlib < 0.5 segfaults (native
+        crash) deserializing CPU executables, and multi-process
+        executables span devices no single process can rebind. Emits
+        the ``aot``/``disabled`` event so the stream records WHY a
+        restart ran cold."""
+        from deepspeed_tpu.utils.compat import aot_serialization_safe
+
+        if jax.process_count() > 1:
+            reason = "multi-process executables are not AOT-shippable"
+        elif not aot_serialization_safe():
+            reason = ("jaxlib < 0.5 CPU executable (de)serialization is "
+                      "known to segfault (compat.aot_serialization_safe)")
+        else:
+            return True
+        logger.warning(f"[aot] {what} skipped: {reason}; falling back to "
+                       "normal compilation")
+        self.telemetry.emit("aot", "disabled", step=self.global_steps,
+                            data={"what": what, "reason": reason})
+        return False
+
+    def _save_aot_bundle(self, ckpt_dir):
+        from deepspeed_tpu.aot import capture_entries, save_bundle
+
+        if not self._aot_supported("bundle capture"):
+            return
+        entries = capture_entries(self.telemetry)
+        manifest = save_bundle(self.checkpoint_engine, ckpt_dir, entries,
+                               self._aot_identity())
+        if manifest is None:
+            logger.warning("[aot] no compiled programs to capture (no "
+                           "watched function has compiled yet); "
+                           "checkpoint saved without a bundle")
+            return
+        total = sum(p["size"] for p in manifest["programs"])
+        self.telemetry.emit("aot", "captured", step=self.global_steps,
+                            data={"programs": len(manifest["programs"]),
+                                  "bytes": total})
+        log_dist(f"[aot] captured {len(manifest['programs'])} compiled "
+                 f"program(s) ({total / 2**20:.1f} MiB) into {ckpt_dir}",
+                 ranks=[0])
+
+    def _maybe_arm_aot(self, ckpt_dir):
+        """Arm the AOT store from a restored tag's bundle (if any).
+        Every failure path is loud-but-soft: the restart compiles
+        normally unless ``aot.fail_on_mismatch`` asked for a hard
+        stop."""
+        from deepspeed_tpu.aot import AOTStore, load_bundle, verify_manifest
+        from deepspeed_tpu.aot.bundle import format_mismatches
+
+        if not self._config.aot_config.enabled:
+            return
+        try:
+            reader = load_bundle(ckpt_dir)
+        except OSError as e:
+            logger.warning(f"[aot] bundle at {ckpt_dir!r} unreadable "
+                           f"({e}); compiling normally")
+            self.telemetry.emit("aot", "disabled", step=self.global_steps,
+                                data={"what": "restore",
+                                      "reason": f"unreadable: {e}"[:300]})
+            return
+        if reader is None:
+            return  # checkpoint predates AOT / saved with it off
+        if not self._aot_supported("bundle restore"):
+            return
+        mismatches = verify_manifest(reader.manifest, self._aot_identity())
+        if mismatches:
+            rendered = format_mismatches(mismatches)
+            self.telemetry.emit(
+                "aot", "disabled", step=self.global_steps,
+                data={"what": "restore", "reason": "identity_mismatch",
+                      "mismatches": mismatches})
+            if self._config.aot_config.fail_on_mismatch:
+                raise RuntimeError(
+                    f"AOT bundle at {ckpt_dir!r} was built for a "
+                    "different runtime (aot.fail_on_mismatch):\n"
+                    + rendered)
+            logger.warning(
+                f"[aot] bundle at {ckpt_dir!r} was built for a different "
+                f"runtime; compiling normally —\n{rendered}")
+            return
+        self.telemetry.set_aot_store(AOTStore(
+            reader, emit=lambda **data: self.telemetry.emit(
+                "aot", "store", data=data)))
+        log_dist(f"[aot] armed program store from {ckpt_dir} "
+                 f"({len(reader)} program(s))", ranks=[0])
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         if self.state is None:
             raise RuntimeError("no state to checkpoint (run a forward first)")
@@ -2201,6 +2329,21 @@ class DeepSpeedEngine:
 
             write_topology_manifest(self.checkpoint_engine, ckpt_dir,
                                     self.describe_topology())
+        if self._config.aot_config.enabled and dist.get_rank() == 0:
+            # AOT program bundle: serialized steady-state executables
+            # ride the tag (written BEFORE commit — hashed into the
+            # integrity manifest and published atomically like any
+            # payload file). Failure here must never cost the
+            # checkpoint: the bundle is a restart accelerator, the
+            # checkpoint is the product.
+            try:
+                self._save_aot_bundle(ckpt_dir)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(f"[aot] bundle capture for {tag!r} failed "
+                               f"({e}); checkpoint saved without it")
+                self.telemetry.emit("aot", "capture_failed",
+                                    step=self.global_steps,
+                                    data={"error": str(e)[:300]})
         self.checkpoint_engine.commit(tag)
         # "latest" moves only AFTER the commit publishes the tag — a crash
         # between the two can never leave latest dangling at a
@@ -2359,6 +2502,10 @@ class DeepSpeedEngine:
                     f"[resilience] checkpoint {t!r} failed mid-load ({e}); "
                     f"falling back to {candidates[i + 1]!r}")
                 continue
+            # a bundle shipped with the restored tag pre-populates AOT
+            # dispatch: the next first call of each watched program
+            # deserializes instead of compiling
+            self._maybe_arm_aot(ckpt_dir)
             if i > 0:
                 self.resilience.emit_fault(
                     "ckpt.fallback", from_tag=candidates[0], to_tag=t,
@@ -2453,6 +2600,14 @@ class DeepSpeedEngine:
             )
             if self._host_offload:
                 self._restore_host_optimizer_flat(flat_opt)
+        # normalize placement: the counters/rng/loss-scale leaves above
+        # arrive host-built (single-device placement) while a running
+        # engine's state is canonically sharded — the very first
+        # dispatch would otherwise present a DIFFERENT argument
+        # signature than the saved run's steady state, which costs one
+        # spurious retrace and makes the AOT program cache miss on
+        # sharding alone
+        self.state = jax.device_put(self.state, self._state_shardings)
         engine_state = self.checkpoint_engine.load(os.path.join(ckpt_dir, "engine"))
         client_state = self._restore_engine_aux(engine_state,
                                                 load_lr_scheduler_states)
@@ -2564,6 +2719,11 @@ class DeepSpeedEngine:
                 self._restore_host_optimizer_flat(
                     self._lazy_full_entries(reader_o, meta_o,
                                             "host_optimizer/"))
+        # same placement normalization as the consolidated path: the
+        # scalar counters/rng above arrive host-built, and a same-mesh
+        # ELASTIC restart is exactly the scenario the AOT program store
+        # serves — its signature lookup must not miss on sharding alone
+        self.state = jax.device_put(self.state, self._state_shardings)
         engine_state = self.checkpoint_engine.load(
             os.path.join(ckpt_dir, "engine"))
         client_state = self._restore_engine_aux(engine_state,

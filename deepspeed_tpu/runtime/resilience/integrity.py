@@ -345,6 +345,24 @@ class ResilientCheckpointEngine(CheckpointEngine):
                         what=f"save {path!r}",
                         on_retry=self._on_retry("save", path))
 
+    def save_bytes(self, path, blob):
+        """Binary sidecars (the AOT program bundle) ride the same retry
+        + chaos seams and the same verdict-invalidation as text
+        sidecars."""
+        save_dir, tag, _ = self._split(path)
+        self._roots.add(save_dir)
+        self._verified_ok.discard(
+            os.path.realpath(os.path.join(save_dir, tag)))
+
+        def do():
+            chaos.raise_if("ckpt.save", path)
+            return self._inner.save_bytes(path, blob)
+
+        return retry_io(do, retries=self._cfg.retries,
+                        backoff_secs=self._cfg.retry_backoff_secs,
+                        what=f"save {path!r}",
+                        on_retry=self._on_retry("save", path))
+
     def load(self, path, map_location=None):
         return self._guarded_load(self._inner, path, map_location)
 

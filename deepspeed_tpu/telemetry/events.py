@@ -29,6 +29,12 @@ satellite families that ride the same sink):
                      whether the load resharded (elastic resume)
 - ``router``       — multi-replica front door: replica state / breaker /
                      failover / degradation-tier transitions
+- ``aot``          — AOT program cache: store armed / per-program hits /
+                     disabled (compat gate, identity mismatch) /
+                     capture + load failures
+- ``tuning``       — live-autotuner trials (axis, candidate value,
+                     objective score / skip reason) and the tuned
+                     values an engine applied at build
 
 Everything in ``data`` must be JSON-safe; :func:`json_safe` coerces numpy
 scalars and drops device arrays (an event must never pin or sync device
@@ -41,7 +47,7 @@ from typing import Any, Dict, Optional
 
 KINDS = ("compile", "step_cost", "memory", "trace_window", "step",
          "wallclock", "comm", "fault", "serving", "model_time", "topology",
-         "router")
+         "router", "aot", "tuning")
 
 
 def json_safe(value: Any):

@@ -65,8 +65,17 @@ class Telemetry:
         # in-memory tail of recent events: the post-mortem context the
         # resilience watchdog dumps alongside the thread stacks
         import collections
+        import weakref
 
         self._tail = collections.deque(maxlen=256)
+        # live watched functions (weak: the engine's reference is the
+        # only owner — see watch_jit) — the AOT capture walks these to
+        # serialize the steady-state executables their caches hold
+        self._watched = weakref.WeakSet()
+        # AOT program store (deepspeed_tpu/aot): armed after a
+        # checkpoint restore ships a bundle; consulted by
+        # WatchedFunction._compile on every dispatch-cache miss
+        self._aot_store = None
         if not self.enabled:
             return
         try:
@@ -111,10 +120,50 @@ class Telemetry:
         if not self.enabled or not (self.config.compile_watchdog
                                     or self.config.hlo_cost):
             return fn
-        # deliberately NOT retained here: the engine's reference is the
-        # only owner, so its release paths (destroy, load_checkpoint,
-        # cache clears) actually free the wrapped compiled executables
-        return WatchedFunction(fn, name, self)
+        # deliberately NOT strongly retained here: the engine's
+        # reference is the only owner, so its release paths (destroy,
+        # load_checkpoint, cache clears) actually free the wrapped
+        # compiled executables; the WeakSet only lets the AOT capture
+        # enumerate whichever instances are still alive
+        wf = WatchedFunction(fn, name, self)
+        self._watched.add(wf)
+        return wf
+
+    def watched_functions(self):
+        """The live watched functions (AOT capture walks their compiled
+        caches)."""
+        return list(self._watched)
+
+    # ------------------------------------------------------------------
+    # AOT program store (deepspeed_tpu/aot)
+    def set_aot_store(self, store):
+        """Arm (or, with None, disarm) the AOT program store. Emits the
+        arming event so the stream records which restarts ran warm."""
+        self._aot_store = store
+        if store is not None:
+            self.emit("aot", self.name, step=self._steps_seen,
+                      action="armed", programs=len(store),
+                      tuned_hash=store.manifest.get("tuned_hash"))
+
+    def aot_lookup(self, name: str, sig_hash: str):
+        """Shipped executable for a program signature, or None. Never
+        raises: a broken store must degrade to normal compilation."""
+        if self._aot_store is None:
+            return None
+        try:
+            return self._aot_store.lookup(name, sig_hash)
+        except Exception as e:  # noqa: BLE001 — dispatch must survive
+            logger.warning(f"telemetry: AOT store lookup for {name!r} "
+                           f"failed ({e}); compiling normally")
+            return None
+
+    def record_aot_hit(self, watched: WatchedFunction, sig_hash: str):
+        """A dispatch-cache miss was served from the shipped bundle —
+        the program the step runs was never compiled in this process.
+        Deliberately NOT counted in the compile totals: the warm-restart
+        pin asserts those stay at zero."""
+        self.emit("aot", watched.name, step=self._steps_seen,
+                  action="hit", sig_hash=sig_hash)
 
     @staticmethod
     def _family(name: str) -> str:

@@ -40,6 +40,24 @@ def persistent_compilation_cache_safe() -> bool:
     return jax.default_backend() != "cpu"
 
 
+def aot_serialization_safe() -> bool:
+    """Whether AOT executable serialize/deserialize
+    (``jax.experimental.serialize_executable``) is safe here.
+
+    Reuses the :func:`persistent_compilation_cache_safe` matrix — the
+    failure is the same native one: jaxlib < 0.5 SIGSEGVs (a hard
+    crash, not a Python error) deserializing CPU executables in a fresh
+    process. Probed empirically on 0.4.37: a trivial jit round-trips,
+    but a real engine train-step program (donation + sharded state)
+    segfaults at deserialize even compiled over a single-device mesh —
+    so the CPU leg is gated wholesale, not just multi-device. TPU
+    executables round-trip fine everywhere we have run them. The AOT
+    layer must consult this BEFORE any serialize/deserialize and fall
+    back loudly (``aot``/``disabled`` telemetry event + normal
+    compilation), never crash."""
+    return persistent_compilation_cache_safe()
+
+
 def tpu_compiler_params(**kwargs):
     """``pltpu.CompilerParams`` under its current name; older runtimes
     (< 0.5) ship the same dataclass as ``TPUCompilerParams``. Every

@@ -1,0 +1,69 @@
+"""Topology fingerprint — the identity key shared by the tuned-config
+artifact (``autotuning/artifact.py``) and the AOT program bundle
+(``deepspeed_tpu/aot``).
+
+Both artifacts are only valid on the hardware they were produced on: a
+tile size tuned on v5e is wrong on v4, and a serialized executable binds
+device ids outright. Every producer therefore stamps
+:func:`topology_fingerprint` into its artifact and every consumer diffs
+it against the live runtime before honoring anything — loudly, with the
+saved-vs-current fields, never by silently applying stale choices.
+
+Two granularities:
+
+- ``topology_fingerprint()`` — chip-level identity (backend, device kind
+  and count, process count, jax/jaxlib versions). What the *tuner*
+  stamps: tuned values transfer across mesh shapes on the same chips.
+- ``topology_fingerprint(mesh_axes=...)`` — adds the named mesh axis
+  sizes. What the *AOT bundle* stamps: a compiled executable is bound to
+  the exact partitioning it was compiled for.
+"""
+
+from typing import Dict, Optional
+
+
+def jaxlib_version() -> str:
+    try:
+        import jaxlib
+
+        return getattr(jaxlib, "__version__", "unknown")
+    except Exception:
+        return "unknown"
+
+
+def topology_fingerprint(mesh_axes: Optional[Dict[str, int]] = None) -> Dict:
+    """JSON-safe identity of the live runtime (module docstring)."""
+    import jax
+
+    devs = jax.devices()
+    fp = {
+        "backend": jax.default_backend(),
+        "device_count": int(jax.device_count()),
+        "process_count": int(jax.process_count()),
+        "device_kind": str(getattr(devs[0], "device_kind", "unknown"))
+        if devs else "none",
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib_version(),
+    }
+    if mesh_axes is not None:
+        fp["mesh_axes"] = {str(a): int(s) for a, s in mesh_axes.items()}
+    return fp
+
+
+def fingerprint_hash(fp: Dict) -> str:
+    """Stable short hash of a fingerprint dict (canonical-JSON sha256)."""
+    import hashlib
+    import json
+
+    blob = json.dumps(fp, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def diff_fingerprint(saved: Dict, current: Dict) -> Dict:
+    """``{field: {"saved": ..., "current": ...}}`` for every mismatched
+    field (union of keys). Empty dict = identical topologies."""
+    out = {}
+    for k in sorted(set(saved) | set(current)):
+        if saved.get(k) != current.get(k):
+            out[k] = {"saved": saved.get(k), "current": current.get(k)}
+    return out

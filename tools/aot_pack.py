@@ -1,0 +1,131 @@
+"""Inspect / verify an AOT program bundle (``deepspeed_tpu/aot``).
+
+A checkpoint tag saved with ``aot: {enabled: true}`` carries
+``aot_manifest.json`` + ``aot_<sha>.bin`` executable blobs. This tool is
+the preflight for a warm restart::
+
+    python tools/aot_pack.py <ckpt_dir>/<tag>            # list programs
+    python tools/aot_pack.py <tag> --verify              # re-hash blobs
+    python tools/aot_pack.py <tag> --current             # diff identity
+    python tools/aot_pack.py <tag> --json                # one JSON line
+
+Exit codes: 0 = bundle usable, 1 = no bundle / unreadable, 2 = mismatch
+(a blob failed verification, or ``--current`` found the bundle was built
+for a different runtime — jaxlib, topology fingerprint, or tuned-config
+hash). ``--current`` touches jax (it fingerprints the live runtime);
+plain listing and ``--verify`` are pure file reads.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.aot.bundle import (BundleReader, format_mismatches,  # noqa: E402
+                                      read_bundle, verify_manifest)
+
+
+def _fmt_size(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="python tools/aot_pack.py")
+    p.add_argument("tag_dir", help="checkpoint tag directory (or any "
+                                   "directory holding aot_manifest.json)")
+    p.add_argument("--verify", action="store_true",
+                   help="re-hash every blob against the manifest")
+    p.add_argument("--current", action="store_true",
+                   help="diff the bundle identity against THIS runtime "
+                        "(jaxlib, topology fingerprint, tuned hash)")
+    p.add_argument("--tuned-artifact", default=None,
+                   help="tuned.json this runtime would build engines "
+                        "with (for the --current tuned-hash leg; "
+                        "default: untuned)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    try:
+        manifest = read_bundle(args.tag_dir)
+    except OSError as e:
+        print(f"aot_pack: {e}", file=sys.stderr)
+        return 1
+    if manifest is None:
+        print(f"aot_pack: no AOT bundle in {args.tag_dir!r}",
+              file=sys.stderr)
+        return 1
+
+    reader = BundleReader(args.tag_dir, manifest)
+    programs = reader.programs()
+    out = {
+        "dir": args.tag_dir,
+        "version": manifest.get("version"),
+        "fingerprint": manifest.get("fingerprint"),
+        "fingerprint_hash": manifest.get("fingerprint_hash"),
+        "tuned_hash": manifest.get("tuned_hash"),
+        "programs": [{k: p[k] for k in ("name", "sig_hash", "file", "size")}
+                     for p in programs],
+        "total_bytes": sum(p["size"] for p in programs),
+    }
+    rc = 0
+    if args.verify:
+        bad = reader.verify_all()
+        out["verify"] = {"ok": not bad, "bad": bad}
+        if bad:
+            rc = 2
+    if args.current:
+        from deepspeed_tpu.aot.capture import current_bundle_identity
+        from deepspeed_tpu.autotuning.artifact import (artifact_hash,
+                                                       read_tuned_artifact)
+
+        tuned = (read_tuned_artifact(args.tuned_artifact)
+                 if args.tuned_artifact else None)
+        current = current_bundle_identity(
+            mesh_axes=(manifest.get("fingerprint") or {}).get("mesh_axes"),
+            tuned_hash=artifact_hash(tuned))
+        # mesh_axes copied from the manifest on purpose: the tool cannot
+        # know which mesh an engine would build, so the diff reports
+        # every OTHER identity field (jaxlib, device kind/count, tuned
+        # hash) against this runtime
+        mismatches = verify_manifest(manifest, current)
+        out["current"] = {"ok": not mismatches, "mismatches": mismatches}
+        if mismatches:
+            rc = 2
+
+    if args.as_json:
+        print(json.dumps(out, sort_keys=True))
+        return rc
+
+    fp = out["fingerprint"] or {}
+    print(f"AOT bundle: {args.tag_dir}")
+    print(f"  identity: jaxlib={fp.get('jaxlib_version')} "
+          f"backend={fp.get('backend')} devices={fp.get('device_count')} "
+          f"({fp.get('device_kind')}) mesh={fp.get('mesh_axes')}")
+    print(f"  fingerprint_hash={out['fingerprint_hash']} "
+          f"tuned_hash={out['tuned_hash']}")
+    print(f"  programs: {len(programs)} "
+          f"({_fmt_size(out['total_bytes'])} total)")
+    for prog in programs:
+        print(f"    {prog['name']:<32} sig={prog['sig_hash']} "
+              f"{_fmt_size(prog['size']):>10}  {prog['file']}")
+    if args.verify:
+        print("  verify: " + ("OK — every blob matches its manifest hash"
+                              if out["verify"]["ok"] else
+                              "MISMATCH:\n    " + "\n    ".join(
+                                  out["verify"]["bad"])))
+    if args.current:
+        print("  current-runtime: " + (
+            "OK — bundle was built for this runtime"
+            if out["current"]["ok"] else
+            "MISMATCH (restart would fall back to cold compiles):\n"
+            + format_mismatches(out["current"]["mismatches"])))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

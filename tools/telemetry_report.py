@@ -52,6 +52,10 @@ def aggregate(events: List[Dict]) -> Dict:
     serving = {"events": 0, "finished": 0, "shed": 0, "prompt_tokens": 0,
                "prefix_hit_tokens": 0, "hit_requests": 0, "blocks_shared": 0,
                "prefill_chunks": 0, "last_gauges": {}}
+    aot = {"events": 0, "hits": 0, "hit_programs": {}, "captured": 0,
+           "captured_bytes": 0, "disabled": [], "load_failed": 0,
+           "armed_programs": 0}
+    tuning = {"events": 0, "trials": {}, "applied": {}}
     for e in events:
         kind, name, data = e.get("kind"), e.get("name"), e.get("data", {})
         if kind == "compile":
@@ -134,6 +138,34 @@ def aggregate(events: List[Dict]) -> Dict:
                 serving["shed"] += 1
             elif name == "step.gauges":
                 serving["last_gauges"] = data
+        elif kind == "aot":
+            aot["events"] += 1
+            action = data.get("action")
+            if action == "hit":
+                aot["hits"] += 1
+                aot["hit_programs"][name] = \
+                    aot["hit_programs"].get(name, 0) + 1
+            elif action == "armed":
+                aot["armed_programs"] = data.get("programs", 0)
+            elif action == "load_failed":
+                aot["load_failed"] += 1
+            elif name == "captured":
+                aot["captured"] = data.get("programs", 0)
+                aot["captured_bytes"] = data.get("bytes", 0)
+            elif name == "disabled":
+                aot["disabled"].append(
+                    {"what": data.get("what"),
+                     "reason": (data.get("reason") or "")[:120],
+                     "step": e.get("step")})
+        elif kind == "tuning":
+            tuning["events"] += 1
+            if name == "applied":
+                tuning["applied"] = data
+            else:
+                ax = tuning["trials"].setdefault(name, [])
+                ax.append({k: data.get(k) for k in
+                           ("value", "objective", "score", "skipped",
+                            "error") if data.get(k) is not None})
     return {
         "compile": compile_by_name,
         "step_cost": step_cost_by_name,
@@ -144,6 +176,8 @@ def aggregate(events: List[Dict]) -> Dict:
         "faults": faults,
         "router": router,
         "serving": serving,
+        "aot": aot,
+        "tuning": tuning,
     }
 
 
@@ -237,6 +271,87 @@ def _fault_lines(agg: Dict, markdown: bool) -> List[str]:
     return out
 
 
+def _aot_lines(agg: Dict, markdown: bool) -> List[str]:
+    """AOT program cache: capture/arm/hit accounting + every loud
+    ``disabled`` record (compat gate, identity mismatch)."""
+    a = agg.get("aot") or {}
+    if not a.get("events"):
+        return []
+    out = [""]
+    head = (f"aot: {a['hits']} warm dispatch hit(s), "
+            f"{a['armed_programs']} program(s) armed, "
+            f"{a['captured']} captured"
+            + (f" ({a['captured_bytes']:,} bytes)" if a.get("captured_bytes")
+               else "")
+            + (f", {a['load_failed']} load failure(s)"
+               if a.get("load_failed") else ""))
+    out.append(("### " if markdown else "") + head)
+    pad = "" if markdown else "  "
+    for name, n in sorted((a.get("hit_programs") or {}).items()):
+        out.append(f"{pad}hit: {name} x{n}")
+    for d in a.get("disabled") or []:
+        out.append(f"{pad}DISABLED ({d.get('what')}): {d.get('reason')}")
+    return out
+
+
+def _tuning_lines(agg: Dict, markdown: bool,
+                  tuned_artifact: Dict = None) -> List[str]:
+    """Live-autotuner trials from the event stream, plus (``--tuned``)
+    the artifact's chosen values with their measurement evidence."""
+    t = agg.get("tuning") or {}
+    if not t.get("events") and not tuned_artifact:
+        return []
+    out = [""]
+    out.append(("### " if markdown else "") + "tuning:")
+    pad = "" if markdown else "  "
+    applied = t.get("applied") or {}
+    if applied:
+        ops = applied.get("ops") or {}
+        out.append(f"{pad}applied at engine build: "
+                   + (", ".join(f"{k}={v}" for k, v in sorted(ops.items()))
+                      or "(config-section values only)")
+                   + f" [tuned_hash {applied.get('tuned_hash')}]")
+    for axis, trials in sorted((t.get("trials") or {}).items()):
+        rendered = ", ".join(
+            (f"{tr.get('value')}: skipped ({tr['skipped']})"
+             if tr.get("skipped") else
+             f"{tr.get('value')}: ERROR" if tr.get("error") else
+             f"{tr.get('value')}: {tr.get('score')}")
+            for tr in trials)
+        out.append(f"{pad}{axis}: {rendered}")
+    if tuned_artifact:
+        axes = tuned_artifact.get("axes") or {}
+        if markdown:
+            out.append("\n| axis | chosen | objective | score | trials |")
+            out.append("|---|---|---|---|---|")
+            for name, ax in sorted(axes.items()):
+                out.append(f"| `{name}` | {ax.get('value')} | "
+                           f"{ax.get('objective')}"
+                           f"{' (min)' if ax.get('minimize') else ''} | "
+                           f"{ax.get('score')} | "
+                           f"{len(ax.get('evidence') or [])} |")
+        else:
+            out.append(f"{pad}tuned artifact "
+                       f"[{tuned_artifact.get('fingerprint_hash')}]:")
+            for name, ax in sorted(axes.items()):
+                out.append(f"{pad}  {name}: chose {ax.get('value')!r} "
+                           f"({ax.get('objective')}={ax.get('score')}, "
+                           f"{len(ax.get('evidence') or [])} trial(s))")
+                for tr in (ax.get("evidence") or []):
+                    if "skipped" in tr:
+                        out.append(f"{pad}    {tr.get('value')!r}: skipped "
+                                   f"— {tr['skipped']}")
+                    elif "error" in tr:
+                        out.append(f"{pad}    {tr.get('value')!r}: ERROR "
+                                   f"— {tr['error'][:80]}")
+                    else:
+                        m = tr.get("measurements") or {}
+                        score = m.get(ax.get("objective"))
+                        out.append(f"{pad}    {tr.get('value')!r}: "
+                                   f"{ax.get('objective')}={score}")
+    return out
+
+
 def _compile_table(agg: Dict, markdown: bool) -> List[str]:
     rows = sorted(agg["compile"].items())
     if not rows:
@@ -295,7 +410,8 @@ def _step_cost_lines(agg: Dict, markdown: bool) -> List[str]:
     return out
 
 
-def render(path: str, markdown: bool = False) -> str:
+def render(path: str, markdown: bool = False,
+           tuned_artifact: Dict = None) -> str:
     events = load_events(path)
     agg = aggregate(events)
     lines = []
@@ -333,6 +449,8 @@ def render(path: str, markdown: bool = False) -> str:
     lines.extend(_fault_lines(agg, markdown))
     lines.extend(_serving_lines(agg, markdown))
     lines.extend(_router_lines(agg, markdown))
+    lines.extend(_aot_lines(agg, markdown))
+    lines.extend(_tuning_lines(agg, markdown, tuned_artifact))
     return "\n".join(lines)
 
 
@@ -343,15 +461,25 @@ def main(argv=None):
                     help="emit markdown tables (for PERF.md)")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON line of the aggregates")
+    ap.add_argument("--tuned", default=None,
+                    help="tuned.json artifact: render the live-tuner "
+                         "trial measurements alongside the event stream")
     args = ap.parse_args(argv)
     path = args.path
     if os.path.isdir(path):
         path = os.path.join(path, "telemetry.jsonl")
+    tuned = None
+    if args.tuned:
+        with open(args.tuned) as f:
+            tuned = json.load(f)
     if args.json:
-        print(json.dumps({"metric": "telemetry_report", "path": path,
-                          **aggregate(load_events(path))}, default=str))
+        payload = {"metric": "telemetry_report", "path": path,
+                   **aggregate(load_events(path))}
+        if tuned is not None:
+            payload["tuned_artifact"] = tuned
+        print(json.dumps(payload, default=str))
     else:
-        print(render(path, markdown=args.markdown))
+        print(render(path, markdown=args.markdown, tuned_artifact=tuned))
 
 
 if __name__ == "__main__":

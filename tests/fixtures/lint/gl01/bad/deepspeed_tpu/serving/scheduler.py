@@ -1,0 +1,8 @@
+"""BAD: jax-free by itself, but the module-level import closure reaches
+jax through a helper — the transitive leg GL01 must follow."""
+
+from deepspeed_tpu.utils.devhelper import device_count
+
+
+def admit(queue):
+    return queue[:device_count()]

@@ -1,0 +1,12 @@
+"""BAD: a registered jax-free module importing jax at module level."""
+
+import json
+
+import jax  # the direct violation GL01 must flag
+
+KINDS = ("compile", "serving")
+
+
+def make_event(kind, name):
+    return json.dumps({"kind": kind, "name": name,
+                       "backend": jax.default_backend()})

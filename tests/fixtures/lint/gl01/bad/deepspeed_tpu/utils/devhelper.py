@@ -1,0 +1,7 @@
+"""The offending closure edge: a helper that pulls jax at import."""
+
+import jax
+
+
+def device_count():
+    return jax.device_count()

@@ -1,0 +1,14 @@
+"""GOOD: the closure stays host-only, and a lazy in-function jax import
+is fine — only MODULE-level imports count."""
+
+from deepspeed_tpu.utils.devhelper import device_count
+
+
+def admit(queue):
+    return queue[:device_count()]
+
+
+def _debug_devices():
+    import jax  # function-scoped: exempt by design
+
+    return jax.devices()

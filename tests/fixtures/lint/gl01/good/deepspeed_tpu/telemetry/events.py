@@ -1,0 +1,9 @@
+"""GOOD: the registered module stays stdlib-only at import time."""
+
+import json
+
+KINDS = ("compile", "serving")
+
+
+def make_event(kind, name):
+    return json.dumps({"kind": kind, "name": name})

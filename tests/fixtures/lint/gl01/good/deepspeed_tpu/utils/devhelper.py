@@ -1,0 +1,7 @@
+"""Host-only helper: stdlib imports only."""
+
+import os
+
+
+def device_count():
+    return int(os.environ.get("WORLD_SIZE", 1))

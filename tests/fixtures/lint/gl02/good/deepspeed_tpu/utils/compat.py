@@ -1,0 +1,20 @@
+"""The shim itself is the ONE exempt module: raw API access lives here."""
+
+from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def tpu_compiler_params(**kwargs):
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def tpu_interpret_mode():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.force_tpu_interpret_mode()
+
+
+def persistent_compilation_cache_safe():
+    return False

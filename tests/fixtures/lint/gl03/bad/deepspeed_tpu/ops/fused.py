@@ -1,0 +1,38 @@
+"""BAD: impure host calls inside traced functions, one per detection
+mode (decorator / jit call-arg / pallas call-arg / partial)."""
+
+import random
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+@jax.jit
+def decorated_step(x):
+    t0 = time.time()          # host clock at trace time
+    print("stepping", t0)     # fires per retrace
+    return x * 2
+
+
+def flowed_step(x, scale):
+    noise = np.random.normal(size=x.shape)   # host RNG baked into trace
+    return x * float(scale) + noise          # float() on traced param
+
+
+compiled = jax.jit(flowed_step)
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * random.random()   # host RNG in a kernel
+
+
+call = pl.pallas_call(kernel, out_shape=None)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def partial_step(x, n):
+    return x.sum().item() + n   # .item() host sync on a traced value

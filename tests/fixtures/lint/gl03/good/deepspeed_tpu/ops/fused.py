@@ -1,0 +1,39 @@
+"""GOOD: the same module shape, pure inside the traced boundary —
+clocks/RNG/syncs live in the host wrapper, randomness rides a traced
+key."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+@jax.jit
+def decorated_step(x, key):
+    noise = jax.random.normal(key, x.shape)   # traced RNG: fine
+    return x * 2 + noise
+
+
+def flowed_step(x, scale):
+    return x * scale
+
+
+compiled = jax.jit(flowed_step)
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+call = pl.pallas_call(kernel, out_shape=None)
+
+
+def host_wrapper(x):
+    """Host-side driver: impure calls OUTSIDE the traced boundary are
+    exactly where they belong."""
+    t0 = time.time()
+    out = decorated_step(x, jax.random.PRNGKey(0))
+    wall = time.time() - t0
+    print("step took", wall)   # host log, not traced
+    return float(out.sum()), wall

@@ -1,0 +1,16 @@
+"""BAD: un-gated host syncs inside the hot decode-loop bodies."""
+
+import jax
+import numpy as np
+
+
+class ServingEngine:
+    def step(self):
+        toks = self._decode_fn()
+        host = np.asarray(toks)          # un-gated sync in step
+        jax.block_until_ready(toks)      # explicit fence
+        return host
+
+    def _decode_step(self, done):
+        state = jax.device_get(self.state)   # whole-state readback
+        return state

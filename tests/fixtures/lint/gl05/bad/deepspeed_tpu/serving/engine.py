@@ -1,0 +1,10 @@
+"""BAD: emits with kinds the registry has never heard of."""
+
+from deepspeed_tpu.telemetry.events import make_event
+
+
+class ServingEngine:
+    def step(self):
+        self.telemetry.emit("servign", "step.gauges", step=1)   # typo kind
+        self._telemetry.emit("decode_stats", "tokens", step=1)  # new, never
+        return make_event("bogus", "x", 0, 0, {})               # registered

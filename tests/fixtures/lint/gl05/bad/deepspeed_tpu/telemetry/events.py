@@ -1,0 +1,8 @@
+"""The fixture registry GL05 resolves (pure AST, never imported)."""
+
+KINDS = ("compile", "serving", "fault")
+
+
+def make_event(kind, name, step, rank, data):
+    return {"kind": kind, "name": name, "step": step, "rank": rank,
+            "data": data}

@@ -1,0 +1,12 @@
+"""GOOD: registered kinds everywhere; dynamic kinds are the emitting
+wrapper's responsibility and are not flagged."""
+
+from deepspeed_tpu.telemetry.events import make_event
+
+
+class ServingEngine:
+    def step(self, kind_from_config):
+        self.telemetry.emit("serving", "step.gauges", step=1)
+        self._telemetry.emit("fault", "watchdog.hang", step=1)
+        self.telemetry.emit(kind_from_config, "dynamic", step=1)
+        return make_event("compile", "x", 0, 0, {})

@@ -1,0 +1,17 @@
+"""BAD: one undocumented field (beta) and the doc fence carries a
+phantom key (gamma) — both directions of drift at once."""
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class WidgetConfig(DeepSpeedConfigModel):
+    alpha: int = 1
+    beta: int = 2          # never made it into docs/config.md
+    legacy_knob: int = Field(0, json_schema_extra={"deprecated": True})
+
+
+class DeepSpeedConfig:
+    def __init__(self, d):
+        self.widget = WidgetConfig(**d.get("widget", {}))

@@ -1,0 +1,19 @@
+"""GOOD: every live field documented, no phantom keys, deprecated
+reference-parity fields exempt."""
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class WidgetConfig(DeepSpeedConfigModel):
+    alpha: int = 1
+    beta: int = 2
+    renamed: int = Field(0, alias="old_name")
+    legacy_knob: int = Field(0, json_schema_extra={"deprecated": True})
+
+
+class DeepSpeedConfig:
+    def __init__(self, d):
+        self.widget = WidgetConfig(**d.get("widget", {}))
+        self.fused_step = d.get("fused_step", False)

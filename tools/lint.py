@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""graft-lint entry point: ``python tools/lint.py [paths...]``.
+
+Thin script wrapper over the :mod:`tools.lint` package (the directory
+next to this file — packages win the import resolution, so the name
+collision is deliberate and stable). Exit 0 clean, 2 on new findings,
+1 on usage errors. See ``docs/lint.md``.
+"""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tools.lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

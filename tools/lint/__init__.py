@@ -1,0 +1,43 @@
+"""graft-lint: project-specific AST static analysis.
+
+The repo's most expensive bug classes are *conventions*, not logic —
+jax-0.4.x-breaking APIs used outside ``utils/compat.py`` (the segfault
+family), impure Python inside traced code (retraces, host syncs), and
+host-only modules quietly growing a module-level ``import jax``. This
+package turns those reviewer-memory invariants into machine-checked
+ones:
+
+- GL01 ``jax-free-host-modules`` — registered host-policy modules (and
+  their module-level import closure) never reach jax at import time.
+- GL02 ``compat-routing`` — every API that segfaulted or renamed under
+  jax 0.4.x flows through ``deepspeed_tpu/utils/compat.py``.
+- GL03 ``trace-purity`` — no impure host calls inside functions that
+  flow into ``jax.jit`` / ``pl.pallas_call`` / ``compat.shard_map``.
+- GL04 ``host-sync-in-hot-loop`` — no un-gated host syncs inside the
+  engine step / decode-loop bodies.
+- GL05 ``event-kind-registry`` — every telemetry emit uses a kind
+  registered in ``telemetry/events.KINDS``.
+- GL06 ``config-doc-parity`` — config dataclass fields and
+  ``docs/config.md`` cannot drift apart (either direction).
+
+Pure-AST and jax-import-free by construction: the whole pass runs in
+tier-1 in well under a second (``tests/unit/test_lint.py``). CLI:
+``python tools/lint.py deepspeed_tpu`` (exit 0 clean, 2 on findings).
+Suppress a finding inline with ``# graft-lint: disable=CODE`` next to a
+justifying comment, or baseline it with a written justification in
+``tools/lint_baseline.json``. See ``docs/lint.md``.
+"""
+
+from tools.lint.core import (  # noqa: F401
+    Checker,
+    Finding,
+    LintError,
+    Report,
+    all_checkers,
+    register,
+    run,
+    unregister,
+)
+
+__all__ = ["Checker", "Finding", "LintError", "Report", "all_checkers",
+           "register", "run", "unregister"]

@@ -1,0 +1,10 @@
+"""Built-in checkers. Importing this package registers GL01–GL06."""
+
+from tools.lint.checkers import (  # noqa: F401
+    gl01_jax_free,
+    gl02_compat_routing,
+    gl03_trace_purity,
+    gl04_host_sync,
+    gl05_event_kinds,
+    gl06_config_docs,
+)

@@ -1,0 +1,154 @@
+"""GL03 trace-purity.
+
+Impure Python inside code that flows into ``jax.jit`` /
+``pl.pallas_call`` / ``compat.shard_map`` runs at **trace time**, not
+step time: a ``time.time()`` there stamps the trace once and never
+again, ``np.random`` bakes one host sample into the program,
+``print`` fires per retrace (the classic "why does my step log
+twice?"), and ``.item()``/``float()`` on a traced value is a hidden
+host sync that serializes the dispatch queue — the exact failure
+family BENCH_r05 calls out.
+
+Traced functions are detected two ways, both pure AST:
+
+- **decorator**: ``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``,
+  ``@pl.pallas_call(...)``, ``@compat.shard_map(...)``;
+- **call-argument dataflow**: a function *name* passed as the first
+  argument to ``jax.jit(...)`` / ``pl.pallas_call(...)`` /
+  ``compat.shard_map(...)`` anywhere in the module marks every
+  same-module def of that name.
+
+``float()``/``int()``/``bool()`` are flagged only on a parameter of
+the traced function (the closest pure-AST notion of "a traced value").
+"""
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from tools.lint.core import Checker, Finding, LintContext, dotted, register
+
+JIT_MARKERS = {"jax.jit", "jit", "pjit", "jax.pjit"}
+PALLAS_MARKERS = {"pl.pallas_call", "pallas_call", "pallas.pallas_call"}
+SHARD_MAP_MARKERS = {"compat.shard_map", "shard_map", "jax.shard_map"}
+ALL_MARKERS = JIT_MARKERS | PALLAS_MARKERS | SHARD_MAP_MARKERS
+PARTIAL = {"partial", "functools.partial"}
+
+CLOCK_CALLS = {"time.time", "time.time_ns", "time.perf_counter",
+               "time.perf_counter_ns", "time.monotonic",
+               "time.monotonic_ns"}
+DATETIME_CALLS = {"datetime.now", "datetime.utcnow",
+                  "datetime.datetime.now", "datetime.datetime.utcnow"}
+RANDOM_FNS = {"random", "randint", "randrange", "uniform", "choice",
+              "choices", "shuffle", "sample", "seed", "gauss",
+              "normalvariate", "getrandbits", "betavariate"}
+HOST_CASTS = {"float", "int", "bool"}
+
+
+def _marker(node) -> bool:
+    d = dotted(node)
+    return d in ALL_MARKERS if d else False
+
+
+@register
+class TracePurity(Checker):
+    code = "GL03"
+    name = "trace-purity"
+    description = ("no impure host calls (clocks, host RNG, print, "
+                   ".item()/float() syncs) inside functions that flow "
+                   "into jax.jit / pl.pallas_call / compat.shard_map")
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        for mod in ctx.modules:
+            # raw-source pre-filter: no trace entry point, no parse.
+            # Spellings, not the bare word "jit" — 'jitted' in a comment
+            # must not cost a parse+walk of the whole module.
+            if mod.mentions("jax.jit", "@jit", "pjit", "jit(",
+                            "pallas_call", "shard_map"):
+                yield from self._check_module(mod)
+
+    # ------------------------------------------------------------------
+    def _traced_functions(self, mod) -> Dict[ast.AST, str]:
+        by_name: Dict[str, List[ast.AST]] = {}
+        for node in mod.nodes():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        traced: Dict[ast.AST, str] = {}
+
+        def mark(fn, how):
+            traced.setdefault(fn, how)
+
+        for node in mod.nodes():
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    how = self._decorator_marker(dec)
+                    if how:
+                        mark(node, how)
+            elif isinstance(node, ast.Call) and _marker(node.func) \
+                    and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Name):
+                    for fn in by_name.get(first.id, ()):
+                        mark(fn, f"passed to {dotted(node.func)}() at "
+                                 f"line {node.lineno}")
+        return traced
+
+    def _decorator_marker(self, dec) -> str:
+        if _marker(dec):
+            return f"decorated @{dotted(dec)}"
+        if isinstance(dec, ast.Call):
+            if _marker(dec.func):
+                return f"decorated @{dotted(dec.func)}(...)"
+            if dotted(dec.func) in PARTIAL and dec.args \
+                    and _marker(dec.args[0]):
+                return f"decorated @partial({dotted(dec.args[0])}, ...)"
+        return ""
+
+    # ------------------------------------------------------------------
+    def _check_module(self, mod) -> Iterable[Finding]:
+        traced = self._traced_functions(mod)
+        if not traced:
+            return
+        params: Dict[ast.AST, Set[str]] = {
+            fn: {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                 + fn.args.kwonlyargs)}
+            for fn in traced}
+        for node in mod.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            # a nested def inside a traced function still runs traced
+            # when called, so any traced ancestor counts
+            fn = next((p for p in mod.ancestors(node) if p in traced), None)
+            if fn is None:
+                continue
+            impurity = self._impurity(node, params[fn])
+            if impurity:
+                yield Finding(
+                    code=self.code, path=mod.relpath, line=node.lineno,
+                    col=node.col_offset,
+                    message=(f"{impurity} inside traced function "
+                             f"'{fn.name}' ({traced[fn]}) — runs at trace "
+                             f"time, not step time (retrace hazard / "
+                             f"hidden host sync)"))
+
+    def _impurity(self, call: ast.Call, params: Set[str]) -> str:
+        d = dotted(call.func)
+        if d in CLOCK_CALLS or d in DATETIME_CALLS:
+            return f"host clock call {d}()"
+        if d is not None:
+            if d.startswith("np.random.") or d.startswith("numpy.random."):
+                return f"host RNG call {d}() (use jax.random with a " \
+                       f"traced key)"
+            if d.startswith("random.") and d.split(".", 1)[1] in RANDOM_FNS:
+                return f"host RNG call {d}() (use jax.random with a " \
+                       f"traced key)"
+        if d == "print":
+            return "print() (fires per retrace; use jax.debug.print)"
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "item" \
+                and not call.args and not call.keywords:
+            return ".item() host sync on a traced value"
+        if d in HOST_CASTS and len(call.args) == 1 \
+                and isinstance(call.args[0], ast.Name) \
+                and call.args[0].id in params:
+            return f"{d}() host sync on traced parameter " \
+                   f"'{call.args[0].id}'"
+        return ""

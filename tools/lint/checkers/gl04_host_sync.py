@@ -1,0 +1,97 @@
+"""GL04 host-sync-in-hot-loop.
+
+The engine step loops are dispatch pipelines: ``np.asarray`` /
+``jax.device_get`` / ``.block_until_ready()`` inside them fences the
+async queue and turns overlap into serialization — the serving tier's
+throughput contract is "one designed host sync per step" (the token
+read), everything else stays on device. Branches that are telemetry-,
+debug- or profiler-gated are exempt (they own their fences); the one
+designed sync carries an inline suppression with its justification.
+
+Hot bodies are matched by (file suffix, function name) — the training
+optimizer step/fused train_batch and the serving decode loop.
+"""
+
+import ast
+from typing import Iterable
+
+from tools.lint.core import Checker, Finding, LintContext, dotted, register
+
+# (module relpath suffix, function names that are hot-loop bodies)
+HOT_BODIES = (
+    ("deepspeed_tpu/runtime/engine.py", ("step", "train_batch")),
+    ("deepspeed_tpu/runtime/pipe/engine.py", ("train_batch",)),
+    ("deepspeed_tpu/serving/engine.py", ("step", "_decode_step")),
+)
+
+# a gating condition mentioning any of these owns its fences
+GATE_WORDS = ("telemetry", "debug", "profil", "wall_clock", "breakdown",
+              "verbose", "dump", "trace", "flops")
+
+
+def _matches(relpath: str, suffix: str) -> bool:
+    return relpath == suffix or relpath.endswith("/" + suffix)
+
+
+def _gated(parents) -> bool:
+    for p in parents:
+        if isinstance(p, ast.If):
+            try:
+                text = ast.unparse(p.test).lower()
+            except Exception:  # pragma: no cover - unparse is total on 3.10
+                continue
+            if any(w in text for w in GATE_WORDS):
+                return True
+    return False
+
+
+@register
+class HostSyncInHotLoop(Checker):
+    code = "GL04"
+    name = "host-sync-in-hot-loop"
+    description = ("np.asarray / jax.device_get / .block_until_ready() "
+                   "in engine step / decode-loop bodies outside "
+                   "telemetry- or debug-gated branches")
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        for mod in ctx.modules:
+            names = next((fns for sfx, fns in HOT_BODIES
+                          if _matches(mod.relpath, sfx)), None)
+            if names:
+                yield from self._check_module(mod, names)
+
+    def _check_module(self, mod, hot_names) -> Iterable[Finding]:
+        for node in mod.nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            sync = self._sync_kind(node)
+            if not sync:
+                continue
+            fn = next((p for p in mod.ancestors(node)
+                       if isinstance(p, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))), None)
+            if fn is None or fn.name not in hot_names:
+                continue
+            if _gated(mod.ancestors(node)):
+                continue
+            yield Finding(
+                code=self.code, path=mod.relpath, line=node.lineno,
+                col=node.col_offset,
+                message=(f"host sync {sync} inside hot-loop body "
+                         f"'{fn.name}' — fences the async dispatch "
+                         f"queue every step; move it behind a "
+                         f"telemetry/debug gate or justify it with an "
+                         f"inline suppression"))
+
+    def _sync_kind(self, call: ast.Call) -> str:
+        d = dotted(call.func)
+        if d in ("np.asarray", "numpy.asarray"):  # exact: jnp.asarray is
+            return f"{d}()"                       # a device op, not a sync
+        if d in ("jax.device_get", "device_get"):
+            return f"{d}()"
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "block_until_ready":
+            return ".block_until_ready()"
+        if d == "jax.block_until_ready":
+            return "jax.block_until_ready()"
+        return ""

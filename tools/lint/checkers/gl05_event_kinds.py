@@ -1,0 +1,94 @@
+"""GL05 event-kind-registry.
+
+Every telemetry emit must use a kind registered in
+``telemetry/events.KINDS``: the report tool, the monitor bridge and the
+resilience watchdog tail all route by kind, so an unregistered kind is
+an event that silently renders nowhere. The registry is read from the
+AST of ``deepspeed_tpu/telemetry/events.py`` (scan set first, lint root
+as fallback) — never imported, so the checker stays jax-free even if
+that module ever regressed.
+
+Checked call shapes (literal first ``kind`` argument only — dynamic
+kinds are the emitting wrapper's responsibility):
+
+- ``<anything>.telemetry.emit("kind", ...)`` (and ``_telemetry``)
+- ``make_event("kind", ...)``
+"""
+
+import ast
+from typing import Iterable, Optional, Tuple
+
+from tools.lint.core import Checker, Finding, LintContext, dotted, register
+from tools.lint.core import str_const
+
+EVENTS_MODULE = "deepspeed_tpu/telemetry/events.py"
+
+
+def registry_kinds(ctx: LintContext) -> Optional[Tuple[str, ...]]:
+    """``KINDS`` extracted from the events module's AST (None when the
+    module or the assignment cannot be found)."""
+    mod = ctx.parse_under_root(EVENTS_MODULE)
+    if mod is None or mod.tree() is None:
+        return None
+    for node in mod.tree().body:
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if "KINDS" in targets and isinstance(
+                    node.value, (ast.Tuple, ast.List)):
+                vals = [str_const(e) for e in node.value.elts]
+                if all(v is not None for v in vals):
+                    return tuple(vals)
+    return None
+
+
+def _emit_kind_arg(call: ast.Call) -> Optional[ast.expr]:
+    """The ``kind`` argument of a telemetry ``emit``/``make_event``
+    call, or None when this call is not one."""
+    d = dotted(call.func)
+    if d is None:
+        return None
+    if d.endswith("telemetry.emit") or d.endswith("_telemetry.emit"):
+        if call.args:
+            return call.args[0]
+        return next((k.value for k in call.keywords if k.arg == "kind"),
+                    None)
+    if d == "make_event" or d.endswith(".make_event"):
+        if call.args:
+            return call.args[0]
+        return next((k.value for k in call.keywords if k.arg == "kind"),
+                    None)
+    return None
+
+
+@register
+class EventKindRegistry(Checker):
+    code = "GL05"
+    name = "event-kind-registry"
+    description = ("every telemetry emit uses a kind registered in "
+                   "telemetry/events.KINDS (unregistered kinds render "
+                   "nowhere)")
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        kinds = registry_kinds(ctx)
+        if kinds is None:
+            return  # no registry in reach (partial scan): nothing to pin
+        for mod in ctx.modules:
+            # raw-source pre-filter: no emit call shape, no parse
+            if not mod.mentions(".emit(", "make_event("):
+                continue
+            for node in mod.nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                arg = _emit_kind_arg(node)
+                if arg is None:
+                    continue
+                kind = str_const(arg)
+                if kind is None or kind in kinds:
+                    continue
+                yield Finding(
+                    code=self.code, path=mod.relpath, line=node.lineno,
+                    col=node.col_offset,
+                    message=(f"telemetry emit uses unregistered kind "
+                             f"{kind!r} — register it in telemetry/"
+                             f"events.KINDS (known: {', '.join(kinds)})"))

@@ -1,0 +1,253 @@
+"""GL06 config-doc-parity.
+
+``docs/config.md`` is the contract surface users configure against;
+the pydantic config models are what the engines actually parse. Eight
+PRs of fast growth let them drift (PRs 6-8 added fields the doc never
+learned). This checker pins both directions:
+
+- **forward**: every field on the config dataclasses in
+  ``runtime/config.py``, ``inference/config.py`` and
+  ``serving/config.py`` must appear in ``docs/config.md`` (as a JSON
+  key in a fence or a backticked token in prose). Reference-parity
+  fields marked deprecated (``json_schema_extra={"deprecated": ...}``)
+  are exempt — they exist to *accept* old configs, not to be
+  recommended.
+- **reverse**: every identifier key inside a ```json fence in
+  ``docs/config.md`` must exist as a field on some config model
+  (including the zero/precision sub-models), a pydantic alias, a
+  ``runtime/constants.py`` key string, or a literal ``.get()`` key in
+  the config modules. Keys nested under free-form dict sections
+  (``params``, ``dcn``, ``parallel_write``) are user payload, not
+  schema, and are skipped.
+"""
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.lint.core import Checker, Finding, LintContext, register
+from tools.lint.core import str_const
+
+ENFORCED_MODULES = (
+    "deepspeed_tpu/runtime/config.py",
+    "deepspeed_tpu/inference/config.py",
+    "deepspeed_tpu/serving/config.py",
+)
+# known-key sources for the reverse direction only (their own doc homes
+# are checkpointing.md / the ZeRO section's curated subset)
+SUPPLEMENTARY_MODULES = (
+    "deepspeed_tpu/runtime/zero/config.py",
+    "deepspeed_tpu/runtime/precision_config.py",
+)
+CONSTANTS_MODULE = "deepspeed_tpu/runtime/constants.py"
+DOCS_FILE = "docs/config.md"
+
+# dict-valued sections whose nested keys are user payload, not schema
+FREEFORM_PARENTS = {"params", "dcn", "parallel_write", "optimizer_params"}
+
+
+# ---------------------------------------------------------------------------
+# config-model side
+
+
+def _is_config_class(node: ast.ClassDef) -> bool:
+    for base in node.bases:
+        name = base.id if isinstance(base, ast.Name) else (
+            base.attr if isinstance(base, ast.Attribute) else "")
+        if name.endswith("ConfigModel") or name.endswith("Config"):
+            return True
+    return False
+
+
+def _is_deprecated(value) -> bool:
+    """Field(..., json_schema_extra={"deprecated": ...})"""
+    if not isinstance(value, ast.Call):
+        return False
+    for kw in value.keywords:
+        if kw.arg == "json_schema_extra" and isinstance(kw.value, ast.Dict):
+            for k in kw.value.keys:
+                if str_const(k) == "deprecated":
+                    return True
+    return False
+
+
+def _field_alias(value) -> Optional[str]:
+    if isinstance(value, ast.Call):
+        for kw in value.keywords:
+            if kw.arg == "alias":
+                return str_const(kw.value)
+    return None
+
+
+def model_fields(tree: ast.Module) -> List[Tuple[str, str, int, bool, str]]:
+    """(class, field, line, deprecated, alias) for every config-model
+    field in a module."""
+    out = []
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef) or not _is_config_class(node):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name) \
+                    and not stmt.target.id.startswith("_"):
+                out.append((node.name, stmt.target.id, stmt.lineno,
+                            _is_deprecated(stmt.value),
+                            _field_alias(stmt.value) or ""))
+    return out
+
+
+def _get_call_keys(tree: ast.Module) -> Set[str]:
+    """Literal first-arg keys of ``<x>.get("...")`` calls — the scalar
+    config surface (``d.get("fused_step")`` etc.)."""
+    keys = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and node.func.attr == "get" and node.args:
+            k = str_const(node.args[0])
+            if k:
+                keys.add(k)
+    return keys
+
+
+def _constant_strings(tree: ast.Module) -> Set[str]:
+    """Module-level ``NAME = "string"`` values (runtime/constants.py)."""
+    out = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            v = str_const(node.value)
+            if v and re.fullmatch(r"[A-Za-z_][\w]*", v):
+                out.add(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# docs side
+
+_FENCE_RE = re.compile(r"^```(\w*)\s*$")
+_TICK_RE = re.compile(r"`([^`\n]+)`")
+_IDENT_RE = re.compile(r"[A-Za-z_][\w]*")
+
+
+def doc_tokens(text: str) -> Set[str]:
+    """Every identifier that appears in backticks or as a JSON-fence
+    key — the 'is it documented at all' universe."""
+    tokens: Set[str] = set()
+    for m in _TICK_RE.finditer(text):
+        tokens.update(_IDENT_RE.findall(m.group(1)))
+    for key, _path, _line in json_fence_keys(text):
+        tokens.add(key)
+    return tokens
+
+
+def json_fence_keys(text: str) -> List[Tuple[str, Tuple[str, ...], int]]:
+    """(key, ancestor-key path, 1-based doc line) for every identifier
+    key inside a ```json fence. Fences here are config *fragments*
+    (``"telemetry": {...}``), so this is a tolerant scanner, not a JSON
+    parser: strings followed by ``:`` are keys, braces track nesting."""
+    out = []
+    in_json = False
+    stack: List[Optional[str]] = []   # open-object keys (None = anonymous)
+    pending: Optional[str] = None     # key whose value comes next
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        fence = _FENCE_RE.match(line.strip())
+        if fence:
+            if not in_json and fence.group(1) == "json":
+                in_json, stack, pending = True, [], None
+            elif in_json:
+                in_json = False
+            continue
+        if not in_json:
+            continue
+        i = 0
+        while i < len(line):
+            ch = line[i]
+            if ch == '"':
+                j = line.find('"', i + 1)
+                if j < 0:
+                    break
+                content = line[i + 1:j]
+                if line[j + 1:].lstrip().startswith(":"):
+                    pending = content
+                    if _IDENT_RE.fullmatch(content):
+                        path = tuple(k for k in stack if k)
+                        out.append((content, path, lineno))
+                i = j + 1
+                continue
+            if ch == "{":
+                stack.append(pending)
+                pending = None
+            elif ch == "}":
+                if stack:
+                    stack.pop()
+                pending = None
+            i += 1
+    return out
+
+
+@register
+class ConfigDocParity(Checker):
+    code = "GL06"
+    name = "config-doc-parity"
+    description = ("config dataclass fields and docs/config.md cannot "
+                   "drift: undocumented fields and phantom documented "
+                   "keys are both findings")
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        text = ctx.read_text_under_root(DOCS_FILE)
+        enforced = [(rel, ctx.parse_under_root(rel))
+                    for rel in ENFORCED_MODULES]
+        enforced = [(rel, m) for rel, m in enforced
+                    if m is not None and m.tree() is not None]
+        if text is None or not enforced:
+            return  # partial scan: nothing to pin against
+
+        known: Set[str] = set()
+        docs_path = self._docs_relpath(ctx)
+
+        # forward: every non-deprecated field is documented
+        tokens = doc_tokens(text)
+        for rel, mod in enforced:
+            for cls, field, line, deprecated, alias in \
+                    model_fields(mod.tree()):
+                known.add(field)
+                if alias:
+                    known.add(alias)
+                if deprecated:
+                    continue
+                if field not in tokens and alias not in tokens:
+                    yield Finding(
+                        code=self.code, path=mod.relpath, line=line, col=0,
+                        message=(f"config field {cls}.{field} is not "
+                                 f"documented in {DOCS_FILE} — add it "
+                                 f"(or mark it deprecated via "
+                                 f"json_schema_extra)"))
+            known |= _get_call_keys(mod.tree())
+
+        for rel in SUPPLEMENTARY_MODULES:
+            mod = ctx.parse_under_root(rel)
+            if mod is not None and mod.tree() is not None:
+                for _cls, field, _line, _dep, alias in \
+                        model_fields(mod.tree()):
+                    known.add(field)
+                    if alias:
+                        known.add(alias)
+                known |= _get_call_keys(mod.tree())
+        consts = ctx.parse_under_root(CONSTANTS_MODULE)
+        if consts is not None and consts.tree() is not None:
+            known |= _constant_strings(consts.tree())
+
+        # reverse: every documented JSON key exists somewhere real
+        for key, path, line in json_fence_keys(text):
+            if FREEFORM_PARENTS & set(path) or key in FREEFORM_PARENTS:
+                continue
+            if key not in known:
+                where = ".".join(path + (key,))
+                yield Finding(
+                    code=self.code, path=docs_path, line=line, col=0,
+                    message=(f"{DOCS_FILE} documents key '{where}' "
+                             f"which no config model, alias or constant "
+                             f"defines — schema drift or a typo"))
+
+    def _docs_relpath(self, ctx: LintContext) -> str:
+        return DOCS_FILE

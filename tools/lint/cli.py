@@ -1,0 +1,70 @@
+"""graft-lint CLI (argument parsing + exit codes).
+
+Exit codes: 0 clean, 2 new findings, 1 usage/configuration error —
+the same convention as ``tools/ckpt_topology.py`` / ``tools/aot_pack.py``
+preflights, so CI gates can distinguish "invariant broken" from "the
+linter itself is misconfigured".
+"""
+
+import argparse
+import os
+import sys
+
+from tools.lint.core import (LintError, all_checkers, default_root,
+                             load_baseline, render_json, render_markdown,
+                             render_text, run)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    checkers = all_checkers()
+    codes = ", ".join(f"{c} ({k.name})" for c, k in checkers.items())
+    p = argparse.ArgumentParser(
+        prog="tools/lint.py",
+        description=f"graft-lint: AST static analysis enforcing this "
+                    f"repo's hard-won invariants. Checkers: {codes}.")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories (default: deepspeed_tpu)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.add_argument("--markdown", action="store_true",
+                   help="markdown section for PERF/review embedding")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: tools/lint_baseline.json "
+                        "when it exists)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report everything as new)")
+    p.add_argument("--select", default=None,
+                   help="comma-separated codes to run (default: all)")
+    p.add_argument("--ignore", default=None,
+                   help="comma-separated codes to skip")
+    p.add_argument("--root", default=None,
+                   help="lint root (default: the repo root; fixtures "
+                        "point this at a tmp tree)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    root = os.path.abspath(args.root) if args.root else default_root()
+    try:
+        baseline = None
+        if not args.no_baseline:
+            path = args.baseline or os.path.join(root, "tools",
+                                                 "lint_baseline.json")
+            if args.baseline or os.path.isfile(path):
+                baseline = load_baseline(path)
+        report = run(
+            paths=[os.path.abspath(p) for p in args.paths] or None,
+            root=root, baseline=baseline,
+            select=args.select.split(",") if args.select else None,
+            ignore=args.ignore.split(",") if args.ignore else None)
+    except LintError as e:
+        print(f"graft-lint: error: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        sys.stdout.write(render_json(report))
+    elif args.markdown:
+        sys.stdout.write(render_markdown(report))
+    else:
+        sys.stdout.write(render_text(report))
+    return 0 if report.clean else 2

@@ -1,0 +1,464 @@
+"""graft-lint framework: module model, checker registry, suppressions,
+baseline, runner and renderers.
+
+Design constraints (pinned in ``tests/unit/test_lint.py``):
+
+- **pure AST** — no module under ``tools/lint`` may import jax (or
+  anything that transitively does). The linter must run in tier-1 on a
+  box with no accelerator stack at all, in well under a second.
+- **deterministic** — findings sort by (path, line, col, code); the
+  ``--json`` payload for an unchanged tree is byte-stable.
+- **explainable** — every finding carries the invariant it enforces,
+  and every escape hatch (inline suppression, baseline entry) carries a
+  human-written justification the report renders.
+"""
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# data model
+
+
+class LintError(Exception):
+    """Configuration/usage error (bad baseline, unknown code, unreadable
+    path) — distinct from findings: the CLI exits 1, not 2."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    code: str          # "GL01".."GL06"
+    path: str          # repo-root-relative, posix separators
+    line: int          # 1-based
+    col: int           # 0-based (ast convention)
+    message: str
+
+    def key(self) -> Tuple:
+        return (self.path, self.line, self.col, self.code, self.message)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """One source file. Parsing is lazy and cached: checkers pre-filter
+    on raw source substrings (``mod.mentions(...)``) so most files are
+    never parsed at all — that laziness is what keeps the whole pass
+    inside the tier-1 budget."""
+
+    path: str                    # absolute
+    relpath: str                 # posix, relative to the lint root
+    source: str
+    # line (1-based) -> set of codes disabled on that line
+    suppressions: Dict[int, set]
+    _tree: Optional[ast.Module] = None
+    _parse_failed: bool = False
+    _nodes: Optional[List[ast.AST]] = None
+    _parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    def mentions(self, *needles: str) -> bool:
+        return any(n in self.source for n in needles)
+
+    def tree(self) -> Optional[ast.Module]:
+        """The parsed AST, or None for a file that does not parse (a
+        broken file must never crash the lint run)."""
+        if self._tree is None and not self._parse_failed:
+            try:
+                self._tree = ast.parse(self.source, filename=self.path)
+            except SyntaxError:
+                self._parse_failed = True
+        return self._tree
+
+    def nodes(self) -> List[ast.AST]:
+        if self._nodes is None:
+            tree = self.tree()
+            self._nodes = list(ast.walk(tree)) if tree is not None else []
+        return self._nodes
+
+    def ancestors(self, node: ast.AST):
+        """Innermost-first ancestors of ``node`` up to the module. The
+        parent map is built on first use — most modules never need one."""
+        if self._parents is None:
+            parents = {}
+            for n in self.nodes():
+                for child in ast.iter_child_nodes(n):
+                    parents[child] = n
+            self._parents = parents
+        node = self._parents.get(node)
+        while node is not None:
+            yield node
+            node = self._parents.get(node)
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    code: str
+    path: str
+    justification: str
+    match: str = ""              # optional substring of the message
+
+    def matches(self, f: Finding) -> bool:
+        return (f.code == self.code and f.path == self.path
+                and (not self.match or self.match in f.message))
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]                      # new (actionable)
+    baselined: List[Tuple[Finding, BaselineEntry]]
+    stale_baseline: List[BaselineEntry]          # matched nothing
+    suppressed: int
+    files_scanned: int
+    codes_run: List[str]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.code] = out.get(f.code, 0) + 1
+        return out
+
+
+# ---------------------------------------------------------------------------
+# checker registry
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator: add a checker to the registry by its ``code``."""
+    code = getattr(cls, "code", None)
+    if not code or code in _REGISTRY:
+        raise LintError(f"checker registration problem for {cls!r}: "
+                        f"missing or duplicate code {code!r}")
+    _REGISTRY[code] = cls
+    return cls
+
+
+def unregister(code: str) -> None:
+    """Remove a checker (docs/tests that register demo checkers must
+    clean up — the registry is process-global)."""
+    _REGISTRY.pop(code, None)
+
+
+def all_checkers() -> Dict[str, type]:
+    _load_builtin_checkers()
+    return dict(sorted(_REGISTRY.items()))
+
+
+def _load_builtin_checkers():
+    # import for side effect (registration); idempotent
+    from tools.lint import checkers  # noqa: F401
+
+
+class Checker:
+    """Base class. Subclasses set ``code``/``name``/``description`` and
+    implement ``run(ctx)`` yielding :class:`Finding`. Checkers are
+    project-scoped: they see every scanned module plus the lint root, so
+    cross-file invariants (import closures, registry lookups, doc
+    parity) need no special casing."""
+
+    code = ""
+    name = ""
+    description = ""
+
+    def run(self, ctx: "LintContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+class LintContext:
+    """What a checker sees: the scanned modules plus the lint root (for
+    out-of-scan-set lookups like ``docs/config.md`` — fixtures redirect
+    it at a tmp tree, so checkers never hardcode the real repo)."""
+
+    def __init__(self, modules: List[ModuleInfo], root: str):
+        self.modules = modules
+        self.root = root
+        self.by_relpath: Dict[str, ModuleInfo] = {
+            m.relpath: m for m in modules}
+        self._extra_cache: Dict[str, Optional[ModuleInfo]] = {}
+
+    def find(self, relpath_suffix: str) -> Optional[ModuleInfo]:
+        """The scanned module whose relpath is, or ends with, the given
+        posix suffix (longest registry entries should be unambiguous)."""
+        if relpath_suffix in self.by_relpath:
+            return self.by_relpath[relpath_suffix]
+        for m in self.modules:
+            if m.relpath.endswith("/" + relpath_suffix):
+                return m
+        return None
+
+    def parse_under_root(self, relpath: str) -> Optional[ModuleInfo]:
+        """Parse a file under the lint root that is not necessarily in
+        the scan set (cached; None when absent or unparseable)."""
+        if relpath in self._extra_cache:
+            return self._extra_cache[relpath]
+        found = self.find(relpath)
+        if found is None:
+            path = os.path.join(self.root, *relpath.split("/"))
+            found = _load_module(path, self.root) \
+                if os.path.isfile(path) else None
+        self._extra_cache[relpath] = found
+        return found
+
+    def read_text_under_root(self, relpath: str) -> Optional[str]:
+        path = os.path.join(self.root, *relpath.split("/"))
+        if not os.path.isfile(path):
+            return None
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by checkers
+
+
+def dotted(node) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+_SUPPRESS_RE = re.compile(r"#\s*graft-lint:\s*disable=([A-Z0-9, ]+)")
+
+
+def _parse_suppressions(source: str) -> Dict[int, set]:
+    out: Dict[int, set] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {c.strip() for c in m.group(1).split(",") if c.strip()}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise LintError(f"cannot read baseline {path}: {e}")
+    if not isinstance(raw, dict):
+        raise LintError(f"baseline {path} must be a JSON object with an "
+                        f"'entries' list, got {type(raw).__name__}")
+    entries = []
+    for i, e in enumerate(raw.get("entries", [])):
+        code, p = e.get("code", ""), e.get("path", "")
+        just = (e.get("justification") or "").strip()
+        if not code or not p:
+            raise LintError(
+                f"baseline entry {i} in {path} needs 'code' and 'path'")
+        if not just:
+            raise LintError(
+                f"baseline entry {i} ({code} {p}) in {path} has no "
+                f"justification — a baselined finding without a written "
+                f"reason is just a hidden finding")
+        entries.append(BaselineEntry(code=code, path=p, justification=just,
+                                     match=e.get("match", "")))
+    return entries
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+
+def _load_module(path: str, root: str) -> Optional[ModuleInfo]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except OSError:
+        return None
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    return ModuleInfo(path=os.path.abspath(path), relpath=rel, source=source,
+                      suppressions=_parse_suppressions(source))
+
+
+def _collect_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            if not p.endswith(".py"):
+                # an explicit non-.py argument silently scanning nothing
+                # would read as "clean" in CI — refuse loudly instead
+                raise LintError(f"not a python file: {p}")
+            out.append(os.path.abspath(p))
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.abspath(
+                            os.path.join(dirpath, fn)))
+        else:
+            raise LintError(f"no such path: {p}")
+    return out
+
+
+def default_root() -> str:
+    """The repo root (parent of ``tools/``)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run(paths: Optional[List[str]] = None, root: Optional[str] = None,
+        baseline: Optional[List[BaselineEntry]] = None,
+        select: Optional[Iterable[str]] = None,
+        ignore: Optional[Iterable[str]] = None) -> Report:
+    """Run the registered checkers over ``paths`` (files or directories;
+    default: ``<root>/deepspeed_tpu``). Returns a :class:`Report`; the
+    caller decides the exit code (CLI: 2 on new findings)."""
+    root = os.path.abspath(root or default_root())
+    if paths is None:
+        paths = [os.path.join(root, "deepspeed_tpu")]
+    modules = [m for m in (_load_module(p, root)
+                           for p in _collect_files(paths)) if m is not None]
+    ctx = LintContext(modules, root)
+
+    checkers = all_checkers()
+    codes = set(checkers)
+    if select:
+        unknown = set(select) - codes
+        if unknown:
+            raise LintError(f"unknown checker code(s): {sorted(unknown)}")
+        codes = set(select)
+    if ignore:
+        codes -= set(ignore)
+
+    raw: List[Finding] = []
+    for code in sorted(codes):
+        raw.extend(checkers[code]().run(ctx))
+
+    # inline suppressions (line-scoped, code-scoped). Findings can land
+    # in files outside the scan set (GL01 closures, GL05/GL06 registry
+    # lookups load via parse_under_root) — their suppressions must be
+    # honored identically, or the same tree lints clean or dirty
+    # depending on the caller's `paths`.
+    active: List[Finding] = []
+    suppressed = 0
+    for f in raw:
+        mod = ctx.by_relpath.get(f.path) or ctx.parse_under_root(f.path)
+        if mod is not None and f.code in mod.suppressions.get(f.line, ()):
+            suppressed += 1
+        else:
+            active.append(f)
+    active.sort(key=Finding.key)
+
+    # baseline
+    baselined: List[Tuple[Finding, BaselineEntry]] = []
+    fresh: List[Finding] = []
+    used = set()
+    for f in active:
+        entry = next((e for e in (baseline or []) if e.matches(f)), None)
+        if entry is not None:
+            baselined.append((f, entry))
+            used.add(id(entry))
+        else:
+            fresh.append(f)
+    stale = [e for e in (baseline or []) if id(e) not in used]
+
+    return Report(findings=fresh, baselined=baselined, stale_baseline=stale,
+                  suppressed=suppressed, files_scanned=len(modules),
+                  codes_run=sorted(codes))
+
+
+# ---------------------------------------------------------------------------
+# renderers (text / json / markdown, telemetry_report-style)
+
+
+def render_text(report: Report) -> str:
+    lines = [f"graft-lint: {len(report.findings)} finding(s), "
+             f"{len(report.baselined)} baselined, "
+             f"{report.suppressed} suppressed, "
+             f"{report.files_scanned} files scanned "
+             f"[{', '.join(report.codes_run)}]"]
+    for f in report.findings:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.code} {f.message}")
+    if report.baselined:
+        lines.append("baselined (tools/lint_baseline.json):")
+        for f, e in report.baselined:
+            lines.append(f"  {f.code} {f.path}:{f.line} — {e.justification}")
+    if report.stale_baseline:
+        lines.append("stale baseline entries (matched nothing — remove):")
+        for e in report.stale_baseline:
+            lines.append(f"  {e.code} {e.path} ({e.match or 'any'})")
+    return "\n".join(lines) + "\n"
+
+
+def render_json(report: Report) -> str:
+    payload = {
+        "version": 1,
+        "clean": report.clean,
+        "files_scanned": report.files_scanned,
+        "codes_run": report.codes_run,
+        "counts": report.counts(),
+        "suppressed": report.suppressed,
+        "findings": [dataclasses.asdict(f) for f in report.findings],
+        "baselined": [dict(dataclasses.asdict(f),
+                           justification=e.justification)
+                      for f, e in report.baselined],
+        "stale_baseline": [dataclasses.asdict(e)
+                           for e in report.stale_baseline],
+    }
+    return json.dumps(payload, indent=2, sort_keys=True) + "\n"
+
+
+def render_markdown(report: Report) -> str:
+    """Markdown section in the ``tools/telemetry_report.py`` house style,
+    embeddable in PERF/review writeups."""
+    checkers = all_checkers()
+    out = ["### lint: machine-checked invariants", ""]
+    out.append(f"- files scanned: {report.files_scanned}")
+    out.append(f"- new findings: {len(report.findings)}")
+    out.append(f"- baselined (justified): {len(report.baselined)}")
+    out.append(f"- inline-suppressed: {report.suppressed}")
+    out.append("")
+    if report.findings:
+        out += ["| code | location | finding |", "|---|---|---|"]
+        for f in report.findings:
+            out.append(f"| {f.code} | `{f.path}:{f.line}` "
+                       f"| {f.message} |")
+        out.append("")
+    if report.baselined:
+        out += ["#### baseline", "",
+                "| code | location | justification |", "|---|---|---|"]
+        for f, e in report.baselined:
+            out.append(f"| {f.code} | `{f.path}:{f.line}` "
+                       f"| {e.justification} |")
+        out.append("")
+    if report.stale_baseline:
+        out += ["#### stale baseline entries (matched nothing — remove)",
+                "", "| code | path | match |", "|---|---|---|"]
+        for e in report.stale_baseline:
+            out.append(f"| {e.code} | `{e.path}` | {e.match or 'any'} |")
+        out.append("")
+    out += ["#### checkers", "", "| code | invariant |", "|---|---|"]
+    for code in report.codes_run:
+        cls = checkers.get(code)
+        if cls is not None:
+            out.append(f"| {code} | {cls.name}: {cls.description} |")
+    return "\n".join(out) + "\n"

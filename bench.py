@@ -192,6 +192,7 @@ def main():
     emit_result(_comm_compression_series(cfg, batch, seq, on_tpu))
     emit_result(_elastic_resume_series(cfg, batch, seq, on_tpu))
     emit_result(_startup_series(cfg, batch, seq, on_tpu))
+    emit_result(_tracing_series(cfg, batch, seq, on_tpu))
 
 
 def _telemetry_series(warm_mark, steps):
@@ -594,6 +595,46 @@ def _train_step_series(cfg, batch, seq, on_tpu, steps=3, ds_overrides=None,
     }
 
 
+def _tracing_series(cfg, batch, seq, on_tpu, steps=3):
+    """Optional extra series (after the headline JSON): the span-tracing
+    overhead bound. Two identical telemetry-enabled measured windows —
+    spans off vs spans on (`telemetry.tracing.enabled`) — so the delta
+    is EXACTLY the span layer's host-side bookkeeping (the compiled
+    programs are byte-identical by the zero-overhead pin; this series
+    bounds the part the pin can't see). Also reports the static
+    exposed-comm estimate the step spans carried."""
+    import sys
+
+    try:
+        # both legs telemetry-enabled: the delta isolates the SPAN layer,
+        # not the (always-on-in-this-series) collector stack around it
+        base = _train_step_series(
+            cfg, batch, seq, on_tpu, steps=steps,
+            ds_overrides={"telemetry": {
+                "enabled": True, "jsonl": False, "memory": False}})
+        traced = _train_step_series(
+            cfg, batch, seq, on_tpu, steps=steps,
+            ds_overrides={"telemetry": {
+                "enabled": True, "jsonl": False, "memory": False,
+                "tracing": {"enabled": True}}})
+        off = base["steps_per_sec"]
+        on = traced["steps_per_sec"]
+        return {
+            "metric": METRIC + "_tracing",
+            "steps_per_sec_tracing_off": off,
+            "steps_per_sec_tracing_on": on,
+            "overhead_pct": round(100.0 * (off - on) / off, 2)
+            if off else None,
+            "n_dev": base["n_dev"], "batch": batch, "seq": seq,
+            "steps": steps,
+        }
+    except Exception as e:  # noqa: BLE001 — extras never kill the headline
+        print(f"# tracing series failed: {e}", file=sys.stderr, flush=True)
+        return {"metric": METRIC + "_tracing", "value": None,
+                "unit": "steps/s", "vs_baseline": None,
+                "error": str(e)[:300]}
+
+
 def _startup_series(cfg, batch, seq, on_tpu, steps=3):
     """Optional extra series (after the headline JSON): what the AOT
     program cache buys on restart. One engine (telemetry + aot enabled)
@@ -821,12 +862,14 @@ def run_series(name, config=None):
         return _comm_compression_series(cfg, batch, seq, on_tpu)
     if name == "elastic_resume":
         return _elastic_resume_series(cfg, batch, seq, on_tpu)
+    if name == "tracing":
+        return _tracing_series(cfg, batch, seq, on_tpu, steps=ctx["steps"])
     raise KeyError(f"unknown bench series {name!r}; available: "
                    f"{sorted(SERIES)}")
 
 
 SERIES = ("train_step", "startup", "telemetry", "resilience",
-          "comm_compression", "elastic_resume")
+          "comm_compression", "elastic_resume", "tracing")
 
 
 if __name__ == "__main__":

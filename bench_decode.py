@@ -181,9 +181,10 @@ def _build_serving(ctx, extra=None, telemetry=False):
     if telemetry:
         # tuner series read compile counts off the telemetry stream;
         # the headline/serving series keep the exact build they always
-        # had (no watch layer in the measured window)
-        kwargs["telemetry"] = {"enabled": True, "jsonl": False,
-                               "memory": False}
+        # had (no watch layer in the measured window). A dict is used
+        # verbatim (the tracing series turns the span layer on)
+        kwargs["telemetry"] = telemetry if isinstance(telemetry, dict) \
+            else {"enabled": True, "jsonl": False, "memory": False}
     return ServingEngine(deepspeed_tpu.init_inference(
         GPT2LMHeadModel(cfg), dtype=cfg.dtype,
         tensor_parallel={"tp_size": 1}, max_out_tokens=cfg.n_positions,
@@ -524,6 +525,76 @@ def _serving_chunk_series(ctx, serving_overrides=None):
 
 
 # ---------------------------------------------------------------------------
+# span tracing: serving tokens/s with the span layer off vs on
+def _serving_tracing_series(ctx):
+    """Optional extra series (after the headline JSON): the span-tracing
+    overhead bound on the serving side — the SAME mixed-arrival workload
+    as the `*_serving` series, run once with telemetry+tracing off and
+    once with request-span tracing on (queue/prefill/decode spans per
+    request). The compiled programs are byte-identical either way (the
+    zero-overhead pin); this bounds the host-side span bookkeeping."""
+    import sys
+
+    cfg = ctx["cfg"]
+    n_requests, arrive_every = ctx["n_requests"], ctx["arrive_every"]
+    lens, srv_new, srv_rng = ctx["lens"], ctx["srv_new"], ctx["srv_rng"]
+
+    def run_mixed(srv):
+        pending = [srv_rng.integers(0, cfg.vocab_size,
+                                    lens[i % len(lens)]).astype(np.int32)
+                   for i in range(n_requests)]
+        t0 = time.perf_counter()
+        while pending or srv.pending:
+            for _ in range(arrive_every):
+                if pending:
+                    srv.submit(pending.pop(0), max_new_tokens=srv_new)
+            srv.step()
+        srv.drain()
+        return time.perf_counter() - t0
+
+    try:
+        rates = {}
+        spans = 0
+        # both legs telemetry-enabled: the delta isolates the SPAN
+        # layer, not the collector stack around it (same contract as
+        # bench.py's train-side tracing series)
+        for label, telemetry in (
+                ("off", {"enabled": True, "jsonl": False, "memory": False}),
+                ("on", {"enabled": True, "jsonl": False, "memory": False,
+                        "tracing": {"enabled": True}})):
+            srv = _build_serving(ctx, telemetry=telemetry)
+            run_mixed(srv)       # warm the bucket set + decode program
+            srv.reset_stats()
+            mark = srv.telemetry.tracer.emitted
+            elapsed = run_mixed(srv)
+            tokens_out = sum(r["new_tokens"] for r in srv.records
+                             if r["state"] != "shed")
+            rates[label] = (round(tokens_out / elapsed, 1)
+                            if elapsed > 0 else None)
+            if label == "on":
+                # tracer-side counter: the telemetry tail is a bounded
+                # ring and would undercount a real window
+                spans = srv.telemetry.tracer.emitted - mark
+            srv.destroy()
+        off, on = rates["off"], rates["on"]
+        return {
+            "metric": f"{METRIC}_tracing",
+            "tokens_per_sec_tracing_off": off,
+            "tokens_per_sec_tracing_on": on,
+            "overhead_pct": round(100.0 * (off - on) / off, 2)
+            if off and on is not None else None,
+            "spans_in_window": spans,
+            "requests": n_requests, "new_tokens": srv_new,
+        }
+    except Exception as e:  # noqa: BLE001 — extras never kill the headline
+        print(f"# serving tracing series failed: {e}", file=sys.stderr,
+              flush=True)
+        return {"metric": f"{METRIC}_tracing", "value": None,
+                "unit": "tokens/s", "vs_baseline": None,
+                "error": str(e)[:300]}
+
+
+# ---------------------------------------------------------------------------
 def run_series(name, config=None):
     """Run ONE decode-bench series in-process and return its payload
     dict (never emits). ``config`` keys: ``serving`` (overrides merged
@@ -544,12 +615,14 @@ def run_series(name, config=None):
     if name == "serving_chunk":
         return _serving_chunk_series(ctx,
                                      serving_overrides=config.get("serving"))
+    if name == "serving_tracing":
+        return _serving_tracing_series(ctx)
     raise KeyError(f"unknown decode series {name!r}; available: "
                    f"{sorted(SERIES)}")
 
 
 SERIES = ("headline", "serving", "serving_fastpath", "router",
-          "decode_attention", "serving_chunk")
+          "decode_attention", "serving_chunk", "serving_tracing")
 
 
 def main():
@@ -564,6 +637,7 @@ def main():
     emit_result(_serving_series(ctx))
     emit_result(_serving_fastpath_series(ctx))
     emit_result(_router_series(ctx))
+    emit_result(_serving_tracing_series(ctx))
 
 
 if __name__ == "__main__":

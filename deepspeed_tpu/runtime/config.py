@@ -224,6 +224,32 @@ class TelemetryTraceConfig(DeepSpeedConfigModel):
         return self
 
 
+class TelemetryTracingConfig(DeepSpeedConfigModel):
+    """``telemetry.tracing``: span-based causal tracing
+    (``telemetry/tracing.py``) — serving request traces and training
+    step-phase traces as ``span`` events on the stream, plus a per-step
+    exposed-comm fraction. Off by default; enabling it changes host-side
+    bookkeeping only (the compiled step/decode HLO stays byte-identical,
+    pinned in ``tests/unit/test_tracing.py``)."""
+
+    enabled: bool = False
+    # per-step exposed-comm accounting: profiled from a closed
+    # jax.profiler window where an XPlane parser exists, otherwise a
+    # zero-overlap static estimate from the compiled step's cost model
+    # (labeled as such). The two rates below are the estimate's
+    # denominators; 0 = auto (device-kind defaults).
+    exposed_comm: bool = True
+    ici_gbps: float = 90.0
+    peak_tflops: float = 0.0
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.ici_gbps < 0 or self.peak_tflops < 0:
+            raise ValueError("telemetry.tracing.ici_gbps/peak_tflops must "
+                             "be >= 0")
+        return self
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """``telemetry`` section (TPU-native): the unified observability event
     stream (``deepspeed_tpu/telemetry/``). Four collectors:
@@ -246,6 +272,11 @@ class TelemetryConfig(DeepSpeedConfigModel):
     enabled: bool = False
     dir: str = "./telemetry"
     jsonl: bool = True
+    # size-bounded sink: rotate the live telemetry.jsonl once it reaches
+    # rotate_bytes (0 = never), keeping the last rotate_keep rotated
+    # segments (<path>.1 newest .. <path>.K oldest)
+    rotate_bytes: int = 0
+    rotate_keep: int = 4
     compile_watchdog: bool = True
     hlo_cost: bool = True
     memory: bool = True
@@ -253,6 +284,8 @@ class TelemetryConfig(DeepSpeedConfigModel):
     warmup_steps: int = 1
     recompile_warn_after: int = 1
     trace: TelemetryTraceConfig = Field(default_factory=TelemetryTraceConfig)
+    tracing: TelemetryTracingConfig = Field(
+        default_factory=TelemetryTracingConfig)
 
     @model_validator(mode="after")
     def _check(self):
@@ -261,6 +294,9 @@ class TelemetryConfig(DeepSpeedConfigModel):
         if self.warmup_steps < 0 or self.recompile_warn_after < 1:
             raise ValueError("telemetry.warmup_steps must be >= 0 and "
                              "recompile_warn_after >= 1")
+        if self.rotate_bytes < 0 or self.rotate_keep < 1:
+            raise ValueError("telemetry.rotate_bytes must be >= 0 and "
+                             "rotate_keep >= 1")
         return self
 
 

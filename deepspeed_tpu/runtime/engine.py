@@ -1188,7 +1188,10 @@ class DeepSpeedEngine:
             # (engine.py:1774,1797); floored at step 2 here so the profiled
             # window never includes XLA compilation of the step programs
             self.flops_profiler.start_profile()
-        with self.telemetry.annotation("ds.fwd_bwd"):
+        # span tracing: the fused fwd+bwd(+reduce) dispatch is ONE
+        # host-observable phase (JAX compiles them into one program)
+        with self.telemetry.annotation("ds.fwd_bwd"), \
+                self.telemetry.step_trace.phase("fwd_bwd"):
             if self._onebit:
                 # fused fwd+bwd+compressed-update program, staged on the
                 # optimizer's warmup/compression flag
@@ -1296,7 +1299,8 @@ class DeepSpeedEngine:
         if at_boundary:
             if self.wall_clock_breakdown_:
                 self.timers(STEP_GLOBAL_TIMER).start()
-            with self.telemetry.annotation("ds.optimizer_step"):
+            with self.telemetry.annotation("ds.optimizer_step"), \
+                    self.telemetry.step_trace.phase("optimizer"):
                 if self._host_offload:
                     self._host_apply()
                 elif self._onebit:
@@ -1414,7 +1418,11 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         losses = []
         for _ in range(gas):
-            b = batch if batch is not None else next(data_iter)
+            if batch is not None:
+                b = batch
+            else:
+                with self.telemetry.step_trace.phase("data"):
+                    b = next(data_iter)
             loss = self.forward(b)
             self.backward(loss)
             self.step()
@@ -2259,9 +2267,15 @@ class DeepSpeedEngine:
         self.resilience.drain_sentinel()
         with self.resilience.watchdog_suspended():
             # a large save to a slow blob store (plus manifest hashing)
-            # can legitimately outlast the step timeout — not a hang
-            return self._save_checkpoint_impl(save_dir, tag, client_state,
-                                              save_latest)
+            # can legitimately outlast the step timeout — not a hang.
+            # Checkpoint IO gets its own trace (it runs between step
+            # traces): one ckpt_io span, action-tagged
+            tracer = self.telemetry.tracer
+            with tracer.span("ckpt_io", tracer.new_trace(hint="ckpt"),
+                             action="save", tag=str(tag),
+                             step=self.global_steps):
+                return self._save_checkpoint_impl(save_dir, tag,
+                                                  client_state, save_latest)
 
     def _save_checkpoint_impl(self, save_dir, tag, client_state, save_latest):
         tag = tag or f"global_step{self.global_steps}"
@@ -2411,11 +2425,15 @@ class DeepSpeedEngine:
         with self.resilience.watchdog_suspended():
             # restore IO (verify hashing + deserialize) may outlast the
             # step timeout — not a hang
-            return self._load_checkpoint_resolved(
-                load_dir, tag,
-                load_optimizer_states=load_optimizer_states,
-                load_lr_scheduler_states=load_lr_scheduler_states,
-                load_module_only=load_module_only)
+            tracer = self.telemetry.tracer
+            with tracer.span("ckpt_io", tracer.new_trace(hint="ckpt"),
+                             action="load", tag=str(tag),
+                             step=self.global_steps):
+                return self._load_checkpoint_resolved(
+                    load_dir, tag,
+                    load_optimizer_states=load_optimizer_states,
+                    load_lr_scheduler_states=load_lr_scheduler_states,
+                    load_module_only=load_module_only)
 
     def _load_checkpoint_resolved(self, load_dir, tag, *,
                                   load_optimizer_states=True,

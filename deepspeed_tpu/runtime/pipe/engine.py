@@ -361,11 +361,12 @@ class PipelineEngine(DeepSpeedEngine):
         """One full optimizer step: ``gas`` micro-batches through the
         pipeline (reference ``pipe/engine.py:294``)."""
         if batch is None:
-            parts = [next(data_iter) for _ in range(self.micro_batches)]
-            batch = jax.tree_util.tree_map(
-                # host-side batch assembly from the data iterator (input
-                # marshaling, not a device readback)
-                lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *parts)  # graft-lint: disable=GL04
+            with self.telemetry.step_trace.phase("data"):
+                parts = [next(data_iter) for _ in range(self.micro_batches)]
+                batch = jax.tree_util.tree_map(
+                    # host-side batch assembly from the data iterator (input
+                    # marshaling, not a device readback)
+                    lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *parts)  # graft-lint: disable=GL04
         loss = self.forward(batch)
         self.backward(loss)
         self.step()

@@ -43,6 +43,7 @@ detected stall while an idle server is never judged hung.
 """
 
 import collections
+import contextlib
 import time
 from typing import Dict, List, Optional
 
@@ -54,6 +55,7 @@ from deepspeed_tpu.serving.config import (ServingConfig, blocks_for_tokens,
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.request import FINISHED, Request
 from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.telemetry.tracing import end_span, to_ns
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -120,11 +122,14 @@ class ServingEngine:
                                       self.blocks_per_seq)
         self.prefix = (PrefixCache(self.block_mgr)
                        if self.config.prefix_cache else None)
-        self.sched = ContinuousBatchingScheduler(
-            self.config, self.block_mgr, self.max_len, self.buckets,
-            prefix_cache=self.prefix)
         self.telemetry = self.engine.telemetry
         self.resilience = self.engine.resilience
+        # span tracer (inert unless telemetry.tracing is on): request
+        # traces — queue/prefill/cow/decode legs — ride the event stream
+        self._tracer = self.telemetry.tracer
+        self.sched = ContinuousBatchingScheduler(
+            self.config, self.block_mgr, self.max_len, self.buckets,
+            prefix_cache=self.prefix, tracer=self._tracer)
 
         self.cache = self._init_cache()
         self._tables = np.full(
@@ -345,7 +350,9 @@ class ServingEngine:
             # appended to, so the request's own fresh block receives a
             # device copy of its rows before anything else runs; the
             # source unpins once the copy is in flight
-            self._cow_copy(*req.cow)
+            with self._req_span(req, "cow", src=req.cow[0],
+                                dst=req.cow[1]):
+                self._cow_copy(*req.cow)
             self.block_mgr.cow_done(req.request_id)
         if not self.chunk_tokens and req.cached_len == 0:
             self._prefill(slot, req, table, done)
@@ -355,6 +362,16 @@ class ServingEngine:
         self._pf_pos[slot] = req.cached_len
         req.length = req.cached_len
 
+    def _req_span(self, req: Request, name: str, **attrs):
+        """Span bracket in ``req``'s trace (nullcontext when tracing is
+        off or the request carries no context). Durations are host-side
+        dispatch+sync walltime — the same clock every request timestamp
+        already uses."""
+        if not self._tracer.enabled or req.trace is None:
+            return contextlib.nullcontext()
+        return self._tracer.span(name, req.trace["trace"],
+                                 parent=req.trace.get("serve_id"), **attrs)
+
     def _prefill(self, slot: int, req: Request, table: np.ndarray,
                  done: List[Request]):
         jnp = self._jnp
@@ -363,12 +380,15 @@ class ServingEngine:
             self._prefill_fns[T] = self._build_prefill(T)
         ids = np.zeros((1, T), np.int32)
         ids[0, :req.prompt_len] = req.prompt
-        tok, self.cache = self._prefill_fns[T](
-            self.engine.params, self.cache, jnp.asarray(ids),
-            jnp.asarray(table[None]),
-            jnp.asarray([req.prompt_len], jnp.int32), self._next_rng())
+        with self._req_span(req, "prefill", bucket=T,
+                            prompt_len=req.prompt_len):
+            tok, self.cache = self._prefill_fns[T](
+                self.engine.params, self.cache, jnp.asarray(ids),
+                jnp.asarray(table[None]),
+                jnp.asarray([req.prompt_len], jnp.int32), self._next_rng())
+            tok = int(np.asarray(tok)[0])
         req.prefill_chunks = 1
-        self._slot_live(slot, req, table, int(np.asarray(tok)[0]), done)
+        self._slot_live(slot, req, table, tok, done)
 
     # ------------------------------------------------------------------
     def _prefill_chunks(self, done: List[Request]):
@@ -416,11 +436,13 @@ class ServingEngine:
             self._chunk_fns[T] = self._build_chunk(T)
         ids = np.zeros((1, T), np.int32)
         ids[0, :step_len] = req.prompt[pos:pos + step_len]
-        tok, self.cache = self._chunk_fns[T](
-            self.engine.params, self.cache, jnp.asarray(ids),
-            jnp.asarray(table[None]), jnp.asarray([pos], jnp.int32),
-            jnp.asarray([step_len], jnp.int32), self._next_rng())
-        return int(np.asarray(tok)[0])
+        with self._req_span(req, "prefill_chunk", pos=pos,
+                            tokens=step_len, bucket=T):
+            tok, self.cache = self._chunk_fns[T](
+                self.engine.params, self.cache, jnp.asarray(ids),
+                jnp.asarray(table[None]), jnp.asarray([pos], jnp.int32),
+                jnp.asarray([step_len], jnp.int32), self._next_rng())
+            return int(np.asarray(tok)[0])
 
     def _slot_live(self, slot: int, req: Request, table: np.ndarray,
                    tok: int, done: List[Request]):
@@ -493,6 +515,14 @@ class ServingEngine:
 
     def _finish(self, req: Request, reason: str, now: float,
                 done: List[Request]):
+        if (self._tracer.enabled and req.trace is not None
+                and req.first_token_ts):
+            # one decode segment: first generated token -> finish (the
+            # per-token cadence is the step loop's, not this request's)
+            self._tracer.record_span(
+                "decode", req.trace["trace"], to_ns(req.first_token_ts),
+                to_ns(now), parent=req.trace.get("serve_id"),
+                tokens=len(req.tokens), request_id=req.request_id)
         self.sched.finish(req, reason, now)
         # reset the slot's host-side row: an idle slot computes into the
         # garbage block until the next admission overwrites it
@@ -513,6 +543,13 @@ class ServingEngine:
         self.telemetry.emit(
             "serving", "request.shed" if shed else "request.finish",
             step=self._step_count, **rec)
+        if self._tracer.enabled and req.trace is not None:
+            # close the replica-side root span (opened at admission);
+            # queue-head sheds that never won a slot carry no handle
+            end_span(req.trace.pop("serve", None),
+                     end_ns=to_ns(req.finish_ts or req.submit_ts),
+                     state=req.state, reason=req.finish_reason,
+                     tokens=len(req.tokens))
         if not began:
             return  # never bracketed: submit-time shed
         if shed:

@@ -54,6 +54,13 @@ class Request:
     # (src, dst) pool blocks: dst must receive a device copy of src's
     # rows before any append (partial-tail copy-on-write), or None
     cow: Optional[tuple] = None
+    # ---- span-tracing context (telemetry/tracing.py) ----
+    # {"trace": id, "parent": span id, ...}: set by the serving engine at
+    # submit (tracing enabled), or stamped by the multi-replica router so
+    # replica-side spans join the CLIENT's trace under the current
+    # attempt span (a failover continues one trace, not two). None when
+    # tracing is off — every consumer guards on it.
+    trace: Optional[dict] = None
 
     @property
     def prompt_len(self) -> int:

@@ -47,6 +47,8 @@ from deepspeed_tpu.serving import request as rq
 from deepspeed_tpu.serving.config import RouterConfig
 from deepspeed_tpu.serving.health import (DEAD, DEGRADED, DRAINING, HEALTHY,
                                           TRIPPED, ReplicaHealth)
+from deepspeed_tpu.telemetry.tracing import (NULL_TRACER, end_span, span_id,
+                                             to_ns, trace_ctx)
 
 _ids = itertools.count()
 
@@ -77,6 +79,15 @@ class RouterRequest:
     submit_ts: float = 0.0
     first_token_ts: float = 0.0
     finish_ts: float = 0.0
+    # ---- span tracing (telemetry/tracing.py; None with tracing off) ----
+    trace_id: Optional[str] = None     # ONE trace across every failover
+    root_span: Optional[object] = None     # open `request` root handle
+    attempt_span: Optional[object] = None  # open `attempt` subtree handle
+    attempt_start_pos: int = 0    # first NEW position this attempt streams
+    # first/last delivery time this attempt (None = nothing delivered
+    # yet: a fake clock's legitimate t=0.0 must not read as unset)
+    deliver_t0: Optional[float] = None
+    deliver_t1: Optional[float] = None
 
     @property
     def prompt_len(self) -> int:
@@ -126,6 +137,12 @@ class ReplicaRouter:
         self.telemetry = (telemetry
                           or getattr(self.replicas[0], "telemetry", None)
                           or _NullTelemetry())
+        # span tracer: client-side request traces (root + per-dispatch
+        # attempt subtrees + exactly-once deliver spans). A failover
+        # continues the SAME trace on the survivor — the replicas join
+        # it through the context stamped on their proxy requests.
+        self._tracer = (getattr(self.telemetry, "tracer", None)
+                        or NULL_TRACER)
         self.health = [ReplicaHealth(config, i, clock, emit=self._emit)
                        for i in range(len(self.replicas))]
         self.tier = 0
@@ -200,6 +217,11 @@ class ReplicaRouter:
             deadline_ms=float(deadline_ms), stream=stream)
         rreq.submit_ts = now
         self._counters["submitted"] += 1
+        if self._tracer.enabled:
+            rreq.trace_id = self._tracer.new_trace(hint=rreq.request_id)
+            rreq.root_span = self._tracer.begin(
+                "request", rreq.trace_id, start_ns=to_ns(now),
+                request_id=rreq.request_id, prompt_len=rreq.prompt_len)
         if rreq.request_id in self.requests:
             return self._shed(rreq, "duplicate_id")
         # ---- degradation ladder admission ----
@@ -292,6 +314,19 @@ class ReplicaRouter:
                     budget or getattr(proxy, "max_new_tokens", 0) or 0)
             rreq.proxy, rreq.replica, rreq.state = proxy, idx, rq.QUEUED
             self._assigned[idx].add(rreq.request_id)
+            if self._tracer.enabled:
+                # one `attempt` subtree per dispatch; the proxy carries
+                # the context so the replica's serve/queue/prefill/
+                # decode spans nest under it — ONE trace end to end,
+                # failovers included
+                rreq.attempt_span = self._tracer.begin(
+                    "attempt", rreq.trace_id, parent=span_id(rreq.root_span),
+                    start_ns=to_ns(now), attempt=rreq.attempt, replica=idx)
+                rreq.attempt_start_pos = len(rreq.tokens)
+                rreq.deliver_t0 = rreq.deliver_t1 = None
+                proxy.trace = trace_ctx(rreq.trace_id,
+                                        parent=span_id(rreq.attempt_span),
+                                        attempt=rreq.attempt)
             return True
         self._shed(rreq, last_reason or "no_replica")
         return False
@@ -319,8 +354,12 @@ class ReplicaRouter:
                                request_id=rreq.request_id, position=pos,
                                streamed=rreq.tokens[pos], replayed=tok)
                 return
+            now = self.clock()
             if not rreq.tokens:
-                rreq.first_token_ts = self.clock()
+                rreq.first_token_ts = now
+            if rreq.deliver_t0 is None:
+                rreq.deliver_t0 = now
+            rreq.deliver_t1 = now
             rreq.state = rq.RUNNING
             rreq.tokens.append(tok)
             if rreq.stream is not None:
@@ -398,10 +437,40 @@ class ReplicaRouter:
         if h.state == DRAINING and not self._assigned[idx]:
             self._emit("replica.drained", replica=idx)
 
+    def _close_attempt(self, rreq: RouterRequest, outcome: str):
+        """End the open ``attempt`` subtree: a ``deliver`` child records
+        exactly the NEW positions this attempt streamed to the client
+        (replayed/deduped positions are an attrs counter, never a second
+        deliver span — the exactly-once contract, visible in the trace),
+        then the attempt span closes with its outcome."""
+        if rreq.attempt_span is None:
+            return
+        now = self.clock()
+        delivered = len(rreq.tokens) - rreq.attempt_start_pos
+        if delivered > 0:
+            t0 = now if rreq.deliver_t0 is None else rreq.deliver_t0
+            t1 = now if rreq.deliver_t1 is None else rreq.deliver_t1
+            self._tracer.record_span(
+                "deliver", rreq.trace_id, to_ns(t0), to_ns(t1),
+                parent=span_id(rreq.attempt_span),
+                from_pos=rreq.attempt_start_pos, to_pos=len(rreq.tokens),
+                tokens=delivered)
+        end_span(rreq.attempt_span, end_ns=to_ns(now), outcome=outcome,
+                 delivered=delivered)
+        rreq.attempt_span = None
+
+    def _close_root(self, rreq: RouterRequest):
+        end_span(rreq.root_span, end_ns=to_ns(rreq.finish_ts),
+                 state=rreq.state, reason=rreq.finish_reason,
+                 failovers=rreq.attempt, tokens=len(rreq.tokens))
+        rreq.root_span = None
+
     def _finalize(self, rreq: RouterRequest, reason: Optional[str]):
         rreq.state, rreq.finish_reason = rq.FINISHED, reason
         rreq.finish_ts = self.clock()
         rreq.proxy = None
+        self._close_attempt(rreq, "finished")
+        self._close_root(rreq)
         self.requests.pop(rreq.request_id, None)
         self.finished.append(rreq)
         self._counters["finished"] += 1
@@ -414,6 +483,8 @@ class ReplicaRouter:
         rreq.state, rreq.finish_reason = rq.SHED, reason
         rreq.finish_ts = self.clock()
         rreq.proxy = None
+        self._close_attempt(rreq, f"shed:{reason}")
+        self._close_root(rreq)
         # identity check: shedding a duplicate-id submit must not evict
         # the live original that owns the slot in the registry
         if self.requests.get(rreq.request_id) is rreq:
@@ -470,6 +541,7 @@ class ReplicaRouter:
                     cancel(rreq.proxy.request_id, "failover")
                 except Exception:
                     pass
+            self._close_attempt(rreq, f"failover:{reason}")
             rreq.attempt += 1
             self._counters["failovers"] += 1
             self._emit("failover", request_id=rid, from_replica=idx,

@@ -32,17 +32,23 @@ from deepspeed_tpu.serving import request as rq
 from deepspeed_tpu.serving.blocks import BlockManager
 from deepspeed_tpu.serving.config import (QUEUE, ServingConfig, bucket_for,
                                           resolve_buckets)
+from deepspeed_tpu.telemetry.tracing import NULL_TRACER, to_ns
 
 
 class ContinuousBatchingScheduler:
     def __init__(self, config: ServingConfig, blocks: BlockManager,
                  max_len: int, buckets: Optional[List[int]] = None,
-                 clock=time.monotonic, prefix_cache=None):
+                 clock=time.monotonic, prefix_cache=None, tracer=None):
         self.config = config
         self.blocks = blocks
         # optional PrefixCache: admission matches cached prompt prefixes
         # and maps their blocks in read-only instead of re-prefilling
         self.prefix = prefix_cache
+        # span tracer (telemetry/tracing.py, host-only): admission emits
+        # the submit->slot `queue` span and sheds emit `shed` spans into
+        # the request's trace — the causal timeline the engine/router
+        # continue. Inert (NULL_TRACER) unless tracing is configured.
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self.max_len = int(max_len)
         self.buckets = buckets if buckets is not None else resolve_buckets(
             config.prompt_buckets, self.max_len, floor=config.block_size)
@@ -143,6 +149,18 @@ class ContinuousBatchingScheduler:
         self.stats["shed"] += 1
         reasons = self.stats["shed_reasons"]
         reasons[reason] = reasons.get(reason, 0) + 1
+        if self.tracer.enabled and req.trace is not None:
+            # terminal shed span in the request's trace (submit-time
+            # sheds carry no trace context yet and are skipped — the
+            # router records those on the client handle). A pre-admission
+            # shed has no serve root yet: fall back to the router-stamped
+            # attempt parent so the span stays attached to its subtree
+            # (a parentless shed would masquerade as the trace root)
+            self.tracer.record_span(
+                "shed", req.trace["trace"], to_ns(req.submit_ts),
+                to_ns(req.finish_ts),
+                parent=req.trace.get("serve_id") or req.trace.get("parent"),
+                reason=reason, request_id=req.request_id)
         return False
 
     # ------------------------------------------------------------------
@@ -201,8 +219,31 @@ class ContinuousBatchingScheduler:
             req.admit_ts = now
             self.slots[slot] = req
             self.stats["admitted"] += 1
+            if self.tracer.enabled:
+                self._trace_admit(req, now, slot)
             admitted.append((slot, req, table))
         return admitted, shed
+
+    def _trace_admit(self, req: rq.Request, now: float, slot: int):
+        """Admission is where a request's replica-side trace context is
+        ESTABLISHED: reuse the router-stamped context (same trace id,
+        parent = the current attempt span) or mint a fresh trace for a
+        standalone submit, open the `serve` root span (ended by the
+        engine at finish/shed), and emit the submit->slot `queue` leg."""
+        if req.trace is None:
+            req.trace = {"trace": self.tracer.new_trace(
+                hint=req.request_id)}
+        if "serve_id" not in req.trace:
+            h = self.tracer.begin(
+                "serve", req.trace["trace"], parent=req.trace.get("parent"),
+                start_ns=to_ns(req.submit_ts), request_id=req.request_id,
+                attempt=req.trace.get("attempt", 0))
+            req.trace["serve"] = h
+            req.trace["serve_id"] = h.span
+        self.tracer.record_span(
+            "queue", req.trace["trace"], to_ns(req.submit_ts), to_ns(now),
+            parent=req.trace.get("serve_id"), slot=slot,
+            request_id=req.request_id)
 
     # ------------------------------------------------------------------
     def cancel(self, request_id: str, reason: str = "cancelled",

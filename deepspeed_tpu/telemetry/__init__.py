@@ -16,10 +16,17 @@ Enable via the ``telemetry`` config block (``runtime/config.py``)::
 """
 
 from deepspeed_tpu.telemetry import compile_watch  # noqa: F401
-from deepspeed_tpu.telemetry.events import load_events, make_event  # noqa: F401
+from deepspeed_tpu.telemetry.events import (  # noqa: F401
+    SPANS,
+    load_all_events,
+    load_events,
+    make_event,
+)
 from deepspeed_tpu.telemetry.jit_watch import (  # noqa: F401
     WatchedFunction,
     compiled_cost_summary,
 )
 from deepspeed_tpu.telemetry.manager import Telemetry  # noqa: F401
+from deepspeed_tpu.telemetry.metrics import Histogram  # noqa: F401
 from deepspeed_tpu.telemetry.sink import JsonlSink, MonitorBridge  # noqa: F401
+from deepspeed_tpu.telemetry.tracing import StepTrace, Tracer  # noqa: F401

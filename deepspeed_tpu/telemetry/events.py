@@ -35,6 +35,12 @@ satellite families that ride the same sink):
 - ``tuning``       — live-autotuner trials (axis, candidate value,
                      objective score / skip reason) and the tuned
                      values an engine applied at build
+- ``span``         — causal tracing (``telemetry/tracing.py``): one
+                     completed span per event — ``data`` carries
+                     ``trace``/``span``/``parent`` ids plus
+                     ``start_ns``/``end_ns`` monotonic bounds; the span
+                     *name* must come from :data:`SPANS` (GL05 pins the
+                     literals, same convention as ``KINDS``)
 
 Everything in ``data`` must be JSON-safe; :func:`json_safe` coerces numpy
 scalars and drops device arrays (an event must never pin or sync device
@@ -42,12 +48,48 @@ buffers — the stream is passive by contract).
 """
 
 import json
+import os
 import time
 from typing import Any, Dict, Optional
 
 KINDS = ("compile", "step_cost", "memory", "trace_window", "step",
          "wallclock", "comm", "fault", "serving", "model_time", "topology",
-         "router", "aot", "tuning")
+         "router", "aot", "tuning", "span")
+
+# Registered span names (the ``span`` kind's analog of KINDS): the report
+# tool groups phase tables and waterfalls by these literals and the
+# Perfetto export categorizes by them, so an unregistered name is a span
+# that renders in no summary. graft-lint GL05 reads this tuple from the
+# AST and pins every literal span-name emit site against it.
+SPANS = (
+    # client/router level: one trace per request
+    "request",        # root — submit to finish/shed, across failovers
+    "attempt",        # one dispatch to one replica (attrs: attempt, replica)
+    "deliver",        # tokens streamed to the client by one attempt
+    # replica/serving-engine level
+    "serve",          # one replica serving one attempt (engine-side root)
+    "queue",          # submit/dispatch -> decode-slot admission
+    "prefill",        # whole-prompt bucketed prefill (legacy path)
+    "prefill_chunk",  # one chunked/tail prefill program call
+    "cow",            # copy-on-write block copy before a shared-tail append
+    "decode",         # first generated token -> finish (one decode segment)
+    "shed",           # admission/deadline shed (zero-work terminal span)
+    # training step level: one trace per optimizer step
+    "step",           # root — first observed phase -> step boundary
+    "data",           # host-side batch fetch/assembly
+    "fwd",            # forward (engines that split fwd/bwd)
+    "bwd",            # backward (engines that split fwd/bwd)
+    "fwd_bwd",        # fused forward+backward(+in-graph reduce) dispatch
+    "reduce",         # gradient reduction, where host-observable
+    "optimizer",      # optimizer apply dispatch
+    "ckpt_io",        # checkpoint save/load IO (own trace, between steps)
+    "exposed_comm",   # measured exposed-comm window (profiled trace close)
+)
+
+# the span event envelope's reserved ``data`` keys — everything else in
+# a span's data is a user attribute (report tables and the Perfetto
+# export both split on this; one definition so they cannot drift)
+SPAN_META = ("trace", "span", "parent", "start_ns", "end_ns")
 
 
 def json_safe(value: Any):
@@ -102,4 +144,30 @@ def load_events(path: str):
                 out.append(json.loads(line))
             except json.JSONDecodeError:
                 continue
+    return out
+
+
+def segment_paths(path: str):
+    """Every on-disk segment of a (possibly rotated) JSONL sink, oldest
+    first: ``telemetry.jsonl.K`` .. ``telemetry.jsonl.1`` then the live
+    ``telemetry.jsonl``. Rotation (``telemetry.rotate_bytes``) shifts
+    ``.k`` -> ``.k+1`` so higher suffixes are older."""
+    numbered = []
+    k = 1
+    while os.path.exists(f"{path}.{k}"):
+        numbered.append(f"{path}.{k}")
+        k += 1
+    out = list(reversed(numbered))
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def load_all_events(path: str):
+    """Parse a JSONL sink *including its rotated segments* back into one
+    chronological event list (the report/export tools' entry point — a
+    long serving run must not lose its early events to rotation)."""
+    out = []
+    for p in segment_paths(path):
+        out.extend(load_events(p))
     return out

@@ -32,6 +32,7 @@ from deepspeed_tpu.telemetry.events import make_event
 from deepspeed_tpu.telemetry.jit_watch import (WatchedFunction,
                                                compiled_cost_summary)
 from deepspeed_tpu.telemetry.sink import JsonlSink, MonitorBridge
+from deepspeed_tpu.telemetry.tracing import NULL_TRACER, StepTrace, Tracer
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -76,6 +77,13 @@ class Telemetry:
         # checkpoint restore ships a bundle; consulted by
         # WatchedFunction._compile on every dispatch-cache miss
         self._aot_store = None
+        # latest compiled cost summary per watchdog family — the static
+        # exposed-comm estimate's input (tracing collector)
+        self._latest_costs: Dict[str, Dict] = {}
+        # span tracer + per-step phase accounting (inert unless
+        # telemetry AND telemetry.tracing are both enabled)
+        self.tracer = NULL_TRACER
+        self.step_trace = StepTrace(NULL_TRACER)
         if not self.enabled:
             return
         try:
@@ -86,8 +94,14 @@ class Telemetry:
             self._rank = 0
         if self.config.jsonl:
             self._sink = JsonlSink(
-                os.path.join(self.config.dir, "telemetry.jsonl"))
+                os.path.join(self.config.dir, "telemetry.jsonl"),
+                rotate_bytes=self.config.rotate_bytes,
+                rotate_keep=self.config.rotate_keep)
         self._bridge = MonitorBridge(monitor)
+        if self.config.tracing.enabled:
+            self.tracer = Tracer(self.emit,
+                                 step_of=lambda: self._steps_seen)
+            self.step_trace = StepTrace(self.tracer, rank=self._rank)
         if self.config.compile_watchdog:
             compile_watch.subscribe(self._on_global_compile)
 
@@ -211,6 +225,7 @@ class Telemetry:
             except Exception:
                 hlo_text = None
             cost = compiled_cost_summary(compiled, hlo_text)
+            self._latest_costs[family] = cost
             self.emit("step_cost", name, step=self._steps_seen, **cost)
             self._mirror_to_comms_logger(name, cost)
 
@@ -306,6 +321,7 @@ class Telemetry:
                           num_steps=tr.num_steps)
                 log_dist(f"telemetry: stopped jax.profiler trace after "
                          f"{tr.num_steps} step(s) -> {tr.dir}", ranks=[0])
+                self._measure_exposed_comm(step, tr)
             except Exception as e:
                 self.emit("trace_window", self.name, step=step,
                           action="stop_failed", error=str(e)[:200])
@@ -330,6 +346,53 @@ class Telemetry:
                 self.emit("trace_window", self.name, step=step,
                           action="start_failed", error=str(e)[:200])
 
+    def _measure_exposed_comm(self, step: int, tr):
+        """After a profiler window closes: try the MEASURED exposed-comm
+        fraction from the captured device timeline. Where no XPlane
+        parser exists (this container's CPU jaxlib) the gate's reason is
+        recorded once and the per-step static estimate stays the only
+        source — labeled as such everywhere it renders."""
+        if not (self.tracer.enabled and self.config.tracing.exposed_comm):
+            return
+        from deepspeed_tpu.telemetry import exposed_comm as xc
+
+        measured, reason = xc.from_profiler_dir(tr.dir)
+        if measured is None:
+            self.emit("trace_window", self.name, step=step,
+                      action="exposed_comm_unavailable", reason=reason)
+            return
+        import time
+
+        now = time.monotonic_ns()
+        window_ns = measured.get("busy_ns") or 0
+        self.tracer.record_span(
+            "exposed_comm", self.tracer.new_trace(hint=f"profile{step}"),
+            now - window_ns, now, window_steps=tr.num_steps,
+            window_end_step=step, **measured)
+
+    def exposed_comm_estimate(self) -> Optional[Dict]:
+        """Static per-step exposed-comm estimate from the costliest
+        compiled program seen so far (the step program, by FLOPs).
+        None until a cost model exists or when disabled. Recomputed only
+        when a compile lands; boundaries between compiles reuse the
+        cached estimate (this runs every step)."""
+        if not (self.tracer.enabled and self.config.tracing.exposed_comm
+                and self._latest_costs):
+            return None
+        cached = getattr(self, "_exposed_cache", None)
+        key = len(self._compile_totals), sum(
+            v["compiles"] for v in self._compile_totals.values())
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from deepspeed_tpu.telemetry import exposed_comm as xc
+
+        cost = max(self._latest_costs.values(),
+                   key=lambda c: c.get("flops") or 0.0)
+        peak = self.config.tracing.peak_tflops or xc.default_peak_tflops()
+        est = xc.static_estimate(cost, self.config.tracing.ici_gbps, peak)
+        self._exposed_cache = (key, est)
+        return est
+
     def annotation(self, name: str):
         """Profiler range for a host-side phase (the ``instrument_w_nvtx``
         analog): visible in the XPlane trace the window captures."""
@@ -351,6 +414,13 @@ class Telemetry:
             self.warm = True
         self.emit("step", self.name, step=step, samples=samples,
                   micro_steps=micro_steps)
+        if self.step_trace.enabled:
+            # flush the step's phase spans (no-op when the engine
+            # bracketed none — the serving decode loop), attaching the
+            # static exposed-comm estimate; a later profiled window
+            # supersedes it with a measured `exposed_comm` span
+            attrs = self.exposed_comm_estimate() or {}
+            self.step_trace.flush(step, **attrs)
         if (self.config.memory
                 and step % max(1, self.config.sample_every) == 0):
             self._sample_memory(step)

@@ -22,23 +22,39 @@ def _rank() -> int:
         return 0
 
 
-# sink paths already opened by THIS process: the first open of a path
-# truncates (a re-run must not append to the previous run's events —
-# telemetry_report would silently aggregate two runs into one table);
-# later opens of the same path in the same process append (several
-# engines sharing one dir produce one combined stream)
-_OPENED_PATHS = set()
+# per-path shared writer state for THIS process: the first open of a
+# path truncates (a re-run must not append to the previous run's events
+# — telemetry_report would silently aggregate two runs into one table)
+# and PURGES any rotated segments a previous run left behind (the
+# segment-aware readers would merge them in otherwise); later opens of
+# the same path in the same process SHARE the one file object and size
+# counter (several engines sharing one dir produce one combined stream,
+# and rotation stays coherent — a sibling sink can never keep writing
+# through a stale fd into a renamed segment)
+_OPEN_STATES = {}
 
 
 class JsonlSink:
     """JSONL writer, active on process 0 only (the same rank-0 gating the
-    monitor writers use). Truncate-per-run (see ``_OPENED_PATHS``); opens
-    lazily and line-buffers so a crash loses at most the in-flight line."""
+    monitor writers use). Truncate-per-run (see ``_OPEN_STATES``); opens
+    lazily and line-buffers so a crash loses at most the in-flight line.
 
-    def __init__(self, path: str):
+    With ``rotate_bytes > 0`` the sink is size-bounded: once the live
+    file reaches the threshold it is rotated to ``<path>.1`` (existing
+    segments shift ``.k`` -> ``.k+1``; at most ``rotate_keep`` rotated
+    segments are retained, the oldest dropped) and a fresh live file
+    opens — a long serving run can never grow the event file without
+    bound. ``events.load_all_events`` reads the segments back in order,
+    so the report/export tools see one stream."""
+
+    def __init__(self, path: str, rotate_bytes: int = 0,
+                 rotate_keep: int = 4):
         self.path = path
+        self.rotate_bytes = int(rotate_bytes)
+        self.rotate_keep = max(1, int(rotate_keep))
+        self.rotations = 0
         self.enabled = _rank() == 0
-        self._f = None
+        self._attached = False
         if self.enabled:
             try:
                 os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -47,38 +63,81 @@ class JsonlSink:
                                f"{path!r} ({e}); JSONL sink disabled")
                 self.enabled = False
 
+    def _state(self):
+        """The path's shared writer state, opening it on first use."""
+        state = _OPEN_STATES.get(self.path)
+        if state is None or state["f"] is None:
+            fresh = state is None  # first open this process: truncate
+            if fresh:
+                # a previous RUN's rotated segments must not leak into
+                # this run's segment-aware readers
+                from deepspeed_tpu.telemetry.events import segment_paths
+
+                for seg in segment_paths(self.path):
+                    if seg != self.path:
+                        os.remove(seg)
+            f = open(self.path, "w" if fresh else "a", buffering=1)
+            state = {"f": f, "size": 0 if fresh else f.tell(), "refs": 0}
+            _OPEN_STATES[self.path] = state
+        if not self._attached:
+            state["refs"] += 1
+            self._attached = True
+        return state
+
     def write(self, event: dict):
         if not self.enabled:
             return
-        if self._f is None:
-            mode = "a" if self.path in _OPENED_PATHS else "w"
-            try:
-                self._f = open(self.path, mode, buffering=1)
-                _OPENED_PATHS.add(self.path)
-            except OSError as e:
-                logger.warning(f"telemetry: cannot open {self.path!r} "
-                               f"({e}); JSONL sink disabled")
-                self.enabled = False
-                return
         try:
-            self._f.write(dumps(event) + "\n")
+            state = self._state()
+            line = dumps(event) + "\n"
+            state["f"].write(line)
+            state["size"] += len(line)
+            if self.rotate_bytes > 0 and state["size"] >= self.rotate_bytes:
+                self._rotate(state)
         except OSError as e:  # disk full mid-run: disable, never raise
             logger.warning(f"telemetry: write to {self.path!r} failed "
                            f"({e}); JSONL sink disabled")
             self.close()
 
+    def _rotate(self, state):
+        """Close the full live file, shift it into the numbered segment
+        chain, reopen fresh — through the SHARED state, so every sink on
+        this path follows the new live file. Any OSError here disables
+        this sink exactly like a failed write (the disk-full contract)."""
+        state["f"].close()
+        state["f"] = None
+        oldest = f"{self.path}.{self.rotate_keep}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for k in range(self.rotate_keep - 1, 0, -1):
+            seg = f"{self.path}.{k}"
+            if os.path.exists(seg):
+                os.replace(seg, f"{self.path}.{k + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        state["f"] = open(self.path, "w", buffering=1)
+        state["size"] = 0
+        self.rotations += 1
+
     def flush(self):
-        if self._f is not None:
-            self._f.flush()
+        state = _OPEN_STATES.get(self.path)
+        if self._attached and state is not None and state["f"] is not None:
+            state["f"].flush()
 
     def close(self):
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        state = _OPEN_STATES.get(self.path)
+        if self._attached and state is not None:
+            state["refs"] -= 1
+            self._attached = False
+            if state["refs"] <= 0 and state["f"] is not None:
+                # last writer gone: close the shared file (the path stays
+                # registered, so a later sink REOPENS in append mode)
+                state["f"].close()
+                state["f"] = None
         # a closed sink stays closed — late events (e.g. another engine's
         # compiles fanning out through the global watchdog) must not
         # silently reopen the file
         self.enabled = False
+
 
 
 # numeric fields worth mirroring into the monitor writers, per event kind

@@ -8,3 +8,11 @@ class ServingEngine:
         self.telemetry.emit("servign", "step.gauges", step=1)   # typo kind
         self._telemetry.emit("decode_stats", "tokens", step=1)  # new, never
         return make_event("bogus", "x", 0, 0, {})               # registered
+
+    def trace(self):
+        self.telemetry.emit("span", "prefil", step=1)        # typo name
+        self._tracer.record_span("dequeue", "t1", 0, 1)      # unregistered
+        with self._tracer.span("warmup", "t1"):              # unregistered
+            pass
+        with self.telemetry.step_trace.phase("fwdbwd"):      # unregistered
+            pass

@@ -1,8 +1,11 @@
 """The fixture registry GL05 resolves (pure AST, never imported)."""
 
-KINDS = ("compile", "serving", "fault")
+KINDS = ("compile", "serving", "fault", "span")
 
 
 def make_event(kind, name, step, rank, data):
     return {"kind": kind, "name": name, "step": step, "rank": rank,
             "data": data}
+
+
+SPANS = ("request", "queue", "decode")

@@ -10,3 +10,12 @@ class ServingEngine:
         self._telemetry.emit("fault", "watchdog.hang", step=1)
         self.telemetry.emit(kind_from_config, "dynamic", step=1)
         return make_event("compile", "x", 0, 0, {})
+
+    def trace(self, name_from_caller):
+        self.telemetry.emit("span", "queue", step=1)
+        self._tracer.record_span("decode", "t1", 0, 1)
+        self._tracer.record_span(name_from_caller, "t1", 0, 1)  # dynamic
+        with self._tracer.span("request", "t1"):
+            pass
+        with self.telemetry.step_trace.phase("queue"):
+            pass

@@ -146,12 +146,25 @@ class TestGL04:
 
 class TestGL05:
     def test_unregistered_kinds_flagged_with_registry_listing(self):
-        found = by_code(fixture_run("gl05", "bad"), "GL05")
+        found = [f for f in by_code(fixture_run("gl05", "bad"), "GL05")
+                 if "unregistered kind" in f.message]
         kinds = {f.message.split("'")[1] for f in found}
         assert kinds == {"servign", "decode_stats", "bogus"}
         assert all("compile, serving, fault" in f.message for f in found)
 
+    def test_unregistered_span_names_flagged(self):
+        """Span-name registry leg: every literal span-name emit site
+        (kind-\"span\" emits, tracer.record_span/span/begin,
+        step_trace.phase) is pinned against telemetry/events.SPANS."""
+        found = [f for f in by_code(fixture_run("gl05", "bad"), "GL05")
+                 if "unregistered span name" in f.message]
+        names = {f.message.split("'")[1] for f in found}
+        assert names == {"prefil", "dequeue", "warmup", "fwdbwd"}
+        assert all("request, queue, decode" in f.message for f in found)
+
     def test_dynamic_kind_not_flagged(self):
+        # the good corpus includes registered span names, a DYNAMIC span
+        # name, and a dynamic kind — none may fire
         assert not by_code(fixture_run("gl05", "good"), "GL05")
 
 

@@ -32,6 +32,8 @@ JAX_FREE_MODULES = (
     "deepspeed_tpu/serving/config.py",
     "deepspeed_tpu/serving/request.py",
     "deepspeed_tpu/telemetry/events.py",
+    "deepspeed_tpu/telemetry/tracing.py",
+    "deepspeed_tpu/telemetry/metrics.py",
     "deepspeed_tpu/autotuning/artifact.py",
 )
 

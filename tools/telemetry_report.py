@@ -19,7 +19,11 @@ from typing import Dict, List
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from deepspeed_tpu.telemetry.events import load_events  # noqa: E402
+from deepspeed_tpu.telemetry.events import (  # noqa: E402
+    SPAN_META,
+    load_all_events,
+)
+from deepspeed_tpu.telemetry.metrics import Histogram  # noqa: E402
 
 
 def _fmt_bytes(n) -> str:
@@ -56,6 +60,7 @@ def aggregate(events: List[Dict]) -> Dict:
            "captured_bytes": 0, "disabled": [], "load_failed": 0,
            "armed_programs": 0}
     tuning = {"events": 0, "trials": {}, "applied": {}}
+    span_events = []
     for e in events:
         kind, name, data = e.get("kind"), e.get("name"), e.get("data", {})
         if kind == "compile":
@@ -166,6 +171,8 @@ def aggregate(events: List[Dict]) -> Dict:
                 ax.append({k: data.get(k) for k in
                            ("value", "objective", "score", "skipped",
                             "error") if data.get(k) is not None})
+        elif kind == "span":
+            span_events.append(e)
     return {
         "compile": compile_by_name,
         "step_cost": step_cost_by_name,
@@ -178,6 +185,87 @@ def aggregate(events: List[Dict]) -> Dict:
         "serving": serving,
         "aot": aot,
         "tuning": tuning,
+        "spans": _aggregate_spans(span_events),
+    }
+
+
+_STEP_PHASES = ("data", "fwd", "bwd", "fwd_bwd", "reduce", "optimizer",
+                "ckpt_io")
+
+
+def _aggregate_spans(span_events: List[Dict]) -> Dict:
+    """Span-trace aggregates: per-name duration histograms (fixed-bucket
+    — constant memory over a long run), the per-step phase table with
+    its exposed-comm column, and waterfall data for the most recent
+    request traces."""
+    if not span_events:
+        return {"count": 0}
+    by_name: Dict[str, Histogram] = {}
+    traces: Dict[str, List[Dict]] = {}
+    measured = []
+    for e in span_events:
+        d = e.get("data", {})
+        dur = max(int(d.get("end_ns", 0)) - int(d.get("start_ns", 0)), 0)
+        h = by_name.get(e.get("name"))
+        if h is None:  # setdefault would build a throwaway per event
+            by_name[e.get("name")] = h = Histogram()
+        h.observe(dur)
+        if e.get("name") == "exposed_comm":
+            measured.append({k: v for k, v in d.items()
+                             if k not in SPAN_META})
+            continue
+        traces.setdefault(str(d.get("trace")), []).append(e)
+    steps, requests = [], []
+    for trace, evs in traces.items():
+        root = next((e for e in evs
+                     if e["data"].get("parent") is None), None)
+        if root is None:
+            continue
+        d = root["data"]
+        dur_ms = (int(d.get("end_ns", 0))
+                  - int(d.get("start_ns", 0))) / 1e6
+        if root["name"] == "step":
+            row = {"step": d.get("step"),
+                   "total_ms": round(dur_ms, 3),
+                   "phases": {}, "exposed_comm_fraction":
+                   d.get("exposed_comm_fraction"),
+                   "exposed_comm_source": d.get("source")}
+            for e in evs:
+                if e["name"] in _STEP_PHASES:
+                    ph = e["data"]
+                    ms = (int(ph.get("end_ns", 0))
+                          - int(ph.get("start_ns", 0))) / 1e6
+                    row["phases"][e["name"]] = round(
+                        row["phases"].get(e["name"], 0.0) + ms, 3)
+            steps.append(row)
+        elif root["name"] in ("request", "serve"):
+            requests.append({
+                "trace": trace,
+                "request_id": d.get("request_id"),
+                "state": d.get("state"), "reason": d.get("reason"),
+                "failovers": d.get("failovers"),
+                "tokens": d.get("tokens"),
+                "total_ms": round(dur_ms, 3),
+                "spans": sorted(
+                    ({"name": e["name"],
+                      "span": e["data"].get("span"),
+                      "parent": e["data"].get("parent"),
+                      "start_ns": e["data"].get("start_ns"),
+                      "end_ns": e["data"].get("end_ns"),
+                      "attrs": {k: v for k, v in e["data"].items()
+                                if k not in SPAN_META}}
+                     for e in evs),
+                    # parents first at equal starts (outermost = longest)
+                    key=lambda s: (s["start_ns"], -(s["end_ns"] or 0))),
+            })
+    steps.sort(key=lambda r: r["step"] if r["step"] is not None else -1)
+    return {
+        "count": len(span_events),
+        "by_name": {k: h.summary(scale=1e-6)
+                    for k, h in sorted(by_name.items())},
+        "steps": steps[-20:],
+        "requests": requests[-5:],
+        "measured_exposed_comm": measured,
     }
 
 
@@ -352,6 +440,104 @@ def _tuning_lines(agg: Dict, markdown: bool,
     return out
 
 
+def _waterfall_lines(req: Dict, pad: str) -> List[str]:
+    """One request trace as an indented causal waterfall (offsets are ms
+    from the root span's start)."""
+    spans = req.get("spans") or []
+    if not spans:
+        return []
+    t0 = min(s["start_ns"] for s in spans)
+    depth = {}
+    parents = {s["span"]: s["parent"] for s in spans}
+    for s in spans:
+        d, p = 0, s["parent"]
+        while p is not None and d < 8:
+            d += 1
+            p = parents.get(p)
+        depth[s["span"]] = d
+    out = []
+    for s in spans:
+        off = (s["start_ns"] - t0) / 1e6
+        dur = (s["end_ns"] - s["start_ns"]) / 1e6
+        hot = {k: v for k, v in (s.get("attrs") or {}).items()
+               if k in ("attempt", "replica", "slot", "tokens", "reason",
+                        "state", "outcome", "from_pos", "to_pos", "bucket",
+                        "pos")}
+        detail = (" " + " ".join(f"{k}={v}" for k, v in hot.items())
+                  if hot else "")
+        out.append(f"{pad}{'  ' * depth[s['span']]}{s['name']:<14} "
+                   f"+{off:8.2f} ms  {dur:8.2f} ms{detail}")
+    return out
+
+
+def _span_lines(agg: Dict, markdown: bool) -> List[str]:
+    """Trace summary: per-span-name latency histograms, the per-step
+    phase table (exposed-comm column labeled by source), and per-request
+    waterfalls."""
+    s = agg.get("spans") or {}
+    if not s.get("count"):
+        return []
+    out = [""]
+    out.append(("### " if markdown else "")
+               + f"tracing: {s['count']} spans")
+    pad = "" if markdown else "  "
+    by_name = s.get("by_name") or {}
+    if by_name:
+        if markdown:
+            out.append("\n| span | count | p50 ms | p95 ms | max ms |")
+            out.append("|---|---|---|---|---|")
+            for name, h in by_name.items():
+                out.append(f"| `{name}` | {h['count']} | {h.get('p50')} | "
+                           f"{h.get('p95')} | {h.get('max')} |")
+        else:
+            out.append(f"{pad}{'span':<16}{'count':>7}{'p50 ms':>10}"
+                       f"{'p95 ms':>10}{'max ms':>10}")
+            for name, h in by_name.items():
+                out.append(f"{pad}{name:<16}{h['count']:>7}"
+                           f"{h.get('p50'):>10}{h.get('p95'):>10}"
+                           f"{h.get('max'):>10}")
+    steps = s.get("steps") or []
+    if steps:
+        phases = sorted({p for r in steps for p in r["phases"]})
+        head = (["step", "total ms"] + [f"{p} ms" for p in phases]
+                + ["exposed comm"])
+        out.append("")
+        if markdown:
+            out.append("| " + " | ".join(head) + " |")
+            out.append("|" + "---|" * len(head))
+        else:
+            out.append(pad + "per-step phases "
+                       "(host-side dispatch walltime):")
+            out.append(pad + "  ".join(f"{h:>12}" for h in head))
+        for r in steps:
+            frac = r.get("exposed_comm_fraction")
+            src = r.get("exposed_comm_source") or ""
+            exp = (f"{frac} ({'est' if 'static' in src else src})"
+                   if frac is not None else "-")
+            cells = ([str(r["step"]), f"{r['total_ms']}"]
+                     + [str(r["phases"].get(p, "-")) for p in phases]
+                     + [exp])
+            if markdown:
+                out.append("| " + " | ".join(cells) + " |")
+            else:
+                out.append(pad + "  ".join(f"{c:>12}" for c in cells))
+    for m in (s.get("measured_exposed_comm") or [])[-3:]:
+        out.append(f"{pad}measured exposed comm (profiled window): "
+                   f"{m.get('exposed_comm_fraction')} "
+                   f"(comm {m.get('comm_ns')} ns / busy "
+                   f"{m.get('busy_ns')} ns)")
+    for req in (s.get("requests") or [])[-3:]:
+        out.append("")
+        head = (f"request {req.get('request_id') or req['trace']}: "
+                f"{req.get('state')} ({req.get('reason')}), "
+                f"{req.get('tokens')} token(s), "
+                f"{req.get('failovers') or 0} failover(s), "
+                f"{req['total_ms']} ms")
+        out.append(pad + head)
+        out.extend(_waterfall_lines(req, pad))
+    return out
+
+
 def _compile_table(agg: Dict, markdown: bool) -> List[str]:
     rows = sorted(agg["compile"].items())
     if not rows:
@@ -412,7 +598,7 @@ def _step_cost_lines(agg: Dict, markdown: bool) -> List[str]:
 
 def render(path: str, markdown: bool = False,
            tuned_artifact: Dict = None) -> str:
-    events = load_events(path)
+    events = load_all_events(path)
     agg = aggregate(events)
     lines = []
     title = (f"Telemetry report — {os.path.basename(path)} "
@@ -449,6 +635,7 @@ def render(path: str, markdown: bool = False,
     lines.extend(_fault_lines(agg, markdown))
     lines.extend(_serving_lines(agg, markdown))
     lines.extend(_router_lines(agg, markdown))
+    lines.extend(_span_lines(agg, markdown))
     lines.extend(_aot_lines(agg, markdown))
     lines.extend(_tuning_lines(agg, markdown, tuned_artifact))
     return "\n".join(lines)
@@ -474,7 +661,7 @@ def main(argv=None):
             tuned = json.load(f)
     if args.json:
         payload = {"metric": "telemetry_report", "path": path,
-                   **aggregate(load_events(path))}
+                   **aggregate(load_all_events(path))}
         if tuned is not None:
             payload["tuned_artifact"] = tuned
         print(json.dumps(payload, default=str))

@@ -525,6 +525,104 @@ def _serving_chunk_series(ctx, serving_overrides=None):
 
 
 # ---------------------------------------------------------------------------
+# speculative decoding: draft-and-verify vs the non-speculative baseline
+def _spec_decode_series(ctx):
+    """The speculative-decoding win on a prompt-lookup-friendly workload
+    (repetitive/extractive prompts, whose greedy continuations the
+    n-gram proposer predicts well): decode tokens/s with and without
+    the verify program, accepted tokens per verify dispatch, acceptance
+    rate, and TTFT p50/p95 both ways — speculation must buy decode
+    throughput without touching time-to-first-token (prefill is not
+    speculated). The measured window drains the SAME prompt set through
+    both engines; greedy bit-exactness (pinned in test_serving.py)
+    means the token streams are identical, so tokens/s is the whole
+    story. Also the measurement hook behind the live autotuner's
+    ``serving.num_speculative_tokens`` axis."""
+    cfg, scfg = ctx["cfg"], ctx["scfg"]
+    srv_rng = ctx["srv_rng"]
+    spec_block = dict(scfg.get("speculative")
+                      or {"num_speculative_tokens": 4})
+    # enabled:false measures the MACHINERY-OFF candidate (the tuner's
+    # "off" grid point): only the baseline leg runs and its throughput
+    # IS the objective value — never a fake ~1.0 "speedup" from
+    # comparing two identical engines
+    spec_off = spec_block.get("enabled", True) is False
+    k = int(spec_block.get("num_speculative_tokens", 4))
+    if ctx["on_tpu"]:
+        motif, prompt_len, new_tok = 16, 4 * scfg["block_size"], \
+            ctx["new_tokens"]
+        n_requests = 2 * ctx["batch"]
+    else:
+        motif, prompt_len, new_tok, n_requests = 4, 16, 16, 6
+
+    def prompts():
+        out = []
+        for _ in range(n_requests):
+            m = srv_rng.integers(0, cfg.vocab_size, motif)
+            out.append(np.tile(m, prompt_len // motif
+                               + 1)[:prompt_len].astype(np.int32))
+        return out
+
+    def window(eng, batch):
+        t0 = time.perf_counter()
+        for p in batch:
+            eng.submit(p, max_new_tokens=new_tok)
+        while eng.pending:
+            eng.step()
+        eng.drain()
+        elapsed = time.perf_counter() - t0
+        st = eng.stats()
+        tokens_out = sum(r["new_tokens"] for r in eng.records
+                         if r["state"] != "shed")
+        return {
+            "tokens_per_sec": round(tokens_out / elapsed, 1)
+            if elapsed > 0 else None,
+            "ttft_ms_p50": st["ttft_ms_p50"],
+            "ttft_ms_p95": st["ttft_ms_p95"],
+            "speculative": st["speculative"],
+        }
+
+    measured = {}
+    batch = prompts()  # ONE prompt set: both engines decode the same work
+    legs = [("baseline", {"speculative": None})]
+    if not spec_off:
+        legs.append(("spec", {"speculative": spec_block}))
+    for label, extra in legs:
+        eng = _build_serving(ctx, extra)
+        window(eng, batch)   # warm the programs (prefill buckets + step)
+        eng.reset_stats()
+        measured[label] = window(eng, batch)
+        eng.destroy()
+        del eng
+    base = measured["baseline"]
+    spec = measured.get("spec", base)
+    sp = spec["speculative"] or {}
+    speedup = (round(spec["tokens_per_sec"] / base["tokens_per_sec"], 3)
+               if not spec_off and base["tokens_per_sec"]
+               and spec["tokens_per_sec"] else None)
+    return {
+        "metric": f"{METRIC}_spec_decode",
+        "speculation_enabled": not spec_off,
+        "tokens_per_sec_baseline": base["tokens_per_sec"],
+        # the objective key: spec-leg throughput, or (machinery off)
+        # the baseline's — "off" competes in the same units
+        "spec_tokens_per_sec": spec["tokens_per_sec"],
+        "speedup": speedup,
+        "accepted_tokens_per_step": sp.get("accepted_tokens_per_step"),
+        "acceptance_rate": sp.get("acceptance_rate"),
+        "draft_tokens": sp.get("draft_tokens"),
+        "ttft_ms_p50_baseline": base["ttft_ms_p50"],
+        "ttft_ms_p95_baseline": base["ttft_ms_p95"],
+        "ttft_ms_p50_spec": spec["ttft_ms_p50"],
+        "ttft_ms_p95_spec": spec["ttft_ms_p95"],
+        "proposer": sp.get("proposer"),
+        "num_speculative_tokens": k,
+        "requests": n_requests, "prompt_len": prompt_len,
+        "new_tokens": new_tok,
+    }
+
+
+# ---------------------------------------------------------------------------
 # span tracing: serving tokens/s with the span layer off vs on
 def _serving_tracing_series(ctx):
     """Optional extra series (after the headline JSON): the span-tracing
@@ -617,12 +715,15 @@ def run_series(name, config=None):
                                      serving_overrides=config.get("serving"))
     if name == "serving_tracing":
         return _serving_tracing_series(ctx)
+    if name == "spec_decode":
+        return _spec_decode_series(ctx)
     raise KeyError(f"unknown decode series {name!r}; available: "
                    f"{sorted(SERIES)}")
 
 
 SERIES = ("headline", "serving", "serving_fastpath", "router",
-          "decode_attention", "serving_chunk", "serving_tracing")
+          "decode_attention", "serving_chunk", "serving_tracing",
+          "spec_decode")
 
 
 def main():
@@ -637,6 +738,7 @@ def main():
     emit_result(_serving_series(ctx))
     emit_result(_serving_fastpath_series(ctx))
     emit_result(_router_series(ctx))
+    emit_result(_spec_decode_series(ctx))
     emit_result(_serving_tracing_series(ctx))
 
 

@@ -156,6 +156,20 @@ def _expand_section_target(section: str, key: str, value):
     if section == "comm_quantization" and key == "tier":
         return ({"enabled": False} if value == "off"
                 else {"enabled": True, "dtype": value})
+    if section == "serving" and key == "speculative.num_speculative_tokens":
+        # same contract as comm.tier: the axis grid measured the
+        # machinery-off default ("off"), so the chosen value owns the
+        # ENABLE decision — speculation is switched on only when a k
+        # actually beat the non-speculative baseline
+        return {"speculative": (
+            {"enabled": False} if value == "off"
+            else {"enabled": True, "num_speculative_tokens": int(value)})}
+    if "." in key:
+        # sub-model target ("serving.speculative.num_speculative_tokens"
+        # under section "serving"): expand into the nested block shape
+        # the pydantic config parses
+        head, rest = key.split(".", 1)
+        return {head: _expand_section_target(section, rest, value)}
     return {key: value}
 
 
@@ -167,8 +181,16 @@ def section_choices(artifact: Dict, section: str) -> Dict[str, object]:
     prefix = section + "."
     out: Dict[str, object] = {}
     for t, v in chosen_values(artifact).items():
-        if t.startswith(prefix):
-            out.update(_expand_section_target(section, t[len(prefix):], v))
+        if not t.startswith(prefix):
+            continue
+        for key, value in _expand_section_target(section, t[len(prefix):],
+                                                 v).items():
+            if isinstance(value, dict) and isinstance(out.get(key), dict):
+                # two axes targeting sibling sub-keys of one nested
+                # block ("speculative.*"): merge, never clobber
+                out[key] = {**out[key], **value}
+            else:
+                out[key] = value
     return out
 
 
@@ -213,6 +235,16 @@ def apply_section(user_section: Optional[Dict], artifact: Dict,
         if key not in merged:
             merged[key] = value
             applied[key] = value
+        elif isinstance(value, dict) and isinstance(merged[key], dict):
+            # nested sub-model (e.g. "speculative"): artifact fills only
+            # the sub-keys the user's block left unset — an explicit
+            # user sub-key still beats the artifact, one level down
+            sub = dict(merged[key])
+            filled = {k: v for k, v in value.items() if k not in sub}
+            if filled:
+                sub.update(filled)
+                merged[key] = sub
+                applied[key] = filled
     if applied:
         logger.info(f"[tuning] {section}: applied "
                     + ", ".join(f"{k}={v}" for k, v in sorted(

@@ -117,6 +117,7 @@ _DEFAULT_ORDER = (
     "comm.tier",
     "serving.prefill_chunk_tokens",
     "serving.prompt_buckets",
+    "serving.num_speculative_tokens",
 )
 
 register_axis(LiveAxis(
@@ -196,6 +197,25 @@ register_axis(LiveAxis(
     objective="tokens_per_sec",
     overrides=lambda v: {"serving": {"prompt_buckets": [int(b)
                                                         for b in v]}},
+))
+
+
+register_axis(LiveAxis(
+    # k, the verify program's draft-token count: larger k buys more
+    # tokens per dispatch only while the proposer's acceptance holds up
+    # — a workload-dependent cliff no roofline predicts, so it is
+    # measured against the real *_spec_decode series. "off" measures
+    # the plain decode program, so (comm.tier convention) the choice to
+    # switch speculation on AT ALL is itself measured — consuming the
+    # artifact enables it only when a k beat the baseline
+    name="serving.num_speculative_tokens",
+    target="serving.speculative.num_speculative_tokens",
+    grid=("off", 2, 4, 8),
+    bench="decode", series="spec_decode",
+    objective="spec_tokens_per_sec",
+    overrides=lambda v: {"serving": {"speculative": (
+        {"enabled": False} if v == "off"
+        else {"enabled": True, "num_speculative_tokens": int(v)})}},
 ))
 
 

@@ -266,6 +266,14 @@ class InferenceEngine:
             if serving_dict is not None:
                 serving_dict = apply_section(serving_dict, artifact,
                                              "serving")
+                if (serving_dict.get("do_sample")
+                        and "speculative" not in (config.serving or {})):
+                    # a tuned speculation choice applies only to greedy
+                    # serving (the accept oracle IS the greedy stream);
+                    # filling it into a sampling config would fail the
+                    # config validator at startup over a bench artifact
+                    # the user never wrote
+                    serving_dict.pop("speculative", None)
             tuned_ops = ops_choices(artifact)
         if serving_dict is not None:
             from deepspeed_tpu.serving.config import ServingConfig

@@ -148,6 +148,20 @@ def decode_attention(q, k_cache, v_cache, cache_index, softmax_scale=None,
 # cache (vLLM-style paging, TPU-native via scalar-prefetch block DMA).
 # The dense append-cache kernel above is kept untouched: it serves the
 # legacy generate() path and is the correctness oracle for this one.
+#
+# MULTI-QUERY-ROW (verify) CONTRACT: the kernel is written over T_q query
+# rows per sequence, not 1 — query row r of sequence b sits at absolute
+# position lengths[b] + r and is causally masked to keys at positions
+# <= lengths[b] + r, including the OTHER rows of the same step (their KV
+# must already be scattered into the pool, which the paged write path
+# does before attending). T_q = 1 is plain decode; T_q = k + 1 is
+# speculative decoding's k-token verify step: the pending token plus k
+# proposed continuation tokens score in one dispatch, each row seeing
+# exactly the prefix it would have seen decoded sequentially — the
+# property that makes greedy verify an exact accept oracle. Proposal
+# rows past a sequence's real count are right-padded junk whose writes
+# went to the garbage block; their outputs are computed and discarded
+# (static shapes — the zero-retrace pin), never read back.
 # ---------------------------------------------------------------------------
 
 
@@ -225,13 +239,19 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref, m_scr,
 
 def decode_attention_paged(q, k_pool, v_pool, block_tables, lengths,
                            softmax_scale=None):
-    """Attend a decode step against a paged KV cache.
+    """Attend a decode (or k-token verify) step against a paged KV cache.
 
     Args:
-      q: ``[B, T_q, H, D]`` query step (``T_q`` small: 1 for plain decode).
+      q: ``[B, T_q, H, D]`` query step. ``T_q = 1`` is plain decode;
+        ``T_q = k + 1`` is the speculative verify step (pending token +
+        ``k`` proposed tokens per sequence, one dispatch). Each query
+        row r attends causally at its own absolute position
+        ``lengths[b] + r`` — bitwise the attention sequential decode
+        would have computed, which is what makes greedy verify exact.
       k_pool / v_pool: ``[num_blocks, block_size, H, D]`` shared block
         pools; this step's keys must already be scattered at each row's
-        ``[lengths[b], lengths[b] + T_q)`` logical positions.
+        ``[lengths[b], lengths[b] + T_q)`` logical positions (verify
+        pads scatter into the garbage block and are never read).
       block_tables: ``[B, MB]`` int32 — row b's logical block j lives in
         pool block ``block_tables[b, j]``; entries past the allocation
         point at the reserved garbage block (their blocks skip compute).
@@ -245,6 +265,9 @@ def decode_attention_paged(q, k_pool, v_pool, block_tables, lengths,
     Returns ``[B, T_q, H, D]`` in the query's dtype.
     """
     b, tq, heads, d = q.shape
+    if tq < 1:
+        raise ValueError(f"need at least one query row per sequence, "
+                         f"got T_q={tq}")
     nb, bs, ph, pd = k_pool.shape
     if (ph, pd) != (heads, d):
         raise ValueError(f"pool heads/dim {(ph, pd)} != query {(heads, d)}")
@@ -345,9 +368,11 @@ def _paged_int8_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, ks_ref,
 
 def decode_attention_paged_int8(q, k_pool, v_pool, k_scale, v_scale,
                                 block_tables, lengths, softmax_scale=None):
-    """Attend a decode step against an int8-quantized paged KV cache.
+    """Attend a decode (or k-token verify) step against an
+    int8-quantized paged KV cache.
 
-    Same contract as :func:`decode_attention_paged`, except ``k_pool`` /
+    Same contract as :func:`decode_attention_paged` (including the
+    multi-query-row verify semantics), except ``k_pool`` /
     ``v_pool`` are ``[num_blocks, block_size, H, D]`` int8 and
     ``k_scale`` / ``v_scale`` are their ``[num_blocks, block_size, H,
     1]`` f32 per-row scales (one scale per token x head —
@@ -357,6 +382,9 @@ def decode_attention_paged_int8(q, k_pool, v_pool, k_scale, v_scale,
     identical fp32 online-softmax update.
     """
     b, tq, heads, d = q.shape
+    if tq < 1:
+        raise ValueError(f"need at least one query row per sequence, "
+                         f"got T_q={tq}")
     nb, bs, ph, pd = k_pool.shape
     if (ph, pd) != (heads, d):
         raise ValueError(f"pool heads/dim {(ph, pd)} != query {(heads, d)}")

@@ -217,6 +217,15 @@ class ChaosReplica:
     - ``slow_decode_secs=S`` — EVERY step takes S extra seconds (a
       thermally-throttled or mis-sharded replica: the soft DEGRADED
       signal, not a trip).
+    - ``crash_between_draft_and_commit=N`` — the Nth ``step()`` runs the
+      wrapped engine with a one-shot :class:`ReplicaCrashed` armed at
+      the serving engine's ``"serving.spec_commit"`` seam: a
+      speculative-decoding replica dies AFTER the verify dispatch but
+      BEFORE any token of the window commits — the hardest failover
+      moment, where the exactly-once splice must see zero speculative
+      tokens (the fault is armed only around this one delegated call,
+      so a co-resident replica stepping through the same seam is never
+      hit).
 
     ``sleep`` is injectable so host-side tests drive stalls through a
     fake clock instead of wall time.
@@ -226,9 +235,12 @@ class ChaosReplica:
                  fail_step_at: int = 0, fail_step_times: int = 1,
                  fail_submit_at: int = 0, fail_submit_times: int = 1,
                  stall_at_step: int = 0, stall_secs: float = 0.0,
-                 slow_decode_secs: float = 0.0, sleep=time.sleep):
+                 slow_decode_secs: float = 0.0,
+                 crash_between_draft_and_commit: int = 0, sleep=time.sleep):
         self.replica = replica
         self.crash_at_step = int(crash_at_step)
+        self.crash_between_draft_and_commit = int(
+            crash_between_draft_and_commit)
         self.fail_step_at = int(fail_step_at)
         self.fail_step_times = int(fail_step_times)
         self.fail_submit_at = int(fail_submit_at)
@@ -261,6 +273,14 @@ class ChaosReplica:
             self.sleep(self.stall_secs)
         if self.slow_decode_secs:
             self.sleep(self.slow_decode_secs)
+        if (self.crash_between_draft_and_commit
+                and self.steps >= self.crash_between_draft_and_commit):
+            # one-shot, scoped to THIS delegated call: the wrapped
+            # engine's raise_if("serving.spec_commit") fires between its
+            # verify dispatch and commit loop
+            with io_errors("serving.spec_commit", at_call=1,
+                           exc=ReplicaCrashed):
+                return self.replica.step()
         return self.replica.step()
 
     def __getattr__(self, name):

@@ -20,6 +20,11 @@ Pieces:
   steady-state retrace count is zero;
 - :class:`~deepspeed_tpu.serving.request.Request` — one in-flight
   generation with streaming callbacks and per-request telemetry;
+- :mod:`~deepspeed_tpu.serving.spec_decode` — speculative decoding's
+  host-side proposers (prompt-lookup n-gram matching, injected draft
+  models); the engine's ONE compiled k-token verify program scores the
+  proposals and commits the greedy-agreed prefix (1 to k+1 tokens per
+  dispatch, bit-identical streams);
 - :class:`~deepspeed_tpu.serving.router.ReplicaRouter` +
   :class:`~deepspeed_tpu.serving.health.ReplicaHealth` — the resilient
   multi-replica front door: health-aware routing, failover with
@@ -28,7 +33,8 @@ Pieces:
 
 from deepspeed_tpu.serving.blocks import BlockManager
 from deepspeed_tpu.serving.config import (RouterConfig, ServingConfig,
-                                          bucket_for, resolve_buckets)
+                                          SpeculativeConfig, bucket_for,
+                                          resolve_buckets)
 from deepspeed_tpu.serving.engine import ServingEngine
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.health import (DEAD, DEGRADED, DRAINING, HEALTHY,
@@ -37,10 +43,15 @@ from deepspeed_tpu.serving.request import (FINISHED, QUEUED, RUNNING, SHED,
                                            Request)
 from deepspeed_tpu.serving.router import ReplicaRouter, RouterRequest
 from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.serving.spec_decode import (DraftModelProposer,
+                                               PromptLookupProposer,
+                                               Proposer, build_proposer)
 
-__all__ = ["BlockManager", "ContinuousBatchingScheduler", "PrefixCache",
-           "ReplicaHealth",
+__all__ = ["BlockManager", "ContinuousBatchingScheduler",
+           "DraftModelProposer", "PrefixCache", "PromptLookupProposer",
+           "Proposer", "ReplicaHealth",
            "ReplicaRouter", "Request", "RouterConfig", "RouterRequest",
-           "ServingConfig", "ServingEngine", "bucket_for", "resolve_buckets",
+           "ServingConfig", "ServingEngine", "SpeculativeConfig",
+           "bucket_for", "build_proposer", "resolve_buckets",
            "QUEUED", "RUNNING", "FINISHED", "SHED",
            "HEALTHY", "DEGRADED", "TRIPPED", "DEAD", "DRAINING"]

@@ -76,6 +76,10 @@ class BlockManager:
         self._evictable: "OrderedDict[int, None]" = OrderedDict()
         # seq -> pinned COW source block (held until cow_done/release)
         self._cow_pending: Dict[str, int] = {}
+        # seq -> owned-block count BEFORE its open speculative window
+        # (speculate() grants extra blocks past it; commit/drop return
+        # the uncommitted tail to the free list without copies)
+        self._spec_base: Dict[str, int] = {}
         # notification hook: called with the block id when an evictable
         # block is recycled, so the prefix cache can drop its trie entry
         self.on_evict = None
@@ -222,6 +226,77 @@ class BlockManager:
         if src is not None:
             self._unref(src)
 
+    # ------------------------------------------------------------------
+    # speculative window (draft-and-verify decoding)
+    # ------------------------------------------------------------------
+    def speculate(self, seq_id: str, n_tokens: int) -> List[int]:
+        """Open (or extend) ``seq_id``'s speculative write window: its
+        block set grows to cover ``n_tokens`` cache rows so a verify
+        step can scatter up to ``k`` draft tokens past the committed
+        length. Blocks the sequence already owns are reused in place
+        (the worst-case admission reservation usually covers the whole
+        window — then this is pure ledger work); only coverage past them
+        takes fresh blocks, and those are the window's droppable tail.
+        Returns the freshly granted blocks (often ``[]``).
+
+        Re-speculating with a window still open is legal and keeps the
+        ORIGINAL base — a verify dispatch that died between draft and
+        commit (chaos, failover) must be able to retry from the same
+        committed state without leaking its first grant.
+        """
+        blocks = self._owned.get(seq_id)
+        if blocks is None:
+            raise ValueError(f"sequence {seq_id!r} owns no blocks to "
+                             "speculate past")
+        need = self.blocks_needed(n_tokens)
+        if need > self.max_blocks_per_seq:
+            raise ValueError(
+                f"speculative window of {n_tokens} tokens needs {need} "
+                f"blocks > max_blocks_per_seq {self.max_blocks_per_seq}")
+        self._spec_base.setdefault(seq_id, len(blocks))
+        extra = need - len(blocks)
+        if extra <= 0:
+            return []
+        if self.num_free < extra:
+            raise RuntimeError(
+                f"cache pool exhausted: speculative window needs {extra} "
+                f"fresh blocks, {self.num_free} reclaimable")
+        fresh = [self._take() for _ in range(extra)]
+        for b in fresh:
+            self._ref[b] = self._ref.get(b, 0) + 1
+        blocks.extend(fresh)
+        return fresh
+
+    def commit_speculative(self, seq_id: str, n_tokens: int) -> int:
+        """Close the speculative window, keeping blocks that cover the
+        accepted prefix's ``n_tokens`` cache rows: they simply fold into
+        the sequence's owned set (their KV bytes are already in place —
+        no copies), while granted blocks past the commit point return to
+        the free list. Returns how many blocks were dropped. No open
+        window is a no-op (a pure-ledger window never granted blocks).
+        """
+        base = self._spec_base.pop(seq_id, None)
+        if base is None:
+            return 0
+        blocks = self._owned.get(seq_id)
+        if not blocks:
+            return 0
+        keep = max(base, self.blocks_needed(n_tokens))
+        dropped = blocks[keep:]
+        del blocks[keep:]
+        for b in reversed(dropped):
+            self._unref(b)
+        return len(dropped)
+
+    def drop_speculative(self, seq_id: str) -> int:
+        """Reject the whole window: every granted block returns to the
+        free list, the owned set is exactly as before ``speculate()``."""
+        return self.commit_speculative(seq_id, 0)
+
+    def speculating(self, seq_id: str) -> bool:
+        return seq_id in self._spec_base
+
+    # ------------------------------------------------------------------
     def release(self, seq_id: str) -> int:
         """Drop a finished sequence's references; returns how many table
         entries were released. A block's storage is reclaimed only at
@@ -229,6 +304,7 @@ class BlockManager:
         cached blocks park on the evictable LRU instead of the free list.
         Unknown ids are a no-op (a shed request never owned blocks)."""
         self.cow_done(seq_id)
+        self._spec_base.pop(seq_id, None)
         blocks = self._owned.pop(seq_id, None)
         if not blocks:
             return 0

@@ -22,6 +22,83 @@ from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 SHED = "shed"
 QUEUE = "queue"
 
+PROMPT_LOOKUP = "prompt_lookup"
+DRAFT_MODEL = "draft_model"
+
+
+class SpeculativeConfig(DeepSpeedConfigModel):
+    """The ``serving.speculative`` block: draft-and-verify decoding on
+    the fixed-slot decode loop. Absent (the default) speculation does
+    not exist — the decode program and its compiled HLO are
+    byte-identical to previous releases. Present, each decode step
+    proposes up to ``num_speculative_tokens`` continuation tokens per
+    slot on the host and ONE compiled verify program scores them all in
+    a single dispatch; the longest prefix the target model agrees with
+    is committed (1 to k+1 tokens per step for one dispatch). Greedy
+    decode is the exact accept oracle, so the emitted stream is
+    bit-identical to non-speculative decode — ``serving.do_sample``
+    must stay off while speculation is on."""
+
+    enabled: bool = True
+    # "prompt_lookup": n-gram match against the request's own context
+    # (zero extra model); "draft_model": a small injected draft
+    # (ServingEngine(..., draft_model=...)) guesses greedily
+    proposer: str = PROMPT_LOOKUP
+    # k — draft tokens proposed (and query rows verified) per step; a
+    # config constant, so the verify program's shape is static and the
+    # zero-steady-state-retrace pin holds (short proposals right-pad
+    # against the garbage block)
+    num_speculative_tokens: int = 4
+    # prompt-lookup knobs: suffix n-gram sizes tried, longest first
+    prompt_lookup_min_ngram: int = 1
+    prompt_lookup_max_ngram: int = 3
+    # trailing context tokens the n-gram scan searches (0 = unbounded).
+    # The scan is host Python on the step-critical path: a miss costs
+    # the FULL scan every step, so long-context serving needs the bound
+    prompt_lookup_window: int = 1024
+    # draft-model knob: trailing context tokens the draft sees per step
+    # (0 = the full prompt + generation; the draft runs every step, so
+    # this bounds its per-step cost)
+    draft_context_window: int = 0
+
+    @field_validator("num_speculative_tokens")
+    @classmethod
+    def _k(cls, v):
+        if v <= 0:
+            raise ValueError(
+                "serving.speculative.num_speculative_tokens must be > 0 "
+                f"(k proposed tokens per verify step), got {v}")
+        return v
+
+    @field_validator("proposer")
+    @classmethod
+    def _proposer(cls, v):
+        if v not in (PROMPT_LOOKUP, DRAFT_MODEL):
+            raise ValueError(
+                f"serving.speculative.proposer must be '{PROMPT_LOOKUP}' "
+                f"or '{DRAFT_MODEL}', got {v!r}")
+        return v
+
+    @field_validator("draft_context_window", "prompt_lookup_window")
+    @classmethod
+    def _window(cls, v, info):
+        if v < 0:
+            raise ValueError(
+                f"serving.speculative.{info.field_name} must be >= 0 "
+                f"(0 = full context), got {v}")
+        return v
+
+    @model_validator(mode="after")
+    def _ngrams(self):
+        if not (1 <= self.prompt_lookup_min_ngram
+                <= self.prompt_lookup_max_ngram):
+            raise ValueError(
+                "serving.speculative needs 1 <= prompt_lookup_min_ngram "
+                f"<= prompt_lookup_max_ngram, got min="
+                f"{self.prompt_lookup_min_ngram} max="
+                f"{self.prompt_lookup_max_ngram}")
+        return self
+
 
 class RouterConfig(DeepSpeedConfigModel):
     """The ``serving.router`` block: N replica serving engines behind one
@@ -156,6 +233,9 @@ class ServingConfig(DeepSpeedConfigModel):
     top_k: int = 0
     top_p: float = 0.0
     seed: int = 0
+    # ---- speculative decoding (None = speculation does not exist; the
+    # decode program and its compiled HLO are byte-identical) ----
+    speculative: Optional[SpeculativeConfig] = None
     # ---- multi-replica front door (None = the router layer does not
     # exist; single-engine serving is exactly as before) ----
     router: Optional[RouterConfig] = None
@@ -202,6 +282,19 @@ class ServingConfig(DeepSpeedConfigModel):
                 f"serving.kv_cache_dtype must be '' (model dtype) or "
                 f"'int8', got {v!r}")
         return v
+
+    @model_validator(mode="after")
+    def _speculative_needs_greedy(self):
+        if (self.speculative is not None and self.speculative.enabled
+                and self.do_sample):
+            # the accept oracle is exact token equality against the
+            # target's own greedy stream; a sampled stream has no such
+            # oracle, so verification would silently change outputs
+            raise ValueError(
+                "serving.speculative requires greedy decoding "
+                "(do_sample: false): draft acceptance is verified "
+                "against the bit-reproducible greedy token stream")
+        return self
 
 
 def resolve_buckets(buckets, max_len: int, floor: int = 8):

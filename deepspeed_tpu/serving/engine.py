@@ -21,7 +21,20 @@ programs:
   one shape) and *prefix-cache tail prefill* (a request whose prompt
   prefix is already pooled writes only the unmatched tail);
 - ``serving.cow`` — copy one pool block's rows to another (every cache
-  leaf, scales included): the device half of partial-tail copy-on-write.
+  leaf, scales included): the device half of partial-tail copy-on-write;
+- ``serving.verify[slots=N,k=K]`` — speculative decoding's whole device
+  surface (compiled only when ``serving.speculative`` is on, REPLACING
+  the decode program in the step loop): every slot advances ``K + 1``
+  query rows — the pending last token plus up to ``K`` host-proposed
+  draft tokens, right-padded against the garbage block — through the
+  multi-query-row paged attention kernel in ONE dispatch, returning the
+  target model's greedy token at every row. The host keeps the longest
+  proposal prefix the greedy oracle agrees with (1 to K+1 tokens per
+  step for one dispatch), commits the accepted extent through the block
+  manager's speculative ledger, and drops the rejected tail without
+  copies — rejected rows sit past the committed length, masked out of
+  every later attention window and overwritten by the next step's
+  writes. Greedy output is bit-identical to non-speculative decode.
 
 Finished sequences are evicted and queued requests spliced into free
 slots *between* decode steps — shapes never change, so the steady-state
@@ -49,12 +62,14 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.runtime.resilience.chaos import raise_if
 from deepspeed_tpu.serving.blocks import BlockManager
 from deepspeed_tpu.serving.config import (ServingConfig, blocks_for_tokens,
                                           bucket_for, resolve_buckets)
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.request import FINISHED, Request
 from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.serving.spec_decode import build_proposer
 from deepspeed_tpu.telemetry.tracing import end_span, to_ns
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -65,7 +80,8 @@ def _model_window(model_config) -> Optional[int]:
 
 
 class ServingEngine:
-    def __init__(self, model_or_engine, config=None, **kwargs):
+    def __init__(self, model_or_engine, config=None, draft_model=None,
+                 **kwargs):
         import jax
         import jax.numpy as jnp
 
@@ -148,8 +164,22 @@ class ServingEngine:
         self._pf_next = 0  # round-robin cursor over prefilling slots
         self._chunk_fns: Dict[int, object] = {}
         self._cow_fn = None
+        # speculative decoding: host-side proposer + the ONE compiled
+        # k-token verify program (replaces the decode program in the
+        # step loop; None => the decode path is exactly as before)
+        self._proposer = build_proposer(self.config.speculative,
+                                        draft_model=draft_model)
+        self.spec_k = (int(self.config.speculative.num_speculative_tokens)
+                       if self._proposer is not None else 0)
+        self._verify_fn = None
         self._rng = jax.random.PRNGKey(self.config.seed)
         self._step_count = 0
+        # speculation counters over the stats window (reset_stats zeroes
+        # them WITH the records deque — the bounded records alone would
+        # decay any per-step ratio on a long-running server)
+        self._spec_steps = 0
+        self._window_draft_tokens = 0
+        self._window_accepted_tokens = 0
         self._finished_count = 0
         # bounded retention (a long-running server must not accumulate a
         # dead Request per served request until OOM — same contract as
@@ -269,6 +299,38 @@ class ServingEngine:
             jax.jit(fn, donate_argnums=self._donate()),
             f"serving.chunk[T={T}]")
 
+    def _build_verify(self):
+        """The k-token verify program — speculative decoding's single
+        compiled surface. Every slot advances ``T = k + 1`` query rows
+        at once (pending last token + the proposals, right-padded), the
+        multi-query-row paged attention kernel masks each row causally
+        at ``lengths[b] + row``, ``num_valid`` routes pad rows' KV
+        writes into the garbage block, and the program returns the
+        greedy token at EVERY row — the host's exact accept oracle. Row
+        0's math is the decode program's term for term, so a verify
+        step that accepts nothing still emits the identical token the
+        plain decode step would have."""
+        jax, jnp = self._jax, self._jnp
+        dmodule, dequant = self._dmodule, self.engine._dequantize
+        logits_of = self.engine._logits_of
+
+        def fn(qparams, cache, tokens, tables, lengths, num_valid, rng):
+            params = dequant(qparams)
+            paging = {"block_tables": tables, "lengths": lengths,
+                      "num_valid": num_valid, "prefill": False}
+            out, vars_ = dmodule.apply({"params": params, "cache": cache},
+                                       tokens, mutable=["cache"],
+                                       paging=paging)
+            logits = logits_of(out)                       # [N, k+1, V]
+            n, t, v = logits.shape
+            toks = self._sample(logits.reshape(n * t, v), rng)
+            return toks.reshape(n, t), vars_["cache"]
+
+        return self.engine.telemetry.watch_jit(
+            jax.jit(fn, donate_argnums=self._donate()),
+            f"serving.verify[slots={self.config.decode_slots},"
+            f"k={self.spec_k}]")
+
     def _build_cow(self):
         """Copy one pool block's rows onto another across every cache
         leaf (key/value pools and, under int8 KV, their scale side
@@ -333,10 +395,14 @@ class ServingEngine:
             self._begin(slot, req, table, done)
         self._prefill_chunks(done)
         # one decode step for the whole slot batch (mid-prefill slots are
-        # idle decode rows: garbage table, outputs ignored)
+        # idle decode rows: garbage table, outputs ignored); with
+        # speculation on, the verify program IS the decode step
         if any(slot not in self._prefilling
                for slot, _ in self.sched.running()):
-            self._decode_step(done)
+            if self._proposer is not None:
+                self._spec_step(done)
+            else:
+                self._decode_step(done)
         return done
 
     def _begin(self, slot: int, req: Request, table: np.ndarray,
@@ -513,6 +579,129 @@ class ServingEngine:
                           >= req.max_new_tokens else "window")
                 self._finish(req, reason, now, done)
 
+    def _spec_step(self, done: List[Request]):
+        """One speculative decode step: propose draft tokens on the host
+        (``draft`` span), score every slot's pending token + proposals
+        in ONE compiled verify dispatch, then commit the longest prefix
+        the greedy oracle agreed with (``verify``/``spec_commit``
+        spans). Emits 1 to ``k + 1`` tokens per active slot for the
+        dispatch cost of one decode step; proposals right-pad to ``k``
+        against the garbage block so the program shape never changes."""
+        jnp = self._jnp
+        if self._verify_fn is None:
+            self._verify_fn = self._build_verify()
+        k = self.spec_k
+        active = [(s, r) for s, r in self.sched.running()
+                  if s not in self._prefilling]
+        tokens = np.zeros((self.config.decode_slots, k + 1), np.int32)
+        tokens[:, 0] = self._last_tokens
+        num_valid = np.ones((self.config.decode_slots,), np.int32)
+        proposals: Dict[int, List[int]] = {}
+        for slot, req in active:
+            budget = self.sched.speculative_budget(req, k)
+            props: List[int] = []
+            if budget > 0:
+                with self._req_span(req, "draft",
+                                    proposer=self._proposer.name,
+                                    budget=budget):
+                    props = [int(t) for t in
+                             self._proposer.propose(req, budget)][:budget]
+            proposals[slot] = props
+            if props:
+                tokens[slot, 1:1 + len(props)] = props
+                num_valid[slot] = 1 + len(props)
+            # open the speculative ledger window over the verify write
+            # extent [0, length + 1 + n_p). Admission's worst-case
+            # reservation covers every window THIS engine can open, so
+            # the grant must be empty — a future lazy-allocation policy
+            # that takes real grants must also extend the slot's row of
+            # self._tables first, or the verify writes would scatter
+            # into the garbage block (the ledger stays general; the
+            # fuzz drives its granting paths directly)
+            granted = self.block_mgr.speculate(req.request_id,
+                                               req.length + 1 + len(props))
+            assert not granted, \
+                "speculative grant without a device table update"
+        t0 = time.monotonic()
+        toks, self.cache = self._verify_fn(
+            self.engine.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(self._tables), jnp.asarray(self._lengths),
+            jnp.asarray(num_valid), self._next_rng())
+        # the ONE designed host sync per decode step (same contract as
+        # the non-speculative loop): verified tokens drive commit/finish
+        toks = np.asarray(toks)  # graft-lint: disable=GL04
+        now = time.monotonic()
+        # chaos seam: a replica killed BETWEEN verify and commit has
+        # emitted nothing from this window — host state is exactly the
+        # pre-step state, so a retry or failover replays cleanly and
+        # the router's exactly-once splice sees no speculative token
+        raise_if("serving.spec_commit")
+        self._step_count += 1
+        self._spec_steps += 1
+        self.telemetry.on_step_boundary(self._step_count,
+                                        samples=len(active))
+        if self.telemetry.enabled:
+            self.telemetry.emit("serving", "step.gauges",
+                                step=self._step_count, **self.gauges())
+        self.resilience.serving_step_progress()
+        for slot, req in active:
+            props = proposals[slot]
+            accepted = 0
+            for p in props:
+                if int(toks[slot, accepted]) == p:
+                    accepted += 1
+                else:
+                    break
+            # draft AND accepted counters land here, past the chaos
+            # seam: a step killed between verify and commit counted
+            # nothing, so its retry cannot double-count the window
+            req.draft_tokens += len(props)
+            self._window_draft_tokens += len(props)
+            req.accepted_tokens += accepted
+            self._window_accepted_tokens += accepted
+            if self._tracer.enabled and req.trace is not None:
+                # per-request view of the SHARED batched verify dispatch
+                self._tracer.record_span(
+                    "verify", req.trace["trace"], to_ns(t0), to_ns(now),
+                    parent=req.trace.get("serve_id"),
+                    proposed=len(props), accepted=accepted,
+                    request_id=req.request_id)
+            with self._req_span(req, "spec_commit", accepted=accepted):
+                finished, reason = self._spec_commit(slot, req, toks[slot],
+                                                     accepted)
+            if finished:
+                self._finish(req, reason, now, done)
+
+    def _spec_commit(self, slot: int, req: Request, row, accepted: int):
+        """Commit one verified row: emit the model's greedy tokens at
+        rows ``0..accepted`` (the accepted drafts, then the correction —
+        or, with everything accepted, the free bonus token) under the
+        sequential finish semantics, so eos / token budget / model
+        window stop the stream exactly where non-speculative decode
+        would. The accepted extent folds into the block ledger in place
+        (its KV was written by the verify dispatch); the rejected tail
+        drops without copies — its rows sit past the committed length,
+        masked out of every later attention window and overwritten by
+        the next step's writes."""
+        finished, reason = False, None
+        for i in range(accepted + 1):
+            tok = int(row[i])
+            req.length += 1
+            self._lengths[slot] = req.length
+            self._last_tokens[slot] = tok
+            finished = (tok == req.eos_token_id
+                        or len(req.tokens) + 1 >= req.max_new_tokens
+                        or req.length + 1 > self.max_len)
+            req.emit_token(tok, finished)
+            if finished:
+                reason = ("eos" if tok == req.eos_token_id else
+                          "max_tokens" if len(req.tokens)
+                          >= req.max_new_tokens else "window")
+                break
+        # + 1: the pending last token's next write lands at req.length
+        self.block_mgr.commit_speculative(req.request_id, req.length + 1)
+        return finished, reason
+
     def _finish(self, req: Request, reason: str, now: float,
                 done: List[Request]):
         if (self._tracer.enabled and req.trace is not None
@@ -616,6 +805,9 @@ class ServingEngine:
         requests and the cache pool are untouched."""
         self.records.clear()
         self.finished.clear()
+        self._spec_steps = 0
+        self._window_draft_tokens = 0
+        self._window_accepted_tokens = 0
         self.sched.reset_stats()
 
     def stats(self) -> dict:
@@ -636,10 +828,29 @@ class ServingEngine:
                 "window_hit_rate": round(hit_toks / prompt_toks, 4)
                 if prompt_toks else 0.0,
             }
+        spec_stats = None
+        if self._proposer is not None:
+            # window counters, not the bounded records deque: a long
+            # run past the deque's maxlen must not decay the ratios
+            drafts = self._window_draft_tokens
+            acc = self._window_accepted_tokens
+            spec_stats = {
+                "proposer": self._proposer.name,
+                "num_speculative_tokens": self.spec_k,
+                "draft_tokens": drafts,
+                "accepted_tokens": acc,
+                "acceptance_rate": round(acc / drafts, 4)
+                if drafts else None,
+                # aggregate extra tokens ONE verify dispatch bought,
+                # over the stats window — the headline speculation win
+                "accepted_tokens_per_step": round(acc / self._spec_steps, 4)
+                if self._spec_steps else None,
+            }
         s = self.sched.stats
         total = max(1, s["submitted"])
         return {
             "prefix_cache": prefix_stats,
+            "speculative": spec_stats,
             "finished": s["finished"], "shed": s["shed"],
             "shed_reasons": dict(s["shed_reasons"]),
             "shed_rate": round(s["shed"] / total, 4),
@@ -660,6 +871,7 @@ class ServingEngine:
         self._chunk_fns.clear()
         self._decode_fn = None
         self._cow_fn = None
+        self._verify_fn = None
         self.cache = None
         if self._owns_engine:
             self.engine.destroy()

@@ -54,6 +54,10 @@ class Request:
     # (src, dst) pool blocks: dst must receive a device copy of src's
     # rows before any append (partial-tail copy-on-write), or None
     cow: Optional[tuple] = None
+    # ---- speculative-decoding accounting (advanced by the engine's
+    # verify step; zero when speculation is off or never proposed) ----
+    draft_tokens: int = 0             # proposer tokens sent to verify
+    accepted_tokens: int = 0          # drafts the target model agreed with
     # ---- span-tracing context (telemetry/tracing.py) ----
     # {"trace": id, "parent": span id, ...}: set by the serving engine at
     # submit (tracing enabled), or stamped by the multi-replica router so
@@ -94,4 +98,9 @@ class Request:
             "prefix_hit_tokens": self.prefix_hit_tokens,
             "blocks_shared": self.blocks_shared,
             "prefill_chunks": self.prefill_chunks,
+            "draft_tokens": self.draft_tokens,
+            "accepted_tokens": self.accepted_tokens,
+            "acceptance_rate": round(
+                self.accepted_tokens / self.draft_tokens, 4)
+            if self.draft_tokens else None,
         }

@@ -82,6 +82,18 @@ class ContinuousBatchingScheduler:
         dl = self._deadline_secs(req)
         return bool(dl) and (now - req.submit_ts) > dl
 
+    def speculative_budget(self, req: rq.Request, k: int) -> int:
+        """How many draft tokens a verify step may propose for ``req``:
+        ``k`` capped by (a) the emit budget — a verify step always emits
+        at least one non-speculative token, so only ``max_new - emitted
+        - 1`` drafts can ever be kept — and (b) the model window, so the
+        speculative write extent ``[length, length + n_p]`` never leaves
+        the admission-reserved block coverage. Proposing past either cap
+        is verify compute that can never commit."""
+        remaining = req.max_new_tokens - len(req.tokens)
+        window = self.max_len - req.length - 1
+        return max(0, min(int(k), remaining - 1, window))
+
     @property
     def pending(self) -> bool:
         return bool(self.queue) or any(r is not None for r in self.slots)

@@ -73,6 +73,9 @@ SPANS = (
     "prefill_chunk",  # one chunked/tail prefill program call
     "cow",            # copy-on-write block copy before a shared-tail append
     "decode",         # first generated token -> finish (one decode segment)
+    "draft",          # speculative proposer call (host-side, per request)
+    "verify",         # the shared k-token verify dispatch, per-request view
+    "spec_commit",    # accepted-prefix commit + rejected-tail drop
     "shed",           # admission/deadline shed (zero-work terminal span)
     # training step level: one trace per optimizer step
     "step",           # root — first observed phase -> step boundary

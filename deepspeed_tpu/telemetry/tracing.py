@@ -8,9 +8,11 @@ ride the stream:
 
 - **serving request traces** — one trace per request: submit -> queue ->
   admission -> each prefill chunk -> copy-on-write -> decode segment ->
-  finish/shed, and (behind the multi-replica router) one ``attempt``
-  subtree per replica dispatch, so a failover CONTINUES the same trace
-  on the survivor instead of starting a new one.
+  finish/shed (plus, under speculative decoding, per-step
+  ``draft``/``verify``/``spec_commit`` legs), and (behind the
+  multi-replica router) one ``attempt`` subtree per replica dispatch, so
+  a failover CONTINUES the same trace on the survivor instead of
+  starting a new one.
 - **training step traces** — one trace per optimizer step with phase
   children (``data``/``fwd_bwd``/``optimizer``/...) and an
   exposed-comm-fraction attribute (``telemetry/exposed_comm.py``).

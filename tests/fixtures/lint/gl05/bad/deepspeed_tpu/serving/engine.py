@@ -16,3 +16,10 @@ class ServingEngine:
             pass
         with self.telemetry.step_trace.phase("fwdbwd"):      # unregistered
             pass
+
+    def spec_step(self):
+        # speculative-decoding near-misses: the registered names are
+        # draft / verify / spec_commit — drift stays pinned
+        self._tracer.record_span("drafts", "t1", 0, 1)       # near-miss
+        with self._tracer.span("commit", "t1"):              # unregistered
+            pass

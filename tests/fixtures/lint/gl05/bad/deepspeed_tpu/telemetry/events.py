@@ -8,4 +8,5 @@ def make_event(kind, name, step, rank, data):
             "data": data}
 
 
-SPANS = ("request", "queue", "decode")
+SPANS = ("request", "queue", "decode", "draft", "verify",
+         "spec_commit")

@@ -19,3 +19,11 @@ class ServingEngine:
             pass
         with self.telemetry.step_trace.phase("queue"):
             pass
+
+    def spec_step(self):
+        # speculative decoding's registered span names
+        with self._tracer.span("draft", "t1"):
+            pass
+        self._tracer.record_span("verify", "t1", 0, 1)
+        with self._tracer.span("spec_commit", "t1"):
+            pass

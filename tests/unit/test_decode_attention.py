@@ -127,6 +127,8 @@ def _paged_dense_ref(q4, k_pool, v_pool, tables, lengths):
     ([0, 5], 1), ([7, 63], 1), ([64, 1], 1),       # boundary straddles
     ([32, 16], 1),                                  # exactly on boundaries
     ([0, 12], 4), ([60, 30], 4),                    # multi-query steps
+    ([0, 31, 64], 5),                               # verify shapes (k+1
+    ([3, 17, 40], 8),                               # rows, mixed depths)
 ])
 def test_paged_matches_dense_gather(lengths, tq):
     from deepspeed_tpu.ops.decode_attention import decode_attention_paged
@@ -138,6 +140,69 @@ def test_paged_matches_dense_gather(lengths, tq):
     ref = _paged_dense_ref(*args)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_verify_rows_equal_sequential_single_row_calls():
+    """The accept-oracle property at kernel level: row r of one
+    multi-query verify call computes the SAME attention a plain decode
+    call would at length + r — the prefix each draft token would have
+    seen decoded sequentially. This is what makes greedy k-token verify
+    an exact oracle rather than an approximation."""
+    from deepspeed_tpu.ops.decode_attention import decode_attention_paged
+
+    tq = 4
+    args = _paged_setup(2, [5, 37], tq, bs=32, mb=4, seed=1)
+    q4, k_pool, v_pool, tables, lens = args
+    with tpu_interpret_mode():
+        multi = np.asarray(decode_attention_paged(*args))
+    for r in range(tq):
+        with tpu_interpret_mode():
+            single = decode_attention_paged(q4[:, r:r + 1], k_pool, v_pool,
+                                            tables, lens + r)
+        np.testing.assert_allclose(multi[:, r:r + 1], np.asarray(single),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_verify_rejected_tail_rows_isolated():
+    """The no-copy drop's kernel-level guarantee: row r reads only keys
+    at positions <= lengths[b] + r, so scribbling the pool rows that
+    held a REJECTED speculative tail (positions past the accepted
+    prefix) leaves every accepted row's output bit-identical — dropping
+    the tail needs no copy, no zeroing, nothing."""
+    from deepspeed_tpu.ops.decode_attention import decode_attention_paged
+
+    bs, tq, length, accepted = 8, 4, 10, 1
+    q4, k_pool, v_pool, tables, lens = _paged_setup(1, [length], tq, bs=bs,
+                                                    mb=4, seed=3)
+    with tpu_interpret_mode():
+        out1 = np.asarray(decode_attention_paged(q4, k_pool, v_pool,
+                                                 tables, lens))
+    kp = np.asarray(k_pool).copy()
+    vp = np.asarray(v_pool).copy()
+    table = np.asarray(tables)[0]
+    for pos in range(length + accepted + 1, length + tq):
+        blk, off = table[pos // bs], pos % bs
+        kp[blk, off] = 7777.0
+        vp[blk, off] = -7777.0
+    with tpu_interpret_mode():
+        out2 = np.asarray(decode_attention_paged(q4, jnp.asarray(kp),
+                                                 jnp.asarray(vp),
+                                                 tables, lens))
+    # rows 0..accepted (the kept prefix + its correction row) untouched
+    np.testing.assert_array_equal(out1[:, :accepted + 1],
+                                  out2[:, :accepted + 1])
+
+
+def test_paged_verify_rejects_zero_rows():
+    from deepspeed_tpu.ops.decode_attention import (
+        decode_attention_paged, decode_attention_paged_int8)
+
+    q4, k_pool, v_pool, tables, lens = _paged_setup(1, [5], 1, bs=8, mb=4)
+    with pytest.raises(ValueError, match="query row"):
+        decode_attention_paged(q4[:, :0], k_pool, v_pool, tables, lens)
+    kq, vq, ks, vs = _int8_pools(k_pool, v_pool)
+    with pytest.raises(ValueError, match="query row"):
+        decode_attention_paged_int8(q4[:, :0], kq, vq, ks, vs, tables, lens)
 
 
 def test_paged_cache_index_exactly_on_block_boundary():
@@ -246,7 +311,8 @@ def _int8_pools(k_pool, v_pool):
 
 
 @pytest.mark.parametrize("lengths,tq", [([0, 5], 1), ([7, 63], 1),
-                                        ([60, 30], 4)])
+                                        ([60, 30], 4),
+                                        ([0, 23, 57], 5)])  # verify shapes
 def test_paged_int8_kernel_matches_dequant_oracle(lengths, tq):
     """The int8 kernel dequantizes inside the block DMA; the dense
     gather-dequantize oracle must agree to fp32 round-off — both read

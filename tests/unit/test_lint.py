@@ -159,8 +159,10 @@ class TestGL05:
         found = [f for f in by_code(fixture_run("gl05", "bad"), "GL05")
                  if "unregistered span name" in f.message]
         names = {f.message.split("'")[1] for f in found}
-        assert names == {"prefil", "dequeue", "warmup", "fwdbwd"}
-        assert all("request, queue, decode" in f.message for f in found)
+        assert names == {"prefil", "dequeue", "warmup", "fwdbwd",
+                         "drafts", "commit"}
+        assert all("request, queue, decode, draft, verify, spec_commit"
+                   in f.message for f in found)
 
     def test_dynamic_kind_not_flagged(self):
         # the good corpus includes registered span names, a DYNAMIC span

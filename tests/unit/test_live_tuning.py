@@ -78,6 +78,74 @@ class TestArtifact:
         assert apply_section({}, art, "comm_quantization") == {
             "bucket_bytes": 4 * MiB}
 
+    def test_nested_submodel_target_expands_and_merges(self, tmp_path):
+        """A sub-model target ("serving.speculative.num_speculative_
+        tokens") must expand into the nested block shape the pydantic
+        config parses, and apply_section must fill INSIDE a user block
+        without stomping the user's explicit sub-keys."""
+        _, art = _artifact(tmp_path, axes={
+            "serving.num_speculative_tokens": {
+                "target": "serving.speculative.num_speculative_tokens",
+                "value": 8, "objective": "spec_tokens_per_sec",
+                "minimize": False, "score": 100.0, "evidence": []}})
+        assert section_choices(art, "serving") == {
+            "speculative": {"enabled": True, "num_speculative_tokens": 8}}
+        # no user block: the whole nested choice fills in
+        assert apply_section({}, art, "serving") == {
+            "speculative": {"enabled": True, "num_speculative_tokens": 8}}
+        # user block present: artifact fills only missing sub-keys
+        merged = apply_section(
+            {"speculative": {"proposer": "prompt_lookup"}}, art, "serving")
+        assert merged == {"speculative": {"proposer": "prompt_lookup",
+                                          "enabled": True,
+                                          "num_speculative_tokens": 8}}
+        # explicit user sub-key beats the artifact, one level down
+        merged = apply_section(
+            {"speculative": {"num_speculative_tokens": 2}}, art, "serving")
+        assert merged["speculative"]["num_speculative_tokens"] == 2
+
+    def test_sibling_nested_targets_merge_not_clobber(self, tmp_path):
+        """Two axes under the same nested block must BOTH apply —
+        dict.update clobbering would silently drop one tuned choice."""
+        _, art = _artifact(tmp_path, axes={
+            "serving.num_speculative_tokens": {
+                "target": "serving.speculative.num_speculative_tokens",
+                "value": 8, "objective": "spec_tokens_per_sec",
+                "minimize": False, "score": 100.0, "evidence": []},
+            "serving.prompt_lookup_max_ngram": {
+                "target": "serving.speculative.prompt_lookup_max_ngram",
+                "value": 2, "objective": "spec_tokens_per_sec",
+                "minimize": False, "score": 90.0, "evidence": []}})
+        assert section_choices(art, "serving") == {
+            "speculative": {"enabled": True, "num_speculative_tokens": 8,
+                            "prompt_lookup_max_ngram": 2}}
+
+    def test_spec_decode_axis_registered(self):
+        axis = get_axis("serving.num_speculative_tokens")
+        assert axis.bench == "decode" and axis.series == "spec_decode"
+        assert axis.objective == "spec_tokens_per_sec"
+        assert axis.series_config(8) == {"serving": {"speculative": {
+            "enabled": True, "num_speculative_tokens": 8}}}
+        # the machinery-off candidate is IN the grid (comm.tier
+        # convention): switching speculation on at all is measured
+        assert "off" in axis.grid
+        assert axis.series_config("off") == {"serving": {
+            "speculative": {"enabled": False}}}
+
+    def test_spec_off_choice_disables_instead_of_enabling(self, tmp_path):
+        """An artifact whose measured winner was "off" must apply as
+        enabled:false — never switch the verify program on behind a
+        config that did not ask for it and whose workload lost."""
+        _, art = _artifact(tmp_path, axes={
+            "serving.num_speculative_tokens": {
+                "target": "serving.speculative.num_speculative_tokens",
+                "value": "off", "objective": "spec_tokens_per_sec",
+                "minimize": False, "score": 50.0, "evidence": []}})
+        assert section_choices(art, "serving") == {
+            "speculative": {"enabled": False}}
+        assert apply_section({}, art, "serving") == {
+            "speculative": {"enabled": False}}
+
     def test_paired_tiles_target_expands_to_kernel_keys(self, tmp_path):
         """The flash tiles axis records ONE paired choice; consumption
         must expand it into the two per-key registry entries the kernel

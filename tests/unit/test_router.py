@@ -973,6 +973,133 @@ class TestRouterConfigValidation:
 
 
 # ---------------------------------------------------------------------------
+# speculative decoding x failover: the exactly-once splice over
+# multi-token verify commits
+# ---------------------------------------------------------------------------
+class SpecFakeReplica(FakeReplica):
+    """A FakeReplica whose step() commits a BATCH of tokens per request
+    (a speculative verify step's accepted window) — same deterministic
+    ``_greedy`` stream, ``spec_batch`` positions at once.
+
+    ``crash_after_partial=(step, j)``: on that step the first stepped
+    request delivers exactly ``j`` tokens of its window and then the
+    replica dies — ``j = 0`` is the killed-between-draft-and-commit
+    case (nothing from the window was ever delivered), ``j > 0`` is a
+    death mid-stream after a partial commit reached the client. Either
+    way the dedupe splice must deliver every position exactly once."""
+
+    def __init__(self, spec_batch=3, crash_after_partial=None, **kw):
+        super().__init__(**kw)
+        self.spec_batch = int(spec_batch)
+        self.crash_after_partial = crash_after_partial
+
+    def step(self):
+        self.steps += 1
+        while self.queue and len(self.running) < self.slots:
+            head = self.queue.pop(0)
+            head.state = rq.RUNNING
+            self.running.append(head)
+        for req in list(self.running):
+            for j in range(self.spec_batch):
+                if (self.crash_after_partial is not None
+                        and self.steps == self.crash_after_partial[0]
+                        and j >= self.crash_after_partial[1]):
+                    raise ReplicaCrashed(
+                        f"chaos: died mid-verify at step {self.steps} "
+                        f"after {j} committed token(s)")
+                pos = len(req.tokens)
+                tok = self._token(req, pos)
+                done = (tok == req.eos_token_id
+                        or pos + 1 >= req.max_new_tokens)
+                req.emit_token(tok, done)
+                if done:
+                    req.state = rq.FINISHED
+                    req.finish_reason = ("eos" if tok == req.eos_token_id
+                                         else "max_tokens")
+                    self.running.remove(req)
+                    break
+
+
+class TestSpeculativeFailoverSplice:
+    def _run(self, crash_after_partial, max_new=7):
+        spec = SpecFakeReplica(spec_batch=3,
+                               crash_after_partial=crash_after_partial)
+        router = _router([spec, FakeReplica()])
+        seen = []
+        r = router.submit([1, 2], max_new_tokens=max_new,
+                          stream=lambda req, tok, done: seen.append(tok))
+        router.drain(max_steps=40)
+        return router, r, seen
+
+    def test_killed_between_draft_and_commit_replays_cleanly(self):
+        """The ISSUE case: the replica dies after its verify dispatch
+        but before ANY token of the window commits (step 2, 0 tokens
+        delivered). Only the verify-COMMITTED tokens of step 1 count as
+        delivered: the survivor replays exactly those and continues —
+        no speculative token is replayed to the client, none skipped."""
+        router, r, seen = self._run(crash_after_partial=(2, 0))
+        expected = [_greedy([1, 2], p) for p in range(7)]
+        assert r.state == rq.FINISHED and r.tokens == expected
+        assert seen == expected  # each position exactly once, in order
+        st = router.stats()
+        assert st["failovers"] == 1
+        # step 1 committed+delivered 3 tokens; the survivor's replay of
+        # them is swallowed by the position splice, not re-streamed
+        assert st["deduped_tokens"] == 3
+        assert st["replay_divergence"] == 0
+
+    def test_killed_mid_commit_partial_window_exactly_once(self):
+        """Death mid-stream AFTER part of a window reached the client
+        (step 2 delivered 2 of 3): delivered-tokens accounting must
+        count exactly the 5 streamed positions — the survivor (a plain
+        one-token-per-step replica: window shapes may differ across
+        replicas) dedupes all 5 and streams the rest once."""
+        router, r, seen = self._run(crash_after_partial=(2, 2))
+        expected = [_greedy([1, 2], p) for p in range(7)]
+        assert r.state == rq.FINISHED and r.tokens == expected
+        assert seen == expected
+        st = router.stats()
+        assert st["deduped_tokens"] == 5  # 3 (step 1) + 2 (partial)
+        assert st["replay_divergence"] == 0
+
+    def test_spec_to_spec_failover_window_boundaries_differ(self):
+        """Survivor is ALSO speculative but with a different window
+        size: batch boundaries shift across the splice, positions must
+        not — the dedupe is positional, never window-shaped."""
+        dying = SpecFakeReplica(spec_batch=4,
+                                crash_after_partial=(2, 1))
+        survivor = SpecFakeReplica(spec_batch=2)
+        router = _router([dying, survivor])
+        seen = []
+        r = router.submit([3, 4, 5], max_new_tokens=9,
+                          stream=lambda req, tok, done: seen.append(tok))
+        router.drain(max_steps=40)
+        expected = [_greedy([3, 4, 5], p) for p in range(9)]
+        assert r.tokens == expected and seen == expected
+        assert router.stats()["deduped_tokens"] == 5  # 4 + 1 partial
+        assert router.stats()["replay_divergence"] == 0
+
+    def test_multi_request_spec_crash_all_streams_exactly_once(self):
+        """Several in-flight requests at different window offsets when
+        the replica dies: every stream splices independently."""
+        dying = SpecFakeReplica(slots=3, spec_batch=3,
+                                crash_after_partial=(3, 0))
+        router = _router([dying, FakeReplica(slots=3)])
+        prompts = [[1], [2, 3], [4, 5, 6]]
+        seen = {i: [] for i in range(len(prompts))}
+        reqs = []
+        for i, p in enumerate(prompts):
+            cb = (lambda ix: lambda r, t, d: seen[ix].append(t))(i)
+            reqs.append(router.submit(p, max_new_tokens=8, stream=cb))
+        router.drain(max_steps=60)
+        for i, (p, r) in enumerate(zip(prompts, reqs)):
+            expected = [_greedy(p, pos) for pos in range(8)]
+            assert r.state == rq.FINISHED and r.tokens == expected, i
+            assert seen[i] == expected, i
+        assert router.stats()["replay_divergence"] == 0
+
+
+# ---------------------------------------------------------------------------
 # tooling: telemetry report + import hygiene
 # ---------------------------------------------------------------------------
 class TestTelemetryReportRouterSection:
@@ -1143,6 +1270,58 @@ class TestRouterOverRealEngines:
             assert req.tokens == clean.tokens
             assert streams[i] == clean_streams[i] == req.tokens
         assert router.stats()["replay_divergence"] == 0
+
+    def test_spec_replica_killed_between_draft_and_commit(self):
+        """Chaos regression for the speculative x failover interplay: a
+        speculating replica dies at the serving.spec_commit seam — AFTER
+        its verify dispatch, BEFORE any token of the window commits.
+        Because the engine emits only verify-committed tokens, the
+        exactly-once splice counts none of the dead window as delivered:
+        the survivor replays the committed prefix (deduped, bit-checked)
+        and streams the rest once, bit-identical to an unfaulted run."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        import jax.numpy as jnp
+
+        spec_serving = {"block_size": 8, "decode_slots": 2,
+                        "default_max_new_tokens": 4,
+                        "speculative": {"num_speculative_tokens": 3}}
+        _, ref = _tiny_engine()
+        _, e0 = _tiny_engine(serving=spec_serving)
+        _, e1 = _tiny_engine(serving=spec_serving)
+        e0.params = ref.params
+        e1.params = ref.params
+        rng = np.random.default_rng(11)
+        motif = rng.integers(1, 256, 4)
+        # repetitive prompts keep the proposer busy: real accepted
+        # windows are in flight when the chaos fires
+        prompts = [np.tile(motif, 4)[:14], rng.integers(1, 256, 7)]
+        news = [6, 5]
+        expected = []
+        for p, n in zip(prompts, news):
+            out = ref.generate(jnp.asarray(np.asarray(p)[None]),
+                               max_new_tokens=n, do_sample=False)
+            expected.append([int(t) for t in out[0, len(p):]])
+        router = ReplicaRouter(
+            [ServingEngine(e0),
+             ChaosReplica(ServingEngine(e1),
+                          crash_between_draft_and_commit=2)],
+            config={"max_failovers": 2})
+        seen = {i: [] for i in range(len(prompts))}
+        reqs = []
+        for i, (p, n) in enumerate(zip(prompts, news)):
+            cb = (lambda ix: lambda r, t, d: seen[ix].append(t))(i)
+            reqs.append(router.submit(p, max_new_tokens=n, stream=cb))
+        router.drain(max_steps=200)
+        st = router.stats()
+        assert st["failovers"] >= 1, st
+        for i, (req, exp) in enumerate(zip(reqs, expected)):
+            assert req.state == rq.FINISHED, (i, req.finish_reason)
+            assert req.tokens == exp, i       # bit-identical stream
+            assert seen[i] == exp, i          # each position exactly once
+        assert st["replay_divergence"] == 0
+        assert st["replica_states"][1] == "dead"
+        router.destroy()
 
     def test_init_serving_builds_router_from_config(self):
         import deepspeed_tpu

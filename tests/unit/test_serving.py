@@ -1086,6 +1086,251 @@ class TestServingFastPath:
 
 
 # ---------------------------------------------------------------------------
+# speculative decoding: the k-token verify program (heavy)
+# ---------------------------------------------------------------------------
+_SPEC = {**_SERVING, "speculative": {"num_speculative_tokens": 3}}
+
+
+class _AdversarialProposer:
+    """Deterministic mixed-quality proposer: cycles between a full-junk
+    window, a loop-guess with a poisoned tail (partial accept), and no
+    proposal at all — every accept/reject commit path runs."""
+
+    name = "adversarial"
+
+    def __init__(self):
+        self.rng = np.random.default_rng(9)
+        self.n = 0
+
+    def propose(self, req, k):
+        self.n += 1
+        if self.n % 3 == 0:
+            return [int(self.rng.integers(1, 256)) for _ in range(k)]
+        if self.n % 3 == 1 and req.tokens:
+            return [int(req.tokens[-1])] * (k - 1) + [255]
+        return []
+
+
+@pytest.mark.heavy
+class TestSpeculativeDecoding:
+    def _ref_tokens(self, engine, prompt, n):
+        import jax.numpy as jnp
+
+        _, ref = _tiny_serving()
+        ref.params = engine.params
+        out = ref.generate(jnp.asarray(np.asarray(prompt)[None]),
+                           max_new_tokens=n, do_sample=False)
+        return [int(t) for t in out[0, len(prompt):]]
+
+    def test_bit_exact_staggered_mixed_accept_reject(self):
+        """THE acceptance proof: speculative decode emits the identical
+        token stream as non-speculative generate() for every request,
+        under staggered continuous batching and an adversarial proposer
+        that forces full-accept, partial-accept, full-reject and
+        no-proposal verify steps."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, engine = _tiny_serving(serving=_SPEC)
+        srv = ServingEngine(engine)
+        srv._proposer = _AdversarialProposer()
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, 256, n) for n in (5, 11, 3, 8, 16)]
+        news = [6, 4, 5, 3, 8]
+        reqs = [srv.submit(prompts[0], max_new_tokens=news[0]),
+                srv.submit(prompts[1], max_new_tokens=news[1])]
+        srv.step()
+        srv.step()
+        for p, n in zip(prompts[2:], news[2:]):
+            reqs.append(srv.submit(p, max_new_tokens=n))
+            srv.step()
+        srv.drain()
+        for req, p, n in zip(reqs, prompts, news):
+            assert req.state == FINISHED, (req.state, req.finish_reason)
+            assert req.tokens == self._ref_tokens(engine, p, n), \
+                req.request_id
+        st = srv.stats()["speculative"]
+        # the adversarial mix really drove both branches
+        assert st["draft_tokens"] > 0
+        assert 0 < st["acceptance_rate"] < 1, st
+        # pool fully clean: every window closed, every block returned
+        assert srv.block_mgr.num_free == srv.num_blocks - 1
+        assert not srv.block_mgr._spec_base
+
+    def test_prompt_lookup_acceptance_and_trace_spans(self):
+        """Prompt lookup on a repetitive workload accepts drafts (the
+        speedup's substrate), per-request records carry the speculation
+        fields, and the request trace gains draft/verify/spec_commit
+        legs."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, engine = _tiny_serving(
+            serving=_SPEC,
+            telemetry={"enabled": True, "jsonl": False, "memory": False,
+                       "compile_watchdog": False,
+                       "tracing": {"enabled": True}})
+        srv = ServingEngine(engine)
+        rng = np.random.default_rng(1)
+        motif = rng.integers(1, 256, 4)
+        prompt = np.tile(motif, 5)[:18]
+        req = srv.submit(prompt, max_new_tokens=8)
+        srv.drain()
+        assert req.state == FINISHED
+        assert req.tokens == self._ref_tokens(engine, prompt, 8)
+        assert req.draft_tokens > 0 and req.accepted_tokens > 0
+        rec = req.record()
+        assert rec["draft_tokens"] == req.draft_tokens
+        assert rec["acceptance_rate"] == pytest.approx(
+            req.accepted_tokens / req.draft_tokens, abs=1e-3)
+        spans = {e["name"] for e in engine.telemetry.tail(200)
+                 if e["kind"] == "span"}
+        assert {"draft", "verify", "spec_commit"} <= spans, spans
+        # fewer verify dispatches than tokens: the win, measured
+        assert srv._spec_steps < len(req.tokens)
+
+    def test_zero_steady_state_retraces_with_verify_program(self):
+        """Acceptance: the verify program (k static, proposals
+        right-padded) compiles once — steady-state speculative traffic
+        holds the compile-watchdog zero-retrace pin."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, engine = _tiny_serving(
+            serving=_SPEC,
+            telemetry={"enabled": True, "compile_watchdog": True,
+                       "jsonl": False, "memory": False, "warmup_steps": 1})
+        srv = ServingEngine(engine)
+        rng = np.random.default_rng(2)
+        for n in (5, 13, 30, 60):
+            srv.submit(rng.integers(1, 256, n), max_new_tokens=3)
+        srv.drain()
+        warm = {k: dict(v) for k, v in
+                engine.telemetry.summary()["per_function"].items()}
+        assert "serving.verify" in warm
+        assert "serving.decode" not in warm  # verify REPLACES decode
+        for i, n in enumerate((3, 7, 9, 20, 33, 50, 6, 15)):
+            srv.submit(rng.integers(1, 256, n), max_new_tokens=4)
+            srv.step()
+        srv.drain()
+        after = engine.telemetry.summary()["per_function"]
+        for fam in ("serving.verify", "serving.prefill"):
+            assert after[fam]["compiles"] == warm[fam]["compiles"], \
+                (fam, warm[fam], after[fam])
+            assert after[fam]["retraces_after_warm"] == \
+                warm[fam]["retraces_after_warm"]
+
+    def test_decode_hlo_byte_identical_without_speculative(self):
+        """Acceptance (zero-overhead pin): with the speculative block
+        absent OR disabled, the compiled decode program is byte-identical
+        — and an enabled engine still lowers the identical decode
+        program (speculation only swaps which program the step loop
+        dispatches)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.serving import ServingEngine
+
+        texts = []
+        for extra in ({}, {"speculative": {"enabled": False}},
+                      {"speculative": {"num_speculative_tokens": 3}}):
+            _, engine = _tiny_serving(serving={**_SERVING, **extra})
+            srv = ServingEngine(engine)
+            fn = srv._build_decode()
+            tokens = jnp.zeros((srv.config.decode_slots, 1), jnp.int32)
+            tables = jnp.zeros((srv.config.decode_slots,
+                                srv.blocks_per_seq), jnp.int32)
+            lengths = jnp.zeros((srv.config.decode_slots,), jnp.int32)
+            lowered = fn.lower(engine.params, srv.cache, tokens, tables,
+                               lengths, jax.random.PRNGKey(0))
+            texts.append(lowered.compile().as_text())
+            srv.destroy()
+        assert texts[0] == texts[1] == texts[2]
+        # feature-off serving never builds the verify program and a
+        # disabled block behaves exactly like an absent one
+        _, engine = _tiny_serving(
+            serving={**_SERVING, "speculative": {"enabled": False}})
+        srv = ServingEngine(engine)
+        srv.submit(np.arange(1, 10), max_new_tokens=3)
+        srv.drain()
+        assert srv._verify_fn is None and srv._proposer is None
+        assert srv._decode_fn is not None
+
+    def test_draft_model_proposer_end_to_end(self):
+        """The draft-model path: a second engine with the SAME params is
+        a perfect draft (its full-context greedy tokens ARE the
+        target's), so EVERY proposal accepts and the stream still
+        bit-matches. Exercises the .generate duck-typing plumbing."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, draft_engine = _tiny_serving(serving={"block_size": 8})
+        _, engine = _tiny_serving(serving={
+            **_SERVING,
+            "speculative": {"proposer": "draft_model",
+                            "num_speculative_tokens": 2}})
+        engine.params = draft_engine.params
+        srv = ServingEngine(engine, draft_model=draft_engine)
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, 256, n) for n in (5, 9)]
+        reqs = [srv.submit(p, max_new_tokens=4) for p in prompts]
+        srv.drain()
+        for req, p in zip(reqs, prompts):
+            assert req.state == FINISHED
+            assert req.tokens == self._ref_tokens(engine, p, 4)
+        st = srv.stats()["speculative"]
+        assert st["proposer"] == "draft_model"
+        assert st["draft_tokens"] > 0
+        assert st["acceptance_rate"] == 1.0, st  # the perfect draft
+
+    def test_chaos_seam_between_verify_and_commit_is_replayable(self):
+        """A fault at the serving.spec_commit seam (the ChaosReplica
+        kill point) loses the whole window — nothing was emitted, host
+        state is the pre-step state, and simply stepping again produces
+        the identical stream. The engine-side half of the router's
+        exactly-once contract."""
+        from deepspeed_tpu.runtime.resilience import chaos
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, engine = _tiny_serving(serving=_SPEC)
+        srv = ServingEngine(engine)
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(1, 256, 6)
+        req = srv.submit(prompt, max_new_tokens=5)
+        srv.step()  # admit + prefill + first verify step
+        emitted_before = list(req.tokens)
+        with chaos.io_errors("serving.spec_commit", at_call=1,
+                             exc=chaos.ReplicaCrashed):
+            with pytest.raises(chaos.ReplicaCrashed):
+                srv.step()
+        # the killed window emitted nothing; its ledger windows may stay
+        # open but granted nothing (worst-case reservation), so a retry
+        # re-speculates from the SAME committed base
+        assert req.tokens == emitted_before
+        for rid, base in srv.block_mgr._spec_base.items():
+            assert base == len(srv.block_mgr._owned[rid])
+        srv.drain()  # retry from the same committed state
+        assert req.state == FINISHED
+        assert req.tokens == self._ref_tokens(engine, prompt, 5)
+
+    def test_int8_kv_speculative_agreement(self):
+        """Speculation composes with int8 paged KV: the verify program
+        writes the identical quantized rows sequential decode would, so
+        spec and non-spec int8 engines agree token for token."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, engine = _tiny_serving(serving={**_SERVING,
+                                           "kv_cache_dtype": "int8"})
+        srv = ServingEngine(engine)
+        _, engine_s = _tiny_serving(serving={**_SPEC,
+                                             "kv_cache_dtype": "int8"})
+        engine_s.params = engine.params
+        srv_s = ServingEngine(engine_s)
+        rng = np.random.default_rng(5)
+        motif = rng.integers(1, 256, 4)
+        prompts = [np.tile(motif, 4)[:13], rng.integers(1, 256, 7)]
+        toks = srv.generate_batch(prompts, max_new_tokens=4)
+        toks_s = srv_s.generate_batch(prompts, max_new_tokens=4)
+        assert toks == toks_s, (toks, toks_s)
+
+
+# ---------------------------------------------------------------------------
 # legacy generate() bucketing satellite + zero-drift guard
 # ---------------------------------------------------------------------------
 @pytest.mark.heavy
@@ -1191,7 +1436,9 @@ class TestTelemetryReportServingSection:
         evs = [
             make_event("serving", "request.finish", 1, 0,
                        {"prompt_len": 20, "prefix_hit_tokens": 0,
-                        "blocks_shared": 0, "prefill_chunks": 3}),
+                        "blocks_shared": 0, "prefill_chunks": 3,
+                        "draft_tokens": 12, "accepted_tokens": 9,
+                        "acceptance_rate": 0.75}),
             make_event("serving", "request.finish", 2, 0,
                        {"prompt_len": 20, "prefix_hit_tokens": 16,
                         "blocks_shared": 2, "prefill_chunks": 1}),
@@ -1219,13 +1466,27 @@ class TestTelemetryReportServingSection:
         assert agg["blocks_shared"] == 2
         assert agg["prefill_chunks"] == 4
         assert agg["last_gauges"]["cached_blocks"] == 3
+        # speculation column: drafts/accepted roll up, speculating
+        # requests are counted apart from non-speculating ones
+        assert agg["draft_tokens"] == 12 and agg["accepted_tokens"] == 9
+        assert agg["spec_requests"] == 1
         text = render(path)
         assert "serving: 2 finished, 1 shed, 4 prefill chunks" in text
         assert "1/2 requests hit" in text
         assert "16/40 prompt tokens served from cache (40.0%)" in text
+        assert "speculation: 1/2 requests speculated, " \
+            "9/12 draft tokens accepted (75.0%)" in text
         assert "5 free blocks, 3 cached" in text
         md = render(path, markdown=True)
         assert "### serving:" in md
+        assert "draft tokens accepted" in md
+        import json as _json
+        from tools.telemetry_report import aggregate as _agg
+
+        from deepspeed_tpu.telemetry.events import load_events as _load
+
+        payload = _json.loads(_json.dumps(_agg(_load(path))["serving"]))
+        assert payload["draft_tokens"] == 12  # --json carries the column
 
     def test_empty_stream_renders_no_serving_section(self, tmp_path):
         from tools.telemetry_report import render

@@ -1,0 +1,444 @@
+"""Speculative decoding, host tier (tier-1: no jax, milliseconds).
+
+Four layers, mirroring the module split:
+
+- proposers (``serving/spec_decode.py``): prompt-lookup n-gram matching
+  (recency + full-window preference), the draft-model wrapper, the
+  config-driven factory;
+- config: the ``serving.speculative`` block's validation, including the
+  greedy-only contract (speculation has no accept oracle under
+  sampling);
+- scheduler policy: the per-step draft budget (emit budget + model
+  window caps);
+- block manager: the speculative ledger (grant / commit-accepted /
+  drop-rejected without copies) and the randomized
+  scheduler/blocks/prefix fuzz extended with speculate/commit/drop ops
+  — refcount / free-list / evictable / ``committed_tokens`` mutual
+  consistency under speculation.
+
+The device half (the compiled verify program, greedy bit-exactness,
+zero-retrace and HLO pins) lives in tests/unit/test_serving.py.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving.blocks import BlockManager
+from deepspeed_tpu.serving.config import ServingConfig, SpeculativeConfig
+from deepspeed_tpu.serving.request import Request
+from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.serving.spec_decode import (DraftModelProposer,
+                                               PromptLookupProposer,
+                                               build_proposer)
+
+
+def _req(prompt, tokens=(), max_new=8):
+    r = Request(prompt=list(prompt), max_new_tokens=max_new)
+    r.tokens = list(tokens)
+    return r
+
+
+# ---------------------------------------------------------------------------
+# prompt-lookup proposer
+# ---------------------------------------------------------------------------
+class TestPromptLookup:
+    def test_matches_repeated_ngram_continuation(self):
+        p = PromptLookupProposer(min_ngram=1, max_ngram=3)
+        # context ...[5,6,7]...[5,6,7]: suffix trigram [5,6,7] matched
+        # at its earlier occurrence, continuation [8, 9, 1] follows it
+        req = _req([5, 6, 7, 8, 9, 1, 5, 6, 7])
+        assert p.propose(req, 3) == [8, 9, 1]
+        assert p.propose(req, 2) == [8, 9]
+
+    def test_generated_tokens_are_part_of_the_context(self):
+        p = PromptLookupProposer()
+        # the suffix lives in the GENERATED tail; its match is in the
+        # prompt — assisted generation over the request's whole history
+        req = _req([1, 2, 3, 4, 5], tokens=[2, 3])
+        assert p.propose(req, 2) == [4, 5]
+
+    def test_longest_ngram_wins(self):
+        p = PromptLookupProposer(min_ngram=1, max_ngram=3)
+        # bigram [2,3] occurs twice with different continuations; the
+        # trigram [1,2,3] is unique to the first — trigram evidence wins
+        req = _req([1, 2, 3, 7, 9, 2, 3, 8, 1, 2, 3])
+        assert p.propose(req, 1) == [7]
+
+    def test_prefers_match_with_full_k_continuation(self):
+        p = PromptLookupProposer(min_ngram=1, max_ngram=2)
+        # period-1 loop: the most recent self-adjacent match can offer
+        # only a truncated continuation — the proposer must keep
+        # scanning left for a full-k window (the acceptance-per-step
+        # difference between ~1 and ~k on looping generations)
+        req = _req([4] * 8)
+        assert p.propose(req, 4) == [4, 4, 4, 4]
+
+    def test_falls_back_to_truncated_continuation(self):
+        p = PromptLookupProposer(min_ngram=2, max_ngram=2)
+        # one earlier occurrence only, with a single following token
+        req = _req([9, 1, 2, 5, 1, 2])
+        assert p.propose(req, 4) == [5, 1, 2]
+
+    def test_no_match_proposes_nothing(self):
+        p = PromptLookupProposer(min_ngram=2, max_ngram=3)
+        assert p.propose(_req([1, 2, 3, 4, 5, 6]), 4) == []
+        assert p.propose(_req([1]), 4) == []          # too short
+        assert p.propose(_req([1, 2, 1, 2]), 0) == []  # no budget
+
+    def test_lookback_window_bounds_the_scan(self):
+        """The scan is host Python on the step-critical path: `window`
+        caps it to the trailing tokens — a match that only exists
+        further back is (by design) not found."""
+        p = PromptLookupProposer(min_ngram=2, max_ngram=2, window=6)
+        req = _req([7, 8, 50, 1, 2, 3, 4, 5, 7, 8])
+        assert p.propose(req, 2) == []          # match at pos 0: too far
+        assert PromptLookupProposer(min_ngram=2, max_ngram=2).propose(
+            req, 1) == [50]                     # unbounded finds it
+
+    def test_ngram_bounds_validated(self):
+        with pytest.raises(ValueError):
+            PromptLookupProposer(min_ngram=0)
+        with pytest.raises(ValueError):
+            PromptLookupProposer(min_ngram=3, max_ngram=2)
+        with pytest.raises(ValueError):
+            PromptLookupProposer(window=-1)
+
+
+# ---------------------------------------------------------------------------
+# draft-model proposer + factory
+# ---------------------------------------------------------------------------
+class TestDraftModel:
+    def test_callable_draft_with_context_window(self):
+        seen = {}
+
+        def draft(ctx, k):
+            seen["ctx"], seen["k"] = list(ctx), k
+            return [100 + i for i in range(k + 2)]  # over-long: clipped
+
+        p = DraftModelProposer(draft, context_window=3)
+        req = _req([1, 2, 3, 4, 5], tokens=[6, 7])
+        assert p.propose(req, 3) == [100, 101, 102]
+        assert seen["ctx"] == [5, 6, 7] and seen["k"] == 3
+
+    def test_generate_surface_duck_types(self):
+        class FakeEngine:
+            def generate(self, ids, max_new_tokens=0, do_sample=True):
+                assert do_sample is False  # greedy drafts only
+                row = list(ids[0])
+                return [row + [9] * max_new_tokens]
+
+        p = DraftModelProposer(FakeEngine())
+        assert p.propose(_req([1, 2, 3]), 2) == [9, 9]
+
+    def test_rejects_non_draft(self):
+        with pytest.raises(ValueError):
+            DraftModelProposer(None)
+        with pytest.raises(ValueError):
+            DraftModelProposer(object())
+
+    def test_factory_routes_and_validates(self):
+        cfg = SpeculativeConfig(proposer="prompt_lookup",
+                                prompt_lookup_max_ngram=2)
+        p = build_proposer(cfg)
+        assert isinstance(p, PromptLookupProposer) and p.max_ngram == 2
+        assert build_proposer(None) is None
+        assert build_proposer(SpeculativeConfig(enabled=False)) is None
+        with pytest.raises(ValueError):
+            build_proposer(SpeculativeConfig(proposer="draft_model"))
+        p2 = build_proposer(SpeculativeConfig(proposer="draft_model",
+                                              draft_context_window=5),
+                            draft_model=lambda ctx, k: [])
+        assert isinstance(p2, DraftModelProposer)
+        assert p2.context_window == 5
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+class TestSpeculativeConfig:
+    def test_defaults_off_and_block_validation(self):
+        assert ServingConfig().speculative is None  # absent = not a thing
+        cfg = ServingConfig(speculative={"num_speculative_tokens": 6})
+        assert cfg.speculative.enabled
+        assert cfg.speculative.proposer == "prompt_lookup"
+        assert cfg.speculative.num_speculative_tokens == 6
+        with pytest.raises(ValueError):
+            SpeculativeConfig(num_speculative_tokens=0)
+        with pytest.raises(ValueError):
+            SpeculativeConfig(proposer="medusa")
+        with pytest.raises(ValueError):
+            SpeculativeConfig(prompt_lookup_min_ngram=0)
+        with pytest.raises(ValueError):
+            SpeculativeConfig(prompt_lookup_min_ngram=4,
+                              prompt_lookup_max_ngram=2)
+        with pytest.raises(ValueError):
+            SpeculativeConfig(draft_context_window=-1)
+
+    def test_speculation_requires_greedy(self):
+        """The accept oracle is the bit-reproducible greedy stream; a
+        sampling config has none, so the combination must refuse loudly
+        instead of silently changing outputs."""
+        with pytest.raises(ValueError):
+            ServingConfig(do_sample=True, speculative={})
+        # disabled block composes with sampling fine
+        assert ServingConfig(do_sample=True,
+                             speculative={"enabled": False}).do_sample
+
+
+# ---------------------------------------------------------------------------
+# scheduler policy: the per-step draft budget
+# ---------------------------------------------------------------------------
+class TestSpeculativeBudget:
+    def _sched(self, max_len=64):
+        cfg = ServingConfig(block_size=8, decode_slots=2)
+        return ContinuousBatchingScheduler(
+            cfg, BlockManager(17, 8, 8), max_len=max_len, clock=lambda: 0.0)
+
+    def test_emit_budget_cap(self):
+        sched = self._sched()
+        req = _req([1] * 4, tokens=[5], max_new=8)
+        req.length = 4
+        # 7 tokens left to emit; one is the step's own non-speculative
+        # token, so at most 6 drafts can ever commit
+        assert sched.speculative_budget(req, 4) == 4
+        assert sched.speculative_budget(req, 10) == 6
+        req.tokens = [5] * 7          # one token left: nothing to draft
+        assert sched.speculative_budget(req, 4) == 0
+
+    def test_model_window_cap(self):
+        sched = self._sched(max_len=16)
+        req = _req([1] * 4, tokens=[5], max_new=12)
+        req.length = 13
+        # write extent [length, length + n_p] must stay inside the
+        # admission-reserved coverage: 16 - 13 - 1 = 2
+        assert sched.speculative_budget(req, 8) == 2
+        req.length = 15
+        assert sched.speculative_budget(req, 8) == 0
+
+    def test_never_negative(self):
+        sched = self._sched(max_len=8)
+        req = _req([1] * 6, tokens=[5, 6, 7], max_new=3)
+        req.length = 8
+        assert sched.speculative_budget(req, 4) == 0
+
+
+# ---------------------------------------------------------------------------
+# block manager: the speculative ledger
+# ---------------------------------------------------------------------------
+class TestSpeculativeBlocks:
+    def test_ledger_only_window_within_reservation(self):
+        mgr = BlockManager(num_blocks=8, block_size=8, max_blocks_per_seq=4)
+        t = mgr.allocate("a", 20)                       # 3 blocks reserved
+        free0 = mgr.num_free
+        assert mgr.speculate("a", 24) == []             # covered: no grant
+        assert mgr.speculating("a") and mgr.num_free == free0
+        assert mgr.commit_speculative("a", 21) == 0     # ledger-only close
+        assert not mgr.speculating("a")
+        assert mgr.owned("a") == [int(b) for b in t[:3]]
+
+    def test_grant_commit_keeps_accepted_drops_tail(self):
+        mgr = BlockManager(num_blocks=8, block_size=8, max_blocks_per_seq=6)
+        mgr.allocate("a", 8)                            # 1 block
+        fresh = mgr.speculate("a", 30)                  # needs 4: +3 grants
+        assert len(fresh) == 3 and len(mgr.owned("a")) == 4
+        # accepted prefix reaches into the first granted block only:
+        # the rest return to the free list WITHOUT copies
+        assert mgr.commit_speculative("a", 12) == 2
+        owned = mgr.owned("a")
+        assert len(owned) == 2 and owned[1] == fresh[0]
+        assert mgr.num_free == 7 - 2
+        assert mgr.release("a") == 2
+        assert mgr.num_free == 7
+
+    def test_drop_rejects_whole_window(self):
+        mgr = BlockManager(num_blocks=8, block_size=8, max_blocks_per_seq=6)
+        mgr.allocate("a", 8)
+        mgr.speculate("a", 30)
+        assert mgr.drop_speculative("a") == 3
+        assert len(mgr.owned("a")) == 1 and mgr.num_free == 6
+        assert mgr.drop_speculative("a") == 0           # closed: no-op
+
+    def test_respeculate_keeps_original_base(self):
+        """A verify dispatch killed between draft and commit retries
+        from the same committed state: the second speculate() must not
+        treat the first window's grants as committed ownership."""
+        mgr = BlockManager(num_blocks=10, block_size=8, max_blocks_per_seq=6)
+        mgr.allocate("a", 8)
+        first = mgr.speculate("a", 30)                  # needs 4: +3
+        again = mgr.speculate("a", 40)                  # needs 5: +1 more
+        assert len(first) == 3 and len(again) == 1
+        assert mgr.commit_speculative("a", 8) == 4      # back to base
+        assert len(mgr.owned("a")) == 1
+        assert set(first) | set(again) <= set(mgr._free)
+
+    def test_commit_never_drops_below_base(self):
+        mgr = BlockManager(num_blocks=8, block_size=8, max_blocks_per_seq=6)
+        mgr.allocate("a", 20)                           # 3 blocks
+        mgr.speculate("a", 28)                          # +1
+        assert mgr.commit_speculative("a", 0) == 1      # base kept
+        assert len(mgr.owned("a")) == 3
+
+    def test_release_and_errors(self):
+        mgr = BlockManager(num_blocks=8, block_size=8, max_blocks_per_seq=4)
+        with pytest.raises(ValueError):
+            mgr.speculate("ghost", 8)                   # owns nothing
+        mgr.allocate("a", 8)
+        with pytest.raises(ValueError):                 # table can't map it
+            mgr.speculate("a", 8 * 5)
+        mgr.speculate("a", 16)
+        assert mgr.release("a") == 2                    # grants released too
+        assert not mgr.speculating("a") and mgr.num_free == 7
+
+    def test_grant_exhaustion_raises_and_stays_consistent(self):
+        mgr = BlockManager(num_blocks=4, block_size=8, max_blocks_per_seq=8)
+        mgr.allocate("a", 8)
+        mgr.allocate("b", 16)
+        with pytest.raises(RuntimeError):
+            mgr.speculate("a", 32)                      # needs 3 fresh, 0 free
+        # the failed window is open but granted nothing; closing it is
+        # clean and the pool partition is intact
+        assert mgr.speculating("a")
+        assert mgr.commit_speculative("a", 8) == 0
+        assert mgr.num_free == 0 and len(mgr.owned("a")) == 1
+
+    def test_grants_can_recycle_evictable_blocks(self):
+        evicted = []
+        mgr = BlockManager(num_blocks=5, block_size=8, max_blocks_per_seq=4)
+        mgr.on_evict = evicted.append
+        t = mgr.allocate("a", 16)
+        for b in t[:2]:
+            mgr.mark_cached(b)
+        mgr.release("a")                                # parks evictable
+        mgr.allocate("b", 8)
+        mgr.speculate("b", 24)                          # takes 1 free + 1 LRU
+        assert evicted == [int(t[1])]  # release parks deepest-first
+        assert mgr.num_cached == 1     # t[0] survives as the warmest
+
+
+# ---------------------------------------------------------------------------
+# randomized fuzz: scheduler + blocks + prefix cache + speculation
+# ---------------------------------------------------------------------------
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestSpeculativeBlockFuzz:
+    """Satellite: the PR 6/7 accounting fuzz extended with
+    speculate/commit/drop ops interleaved against shared-prefix admits,
+    COW pins, LRU evictions, finishes and cancels — pinning refcount /
+    free-list / evictable / ``committed_tokens`` / spec-ledger mutual
+    consistency under speculation. Host-only, tier-1."""
+
+    def _invariants(self, sched, blocks, prefix):
+        live = list(sched.queue) + [r for r in sched.slots if r is not None]
+        assert sched.committed_tokens == sum(
+            r.prompt_len + r.max_new_tokens for r in live)
+        assert sched._live_ids == {r.request_id for r in live}
+        # every physical block is in EXACTLY one state
+        free = set(blocks._free)
+        evictable = set(blocks._evictable)
+        referenced = set(blocks._ref)
+        assert not (free & evictable) and not (free & referenced) \
+            and not (evictable & referenced)
+        assert free | evictable | referenced == \
+            set(range(1, blocks.num_blocks))
+        # refcount == holders (owned lists INCLUDE speculative grants)
+        expect = {}
+        for blocks_list in blocks._owned.values():
+            for b in blocks_list:
+                expect[b] = expect.get(b, 0) + 1
+        for b in blocks._cow_pending.values():
+            expect[b] = expect.get(b, 0) + 1
+        assert blocks._ref == expect
+        assert evictable <= blocks._cached
+        assert not (free & blocks._cached)
+        assert set(prefix._by_block) == blocks._cached
+        # only RUNNING sequences own blocks; only owners speculate, and
+        # a window's base never exceeds its owner's current block count
+        assert set(blocks._owned) == {
+            r.request_id for r in sched.slots if r is not None}
+        assert set(blocks._spec_base) <= set(blocks._owned)
+        for rid, base in blocks._spec_base.items():
+            assert 0 < base <= len(blocks._owned[rid])
+
+    def test_random_walk_with_speculation(self):
+        rng = np.random.default_rng(11)
+        clk = _Clock()
+        from deepspeed_tpu.serving.prefix_cache import PrefixCache
+
+        cfg = ServingConfig(block_size=8, decode_slots=2,
+                            max_queue_depth=6, deadline_ms=200.0,
+                            default_max_new_tokens=4, prefix_cache=True,
+                            speculative={"num_speculative_tokens": 4})
+        blocks = BlockManager(14, cfg.block_size, 10)
+        prefix = PrefixCache(blocks)
+        sched = ContinuousBatchingScheduler(cfg, blocks, max_len=64,
+                                            clock=clk, prefix_cache=prefix)
+        families = [list(rng.integers(1, 99, 40)) for _ in range(3)]
+        next_id = 0
+        for step in range(900):
+            op = rng.choice(["submit", "admit", "speculate", "commit",
+                             "drop", "finish", "cancel", "tick"])
+            running = [r for r in sched.slots if r is not None]
+            if op == "submit":
+                fam = families[int(rng.integers(len(families)))]
+                cut = int(rng.integers(1, len(fam)))
+                prompt = fam[:cut] + list(rng.integers(100, 200, int(
+                    rng.integers(0, 6))))
+                rid, next_id = f"z-{next_id}", next_id + 1
+                sched.submit(Request(
+                    prompt=prompt,
+                    max_new_tokens=int(rng.integers(1, 10)),
+                    request_id=rid,
+                    deadline_ms=float(rng.choice([0.0, 50.0, 500.0]))),
+                    now=clk.t)
+            elif op == "admit":
+                admitted, _ = sched.admit(now=clk.t)
+                for _, r, table in admitted:
+                    blocks.cow_done(r.request_id)
+                    prefix.insert(r.prompt, table)
+                    r.length = r.prompt_len
+            elif op == "speculate" and running:
+                r = running[int(rng.integers(len(running)))]
+                window = r.length + 1 + int(rng.integers(0, 24))
+                try:
+                    blocks.speculate(r.request_id, window)
+                except (RuntimeError, ValueError):
+                    pass  # pool pressure / table overflow: legal refusals
+            elif op == "commit" and running:
+                r = running[int(rng.integers(len(running)))]
+                accepted = int(rng.integers(0, 5))
+                r.length = min(r.length + accepted, 63)
+                blocks.commit_speculative(r.request_id, r.length + 1)
+            elif op == "drop" and running:
+                r = running[int(rng.integers(len(running)))]
+                blocks.drop_speculative(r.request_id)
+            elif op == "finish" and running:
+                pick = running[int(rng.integers(len(running)))]
+                sched.finish(pick, "eos", now=clk.t)
+            elif op == "cancel" and sched._live_ids:
+                ids = sorted(sched._live_ids)
+                sched.cancel(ids[int(rng.integers(len(ids)))],
+                             "cancelled", now=clk.t)
+            elif op == "tick":
+                clk.t += float(rng.random() * 0.2)
+            self._invariants(sched, blocks, prefix)
+        # drain: live accounting returns to zero; the pool partitions
+        # into free + warm evictable cache, no window left open
+        clk.t += 10.0
+        for _ in range(60):
+            admitted, _ = sched.admit(now=clk.t)
+            for _, r, table in admitted:
+                blocks.cow_done(r.request_id)
+                prefix.insert(r.prompt, table)
+            for r in [r for r in sched.slots if r is not None]:
+                sched.finish(r, "eos", now=clk.t)
+        assert not sched.pending
+        assert sched.committed_tokens == 0 and not sched._live_ids
+        assert not blocks._ref and not blocks._cow_pending
+        assert not blocks._spec_base
+        assert blocks.num_free == blocks.num_blocks - 1

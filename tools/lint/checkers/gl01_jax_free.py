@@ -31,6 +31,7 @@ JAX_FREE_MODULES = (
     "deepspeed_tpu/serving/prefix_cache.py",
     "deepspeed_tpu/serving/config.py",
     "deepspeed_tpu/serving/request.py",
+    "deepspeed_tpu/serving/spec_decode.py",
     "deepspeed_tpu/telemetry/events.py",
     "deepspeed_tpu/telemetry/tracing.py",
     "deepspeed_tpu/telemetry/metrics.py",

@@ -55,7 +55,8 @@ def aggregate(events: List[Dict]) -> Dict:
               "replay_divergence": 0, "events": 0}
     serving = {"events": 0, "finished": 0, "shed": 0, "prompt_tokens": 0,
                "prefix_hit_tokens": 0, "hit_requests": 0, "blocks_shared": 0,
-               "prefill_chunks": 0, "last_gauges": {}}
+               "prefill_chunks": 0, "last_gauges": {},
+               "draft_tokens": 0, "accepted_tokens": 0, "spec_requests": 0}
     aot = {"events": 0, "hits": 0, "hit_programs": {}, "captured": 0,
            "captured_bytes": 0, "disabled": [], "load_failed": 0,
            "armed_programs": 0}
@@ -139,6 +140,12 @@ def aggregate(events: List[Dict]) -> Dict:
                     serving["hit_requests"] += 1
                 serving["blocks_shared"] += data.get("blocks_shared") or 0
                 serving["prefill_chunks"] += data.get("prefill_chunks") or 0
+                drafts = data.get("draft_tokens") or 0
+                serving["draft_tokens"] += drafts
+                serving["accepted_tokens"] += \
+                    data.get("accepted_tokens") or 0
+                if drafts:
+                    serving["spec_requests"] += 1
             elif name == "request.shed":
                 serving["shed"] += 1
             elif name == "step.gauges":
@@ -287,6 +294,13 @@ def _serving_lines(agg: Dict, markdown: bool) -> List[str]:
             f"requests hit, {s['prefix_hit_tokens']}/{s['prompt_tokens']} "
             f"prompt tokens served from cache ({100 * rate:.1f}%), "
             f"{s['blocks_shared']} blocks mapped shared")
+    if s.get("draft_tokens"):
+        rate = s["accepted_tokens"] / s["draft_tokens"]
+        out.append(
+            f"{pad}speculation: {s['spec_requests']}/{s['finished']} "
+            f"requests speculated, {s['accepted_tokens']}/"
+            f"{s['draft_tokens']} draft tokens accepted "
+            f"({100 * rate:.1f}%)")
     g = s.get("last_gauges") or {}
     if "cached_blocks" in g or "free_blocks" in g:
         out.append(f"{pad}pool at last step: "
@@ -462,7 +476,7 @@ def _waterfall_lines(req: Dict, pad: str) -> List[str]:
         hot = {k: v for k, v in (s.get("attrs") or {}).items()
                if k in ("attempt", "replica", "slot", "tokens", "reason",
                         "state", "outcome", "from_pos", "to_pos", "bucket",
-                        "pos")}
+                        "pos", "proposed", "accepted", "proposer")}
         detail = (" " + " ".join(f"{k}={v}" for k, v in hot.items())
                   if hot else "")
         out.append(f"{pad}{'  ' * depth[s['span']]}{s['name']:<14} "

@@ -425,6 +425,119 @@ def _router_series(ctx):
 
 
 # ---------------------------------------------------------------------------
+# fleet: replayed-trace SLO attainment, fixed vs autoscaled, warm vs cold
+def _fleet_series(ctx):
+    """The elasticity tier: ONE seeded diurnal+burst arrival trace
+    replayed (fake clocks, faster than real time) against (a) the
+    static minimum fleet — one replica — and (b) the autoscaled fleet
+    (min 1, max 2, SLO error budgets) built through the cold
+    ``ReplicaFactory`` path. Reports SLO attainment + tokens per
+    simulated second for both, plus the scale-up time-to-first-token
+    for a WARM replica (parked engine, compiled programs live) vs a
+    COLD one (fresh build, full compile) — the number the PR 8 AOT
+    bundle exists to shrink."""
+    from deepspeed_tpu.serving.replay import (ReplayClock, TraceReplayer,
+                                              synthesize_trace)
+    from deepspeed_tpu.serving.router import (CallableReplicaFactory,
+                                              FleetManager, ReplicaRouter)
+
+    cfg, scfg = ctx["cfg"], ctx["scfg"]
+    on_tpu, srv_new = ctx["on_tpu"], ctx["srv_new"]
+    if on_tpu:
+        duration, base_rate, burst = 60.0, 2.0, (15.0, 15.0, 8.0)
+        prompt_mean, prompt_max = ctx["prompt"] // 2, ctx["prompt"]
+        queue_cap, step_secs = 8, 0.25
+    else:
+        duration, base_rate, burst = 16.0, 1.0, (4.0, 5.0, 5.0)
+        prompt_mean, prompt_max = 5, 8
+        queue_cap, step_secs = 3, 0.25
+    trace = synthesize_trace(
+        duration, seed=23, base_rate=base_rate,
+        diurnal_fraction=0.3, diurnal_period_secs=duration,
+        bursts=[burst], prompt_len_mean=prompt_mean,
+        prompt_len_max=prompt_max, gen_mean=srv_new, gen_sigma=0.2,
+        gen_max=srv_new)
+    slo = {"ttft_p95_ms": 1000.0, "shed_rate": 0.05}
+    fleet_cfg = {"min_replicas": 1, "max_replicas": 2,
+                 "target_ttft_p95_ms": slo["ttft_p95_ms"],
+                 "target_shed_rate": slo["shed_rate"],
+                 "fast_window_steps": 6, "slow_window_steps": 40,
+                 "scale_up_load": 0.6, "scale_up_cooldown_steps": 2,
+                 "scale_down_cooldown_steps": 8,
+                 "scale_down_quiet_steps": 10}
+    build = lambda: _build_serving(ctx, {"max_queue_depth": queue_cap})  # noqa: E731
+
+    def leg(autoscale):
+        clock = ReplayClock()
+        # shed_priority_floor 0 disables the degradation ladder's
+        # priority shed for this all-priority-0 trace: this series
+        # measures the CAPACITY axis (sheds = queue_full backpressure),
+        # the ladder axis is the *_router series' job — identical
+        # router config on both legs either way
+        router = ReplicaRouter([build()], clock=clock,
+                               config={"shed_priority_floor": 0})
+        target = FleetManager(router,
+                              factory=CallableReplicaFactory(build),
+                              config=fleet_cfg) if autoscale else router
+        t0 = time.perf_counter()
+        rep = TraceReplayer(target, trace, clock, step_secs=step_secs,
+                            seed=31, max_steps=20000)
+        rep.run()
+        wall = time.perf_counter() - t0
+        out = rep.report(slo=slo)
+        stats = target.stats() if autoscale else {}
+        return target, out, wall, stats
+
+    static_t, static, static_wall, _ = leg(False)
+    fleet_t, auto, auto_wall, fstats = leg(True)
+
+    # warm vs cold scale-up TTFT (wall time): a parked engine that
+    # already served the replay vs a factory-fresh engine paying its
+    # compiles — both measured submit -> first token on an idle replica
+    def first_token_secs(engine):
+        seen = []
+        t0 = time.perf_counter()
+        engine.submit(np.arange(1, prompt_max + 1, dtype=np.int32),
+                      max_new_tokens=2,
+                      stream=lambda r, t, d: seen.append(t))
+        while not seen:
+            engine.step()
+        dt = time.perf_counter() - t0
+        engine.drain()
+        return dt
+
+    warm_engine = fleet_t.router.replicas[0]      # served the replay
+    warm_secs = first_token_secs(warm_engine)
+    cold_engine = build()
+    cold_secs = first_token_secs(cold_engine)
+
+    payload = {
+        "metric": f"{METRIC}_fleet",
+        "trace_requests": len(trace),
+        "sim_secs": auto["sim_secs"],
+        "static_slo_attainment": static.get("slo_attainment"),
+        "static_ttft_ms_p95": static["ttft_ms_p95"],
+        "static_shed_rate": static["shed_rate"],
+        "static_tokens_per_sim_sec": static["tokens_per_sim_sec"],
+        "autoscaled_slo_attainment": auto.get("slo_attainment"),
+        "autoscaled_ttft_ms_p95": auto["ttft_ms_p95"],
+        "autoscaled_shed_rate": auto["shed_rate"],
+        "autoscaled_tokens_per_sim_sec": auto["tokens_per_sim_sec"],
+        "scale_ups": fstats.get("scale_ups"),
+        "scale_downs": fstats.get("scale_downs"),
+        "max_replicas": fleet_cfg["max_replicas"],
+        "replay_wall_secs_static": round(static_wall, 3),
+        "replay_wall_secs_autoscaled": round(auto_wall, 3),
+        "warm_scale_up_ttft_ms": round(1e3 * warm_secs, 2),
+        "cold_scale_up_ttft_ms": round(1e3 * cold_secs, 2),
+    }
+    cold_engine.destroy()
+    static_t.destroy()
+    fleet_t.destroy()
+    return payload
+
+
+# ---------------------------------------------------------------------------
 # tuner series: the live autotuner's decode-side measurement hooks
 def _decode_attention_series(ctx, block_k=None, reps=None):
     """Microbench of the dense decode-attention kernel at one ``block_k``
@@ -708,6 +821,8 @@ def run_series(name, config=None):
         return _serving_fastpath_series(ctx)
     if name == "router":
         return _router_series(ctx)
+    if name == "fleet":
+        return _fleet_series(ctx)
     if name == "decode_attention":
         return _decode_attention_series(ctx, block_k=config.get("block_k"))
     if name == "serving_chunk":
@@ -721,7 +836,7 @@ def run_series(name, config=None):
                    f"{sorted(SERIES)}")
 
 
-SERIES = ("headline", "serving", "serving_fastpath", "router",
+SERIES = ("headline", "serving", "serving_fastpath", "router", "fleet",
           "decode_attention", "serving_chunk", "serving_tracing",
           "spec_decode")
 
@@ -738,6 +853,7 @@ def main():
     emit_result(_serving_series(ctx))
     emit_result(_serving_fastpath_series(ctx))
     emit_result(_router_series(ctx))
+    emit_result(_fleet_series(ctx))
     emit_result(_spec_decode_series(ctx))
     emit_result(_serving_tracing_series(ctx))
 

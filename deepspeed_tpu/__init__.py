@@ -119,7 +119,8 @@ def init_inference(model, config=None, **kwargs):
     return InferenceEngine(model, config=config, **kwargs)
 
 
-def init_serving(model, config=None, replicas=None, **kwargs):
+def init_serving(model, config=None, replicas=None, factory=None,
+                 clock=None, **kwargs):
     """Build the continuous-batching serving runtime (paged KV cache +
     request scheduler) over an inference engine. ``model`` may be a flax
     model (a fresh :class:`InferenceEngine` is built from ``config`` /
@@ -136,7 +137,19 @@ def init_serving(model, config=None, replicas=None, **kwargs):
     health-aware routing, deterministic-replay failover, and the
     SLO-guarded degradation ladder. Without the block nothing changes:
     the single engine is returned and its compiled programs are
-    byte-identical to previous releases."""
+    byte-identical to previous releases.
+
+    With a ``serving.fleet`` block on top the router is wrapped in the
+    elastic :class:`~deepspeed_tpu.serving.router.FleetManager` (SLO
+    error-budget autoscaling through the drain/reactivate seams):
+    scale-up builds fresh replicas through ``factory`` — a
+    :class:`~deepspeed_tpu.serving.router.ReplicaFactory` or a zero-arg
+    builder callable; when building engines from ``model``, the default
+    factory clones the same build, so the warm AOT/tuning path is
+    whatever the caller's config restores. ``clock`` injects the
+    router/fleet timebase (default ``time.monotonic``) — pass the
+    trace-replay harness's ``ReplayClock`` to drive the whole front
+    door faster than real time."""
     from deepspeed_tpu.serving import ServingEngine
 
     # probe ONLY router presence ahead of construction (full coercion
@@ -156,32 +169,71 @@ def init_serving(model, config=None, replicas=None, **kwargs):
                                    else getattr(router, "enabled", True)):
         router = None  # the standard config off switch: block present,
         #                layer disabled — identical to absent
+    fleet = (serving.get("fleet") if isinstance(serving, dict)
+             else getattr(serving, "fleet", None))
+    if fleet is not None and not (fleet.get("enabled", True)
+                                  if isinstance(fleet, dict)
+                                  else getattr(fleet, "enabled", True)):
+        fleet = None  # standard off switch, same as the router block
+    clock_kwargs = {} if clock is None else {"clock": clock}
     if router is None and replicas is None:
-        return ServingEngine(model, config=config, **kwargs)
+        return ServingEngine(model, config=config, **clock_kwargs,
+                             **kwargs)
 
     from deepspeed_tpu.inference.engine import InferenceEngine
-    from deepspeed_tpu.serving.router import ReplicaRouter
+    from deepspeed_tpu.serving.router import (CallableReplicaFactory,
+                                              FleetManager, ReplicaRouter)
 
+    built_from_model = False
     if replicas is None or isinstance(replicas, int):
         if isinstance(model, InferenceEngine):
             raise ValueError(
                 "one InferenceEngine is one replica — pass the prebuilt "
                 "engines as a list via `replicas` instead of a count")
-        first = ServingEngine(model, config=config, **kwargs)
+        built_from_model = True
+        first = ServingEngine(model, config=config, **clock_kwargs,
+                              **kwargs)
         count = replicas if isinstance(replicas, int) else (
             first.config.router.replicas if first.config.router else 2)
-        engines = [first] + [ServingEngine(model, config=config, **kwargs)
+        engines = [first] + [ServingEngine(model, config=config,
+                                           **clock_kwargs, **kwargs)
                              for _ in range(count - 1)]
     else:
-        engines = [ServingEngine(r) if isinstance(r, InferenceEngine)
-                   else r for r in replicas]
-    if router is None:  # prebuilt replicas, no explicit block: fall
-        #                 back to a router config an engine carries
-        router = next(
-            (c for c in (getattr(getattr(e, "config", None), "router",
-                                 None) for e in engines) if c is not None),
-            None)
-    return ReplicaRouter(engines, config=router)
+        engines = [ServingEngine(r, **clock_kwargs)
+                   if isinstance(r, InferenceEngine) else r
+                   for r in replicas]
+
+    def _carried(field):
+        # prebuilt replicas, no explicit block: fall back to a config an
+        # engine carries (explicit caller blocks always win)
+        return next(
+            (c for c in (getattr(getattr(e, "config", None), field, None)
+                         for e in engines) if c is not None), None)
+
+    if router is None:
+        router = _carried("router")
+    if fleet is None:
+        # same fallback as the router block: an engine-carried fleet
+        # config silently dropped would read as "autoscaling is on"
+        # when it is not
+        fleet = _carried("fleet")
+        if fleet is not None and not getattr(fleet, "enabled", True):
+            fleet = None
+    front = ReplicaRouter(engines, config=router, **clock_kwargs)
+    if fleet is None:
+        if factory is not None:
+            raise ValueError(
+                "init_serving got a replica `factory` but no "
+                "serving.fleet block — the factory is the fleet "
+                "manager's scale-up seam; add \"fleet\": {...} to use it")
+        return front
+    if factory is None and built_from_model:
+        # same build as the initial replicas: whatever AOT/tuning warm
+        # path the caller's config restores, a scaled-up replica gets too
+        factory = CallableReplicaFactory(
+            lambda: ServingEngine(model, config=config, **clock_kwargs,
+                                  **kwargs))
+    return FleetManager(front, factory=factory, config=fleet)
 
 
 def add_config_arguments(parser):

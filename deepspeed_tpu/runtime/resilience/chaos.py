@@ -22,6 +22,9 @@ dump-and-abort) therefore needs seams where faults can be injected
 - :class:`ChaosReplica` — replica-level faults for the multi-replica
   serving router: crash at decode step N (:class:`ReplicaCrashed`),
   transient flaky step/submit, stall, slow decode.
+- :class:`FlakyFactory` — faults at the fleet manager's
+  ``ReplicaFactory`` scale-up seam: N failed builds (the autoscaler's
+  exponential-backoff food), injectable build stalls.
 
 All injectors are process-local and OFF by default; :func:`raise_if`
 costs one module-level ``if`` when nothing is armed.
@@ -286,6 +289,40 @@ class ChaosReplica:
     def __getattr__(self, name):
         # gauges/stats/pending/buckets/telemetry/... delegate untouched
         return getattr(self.replica, name)
+
+
+class FlakyFactory:
+    """Deterministic faults for the fleet manager's ``ReplicaFactory``
+    seam: wraps a factory (or a zero-arg builder callable); the first
+    ``fail_times`` ``build()`` calls raise transient
+    :class:`ChaosIOError` (the autoscaler must back off exponentially,
+    not hammer), and ``stall_secs`` blocks before every build through
+    the injectable ``sleep`` (a cold container pull, as the fleet
+    observes it — drive it with a fake clock in tests)."""
+
+    def __init__(self, factory, fail_times: int = 0,
+                 stall_secs: float = 0.0, sleep=time.sleep):
+        self.factory = factory
+        self.fail_times = int(fail_times)
+        self.stall_secs = float(stall_secs)
+        self.sleep = sleep
+        self.builds = 0     # build() calls observed
+        self.failures = 0   # failures actually injected
+
+    @property
+    def warm(self) -> bool:
+        return bool(getattr(self.factory, "warm", False))
+
+    def build(self):
+        self.builds += 1
+        if self.stall_secs:
+            self.sleep(self.stall_secs)
+        if self.builds <= self.fail_times:
+            self.failures += 1
+            raise ChaosIOError(
+                f"chaos: replica factory failed [build {self.builds}]")
+        build = getattr(self.factory, "build", None)
+        return build() if build is not None else self.factory()
 
 
 # ----------------------------------------------------------------------

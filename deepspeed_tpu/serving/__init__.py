@@ -28,30 +28,53 @@ Pieces:
 - :class:`~deepspeed_tpu.serving.router.ReplicaRouter` +
   :class:`~deepspeed_tpu.serving.health.ReplicaHealth` — the resilient
   multi-replica front door: health-aware routing, failover with
-  deterministic replay, and an SLO-guarded degradation ladder.
+  deterministic replay, and an SLO-guarded degradation ladder;
+- the fleet tier — :class:`~deepspeed_tpu.serving.router.FleetManager`
+  (elastic scale over the router's drain/reactivate seams, through the
+  :class:`~deepspeed_tpu.serving.router.ReplicaFactory` warm-build
+  seam), :mod:`~deepspeed_tpu.serving.autoscaler` (the SLO error-budget
+  policy), :mod:`~deepspeed_tpu.serving.replay` (trace-driven workload
+  replay over fake clocks) and
+  :class:`~deepspeed_tpu.serving.capacity.CapacityModel` (latency-vs-
+  load curves + ``fleet_size_for``).
 """
 
+from deepspeed_tpu.serving.autoscaler import Autoscaler, BudgetWindow
 from deepspeed_tpu.serving.blocks import BlockManager
-from deepspeed_tpu.serving.config import (RouterConfig, ServingConfig,
+from deepspeed_tpu.serving.capacity import CapacityModel
+from deepspeed_tpu.serving.config import (FleetConfig, ReplayConfig,
+                                          RouterConfig, ServingConfig,
                                           SpeculativeConfig, bucket_for,
                                           resolve_buckets)
 from deepspeed_tpu.serving.engine import ServingEngine
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.health import (DEAD, DEGRADED, DRAINING, HEALTHY,
                                           TRIPPED, ReplicaHealth)
+from deepspeed_tpu.serving.replay import (Arrival, ReplayClock,
+                                          TraceReplayer, burst_trace,
+                                          diurnal_trace, load_trace,
+                                          save_trace, synthesize_trace)
 from deepspeed_tpu.serving.request import (FINISHED, QUEUED, RUNNING, SHED,
                                            Request)
-from deepspeed_tpu.serving.router import ReplicaRouter, RouterRequest
+from deepspeed_tpu.serving.router import (CallableReplicaFactory,
+                                          FleetManager, ReplicaFactory,
+                                          ReplicaRouter, RouterRequest)
 from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
 from deepspeed_tpu.serving.spec_decode import (DraftModelProposer,
                                                PromptLookupProposer,
                                                Proposer, build_proposer)
 
-__all__ = ["BlockManager", "ContinuousBatchingScheduler",
-           "DraftModelProposer", "PrefixCache", "PromptLookupProposer",
-           "Proposer", "ReplicaHealth",
+__all__ = ["Arrival", "Autoscaler", "BlockManager", "BudgetWindow",
+           "CallableReplicaFactory", "CapacityModel",
+           "ContinuousBatchingScheduler",
+           "DraftModelProposer", "FleetConfig", "FleetManager",
+           "PrefixCache", "PromptLookupProposer",
+           "Proposer", "ReplayClock", "ReplayConfig", "ReplicaFactory",
+           "ReplicaHealth",
            "ReplicaRouter", "Request", "RouterConfig", "RouterRequest",
            "ServingConfig", "ServingEngine", "SpeculativeConfig",
-           "bucket_for", "build_proposer", "resolve_buckets",
+           "TraceReplayer", "bucket_for", "build_proposer", "burst_trace",
+           "diurnal_trace", "load_trace", "resolve_buckets", "save_trace",
+           "synthesize_trace",
            "QUEUED", "RUNNING", "FINISHED", "SHED",
            "HEALTHY", "DEGRADED", "TRIPPED", "DEAD", "DRAINING"]

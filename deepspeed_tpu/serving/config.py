@@ -100,6 +100,127 @@ class SpeculativeConfig(DeepSpeedConfigModel):
         return self
 
 
+class ReplayConfig(DeepSpeedConfigModel):
+    """The ``serving.replay`` block: workload-replay defaults consumed by
+    :class:`deepspeed_tpu.serving.replay.TraceReplayer` (the trace-driven
+    load harness). Pure bookkeeping — the block never touches the serving
+    engines or their compiled programs; it only parameterizes how a
+    recorded arrival trace is replayed against them."""
+
+    enabled: bool = True
+    # JSONL arrival trace to replay ("" = the caller passes records)
+    trace_path: str = ""
+    # simulated seconds each replay iteration advances the fake clock by
+    # (one target.step() per iteration — smaller = finer arrival timing,
+    # more steps per simulated second)
+    step_secs: float = 0.05
+    # deterministic prompt-token synthesis seed (same seed + same trace
+    # = bit-identical prompts, the replay-determinism contract)
+    seed: int = 0
+    # synthesized prompt tokens are drawn from [1, vocab_size)
+    vocab_size: int = 1000
+    # hard iteration bound (0 = run to trace end + drain) — the guard
+    # against a wedged target spinning the replay loop forever
+    max_steps: int = 0
+
+    @field_validator("step_secs")
+    @classmethod
+    def _step(cls, v):
+        if v <= 0:
+            raise ValueError(
+                f"serving.replay.step_secs must be > 0 (simulated seconds "
+                f"per replay iteration), got {v}")
+        return v
+
+    @field_validator("vocab_size")
+    @classmethod
+    def _vocab(cls, v):
+        if v < 2:
+            raise ValueError(
+                f"serving.replay.vocab_size must be >= 2, got {v}")
+        return v
+
+
+class FleetConfig(DeepSpeedConfigModel):
+    """The ``serving.fleet`` block: the SLO error-budget autoscaler over
+    the multi-replica router (:class:`deepspeed_tpu.serving.router.
+    FleetManager`). Absent (the default) the fleet layer does not exist
+    — the router runs its static replica set and the compiled programs
+    are byte-identical. Present (requires ``serving.router``), scaling
+    decisions walk replicas through the router's ``start_drain`` /
+    ``reactivate`` seams against error budgets: scale-down drains and
+    parks engines, scale-up reactivates parked replicas (warm — their
+    compiled programs are live) or builds fresh ones through the
+    ``ReplicaFactory`` seam."""
+
+    enabled: bool = True
+    # fleet size bounds (active = HEALTHY + DEGRADED replicas)
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # ---- SLO error budgets (0 = that budget is off) ----
+    # TTFT p95 target: at most 5% of finished requests may exceed it (the
+    # p95 semantic IS the budget); burn rate = observed-over fraction/0.05
+    target_ttft_p95_ms: float = 0.0
+    # allowed shed fraction; burn rate = observed shed rate / this
+    target_shed_rate: float = 0.0
+    # ---- burn-rate windows (router steps) ----
+    fast_window_steps: int = 8     # urgent scale-up detection
+    slow_window_steps: int = 64    # budget-remaining accounting + quiet gate
+    # fast-window burn rate at or above this triggers scale-up (1.0 =
+    # burning exactly the budget; >1 tolerates short spikes)
+    burn_rate_fast: float = 1.0
+    # ---- load thresholds (router overload score, 0..1) ----
+    scale_up_load: float = 0.8     # queue pressure alone can trigger growth
+    scale_down_load: float = 0.3   # pressure must sit below this to shrink
+    # ---- hysteresis + cooldowns (router steps) ----
+    scale_up_cooldown_steps: int = 4
+    scale_down_cooldown_steps: int = 16
+    # consecutive quiet steps (low load AND fast burns within budget)
+    # required before a scale-down — the anti-flap guard
+    scale_down_quiet_steps: int = 16
+    # ---- the ReplicaFactory seam ----
+    # steps to wait after a failed factory build before retrying; doubles
+    # per consecutive failure (the retry_io exponential series)
+    factory_backoff_steps: int = 4
+    # a drain older than this many steps force-yields its in-flight work
+    # to survivors and parks anyway (0 = wait forever) — scale-down must
+    # never deadlock drain() behind one wedged replica
+    drain_timeout_steps: int = 0
+
+    @field_validator("min_replicas", "max_replicas", "fast_window_steps",
+                     "slow_window_steps", "scale_up_cooldown_steps",
+                     "scale_down_cooldown_steps", "scale_down_quiet_steps",
+                     "factory_backoff_steps")
+    @classmethod
+    def _positive(cls, v, info):
+        if v <= 0:
+            raise ValueError(
+                f"serving.fleet.{info.field_name} must be > 0, got {v}")
+        return v
+
+    @field_validator("target_ttft_p95_ms", "target_shed_rate",
+                     "burn_rate_fast", "drain_timeout_steps")
+    @classmethod
+    def _non_negative(cls, v, info):
+        if v < 0:
+            raise ValueError(
+                f"serving.fleet.{info.field_name} must be >= 0, got {v}")
+        return v
+
+    @model_validator(mode="after")
+    def _bounds(self):
+        if self.min_replicas > self.max_replicas:
+            raise ValueError(
+                "serving.fleet needs min_replicas <= max_replicas, got "
+                f"{self.min_replicas} > {self.max_replicas}")
+        if not (0.0 <= self.scale_down_load < self.scale_up_load <= 1.0):
+            raise ValueError(
+                "serving.fleet needs 0 <= scale_down_load < scale_up_load "
+                f"<= 1 (load hysteresis), got down={self.scale_down_load} "
+                f"up={self.scale_up_load}")
+        return self
+
+
 class RouterConfig(DeepSpeedConfigModel):
     """The ``serving.router`` block: N replica serving engines behind one
     submit()/drain() front door (:class:`deepspeed_tpu.serving.router.
@@ -239,6 +360,12 @@ class ServingConfig(DeepSpeedConfigModel):
     # ---- multi-replica front door (None = the router layer does not
     # exist; single-engine serving is exactly as before) ----
     router: Optional[RouterConfig] = None
+    # ---- fleet manager (None = no autoscaler; the router's replica set
+    # is static exactly as before). Requires a router block. ----
+    fleet: Optional[FleetConfig] = None
+    # ---- workload-replay defaults (None = no defaults; the replay
+    # harness takes explicit arguments). Never touches the engines. ----
+    replay: Optional[ReplayConfig] = None
 
     @field_validator("block_size", "decode_slots")
     @classmethod
@@ -282,6 +409,20 @@ class ServingConfig(DeepSpeedConfigModel):
                 f"serving.kv_cache_dtype must be '' (model dtype) or "
                 f"'int8', got {v!r}")
         return v
+
+    @model_validator(mode="after")
+    def _fleet_needs_router(self):
+        if (self.fleet is not None and self.fleet.enabled
+                and (self.router is None or not self.router.enabled)):
+            # the fleet manager scales the ROUTER's replica set through
+            # its drain/reactivate seams — without a router there is
+            # nothing to scale, and silently ignoring the block would
+            # read as "autoscaling is on" when it is not
+            raise ValueError(
+                "serving.fleet requires a serving.router block (the fleet "
+                "manager scales the router's replica set; add \"router\": "
+                "{...} or drop the fleet block)")
+        return self
 
     @model_validator(mode="after")
     def _speculative_needs_greedy(self):

@@ -81,7 +81,7 @@ def _model_window(model_config) -> Optional[int]:
 
 class ServingEngine:
     def __init__(self, model_or_engine, config=None, draft_model=None,
-                 **kwargs):
+                 clock=time.monotonic, **kwargs):
         import jax
         import jax.numpy as jnp
 
@@ -89,6 +89,11 @@ class ServingEngine:
         from deepspeed_tpu.runtime.config import DeepSpeedConfigError
 
         self._jax, self._jnp = jax, jnp
+        # injectable timebase: every request timestamp, deadline sweep
+        # and span bracket reads THIS clock, so the trace-replay harness
+        # can drive a real engine faster than real time (the router and
+        # fleet manager share the same seam)
+        self.clock = clock
         if isinstance(model_or_engine, InferenceEngine):
             if config is not None or kwargs:
                 raise ValueError(
@@ -145,7 +150,8 @@ class ServingEngine:
         self._tracer = self.telemetry.tracer
         self.sched = ContinuousBatchingScheduler(
             self.config, self.block_mgr, self.max_len, self.buckets,
-            prefix_cache=self.prefix, tracer=self._tracer)
+            clock=self.clock, prefix_cache=self.prefix,
+            tracer=self._tracer)
 
         self.cache = self._init_cache()
         self._tables = np.full(
@@ -381,7 +387,7 @@ class ServingEngine:
         requests into free slots, advance mid-prefill prompts one budgeted
         chunk, then advance every decode-ready sequence one token. Returns
         requests finished this step."""
-        now = time.monotonic()
+        now = self.clock()
         done: List[Request] = []
         # deadline sweep over running work
         for slot, req in self.sched.running():
@@ -514,7 +520,7 @@ class ServingEngine:
                    tok: int, done: List[Request]):
         """Prompt fully pooled: index the prompt for future prefix hits,
         join the decode batch, and emit the first sampled token."""
-        req.first_token_ts = time.monotonic()
+        req.first_token_ts = self.clock()
         req.length = req.prompt_len
         self._tables[slot] = table
         self._lengths[slot] = req.prompt_len
@@ -528,7 +534,7 @@ class ServingEngine:
         req.emit_token(tok, finished)
         if finished:
             reason = "eos" if tok == req.eos_token_id else "max_tokens"
-            self._finish(req, reason, time.monotonic(), done)
+            self._finish(req, reason, self.clock(), done)
 
     def _cow_copy(self, src: int, dst: int):
         jnp = self._jnp
@@ -551,7 +557,7 @@ class ServingEngine:
         # the ONE designed host sync per decode step: sampled tokens must
         # reach the host to stream to callers and drive finish logic
         toks = np.asarray(toks)  # graft-lint: disable=GL04
-        now = time.monotonic()
+        now = self.clock()
         self._step_count += 1
         self.telemetry.on_step_boundary(self._step_count,
                                         samples=len(active))
@@ -622,7 +628,7 @@ class ServingEngine:
                                                req.length + 1 + len(props))
             assert not granted, \
                 "speculative grant without a device table update"
-        t0 = time.monotonic()
+        t0 = self.clock()
         toks, self.cache = self._verify_fn(
             self.engine.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(self._tables), jnp.asarray(self._lengths),
@@ -630,7 +636,7 @@ class ServingEngine:
         # the ONE designed host sync per decode step (same contract as
         # the non-speculative loop): verified tokens drive commit/finish
         toks = np.asarray(toks)  # graft-lint: disable=GL04
-        now = time.monotonic()
+        now = self.clock()
         # chaos seam: a replica killed BETWEEN verify and commit has
         # emitted nothing from this window — host state is exactly the
         # pre-step state, so a retry or failover replays cleanly and
@@ -754,7 +760,7 @@ class ServingEngine:
         it is recorded as shed with ``reason``. The multi-replica router
         calls this at failover so abandoned proxies never keep decoding
         on a replica that later recovers."""
-        req = self.sched.cancel(request_id, reason, time.monotonic())
+        req = self.sched.cancel(request_id, reason, self.clock())
         if req is None:
             return False
         if 0 <= req.slot < len(self._tables):

@@ -184,6 +184,13 @@ class ReplicaHealth:
     # ------------------------------------------------------------------
     # rolling restarts
     def start_drain(self):
+        """No-op on an already-DRAINING replica (a repeated drain call —
+        an operator retry, or the fleet manager re-evaluating — must not
+        reset drain bookkeeping or cancel an open probe verdict), and on
+        a DEAD one (there is nothing left to drain; ``reactivate`` is
+        the only door back)."""
+        if self.state in (DRAINING, DEAD):
+            return
         self.probing = False
         self._set_state(DRAINING, "drain")
 

@@ -44,9 +44,11 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Set
 
 from deepspeed_tpu.serving import request as rq
-from deepspeed_tpu.serving.config import RouterConfig
+from deepspeed_tpu.serving.autoscaler import (SCALE_DOWN, SCALE_UP,
+                                              Autoscaler, Decision)
+from deepspeed_tpu.serving.config import FleetConfig, RouterConfig
 from deepspeed_tpu.serving.health import (DEAD, DEGRADED, DRAINING, HEALTHY,
-                                          TRIPPED, ReplicaHealth)
+                                          STATES, TRIPPED, ReplicaHealth)
 from deepspeed_tpu.telemetry.tracing import (NULL_TRACER, end_span, span_id,
                                              to_ns, trace_ctx)
 
@@ -608,18 +610,30 @@ class ReplicaRouter:
                    score=round(score, 4))
 
     # ------------------------------------------------------------------
-    # rolling restarts
+    # rolling restarts + fleet seams
     def start_drain(self, idx: int):
         """Stop routing new work to replica ``idx``; in-flight requests
         finish in place (a ``replica.drained`` event fires when the last
-        one does)."""
+        one does). Idempotent: a repeat call on an already-DRAINING
+        replica changes nothing — and in particular must not clear an
+        in-flight half-open probe's bookkeeping."""
+        if self.health[idx].state == DRAINING:
+            return
         self.health[idx].start_drain()
         self._probe_req.pop(idx, None)
 
     def reactivate(self, idx: int, replica=None):
-        """Bring a drained (or replaced) replica back into rotation —
+        """Bring a drained/tripped/dead replica back into rotation —
         optionally swapping in a fresh engine object (the restarted
-        process)."""
+        process). A LIVE replica (HEALTHY/DEGRADED) is refused loudly:
+        silently swapping an engine that is still taking traffic would
+        discard its in-flight work's home — ``start_drain()`` it first."""
+        h = self.health[idx]
+        if h.state in (HEALTHY, DEGRADED):
+            raise ValueError(
+                f"replica {idx} is live ({h.state}) — reactivate() only "
+                f"brings back a draining/tripped/dead/parked replica; "
+                f"start_drain({idx}) first to swap a serving engine")
         if replica is not None:
             if self._assigned[idx]:
                 # the old engine is being discarded with work still on
@@ -629,6 +643,58 @@ class ReplicaRouter:
                 self._failover_replica(idx, "reactivate")
             self.replicas[idx] = replica
         self.health[idx].reactivate()
+
+    def add_replica(self, replica) -> int:
+        """Grow the fleet: append a fresh replica (HEALTHY, immediately
+        routable) and return its index. The fleet manager's cold
+        scale-up path; also usable directly for manual capacity adds."""
+        idx = len(self.replicas)
+        self.replicas.append(replica)
+        self.health.append(ReplicaHealth(self.config, idx, self.clock,
+                                         emit=self._emit))
+        self._assigned.append(set())
+        self._emit("replica.added", replica=idx)
+        return idx
+
+    def assigned(self, idx: int) -> int:
+        """In-flight requests currently assigned to replica ``idx`` (the
+        public drain-progress gauge — a DRAINING replica is drained when
+        this reaches zero)."""
+        return len(self._assigned[idx])
+
+    def yield_work(self, idx: int, reason: str = "yield"):
+        """Fail replica ``idx``'s in-flight work over to survivors
+        without a health verdict — the drain-timeout escape hatch: a
+        wedged drain must never deadlock ``drain()`` behind one
+        replica."""
+        if self._assigned[idx]:
+            self._failover_replica(idx, reason)
+
+    def fleet_gauges(self) -> dict:
+        """One merged fleet view from the public surfaces: per-state
+        replica counts, aggregate queue/slot gauges over alive replicas,
+        and the overload score — the payload of the ``fleet`` gauge
+        event and the capacity model's food."""
+        by_state = {s: 0 for s in STATES}
+        depth = cap = busy = total = 0
+        for idx, h in enumerate(self.health):
+            by_state[h.state] += 1
+            if not h.alive:
+                continue
+            g = self._gauges(idx)
+            depth += int(g.get("queue_depth", 0))
+            cap += int(g.get("queue_capacity", 0))
+            busy += int(g.get("slots_busy", 0))
+            total += int(g.get("slots_total", 0))
+        return {
+            "replicas": len(self.replicas),
+            "routable": sum(1 for h in self.health if h.routable),
+            "by_state": by_state,
+            "queue_depth": depth, "queue_capacity": cap,
+            "slots_busy": busy, "slots_total": total,
+            "live_requests": len(self.requests),
+            "overload": round(self.overload(), 4),
+        }
 
     # ------------------------------------------------------------------
     @property
@@ -684,3 +750,376 @@ class ReplicaRouter:
             destroy = getattr(r, "destroy", None)
             if destroy is not None:
                 destroy()
+
+
+# ---------------------------------------------------------------------------
+# fleet manager: elastic scale over the router's drain/reactivate seams
+
+
+class ReplicaFactory:
+    """The scale-up seam: builds one fresh replica engine. ``build()``
+    returns anything with the ``ServingEngine`` surface, or raises —
+    the fleet manager backs off exponentially on failures (and the
+    chaos harness wraps this seam to prove it).
+
+    ``warm`` declares the build path: a warm factory restores the PR 8
+    AOT program bundle + ``tuned.json`` at engine build (checkpoint
+    ``aot``/``tuning`` blocks), so the new replica reaches first token
+    without steady-state compiles; a cold one pays the full compile."""
+
+    warm = False
+
+    def build(self):
+        raise NotImplementedError
+
+
+class CallableReplicaFactory(ReplicaFactory):
+    """Wrap a zero-arg builder callable as the factory seam. The warm
+    production shape closes over the serving/AOT config::
+
+        CallableReplicaFactory(
+            lambda: ServingEngine(init_inference(
+                model, serving=serving_cfg,
+                tuning={"artifact": "ckpt/tuned.json"},   # PR 8 tunables
+                telemetry={"enabled": True})),            # + armed AOTStore
+            warm=True)
+    """
+
+    def __init__(self, fn: Callable, warm: bool = False):
+        self._fn = fn
+        self.warm = bool(warm)
+
+    def build(self):
+        return self._fn()
+
+
+class FleetManager:
+    """Elastic scale over a :class:`ReplicaRouter`: the execution half
+    of the autoscaler (policy in ``serving/autoscaler.py``), walking
+    replicas through the router's public ``start_drain`` /
+    ``reactivate`` / ``add_replica`` seams.
+
+    Scale-down picks the least-loaded routable replica, drains it in
+    place, and **parks** the drained engine — compiled programs and all
+    — instead of destroying it. Scale-up walks the cheapest path first:
+
+    1. **cancel an in-progress drain** (the burst-during-scale-down
+       case: the replica still holds its work, reactivation is free);
+    2. **unpark** a parked engine (warm: its programs are live);
+    3. **build** through the :class:`ReplicaFactory` seam — into a DEAD
+       slot when one exists, else appended — with exponential backoff
+       across factory failures.
+
+    Every decision is a ``fleet`` telemetry event and an ``autoscale``
+    span on the request-trace stream. Token delivery is untouched: the
+    router's exactly-once dedupe shim owns that contract, and scaling
+    only ever uses the same drain/failover paths chaos already proves.
+    """
+
+    def __init__(self, router: ReplicaRouter, factory=None, config=None,
+                 capacity=None):
+        self.router = router
+        if config is None:
+            config = FleetConfig()
+        elif isinstance(config, dict):
+            config = FleetConfig(**config)
+        self.config: FleetConfig = config
+        if callable(factory) and not hasattr(factory, "build"):
+            factory = CallableReplicaFactory(factory)
+        self.factory = factory
+        self.capacity = capacity          # optional CapacityModel feed
+        self.clock = router.clock
+        self.telemetry = router.telemetry
+        self.autoscaler = Autoscaler(config)
+        self._tracer = router._tracer
+        self._trace_id = (self._tracer.new_trace(hint="fleet")
+                          if self._tracer.enabled else None)
+        self._step_count = 0
+        self._parked: Dict[int, object] = {}    # idx -> parked engine
+        self._draining: Dict[int, int] = {}     # idx -> drain start step
+        self._factory_fails = 0
+        self._factory_next_step = 0
+        self._last_step_ts = self.clock()
+        self._counters = self._fresh_counters()
+
+    @staticmethod
+    def _fresh_counters():
+        return {"scale_ups": 0, "scale_downs": 0, "parks": 0,
+                "unparks": 0, "drains_cancelled": 0, "drains_lost": 0,
+                "drain_timeouts": 0, "factory_builds": 0,
+                "factory_failures": 0}
+
+    # ------------------------------------------------------------------
+    def _emit(self, name: str, **data):
+        self.telemetry.emit("fleet", name, step=self._step_count, **data)
+
+    @property
+    def active_size(self) -> int:
+        """Replicas currently taking traffic (HEALTHY + DEGRADED)."""
+        return sum(1 for h in self.router.health if h.routable)
+
+    @property
+    def pending(self) -> bool:
+        return self.router.pending
+
+    # ------------------------------------------------------------------
+    # front-door delegation (the fleet manager IS the front door once
+    # autoscaling is on — same surface, scaling rides step())
+    def submit(self, prompt, **kwargs) -> RouterRequest:
+        rreq = self.router.submit(prompt, **kwargs)
+        if rreq.state == rq.SHED:
+            # submit-time sheds never appear in a step() result — feed
+            # the budget here or the shed budget undercounts exactly
+            # the overload sheds it exists to catch
+            self.autoscaler.observe_requests([rreq.record()])
+        return rreq
+
+    def _routable_load(self) -> float:
+        """Per-replica load over ROUTABLE replicas only — the capacity
+        model fits one serving replica's curve, so parked/draining
+        slots must not dilute the denominator (a saturated survivor
+        would read as half-loaded)."""
+        busy = depth = total = 0
+        for idx, h in enumerate(self.router.health):
+            if not h.routable:
+                continue
+            g = self.router._gauges(idx)
+            busy += int(g.get("slots_busy", 0))
+            depth += int(g.get("queue_depth", 0))
+            total += int(g.get("slots_total", 0))
+        return (busy + depth) / max(1, total)
+
+    def step(self) -> List[RouterRequest]:
+        done = self.router.step()
+        self._step_count += 1
+        self._check_drains()
+        # a drain-timeout yield can fail work over — and shed it — AFTER
+        # the router snapshotted its step result: pick those terminals
+        # up, or the shed budget misses exactly the overload sheds it
+        # exists to catch (and drain() callers never see them)
+        live = self.router._done_this_step
+        if len(live) > len(done):
+            done = done + live[len(done):]
+        overload = self.router.overload()
+        self.autoscaler.observe_requests(r.record() for r in done)
+        self.autoscaler.observe_step(overload)
+        if self.capacity is not None:
+            now = self.clock()
+            dt = max(0.0, now - self._last_step_ts)
+            self._last_step_ts = now
+            tokens = sum(len(r.tokens) for r in done
+                         if r.state == rq.FINISHED)
+            active = max(1, self.active_size)
+            load = self._routable_load()
+            self.capacity.observe(load, tokens=tokens / active, secs=dt)
+            for r in done:
+                if r.state == rq.FINISHED and r.first_token_ts:
+                    self.capacity.observe(load, ttft_ms=1e3 * (
+                        r.first_token_ts - r.submit_ts))
+        if self.active_size > self.config.max_replicas \
+                and not self._draining:
+            # breaker recovery can push the routable count past the
+            # bound (a scale-up replaced tripped replicas that later
+            # probed back HEALTHY): max_replicas is a hard ceiling, not
+            # a hint — drain the excess, one replica per step
+            self._execute(Decision(SCALE_DOWN, "max_replicas",
+                                   self._step_count, overload=overload))
+        else:
+            decision = self.autoscaler.decide(
+                self.active_size, overload=overload,
+                can_shrink=not self._draining)
+            if decision is not None:
+                self._execute(decision)
+        if self.telemetry.enabled:
+            self._emit("fleet.gauges", **self.gauges())
+        return done
+
+    def drain(self, max_steps: Optional[int] = None) -> List[RouterRequest]:
+        out: List[RouterRequest] = []
+        steps = 0
+        while self.pending and (max_steps is None or steps < max_steps):
+            out.extend(self.step())
+            steps += 1
+        return out
+
+    def generate_batch(self, prompts, max_new_tokens: int = 0, **kwargs):
+        reqs = [self.submit(p, max_new_tokens=max_new_tokens, **kwargs)
+                for p in prompts]
+        self.drain()
+        return [r.tokens if r.state == rq.FINISHED else None for r in reqs]
+
+    # ------------------------------------------------------------------
+    # scaling
+    def _check_drains(self):
+        c = self.config
+        for idx in sorted(self._draining):
+            h = self.router.health[idx]
+            if h.state == DEAD:
+                # chaos (or reality) killed the replica mid-drain: the
+                # router already failed its work over exactly-once —
+                # the slot is simply lost, not parked
+                self._draining.pop(idx)
+                self._counters["drains_lost"] += 1
+                self._emit("drain.lost", replica=idx)
+                continue
+            if self.router.assigned(idx) == 0:
+                self._park(idx)
+                continue
+            age = self._step_count - self._draining[idx]
+            if c.drain_timeout_steps and age >= c.drain_timeout_steps:
+                # a wedged drain must not hold the scale-down hostage:
+                # yield the stragglers to survivors and park anyway
+                self.router.yield_work(idx, "drain_timeout")
+                self._counters["drain_timeouts"] += 1
+                self._emit("drain.timeout", replica=idx, steps=age)
+                self._park(idx)
+
+    def _park(self, idx: int):
+        self._draining.pop(idx, None)
+        self._parked[idx] = self.router.replicas[idx]
+        self._counters["parks"] += 1
+        self._emit("replica.parked", replica=idx)
+
+    def _execute(self, decision):
+        t0 = self.clock()
+        before = self.active_size
+        if decision.action == SCALE_UP:
+            detail = self._scale_up(decision.reason)
+        else:
+            detail = self._scale_down(decision.reason)
+        if detail is None:
+            return
+        detail.setdefault("burn", decision.burn)
+        detail["overload"] = decision.overload
+        self._emit(f"scale.{decision.action}", reason=decision.reason,
+                   from_size=before, to_size=self.active_size, **detail)
+        if self._tracer.enabled:
+            self._tracer.record_span(
+                "autoscale", self._trace_id, to_ns(t0),
+                to_ns(self.clock()), action=decision.action,
+                reason=decision.reason, from_size=before,
+                to_size=self.active_size,
+                source=detail.get("source"))
+
+    def _scale_up(self, reason: str) -> Optional[dict]:
+        # 1) cheapest: cancel an in-progress drain (work still in place)
+        for idx in sorted(self._draining):
+            if self.router.health[idx].state == DRAINING:
+                self._draining.pop(idx)
+                self.router.reactivate(idx)
+                self._counters["drains_cancelled"] += 1
+                self._counters["scale_ups"] += 1
+                return {"source": "cancelled_drain", "replica": idx,
+                        "warm": True}
+        # 2) warm: unpark a drained engine — compiled programs live
+        if self._parked:
+            idx = min(self._parked)
+            self._parked.pop(idx)
+            self.router.reactivate(idx)
+            self._counters["unparks"] += 1
+            self._counters["scale_ups"] += 1
+            return {"source": "parked", "replica": idx, "warm": True}
+        # 3) the factory seam, behind exponential failure backoff
+        if self.factory is None:
+            self._emit("scale.blocked", reason=reason,
+                       detail="no_factory")
+            return None
+        if self._step_count < self._factory_next_step:
+            return None  # backing off a failing factory; retry later
+        try:
+            replica = self.factory.build()
+        except Exception as e:
+            self._factory_fails += 1
+            backoff = self.config.factory_backoff_steps \
+                * (2 ** (self._factory_fails - 1))
+            self._factory_next_step = self._step_count + backoff
+            self._counters["factory_failures"] += 1
+            self._emit("factory.failed", error=f"{type(e).__name__}: {e}",
+                       failures=self._factory_fails,
+                       retry_step=self._factory_next_step)
+            return None
+        self._factory_fails = 0
+        self._factory_next_step = 0
+        self._counters["factory_builds"] += 1
+        self._counters["scale_ups"] += 1
+        dead = next((i for i, h in enumerate(self.router.health)
+                     if h.state == DEAD and i not in self._parked), None)
+        if dead is not None:
+            self.router.reactivate(dead, replica=replica)
+            return {"source": "factory", "replica": dead,
+                    "warm": bool(self.factory.warm), "replaced_dead": True}
+        idx = self.router.add_replica(replica)
+        return {"source": "factory", "replica": idx,
+                "warm": bool(self.factory.warm)}
+
+    def _scale_down(self, reason: str) -> Optional[dict]:
+        routable = [i for i, h in enumerate(self.router.health)
+                    if h.routable]
+        if len(routable) <= self.config.min_replicas:
+            return None
+        # least-loaded victim (highest index breaks ties): draining the
+        # emptiest replica finishes fastest
+        idx = min(routable, key=lambda i: (self.router._load(i), -i))
+        self.router.start_drain(idx)
+        self._draining[idx] = self._step_count
+        self._counters["scale_downs"] += 1
+        return {"source": "drain", "replica": idx}
+
+    # manual operator levers (the tests' chaos choreography too)
+    def scale_up(self, reason: str = "manual") -> Optional[dict]:
+        before = self.active_size
+        detail = self._scale_up(reason)
+        if detail is not None:
+            self._emit("scale.up", reason=reason, from_size=before,
+                       to_size=self.active_size, **detail)
+        return detail
+
+    def scale_down(self, idx: Optional[int] = None,
+                   reason: str = "manual") -> Optional[dict]:
+        if idx is None:
+            before = self.active_size
+            detail = self._scale_down(reason)
+            if detail is not None:
+                self._emit("scale.down", reason=reason, from_size=before,
+                           to_size=self.active_size, **detail)
+            return detail
+        if idx in self._draining:
+            return None  # idempotent, like start_drain itself
+        before = self.active_size
+        self.router.start_drain(idx)
+        self._draining[idx] = self._step_count
+        self._counters["scale_downs"] += 1
+        self._emit("scale.down", reason=reason, replica=idx,
+                   from_size=before, to_size=self.active_size)
+        return {"source": "drain", "replica": idx}
+
+    # ------------------------------------------------------------------
+    def gauges(self) -> dict:
+        """The merged per-step fleet view (also the ``fleet.gauges``
+        event payload): router fleet gauges + fleet bookkeeping + SLO
+        budget remaining."""
+        return {
+            **self.router.fleet_gauges(),
+            "active": self.active_size,
+            "parked": len(self._parked),
+            "draining": len(self._draining),
+            "min_replicas": self.config.min_replicas,
+            "max_replicas": self.config.max_replicas,
+            "budget_remaining": self.autoscaler.budget_remaining(),
+        }
+
+    def stats(self) -> dict:
+        return {
+            **self.gauges(),
+            **self._counters,
+            "router": self.router.stats(),
+        }
+
+    def reset_stats(self):
+        self._counters = self._fresh_counters()
+        self.router.reset_stats()
+
+    def destroy(self):
+        # parked engines stay in router.replicas (parking is fleet-level
+        # bookkeeping, not removal), so the router teardown covers them
+        self.router.destroy()

@@ -41,6 +41,10 @@ satellite families that ride the same sink):
                      ``start_ns``/``end_ns`` monotonic bounds; the span
                      *name* must come from :data:`SPANS` (GL05 pins the
                      literals, same convention as ``KINDS``)
+- ``fleet``        — elastic fleet manager: scale up/down decisions,
+                     drains parked/lost/timed out, factory builds and
+                     failures, per-step fleet gauges (replica-state
+                     counts + SLO budget remaining)
 
 Everything in ``data`` must be JSON-safe; :func:`json_safe` coerces numpy
 scalars and drops device arrays (an event must never pin or sync device
@@ -54,7 +58,7 @@ from typing import Any, Dict, Optional
 
 KINDS = ("compile", "step_cost", "memory", "trace_window", "step",
          "wallclock", "comm", "fault", "serving", "model_time", "topology",
-         "router", "aot", "tuning", "span")
+         "router", "aot", "tuning", "span", "fleet")
 
 # Registered span names (the ``span`` kind's analog of KINDS): the report
 # tool groups phase tables and waterfalls by these literals and the
@@ -77,6 +81,8 @@ SPANS = (
     "verify",         # the shared k-token verify dispatch, per-request view
     "spec_commit",    # accepted-prefix commit + rejected-tail drop
     "shed",           # admission/deadline shed (zero-work terminal span)
+    "autoscale",      # one fleet scaling action: decision -> executed
+    #                   (attrs: action, reason, from_size, to_size, source)
     # training step level: one trace per optimizer step
     "step",           # root — first observed phase -> step boundary
     "data",           # host-side batch fetch/assembly

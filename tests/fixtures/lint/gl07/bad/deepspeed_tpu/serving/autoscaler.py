@@ -1,0 +1,15 @@
+"""BAD: a replay-deterministic module reading the wall clock directly."""
+
+import time
+
+
+class Autoscaler:
+    def __init__(self, clock=time.monotonic):   # the seam: legal
+        self.clock = clock
+
+    def decide(self):
+        now = time.monotonic()          # BAD: bypasses the seam
+        wall = time.time()              # BAD: wall clock in a fake-clock world
+        tick = time.perf_counter()      # BAD
+        time.sleep(0.1)                 # BAD: blocks faster-than-real-time
+        return now + wall + tick

@@ -1,0 +1,11 @@
+"""BAD: datetime reads stamp real time into replayed records."""
+
+import datetime
+from datetime import datetime as dt
+
+
+class TraceReplayer:
+    def stamp(self):
+        a = datetime.datetime.now()     # BAD
+        b = dt.utcnow()                 # BAD
+        return a, b

@@ -1,0 +1,11 @@
+"""GOOD: time only flows through the injected clock seam."""
+
+import time
+
+
+class Autoscaler:
+    def __init__(self, clock=time.monotonic):   # referencing = the seam
+        self.clock = clock
+
+    def decide(self):
+        return self.clock()             # reads the injected clock

@@ -1,0 +1,9 @@
+"""GOOD: the device-side engine is NOT in the clocked registry — its
+real clock reads are legal (replay never fakes the engine's timebase)."""
+
+import time
+
+
+class ServingEngine:
+    def step(self):
+        return time.monotonic()

@@ -38,7 +38,8 @@ RUN_LIST = ["getting-started.md", "parallelism.md", "inference.md",
             "zero-inference.md", "sparse-attention.md", "autotuning.md",
             "training-efficiency.md", "checkpointing.md",
             "comm-quantization.md", "telemetry.md", "resilience.md",
-            "serving.md", "elasticity.md", "aot.md", "lint.md"]
+            "serving.md", "elasticity.md", "aot.md", "lint.md",
+            "fleet.md"]
 
 
 @pytest.mark.heavy

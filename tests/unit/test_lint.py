@@ -38,7 +38,7 @@ def by_code(report, code):
 
 
 @pytest.mark.parametrize("code", ["GL01", "GL02", "GL03", "GL04", "GL05",
-                                  "GL06"])
+                                  "GL06", "GL07"])
 def test_checker_fires_on_bad_and_is_silent_on_good(code):
     name = code.lower()
     bad = fixture_run(name, "bad")
@@ -184,6 +184,41 @@ class TestGL06:
         # good tree: alias documents `renamed`, deprecated exempt,
         # params payload never checked
         assert not by_code(fixture_run("gl06", "good"), "GL06")
+
+
+class TestGL07:
+    def test_every_clock_family_fires(self):
+        found = by_code(fixture_run("gl07", "bad"), "GL07")
+        msgs = " | ".join(f.message for f in found)
+        for call in ("time.monotonic", "time.time", "time.perf_counter",
+                     "time.sleep", "datetime.datetime.now", "dt.utcnow"):
+            assert call in msgs, f"GL07 missed {call}"
+
+    def test_seam_default_and_clock_reads_are_legal(self):
+        """``clock=time.monotonic`` as a default argument is the seam
+        itself; ``self.clock()`` reads are how the seam is consumed —
+        neither may fire."""
+        assert not by_code(fixture_run("gl07", "good"), "GL07")
+
+    def test_unregistered_module_keeps_its_real_clock(self):
+        """The good corpus' engine.py calls time.monotonic() directly —
+        it is not in CLOCKED_MODULES (the device side keeps real time),
+        so GL07 must stay scoped to the registry."""
+        report = fixture_run("gl07", "good")
+        assert report.files_scanned == 2      # engine.py really scanned
+        assert not by_code(report, "GL07")
+
+    def test_registry_covers_the_fleet_tier(self):
+        from tools.lint.checkers.gl07_injectable_clock import \
+            CLOCKED_MODULES
+
+        assert {"deepspeed_tpu/serving/router.py",
+                "deepspeed_tpu/serving/health.py",
+                "deepspeed_tpu/serving/scheduler.py",
+                "deepspeed_tpu/serving/autoscaler.py",
+                "deepspeed_tpu/serving/replay.py",
+                "deepspeed_tpu/serving/capacity.py"} \
+            <= set(CLOCKED_MODULES)
 
 
 # ---------------------------------------------------------------------------
@@ -380,7 +415,7 @@ class TestRepoGate:
     def test_whole_package_was_scanned(self, repo_report):
         assert repo_report.files_scanned > 100
         assert repo_report.codes_run == ["GL01", "GL02", "GL03", "GL04",
-                                         "GL05", "GL06"]
+                                         "GL05", "GL06", "GL07"]
 
     def test_runs_inside_the_tier1_budget(self, repo_report):
         assert repo_report.elapsed < 2.0, (

@@ -1,4 +1,4 @@
-"""Built-in checkers. Importing this package registers GL01–GL06."""
+"""Built-in checkers. Importing this package registers GL01–GL07."""
 
 from tools.lint.checkers import (  # noqa: F401
     gl01_jax_free,
@@ -7,4 +7,5 @@ from tools.lint.checkers import (  # noqa: F401
     gl04_host_sync,
     gl05_event_kinds,
     gl06_config_docs,
+    gl07_injectable_clock,
 )

@@ -32,6 +32,9 @@ JAX_FREE_MODULES = (
     "deepspeed_tpu/serving/config.py",
     "deepspeed_tpu/serving/request.py",
     "deepspeed_tpu/serving/spec_decode.py",
+    "deepspeed_tpu/serving/autoscaler.py",
+    "deepspeed_tpu/serving/replay.py",
+    "deepspeed_tpu/serving/capacity.py",
     "deepspeed_tpu/telemetry/events.py",
     "deepspeed_tpu/telemetry/tracing.py",
     "deepspeed_tpu/telemetry/metrics.py",

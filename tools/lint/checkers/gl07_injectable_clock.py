@@ -1,0 +1,113 @@
+"""GL07 injectable-clock.
+
+The serving policy tier (router/health/scheduler) and the fleet tier
+(autoscaler/replay/capacity) are driven by the trace-replay harness
+faster than real time under fake clocks, and every chaos/SLO test pins
+bit-deterministic behavior against that simulated timebase. A direct
+wall-clock read inside these modules — ``time.time()``,
+``time.monotonic()``, ``datetime.now()`` — silently mixes real time
+into the simulation and rots replay determinism in ways no single test
+catches (a record stamped off-timebase, a backoff that half-listens to
+the fake clock).
+
+The seam is the ``clock=...`` constructor parameter every one of these
+classes already has: *referencing* ``time.monotonic`` as a default
+argument is the seam itself and stays legal; *calling* any clock (or
+``time.sleep``, which would block the faster-than-real-time loop) is
+the finding — through the module name, an import alias, or a bare
+``from time import monotonic`` name. Modules outside the registry (the
+device-side engine, benches, tools) keep their real clocks.
+"""
+
+import ast
+from typing import Iterable, Set, Tuple
+
+from tools.lint.core import Checker, Finding, LintContext, dotted, register
+
+# the replay-deterministic registry: these modules may read time ONLY
+# through their injected clock seam
+CLOCKED_MODULES = (
+    "deepspeed_tpu/serving/router.py",
+    "deepspeed_tpu/serving/health.py",
+    "deepspeed_tpu/serving/scheduler.py",
+    "deepspeed_tpu/serving/autoscaler.py",
+    "deepspeed_tpu/serving/replay.py",
+    "deepspeed_tpu/serving/capacity.py",
+)
+
+_TIME_ATTRS = {"time", "monotonic", "perf_counter", "time_ns",
+               "monotonic_ns", "perf_counter_ns", "sleep"}
+_DATETIME_ATTRS = {"now", "utcnow", "today"}
+
+
+def _clock_names(tree: ast.Module) -> Tuple[Set[str], Set[str], Set[str]]:
+    """(time-module aliases, datetime-class aliases, bare clock names
+    pulled in via ``from time import ...``) at module level."""
+    time_mods, dt_names, bare = set(), set(), set()
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_mods.add(a.asname or "time")
+                elif a.name == "datetime":
+                    dt_names.add(a.asname or "datetime")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "time":
+                for a in node.names:
+                    if a.name in _TIME_ATTRS:
+                        bare.add(a.asname or a.name)
+            elif node.module == "datetime":
+                for a in node.names:
+                    if a.name in ("datetime", "date"):
+                        dt_names.add(a.asname or a.name)
+    return time_mods, dt_names, bare
+
+
+@register
+class InjectableClock(Checker):
+    code = "GL07"
+    name = "injectable-clock"
+    description = ("serving policy + fleet modules (router/health/"
+                   "scheduler/autoscaler/replay/capacity) must read time "
+                   "only through their injected clock seam — direct "
+                   "time.*/datetime.now calls rot replay determinism")
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        for entry in CLOCKED_MODULES:
+            mod = ctx.parse_under_root(entry)
+            if mod is None or mod.tree() is None:
+                continue
+            if not mod.mentions("time", "datetime"):
+                continue
+            time_mods, dt_names, bare = _clock_names(mod.tree())
+            for node in mod.nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                bad = self._bad_call(node, time_mods, dt_names, bare)
+                if bad is not None:
+                    yield Finding(
+                        code=self.code, path=mod.relpath,
+                        line=node.lineno, col=node.col_offset,
+                        message=(
+                            f"direct wall-clock call {bad}() in a "
+                            f"replay-deterministic module — read time "
+                            f"through the injected clock seam "
+                            f"(self.clock(); clock=time.monotonic as a "
+                            f"DEFAULT is the seam and stays legal)"))
+
+    @staticmethod
+    def _bad_call(node, time_mods, dt_names, bare):
+        if isinstance(node.func, ast.Name):
+            return node.func.id if node.func.id in bare else None
+        d = dotted(node.func)
+        if d is None or "." not in d:
+            return None
+        base, attr = d.rsplit(".", 1)
+        if attr in _TIME_ATTRS and base in time_mods:
+            return d
+        if attr in _DATETIME_ATTRS and (
+                base in dt_names
+                or base.split(".", 1)[0] in dt_names):
+            # datetime.now() / datetime.datetime.now() / dt.utcnow()
+            return d
+        return None

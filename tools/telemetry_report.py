@@ -57,6 +57,9 @@ def aggregate(events: List[Dict]) -> Dict:
                "prefix_hit_tokens": 0, "hit_requests": 0, "blocks_shared": 0,
                "prefill_chunks": 0, "last_gauges": {},
                "draft_tokens": 0, "accepted_tokens": 0, "spec_requests": 0}
+    fleet = {"events": 0, "scale_ups": 0, "scale_downs": 0, "parks": 0,
+             "drains_lost": 0, "drain_timeouts": 0, "factory_failures": 0,
+             "decisions": [], "last_gauges": {}}
     aot = {"events": 0, "hits": 0, "hit_programs": {}, "captured": 0,
            "captured_bytes": 0, "disabled": [], "load_failed": 0,
            "armed_programs": 0}
@@ -150,6 +153,29 @@ def aggregate(events: List[Dict]) -> Dict:
                 serving["shed"] += 1
             elif name == "step.gauges":
                 serving["last_gauges"] = data
+        elif kind == "fleet":
+            fleet["events"] += 1
+            if name in ("scale.up", "scale.down"):
+                key = "scale_ups" if name == "scale.up" else "scale_downs"
+                fleet[key] += 1
+                fleet["decisions"].append(
+                    {"step": e.get("step"),
+                     "action": name.split(".", 1)[1],
+                     "reason": data.get("reason"),
+                     "source": data.get("source"),
+                     "from": data.get("from_size"),
+                     "to": data.get("to_size")})
+                fleet["decisions"] = fleet["decisions"][-20:]
+            elif name == "replica.parked":
+                fleet["parks"] += 1
+            elif name == "drain.lost":
+                fleet["drains_lost"] += 1
+            elif name == "drain.timeout":
+                fleet["drain_timeouts"] += 1
+            elif name == "factory.failed":
+                fleet["factory_failures"] += 1
+            elif name == "fleet.gauges":
+                fleet["last_gauges"] = data
         elif kind == "aot":
             aot["events"] += 1
             action = data.get("action")
@@ -189,6 +215,7 @@ def aggregate(events: List[Dict]) -> Dict:
         "steps": steps,
         "faults": faults,
         "router": router,
+        "fleet": fleet,
         "serving": serving,
         "aot": aot,
         "tuning": tuning,
@@ -344,6 +371,56 @@ def _router_lines(agg: Dict, markdown: bool) -> List[str]:
     for t in r["tier_transitions"][-5:]:
         out.append(f"{'' if markdown else '  '}tier {t['from']} -> "
                    f"{t['to']} at step {t['step']} (score {t['score']})")
+    return out
+
+
+def _fleet_lines(agg: Dict, markdown: bool) -> List[str]:
+    """Elastic fleet: scaling decisions, drains parked/lost, factory
+    failures, and the last fleet gauge snapshot (per-state replica
+    counts + SLO budget remaining)."""
+    f = agg.get("fleet") or {}
+    if not f.get("events"):
+        return []
+    out = [""]
+    head = (f"fleet: {f['scale_ups']} scale-up(s), "
+            f"{f['scale_downs']} scale-down(s), {f['parks']} park(s)"
+            + (f", {f['drains_lost']} drain(s) lost"
+               if f.get("drains_lost") else "")
+            + (f", {f['drain_timeouts']} drain timeout(s)"
+               if f.get("drain_timeouts") else "")
+            + (f", {f['factory_failures']} factory failure(s)"
+               if f.get("factory_failures") else ""))
+    out.append(("### " if markdown else "") + head)
+    pad = "" if markdown else "  "
+    g = f.get("last_gauges") or {}
+    if g:
+        states = g.get("by_state") or {}
+        chain = ", ".join(f"{k}: {v}" for k, v in sorted(states.items())
+                          if v)
+        out.append(
+            f"{pad}fleet at last step: {g.get('active', '?')} active of "
+            f"{g.get('replicas', '?')} ({chain}), "
+            f"{g.get('parked', 0)} parked, queue "
+            f"{g.get('queue_depth', '?')}/{g.get('queue_capacity', '?')}, "
+            f"overload {g.get('overload', '?')}")
+        budget = g.get("budget_remaining") or {}
+        if budget:
+            out.append(f"{pad}SLO budget remaining: "
+                       + ", ".join(f"{k}: {v}" for k, v in
+                                   sorted(budget.items())))
+    if markdown and f.get("decisions"):
+        out.append("\n| step | action | reason | source | fleet |")
+        out.append("|---|---|---|---|---|")
+        for d in f["decisions"][-10:]:
+            out.append(f"| {d['step']} | {d['action']} | {d['reason']} "
+                       f"| {d.get('source') or '-'} "
+                       f"| {d['from']} -> {d['to']} |")
+    else:
+        for d in (f.get("decisions") or [])[-10:]:
+            out.append(f"{pad}step {d['step']}: {d['action']} "
+                       f"({d['reason']}"
+                       + (f", {d['source']}" if d.get("source") else "")
+                       + f") {d['from']} -> {d['to']}")
     return out
 
 
@@ -649,6 +726,7 @@ def render(path: str, markdown: bool = False,
     lines.extend(_fault_lines(agg, markdown))
     lines.extend(_serving_lines(agg, markdown))
     lines.extend(_router_lines(agg, markdown))
+    lines.extend(_fleet_lines(agg, markdown))
     lines.extend(_span_lines(agg, markdown))
     lines.extend(_aot_lines(agg, markdown))
     lines.extend(_tuning_lines(agg, markdown, tuned_artifact))

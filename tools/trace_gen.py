@@ -1,0 +1,115 @@
+"""Generate synthetic arrival traces for the workload-replay harness.
+
+The CLI face of ``deepspeed_tpu/serving/replay.py``'s generators: one
+JSONL arrival trace (``arrival_ts`` / ``prompt_len`` /
+``max_new_tokens`` / ``tenant`` + ``prefix_len`` / ``priority`` /
+``deadline_ms``) to stdout or ``--out``, fully deterministic given
+``--seed``. Patterns::
+
+    python tools/trace_gen.py --pattern poisson --duration 60 --rate 2 \\
+        --seed 7 --out trace.jsonl
+    python tools/trace_gen.py --pattern diurnal --duration 300 \\
+        --rate 4 --peak-fraction 0.8 --period 120 --seed 7
+    python tools/trace_gen.py --pattern burst --duration 120 --rate 1 \\
+        --burst 30:10:8 --burst 80:5:16 --seed 7
+    python tools/trace_gen.py --pattern diurnal_burst ...   # both
+
+Exit codes: 0 on success, 1 on a usage error (bad burst spec, bad
+pattern). A ``# summary`` line on stderr reports arrivals/sec so a
+generated file is sanity-checkable at a glance.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.serving.replay import (  # noqa: E402
+    save_trace,
+    synthesize_trace,
+)
+
+PATTERNS = ("poisson", "diurnal", "burst", "diurnal_burst")
+
+
+def parse_burst(spec: str):
+    """``start:duration:extra_rate`` -> tuple of floats."""
+    parts = spec.split(":")
+    if len(parts) != 3:
+        raise ValueError(
+            f"burst spec must be start:duration:extra_rate, got {spec!r}")
+    return tuple(float(p) for p in parts)
+
+
+def build(args) -> list:
+    bursts = [parse_burst(s) for s in args.burst]
+    diurnal = args.pattern in ("diurnal", "diurnal_burst")
+    if args.pattern in ("burst", "diurnal_burst") and not bursts:
+        raise ValueError(f"pattern {args.pattern!r} needs at least one "
+                         f"--burst start:duration:extra_rate")
+    return synthesize_trace(
+        args.duration, seed=args.seed, base_rate=args.rate,
+        diurnal_fraction=args.peak_fraction if diurnal else 0.0,
+        diurnal_period_secs=args.period,
+        bursts=bursts if args.pattern != "diurnal" else (),
+        prompt_len_mean=args.prompt_mean, prompt_len_sigma=args.sigma,
+        prompt_len_max=args.prompt_max,
+        gen_mean=args.gen_mean, gen_sigma=args.sigma,
+        gen_max=args.gen_max,
+        tenants=args.tenants, shared_fraction=args.shared_fraction,
+        shared_prefix_len=args.prefix_len,
+        priorities=args.priorities, deadline_ms=args.deadline_ms)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pattern", default="poisson", choices=PATTERNS)
+    ap.add_argument("--duration", type=float, default=60.0,
+                    help="trace length in simulated seconds")
+    ap.add_argument("--rate", type=float, default=1.0,
+                    help="base arrival rate (requests/sec)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--peak-fraction", type=float, default=0.5,
+                    help="diurnal swing around the base rate (0..1)")
+    ap.add_argument("--period", type=float, default=60.0,
+                    help="diurnal period (simulated seconds)")
+    ap.add_argument("--burst", action="append", default=[],
+                    metavar="START:DUR:RATE",
+                    help="burst window (repeatable)")
+    ap.add_argument("--prompt-mean", type=float, default=64.0)
+    ap.add_argument("--prompt-max", type=int, default=512)
+    ap.add_argument("--gen-mean", type=float, default=32.0)
+    ap.add_argument("--gen-max", type=int, default=256)
+    ap.add_argument("--sigma", type=float, default=0.6,
+                    help="lognormal sigma for the heavy-tail lengths")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="shared-prefix tenant pool size (0 = unshared)")
+    ap.add_argument("--shared-fraction", type=float, default=0.0)
+    ap.add_argument("--prefix-len", type=int, default=0,
+                    help="tokens a tenant's prompts share")
+    ap.add_argument("--priorities", type=int, default=1)
+    ap.add_argument("--deadline-ms", type=float, default=0.0)
+    ap.add_argument("--out", default=None,
+                    help="output path (default: stdout)")
+    args = ap.parse_args(argv)
+    try:
+        trace = build(args)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.out:
+        save_trace(args.out, trace)
+    else:
+        import json
+        for a in trace:
+            print(json.dumps(a.to_json(), separators=(",", ":")))
+    shared = sum(1 for a in trace if a.tenant)
+    print(f"# summary: {len(trace)} arrivals over {args.duration}s "
+          f"({len(trace) / args.duration:.2f}/s), {shared} shared-prefix",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -193,6 +193,7 @@ def main():
     emit_result(_elastic_resume_series(cfg, batch, seq, on_tpu))
     emit_result(_startup_series(cfg, batch, seq, on_tpu))
     emit_result(_tracing_series(cfg, batch, seq, on_tpu))
+    emit_result(_metrics_series(cfg, batch, seq, on_tpu))
 
 
 def _telemetry_series(warm_mark, steps):
@@ -635,6 +636,93 @@ def _tracing_series(cfg, batch, seq, on_tpu, steps=3):
                 "error": str(e)[:300]}
 
 
+def _metrics_series(cfg, batch, seq, on_tpu, steps=3):
+    """Optional extra series (after the headline JSON): the live
+    metrics plane's overhead bound. Three numbers on one line —
+    (1) steps/s with the registry + flight recorder OFF vs ON (both
+    legs telemetry-enabled, so the delta isolates the metrics plane;
+    the compiled programs are byte-identical by the zero-overhead pin,
+    this bounds the host-side part the pin can't see); (2) scrape
+    latency against a live endpoint serving a populated registry;
+    (3) the flight-recorder ring's per-event overhead."""
+    import sys
+
+    try:
+        base = _train_step_series(
+            cfg, batch, seq, on_tpu, steps=steps,
+            ds_overrides={"telemetry": {
+                "enabled": True, "jsonl": False, "memory": False}})
+        metered = _train_step_series(
+            cfg, batch, seq, on_tpu, steps=steps,
+            ds_overrides={"telemetry": {
+                "enabled": True, "jsonl": False, "memory": False,
+                "metrics_port": 0,
+                "flight_recorder": {"enabled": True}}})
+        off = base["steps_per_sec"]
+        on = metered["steps_per_sec"]
+
+        # scrape latency against a live endpoint with representative
+        # content (step gauges + latency histograms + label fan-out)
+        import tempfile
+        import urllib.request
+
+        from deepspeed_tpu.telemetry import Telemetry
+
+        with tempfile.TemporaryDirectory(prefix="bench_metrics_") as d:
+            t = Telemetry({"enabled": True, "dir": d, "jsonl": False,
+                           "memory": False, "metrics_port": 0})
+            m = t.metrics
+            for i in range(200):
+                m.histogram("ds_serving_ttft_ms").observe(1.0 + i)
+                m.histogram("ds_serving_queue_ms").observe(0.5 + i)
+                m.counter("ds_serving_requests_total",
+                          ("outcome",)).labels(outcome="finished").inc()
+            for i in range(8):
+                m.gauge("ds_replica_health", ("replica", "state"),
+                        max_label_sets=256).labels(
+                            replica=str(i), state="healthy").set(1)
+            url = t._metrics_server.url
+            lat = []
+            body = b""
+            for _ in range(5):
+                t0 = time.perf_counter()
+                body = urllib.request.urlopen(url, timeout=5).read()
+                lat.append(1e3 * (time.perf_counter() - t0))
+            scrape_ms = round(sorted(lat)[len(lat) // 2], 3)
+            scrape_bytes = len(body)
+
+            # flight-recorder ring: ns per recorded event (pure deque
+            # append + trigger check; the dump path is off-budget)
+            t2 = Telemetry({"enabled": True, "dir": d, "jsonl": False,
+                            "memory": False,
+                            "flight_recorder": {"enabled": True,
+                                                "max_dumps": 1}})
+            n = 20_000
+            t0 = time.perf_counter()
+            for i in range(n):
+                t2.emit("step", "bench", step=i)
+            ring_ns = round(1e9 * (time.perf_counter() - t0) / n)
+            t2.close()
+            t.close()
+        return {
+            "metric": METRIC + "_metrics",
+            "steps_per_sec_metrics_off": off,
+            "steps_per_sec_metrics_on": on,
+            "overhead_pct": round(100.0 * (off - on) / off, 2)
+            if off else None,
+            "scrape_ms_p50": scrape_ms,
+            "scrape_bytes": scrape_bytes,
+            "recorder_ns_per_event": ring_ns,
+            "n_dev": base["n_dev"], "batch": batch, "seq": seq,
+            "steps": steps,
+        }
+    except Exception as e:  # noqa: BLE001 — extras never kill the headline
+        print(f"# metrics series failed: {e}", file=sys.stderr, flush=True)
+        return {"metric": METRIC + "_metrics", "value": None,
+                "unit": "steps/s", "vs_baseline": None,
+                "error": str(e)[:300]}
+
+
 def _startup_series(cfg, batch, seq, on_tpu, steps=3):
     """Optional extra series (after the headline JSON): what the AOT
     program cache buys on restart. One engine (telemetry + aot enabled)
@@ -864,12 +952,14 @@ def run_series(name, config=None):
         return _elastic_resume_series(cfg, batch, seq, on_tpu)
     if name == "tracing":
         return _tracing_series(cfg, batch, seq, on_tpu, steps=ctx["steps"])
+    if name == "metrics":
+        return _metrics_series(cfg, batch, seq, on_tpu, steps=ctx["steps"])
     raise KeyError(f"unknown bench series {name!r}; available: "
                    f"{sorted(SERIES)}")
 
 
 SERIES = ("train_step", "startup", "telemetry", "resilience",
-          "comm_compression", "elastic_resume", "tracing")
+          "comm_compression", "elastic_resume", "tracing", "metrics")
 
 
 if __name__ == "__main__":

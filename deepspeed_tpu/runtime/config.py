@@ -250,6 +250,34 @@ class TelemetryTracingConfig(DeepSpeedConfigModel):
         return self
 
 
+class TelemetryFlightRecorderConfig(DeepSpeedConfigModel):
+    """``telemetry.flight_recorder``: a bounded in-memory ring of recent
+    telemetry events (spans included) + metric-registry snapshots,
+    continuously armed while telemetry is on and dumped atomically to
+    ``<dump_dir>/flightrec-<ts>/`` on fault events, breaker trips,
+    SIGTERM, or an explicit call — the "what was happening in the 30 s
+    before the watchdog killed us" artifact. Off by default; enabling
+    it changes host-side bookkeeping only (the compiled step/decode HLO
+    stays byte-identical, pinned in tests/unit/test_metrics_plane.py).
+    """
+
+    enabled: bool = False
+    events: int = 512          # event-ring capacity (spans ride it too)
+    snapshots: int = 64        # metric-snapshot ring (0 disables)
+    dump_dir: Optional[str] = None   # default: <telemetry.dir>
+    max_dumps: int = 4         # per-process dump budget (fault storms
+    #                            must not fill the disk)
+    on_sigterm: bool = True    # chain a SIGTERM handler (preemption dump)
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.events <= 0 or self.snapshots < 0 or self.max_dumps < 1:
+            raise ValueError(
+                "telemetry.flight_recorder needs events > 0, "
+                "snapshots >= 0 and max_dumps >= 1")
+        return self
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """``telemetry`` section (TPU-native): the unified observability event
     stream (``deepspeed_tpu/telemetry/``). Four collectors:
@@ -283,9 +311,22 @@ class TelemetryConfig(DeepSpeedConfigModel):
     sample_every: int = 1
     warmup_steps: int = 1
     recompile_warn_after: int = 1
+    # live metrics plane (telemetry/registry.py + prom.py): a labeled
+    # Counter/Gauge/Histogram registry with OpenMetrics/Prometheus text
+    # exposition. metrics_port arms the registry AND serves it from a
+    # stdlib http.server endpoint per process (0 = ephemeral port; None
+    # = no server). metrics_file arms the registry and atomically dumps
+    # the exposition text there at step boundaries (the scrape-less
+    # path). Both absent (default): the registry is the inert
+    # NULL_REGISTRY and nothing changes anywhere.
+    metrics_port: Optional[int] = None
+    metrics_host: str = "127.0.0.1"
+    metrics_file: Optional[str] = None
     trace: TelemetryTraceConfig = Field(default_factory=TelemetryTraceConfig)
     tracing: TelemetryTracingConfig = Field(
         default_factory=TelemetryTracingConfig)
+    flight_recorder: TelemetryFlightRecorderConfig = Field(
+        default_factory=TelemetryFlightRecorderConfig)
 
     @model_validator(mode="after")
     def _check(self):
@@ -297,6 +338,10 @@ class TelemetryConfig(DeepSpeedConfigModel):
         if self.rotate_bytes < 0 or self.rotate_keep < 1:
             raise ValueError("telemetry.rotate_bytes must be >= 0 and "
                              "rotate_keep >= 1")
+        if self.metrics_port is not None and not (
+                0 <= self.metrics_port <= 65535):
+            raise ValueError("telemetry.metrics_port must be a valid "
+                             "port (0 binds an ephemeral one) or absent")
         return self
 
 

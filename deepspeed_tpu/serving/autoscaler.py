@@ -225,3 +225,13 @@ class Autoscaler:
         if c.target_shed_rate > 0:
             out["shed"] = self._shed_slow.remaining()
         return out
+
+    def burn_rates(self) -> Dict[str, Dict[str, Optional[float]]]:
+        """Fast- and slow-window burn rates per enabled SLO —
+        ``{"ttft": {"fast": ..., "slow": ...}, ...}`` (values None with
+        no window evidence). The internal numbers the scale-up policy
+        acts on, made externally visible: the metrics plane exports
+        them as ``ds_slo_burn_rate{slo,window}`` gauges."""
+        fast, slow = self._burns(fast=True), self._burns(fast=False)
+        return {name: {"fast": fast.get(name), "slow": slow.get(name)}
+                for name in fast}

@@ -28,10 +28,46 @@ records and ``span`` ``queue`` legs with step attribution).
 import math
 from typing import Dict, Iterable, List, Optional
 
-from deepspeed_tpu.telemetry.metrics import Histogram
+from deepspeed_tpu.telemetry.metrics import MS_BOUNDS, Histogram
 
 # millisecond-scale geometric ladder: 2**-6 .. 2**25 ms (~15 us .. ~9 h)
-_MS_BOUNDS = tuple(2.0 ** i for i in range(-6, 26))
+# — the telemetry-wide shared ladder, so the metric registry's latency
+# histograms merge exactly into these curves (fit_snapshot)
+_MS_BOUNDS = MS_BOUNDS
+
+
+def _gauge_value(snapshot: Dict, name: str) -> float:
+    """First unlabeled-series value of a gauge family in a registry
+    snapshot (0.0 when absent)."""
+    for row in (snapshot.get(name) or {}).get("series", []):
+        if row.get("value") is not None:
+            return float(row["value"])
+    return 0.0
+
+
+def _histogram_from_row(row: Dict) -> Optional[Histogram]:
+    """Reconstruct a mergeable Histogram from one snapshot series row
+    (bounds + per-bucket counts + count/sum/min/max)."""
+    bounds = row.get("bounds")
+    counts = row.get("counts")
+    if not bounds or counts is None:
+        return None
+    h = Histogram(bounds)
+    if len(counts) != len(h.counts):
+        return None
+    h.counts = [int(c) for c in counts]
+    h.count = int(row.get("count") or sum(h.counts))
+    h.total = float(row.get("sum") or 0.0)
+    h.min = row.get("min")
+    h.max = row.get("max")
+    if h.count and h.max is None:
+        # parsed-scrape rows carry no extremes: the top non-empty
+        # bucket's bound is the honest stand-in (percentile clamps on
+        # max, which must not be None while counts exist)
+        top = max(i for i, c in enumerate(h.counts) if c)
+        h.max = float(bounds[min(top, len(bounds) - 1)])
+        h.min = 0.0
+    return h
 
 
 class CapacityModel:
@@ -134,6 +170,41 @@ class CapacityModel:
                              - int(data.get("start_ns", 0))) / 1e6
                 self.observe(load, queue_ms=dur_ms)
                 used += 1
+        return used
+
+    def fit_snapshot(self, snapshot: Dict, *,
+                     load: Optional[float] = None) -> int:
+        """Fit from a metric-registry snapshot (the live metrics plane's
+        format: ``MetricRegistry.snapshot()``, a flight-recorder
+        snapshot ring entry, or a parsed scrape) instead of raw events.
+        The registry's ``ds_serving_ttft_ms``/``ds_serving_queue_ms``
+        histograms share the capacity ladder (``telemetry.metrics.
+        MS_BOUNDS``) so their counts merge EXACTLY into the load
+        bucket's curve. ``load`` defaults to the snapshot's own queue/
+        slot gauges. Feed each snapshot once (the registry is
+        cumulative — delta successive snapshots externally, or fit the
+        final one). Returns observations consumed."""
+        if load is None:
+            load = self.load_of({
+                "queue_depth": _gauge_value(snapshot,
+                                            "ds_serving_queue_depth"),
+                "slots_busy": _gauge_value(snapshot,
+                                           "ds_serving_slots_busy"),
+                "slots_total": _gauge_value(snapshot,
+                                            "ds_serving_slots_total"),
+            })
+        i = self.bucket(load)
+        used = 0
+        for metric, target in (("ds_serving_ttft_ms", self._ttft),
+                               ("ds_serving_queue_ms", self._queue)):
+            for row in (snapshot.get(metric) or {}).get("series", []):
+                if not row.get("count"):
+                    continue
+                h = _histogram_from_row(row)
+                if h is None or h.bounds != target[i].bounds:
+                    continue  # foreign ladder: no exact merge exists
+                target[i].merge(h)
+                used += h.count
         return used
 
     def merge(self, other: "CapacityModel") -> "CapacityModel":

@@ -186,7 +186,16 @@ class ServingEngine:
         self._spec_steps = 0
         self._window_draft_tokens = 0
         self._window_accepted_tokens = 0
+        # prefix-cache window counters (the hit-rate GAUGE's input —
+        # recomputing from the bounded records deque per step would both
+        # cost a scan and decay on long runs)
+        self._window_prompt_tokens = 0
+        self._window_hit_tokens = 0
         self._finished_count = 0
+        # live metrics plane: the telemetry manager's registry (the
+        # inert NULL_REGISTRY unless telemetry.metrics_port/metrics_file
+        # armed it), so every instrumentation site runs unconditional
+        self._metrics = self.telemetry.metrics
         # bounded retention (a long-running server must not accumulate a
         # dead Request per served request until OOM — same contract as
         # the telemetry manager's bounded event tail); stats() percentiles
@@ -565,8 +574,10 @@ class ServingEngine:
         # signals come from here, not from private scheduler state
         # (guarded — telemetry off must not pay the slot scan per step)
         if self.telemetry.enabled:
+            g = self.gauges()
             self.telemetry.emit("serving", "step.gauges",
-                                step=self._step_count, **self.gauges())
+                                step=self._step_count, **g)
+            self._metrics_step_gauges(g)
         # host-observed per-step token progress: a server saturated with
         # long generations must not be judged hung between completions
         self.resilience.serving_step_progress()
@@ -647,8 +658,10 @@ class ServingEngine:
         self.telemetry.on_step_boundary(self._step_count,
                                         samples=len(active))
         if self.telemetry.enabled:
+            g = self.gauges()
             self.telemetry.emit("serving", "step.gauges",
-                                step=self._step_count, **self.gauges())
+                                step=self._step_count, **g)
+            self._metrics_step_gauges(g)
         self.resilience.serving_step_progress()
         for slot, req in active:
             props = proposals[slot]
@@ -738,6 +751,7 @@ class ServingEngine:
         self.telemetry.emit(
             "serving", "request.shed" if shed else "request.finish",
             step=self._step_count, **rec)
+        self._metrics_record(req, rec, shed)
         if self._tracer.enabled and req.trace is not None:
             # close the replica-side root span (opened at admission);
             # queue-head sheds that never won a slot carry no handle
@@ -752,6 +766,66 @@ class ServingEngine:
         else:
             self._finished_count += 1
             self.resilience.serving_heartbeat(self._finished_count)
+
+    def _metrics_record(self, req: Request, rec: dict, shed: bool):
+        """Per-terminal-request registry feed: latency histograms,
+        outcome/token counters, prefix-cache and spec-decode window
+        gauges. One no-op instrument call per line when metrics are
+        disarmed."""
+        m = self._metrics
+        m.counter("ds_serving_requests_total", ("outcome",)).labels(
+            outcome="shed" if shed else "finished").inc()
+        if rec.get("ttft_ms") is not None:
+            m.histogram("ds_serving_ttft_ms").observe(rec["ttft_ms"])
+        if rec.get("queue_ms") is not None:
+            m.histogram("ds_serving_queue_ms").observe(rec["queue_ms"])
+        if shed:
+            return
+        m.counter("ds_serving_tokens_total").inc(rec.get("new_tokens") or 0)
+        # tokens prove a first token landed — a fake clock legitimately
+        # reading 0.0 at that moment must not drop the observation (the
+        # timestamp fields are 0.0-sentinel by dataclass convention)
+        if req.tokens:
+            m.histogram("ds_serving_decode_ms").observe(
+                1e3 * max(req.finish_ts - req.first_token_ts, 0.0))
+        if self.prefix is not None:
+            self._window_prompt_tokens += rec.get("prompt_len") or 0
+            self._window_hit_tokens += rec.get("prefix_hit_tokens") or 0
+            if self._window_prompt_tokens:
+                m.gauge("ds_prefix_cache_hit_rate").set(round(
+                    self._window_hit_tokens
+                    / self._window_prompt_tokens, 4))
+        if self._proposer is not None:
+            drafts = rec.get("draft_tokens") or 0
+            acc = rec.get("accepted_tokens") or 0
+            if drafts:
+                m.counter("ds_spec_draft_tokens_total").inc(drafts)
+                m.counter("ds_spec_accepted_tokens_total").inc(acc)
+            if self._window_draft_tokens:
+                m.gauge("ds_spec_acceptance_rate").set(round(
+                    self._window_accepted_tokens
+                    / self._window_draft_tokens, 4))
+
+    def _metrics_step_gauges(self, g: dict):
+        """Per-decode-step pool/queue gauges from the SAME ``gauges()``
+        payload the ``step.gauges`` event carries (one slot scan, two
+        consumers — the surfaces cannot disagree)."""
+        m = self._metrics
+        m.gauge("ds_serving_queue_depth").set(g.get("queue_depth", 0))
+        m.gauge("ds_serving_slots_busy").set(g.get("slots_busy", 0))
+        m.gauge("ds_serving_slots_total").set(g.get("slots_total", 0))
+        bm = self.block_mgr
+        usable = max(1, bm.num_blocks - 1)   # garbage block excluded
+        used = bm.num_allocated
+        tier = m.gauge("ds_kv_pool_blocks", ("tier",))
+        tier.labels(tier="free").set(bm.num_free)
+        tier.labels(tier="cached").set(bm.num_cached)
+        tier.labels(tier="used").set(used)
+        m.gauge("ds_kv_pool_occupancy").set(round(used / usable, 4))
+        committed = int(g.get("committed_tokens", 0))
+        capacity = used * self.config.block_size
+        m.gauge("ds_kv_pool_fragmentation").set(
+            round(1.0 - committed / capacity, 4) if capacity else 0.0)
 
     # ------------------------------------------------------------------
     def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
@@ -814,6 +888,8 @@ class ServingEngine:
         self._spec_steps = 0
         self._window_draft_tokens = 0
         self._window_accepted_tokens = 0
+        self._window_prompt_tokens = 0
+        self._window_hit_tokens = 0
         self.sched.reset_stats()
 
     def stats(self) -> dict:

@@ -49,6 +49,7 @@ from deepspeed_tpu.serving.autoscaler import (SCALE_DOWN, SCALE_UP,
 from deepspeed_tpu.serving.config import FleetConfig, RouterConfig
 from deepspeed_tpu.serving.health import (DEAD, DEGRADED, DRAINING, HEALTHY,
                                           STATES, TRIPPED, ReplicaHealth)
+from deepspeed_tpu.telemetry.registry import NULL_REGISTRY
 from deepspeed_tpu.telemetry.tracing import (NULL_TRACER, end_span, span_id,
                                              to_ns, trace_ctx)
 
@@ -830,6 +831,11 @@ class FleetManager:
         self.capacity = capacity          # optional CapacityModel feed
         self.clock = router.clock
         self.telemetry = router.telemetry
+        # live metrics plane: the telemetry manager's registry when one
+        # is armed (FakeTelemetry test doubles carry none — getattr, not
+        # attribute reach-in, keeps them working)
+        self._metrics = getattr(self.telemetry, "metrics",
+                                None) or NULL_REGISTRY
         self.autoscaler = Autoscaler(config)
         self._tracer = router._tracer
         self._trace_id = (self._tracer.new_trace(hint="fleet")
@@ -932,7 +938,43 @@ class FleetManager:
                 self._execute(decision)
         if self.telemetry.enabled:
             self._emit("fleet.gauges", **self.gauges())
+            self._metrics_step(overload)
         return done
+
+    def _metrics_step(self, overload: float):
+        """Per-step registry feed: per-replica health (one-hot), fleet
+        state counts, load/overload, and the autoscaler's error-budget
+        internals (burn rates + budget remaining) — the policy's math
+        made externally scrapeable. No-op instruments when the metrics
+        plane is disarmed."""
+        m = self._metrics
+        health = m.gauge("ds_replica_health", ("replica", "state"),
+                         max_label_sets=256)
+        for idx, h in enumerate(self.router.health):
+            for state in STATES:
+                health.labels(replica=str(idx), state=state).set(
+                    1 if h.state == state else 0)
+        by_state = {s: 0 for s in STATES}
+        for h in self.router.health:
+            by_state[h.state] += 1
+        fleet = m.gauge("ds_fleet_replicas", ("state",))
+        for state, n in by_state.items():
+            fleet.labels(state=state).set(n)
+        m.gauge("ds_fleet_active_replicas").set(self.active_size)
+        m.gauge("ds_fleet_parked_replicas").set(len(self._parked))
+        m.gauge("ds_fleet_draining_replicas").set(len(self._draining))
+        m.gauge("ds_fleet_overload").set(round(float(overload), 4))
+        m.gauge("ds_fleet_load").set(round(self._routable_load(), 4))
+        budget = m.gauge("ds_slo_budget_remaining", ("slo",))
+        for slo, rem in self.autoscaler.budget_remaining().items():
+            if rem is not None:
+                budget.labels(slo=slo).set(rem)
+        burn = m.gauge("ds_slo_burn_rate", ("slo", "window"))
+        for slo, windows in self.autoscaler.burn_rates().items():
+            for window, rate in windows.items():
+                if rate is not None:
+                    burn.labels(slo=slo, window=window).set(
+                        round(min(rate, 1e6), 4))
 
     def drain(self, max_steps: Optional[int] = None) -> List[RouterRequest]:
         out: List[RouterRequest] = []
@@ -993,6 +1035,9 @@ class FleetManager:
         detail["overload"] = decision.overload
         self._emit(f"scale.{decision.action}", reason=decision.reason,
                    from_size=before, to_size=self.active_size, **detail)
+        self._metrics.counter("ds_fleet_scale_events_total",
+                              ("action",)).labels(
+                                  action=decision.action).inc()
         if self._tracer.enabled:
             self._tracer.record_span(
                 "autoscale", self._trace_id, to_ns(t0),

@@ -28,5 +28,10 @@ from deepspeed_tpu.telemetry.jit_watch import (  # noqa: F401
 )
 from deepspeed_tpu.telemetry.manager import Telemetry  # noqa: F401
 from deepspeed_tpu.telemetry.metrics import Histogram  # noqa: F401
+from deepspeed_tpu.telemetry.registry import (  # noqa: F401
+    NAMES,
+    NULL_REGISTRY,
+    MetricRegistry,
+)
 from deepspeed_tpu.telemetry.sink import JsonlSink, MonitorBridge  # noqa: F401
 from deepspeed_tpu.telemetry.tracing import StepTrace, Tracer  # noqa: F401

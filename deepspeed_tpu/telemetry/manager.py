@@ -31,6 +31,7 @@ from deepspeed_tpu.telemetry import compile_watch
 from deepspeed_tpu.telemetry.events import make_event
 from deepspeed_tpu.telemetry.jit_watch import (WatchedFunction,
                                                compiled_cost_summary)
+from deepspeed_tpu.telemetry.registry import NULL_REGISTRY
 from deepspeed_tpu.telemetry.sink import JsonlSink, MonitorBridge
 from deepspeed_tpu.telemetry.tracing import NULL_TRACER, StepTrace, Tracer
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -84,6 +85,15 @@ class Telemetry:
         # telemetry AND telemetry.tracing are both enabled)
         self.tracer = NULL_TRACER
         self.step_trace = StepTrace(NULL_TRACER)
+        # live metrics plane (telemetry/registry + prom): the inert
+        # NULL_REGISTRY unless metrics_port/metrics_file arm it, so
+        # instrumentation sites run unconditional everywhere
+        self.metrics = NULL_REGISTRY
+        self._metrics_server = None
+        self._metrics_file = None
+        self._recorder = None
+        self._sigterm_disarm = None
+        self._last_boundary_ns = None
         if not self.enabled:
             return
         try:
@@ -104,6 +114,46 @@ class Telemetry:
             self.step_trace = StepTrace(self.tracer, rank=self._rank)
         if self.config.compile_watchdog:
             compile_watch.subscribe(self._on_global_compile)
+        # live metrics plane: metrics_port or metrics_file arms the
+        # registry (and the per-process scrape endpoint / textfile dump)
+        if (self.config.metrics_port is not None
+                or self.config.metrics_file):
+            from deepspeed_tpu.telemetry.registry import MetricRegistry
+
+            self.metrics = MetricRegistry()
+            self._metrics_file = self.config.metrics_file
+            if self.config.metrics_port is not None:
+                try:
+                    from deepspeed_tpu.telemetry.prom import MetricsServer
+
+                    self._metrics_server = MetricsServer(
+                        self.metrics, port=self.config.metrics_port,
+                        host=self.config.metrics_host)
+                    log_dist(
+                        f"telemetry: metrics endpoint at "
+                        f"{self._metrics_server.url}", ranks=[0])
+                except OSError as e:
+                    logger.warning(
+                        f"telemetry: cannot bind metrics endpoint on "
+                        f"{self.config.metrics_host}:"
+                        f"{self.config.metrics_port} ({e}); registry "
+                        f"stays live, endpoint disabled")
+        # flight recorder: continuously armed ring of recent events +
+        # metric snapshots, dumped on fault/breaker/SIGTERM triggers
+        fr = self.config.flight_recorder
+        if fr.enabled:
+            from deepspeed_tpu.telemetry.flightrec import (FlightRecorder,
+                                                           arm_sigterm,
+                                                           is_trigger)
+
+            self._recorder = FlightRecorder(
+                fr.dump_dir or self.config.dir, events=fr.events,
+                snapshots=fr.snapshots, max_dumps=fr.max_dumps)
+            # bound once: emit() is the hot path (every span rides it)
+            self._is_trigger = is_trigger
+            if fr.on_sigterm:
+                self._sigterm_disarm = arm_sigterm(
+                    lambda: self._flight_dump("sigterm", trigger=None))
 
     # ------------------------------------------------------------------
     # event plumbing
@@ -120,6 +170,35 @@ class Telemetry:
             self._sink.write(event)
         if self._bridge is not None:
             self._bridge.write(event)
+        if self.metrics is not NULL_REGISTRY:
+            self.metrics.counter("ds_events_total", ("kind",)).labels(
+                kind=kind).inc()
+        if self._recorder is not None:
+            self._recorder.record_event(event)
+            if self._is_trigger(kind, name):
+                self._flight_dump(f"{kind}:{name}", trigger=event)
+
+    def _flight_dump(self, reason: str, trigger=None):
+        """One flight-recorder dump (fault event, breaker trip, SIGTERM,
+        or an explicit call). Flushes the JSONL sink first so the dump's
+        event tail and the sink agree on the same window, then records
+        the dump itself as a ``flightrec.dump`` fault event (excluded
+        from re-triggering)."""
+        if self._recorder is None:
+            return None
+        self.flush()
+        registry = self.metrics if self.metrics is not NULL_REGISTRY \
+            else None
+        path = self._recorder.dump(reason, registry=registry,
+                                   trigger=trigger)
+        if path is not None:
+            self.metrics.counter(
+                "ds_flightrec_dumps_total", ("reason",)).labels(
+                    reason=reason.split(":", 1)[0]).inc()
+            self.emit("fault", "flightrec.dump", step=self._steps_seen,
+                      reason=reason, path=path)
+            self.flush()
+        return path
 
     def tail(self, n: int = 50):
         """The most recent ``n`` events (empty when disabled) — consumed
@@ -202,6 +281,14 @@ class Telemetry:
         totals["compile_secs"] += compile_secs
         if retrace and self.warm:
             totals["retraces_after_warm"] += 1
+        m = self.metrics
+        m.counter("ds_compiles_total", ("family",)).labels(
+            family=family).inc()
+        m.counter("ds_compile_seconds_total", ("family",)).labels(
+            family=family).inc(trace_secs + compile_secs)
+        if retrace and self.warm:
+            m.counter("ds_retraces_after_warmup_total",
+                      ("family",)).labels(family=family).inc()
         if self.config.compile_watchdog:
             self.emit("compile", name, step=self._steps_seen,
                       trace_secs=round(trace_secs, 6),
@@ -286,6 +373,12 @@ class Telemetry:
             pass
         self._peak_bytes_seen = max(self._peak_bytes_seen,
                                     int(data.get("peak_bytes_in_use", 0)))
+        m = self.metrics
+        if "bytes_in_use" in data:
+            m.gauge("ds_device_bytes_in_use").set(data["bytes_in_use"])
+        m.gauge("ds_device_peak_bytes").set(self._peak_bytes_seen)
+        if "host_rss_bytes" in data:
+            m.gauge("ds_host_rss_bytes").set(data["host_rss_bytes"])
         self.emit("memory", self.name, step=step, **data)
 
     # ------------------------------------------------------------------
@@ -369,6 +462,11 @@ class Telemetry:
             "exposed_comm", self.tracer.new_trace(hint=f"profile{step}"),
             now - window_ns, now, window_steps=tr.num_steps,
             window_end_step=step, **measured)
+        # the measured number supersedes the static estimate on the
+        # gauge too (its own `source` label keeps both visible)
+        self.metrics.gauge("ds_exposed_comm_fraction", ("source",)).labels(
+            source=str(measured.get("source", "profiled"))).set(
+                measured.get("exposed_comm_fraction") or 0.0)
 
     def exposed_comm_estimate(self) -> Optional[Dict]:
         """Static per-step exposed-comm estimate from the costliest
@@ -412,19 +510,62 @@ class Telemetry:
         self._steps_seen = step
         if not self.warm and step >= self.config.warmup_steps:
             self.warm = True
-        self.emit("step", self.name, step=step, samples=samples,
-                  micro_steps=micro_steps)
+        # the per-step exposed-comm fraction is computed ONCE here and
+        # consumed by all three surfaces — the `step` event field, the
+        # step-trace root attrs (report phase table) and the registry
+        # gauge — so they can never disagree
+        xc = self.exposed_comm_estimate() or {}
+        step_fields = {"samples": samples, "micro_steps": micro_steps}
+        if xc:
+            step_fields["exposed_comm_fraction"] = \
+                xc.get("exposed_comm_fraction")
+            step_fields["exposed_comm_source"] = xc.get("source")
+        self.emit("step", self.name, step=step, **step_fields)
+        m = self.metrics
+        if m is not NULL_REGISTRY:
+            import time as _time
+
+            now_ns = _time.monotonic_ns()
+            m.counter("ds_steps_total").inc()
+            if samples:
+                m.counter("ds_samples_total").inc(int(samples))
+            if self._last_boundary_ns is not None \
+                    and now_ns > self._last_boundary_ns:
+                m.gauge("ds_steps_per_sec").set(
+                    round(1e9 / (now_ns - self._last_boundary_ns), 4))
+            self._last_boundary_ns = now_ns
+            if xc:
+                m.gauge("ds_exposed_comm_fraction", ("source",)).labels(
+                    source=str(xc.get("source"))).set(
+                        xc.get("exposed_comm_fraction") or 0.0)
         if self.step_trace.enabled:
             # flush the step's phase spans (no-op when the engine
             # bracketed none — the serving decode loop), attaching the
-            # static exposed-comm estimate; a later profiled window
-            # supersedes it with a measured `exposed_comm` span
-            attrs = self.exposed_comm_estimate() or {}
-            self.step_trace.flush(step, **attrs)
+            # SAME exposed-comm estimate the step event carries; a later
+            # profiled window supersedes it with a measured
+            # `exposed_comm` span
+            self.step_trace.flush(step, **xc)
         if (self.config.memory
                 and step % max(1, self.config.sample_every) == 0):
             self._sample_memory(step)
+        if step % max(1, self.config.sample_every) == 0:
+            if self._recorder is not None and m is not NULL_REGISTRY:
+                self._recorder.record_snapshot(step, m.snapshot())
+            if self._metrics_file and m is not NULL_REGISTRY:
+                self._write_metrics_file()
         self._maybe_trace(step)
+
+    def _write_metrics_file(self):
+        """Atomic exposition dump to ``telemetry.metrics_file`` (the
+        scrape-less path). IO failures disable the file, not the run."""
+        from deepspeed_tpu.telemetry.prom import write_textfile
+
+        try:
+            write_textfile(self._metrics_file, self.metrics.expose())
+        except OSError as e:
+            logger.warning(f"telemetry: metrics_file write failed "
+                           f"({e}); disabling the textfile dump")
+            self._metrics_file = None
 
     # ------------------------------------------------------------------
     # wall_clock_breakdown (legacy flag routed through the stream)
@@ -469,5 +610,15 @@ class Telemetry:
             self._tracing = False
         if self.enabled and self.config.compile_watchdog:
             compile_watch.unsubscribe(self._on_global_compile)
+        if self._metrics_file and self.metrics is not NULL_REGISTRY:
+            self._write_metrics_file()  # final state for late scrapers
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
+        if self._sigterm_disarm is not None:
+            # a closed recorder must not re-dump its stale ring on a
+            # later SIGTERM (nor keep this manager alive via the chain)
+            self._sigterm_disarm()
+            self._sigterm_disarm = None
         if self._sink is not None:
             self._sink.close()

@@ -19,6 +19,13 @@ from typing import Iterable, List, Optional, Sequence
 # tracer can emit lands inside it.
 DEFAULT_BOUNDS = tuple(1 << i for i in range(48))
 
+# shared millisecond-scale geometric ladder: 2**-6 .. 2**25 ms
+# (~15 us .. ~9 h). ONE definition consumed by both the capacity
+# model's latency curves and the metric registry's latency histograms,
+# so a scraped registry snapshot merges EXACTLY into the capacity
+# model (`CapacityModel.fit_snapshot` requires equal bounds).
+MS_BOUNDS = tuple(2.0 ** i for i in range(-6, 26))
+
 
 class Histogram:
     """Counting histogram over fixed ``bounds`` (ascending upper bucket

@@ -1,0 +1,19 @@
+"""BAD: instruments with metric names the registry has never heard
+of."""
+
+
+class ServingEngine:
+    def step(self):
+        self._metrics.counter("ds_step_total").inc()           # typo name
+        self._metrics.gauge("ds_fleet_overlod").set(0.5)       # typo name
+        m = self.telemetry.metrics
+        m.histogram("ds_serving_ttft_millis").observe(3.0)     # near-miss
+        m.counter(name="ds_decode_stats_total").inc()          # kw form,
+        #                                                        never
+        #                                                        registered
+
+    def burn(self):
+        # near-miss on a registered family: the registered name is
+        # ds_slo_burn_rate — drift stays pinned
+        self._metrics.gauge("ds_slo_burnrate", ("slo",)).labels(
+            slo="ttft").set(1.0)

@@ -1,0 +1,9 @@
+"""The fixture metric-name table GL08 resolves (pure AST, never
+imported)."""
+
+NAMES = {
+    "ds_steps_total": ("counter", "step boundaries"),
+    "ds_serving_ttft_ms": ("histogram", "time to first token (ms)"),
+    "ds_fleet_overload": ("gauge", "router overload score"),
+    "ds_slo_burn_rate": ("gauge", "error-budget burn rate"),
+}

@@ -1,0 +1,33 @@
+"""GOOD: registered names, dynamic names, and non-registry call shapes
+that must not fire."""
+
+import collections
+
+
+def _name_for(kind):
+    return f"ds_{kind}_total"
+
+
+class ServingEngine:
+    def step(self):
+        self._metrics.counter("ds_steps_total").inc()          # registered
+        self._metrics.gauge("ds_fleet_overload").set(0.5)
+        m = self.telemetry.metrics
+        m.histogram("ds_serving_ttft_ms").observe(3.0)
+        m.gauge("ds_slo_burn_rate", ("slo",)).labels(slo="ttft").set(1.0)
+        # dynamic name: the emitting wrapper's responsibility, not a
+        # literal this checker can (or should) judge
+        m.counter(_name_for("steps")).inc()
+
+    def not_metrics(self):
+        # same attribute names on unrelated objects carrying no literal
+        # registry semantics: a plural gauges() read, a stdlib Counter,
+        # a bare counter() call (no attribute chain)
+        g = self.engine.gauges()
+        c = collections.Counter()
+        c.update(["x"])
+        return g, counter()
+
+
+def counter():
+    return 0

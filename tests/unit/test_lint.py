@@ -38,7 +38,7 @@ def by_code(report, code):
 
 
 @pytest.mark.parametrize("code", ["GL01", "GL02", "GL03", "GL04", "GL05",
-                                  "GL06", "GL07"])
+                                  "GL06", "GL07", "GL08"])
 def test_checker_fires_on_bad_and_is_silent_on_good(code):
     name = code.lower()
     bad = fixture_run(name, "bad")
@@ -219,6 +219,45 @@ class TestGL07:
                 "deepspeed_tpu/serving/replay.py",
                 "deepspeed_tpu/serving/capacity.py"} \
             <= set(CLOCKED_MODULES)
+
+
+class TestGL08:
+    def test_every_bad_shape_fires(self):
+        """Typo names, near-misses and the keyword-argument form must
+        all be caught."""
+        found = by_code(fixture_run("gl08", "bad"), "GL08")
+        msgs = " | ".join(f.message for f in found)
+        for name in ("ds_step_total", "ds_fleet_overlod",
+                     "ds_serving_ttft_millis", "ds_decode_stats_total",
+                     "ds_slo_burnrate"):
+            assert name in msgs, f"GL08 missed {name!r}"
+        assert len(found) == 5
+
+    def test_registered_dynamic_and_non_registry_shapes_are_legal(self):
+        """Registered literals pass; dynamic names are the wrapper's
+        responsibility; ``gauges()`` reads, ``collections.Counter`` and
+        bare ``counter()`` calls carry no registry semantics."""
+        assert not by_code(fixture_run("gl08", "good"), "GL08")
+
+    def test_names_table_is_ast_readable_in_the_real_package(self):
+        """The real registry's NAMES must stay a pure dict literal —
+        the checker (and this test) read it without importing."""
+        from tools.lint.checkers.gl08_metric_names import registry_names
+        from tools.lint.core import LintContext
+
+        names = registry_names(LintContext([], REPO))
+        assert names is not None and len(names) >= 20
+        assert "ds_serving_ttft_ms" in names
+        assert "ds_slo_burn_rate" in names
+
+    def test_real_call_sites_subset_of_names(self):
+        """Belt-and-braces: the AST-read table agrees with the runtime
+        NAMES dict (one definition, two readers)."""
+        from deepspeed_tpu.telemetry.registry import NAMES
+        from tools.lint.checkers.gl08_metric_names import registry_names
+        from tools.lint.core import LintContext
+
+        assert set(registry_names(LintContext([], REPO))) == set(NAMES)
 
 
 # ---------------------------------------------------------------------------
@@ -415,7 +454,7 @@ class TestRepoGate:
     def test_whole_package_was_scanned(self, repo_report):
         assert repo_report.files_scanned > 100
         assert repo_report.codes_run == ["GL01", "GL02", "GL03", "GL04",
-                                         "GL05", "GL06", "GL07"]
+                                         "GL05", "GL06", "GL07", "GL08"]
 
     def test_runs_inside_the_tier1_budget(self, repo_report):
         assert repo_report.elapsed < 2.0, (

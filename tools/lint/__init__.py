@@ -19,6 +19,11 @@ ones:
   registered in ``telemetry/events.KINDS``.
 - GL06 ``config-doc-parity`` — config dataclass fields and
   ``docs/config.md`` cannot drift apart (either direction).
+- GL07 ``injectable-clock`` — the serving policy tier reads time only
+  through its injected ``clock`` seam (fake-clock determinism).
+- GL08 ``metric-name-registry`` — every literal metric name at a
+  registry ``counter``/``gauge``/``histogram`` call site is registered
+  in ``telemetry/registry.NAMES``.
 
 Pure-AST and jax-import-free by construction: the whole pass runs in
 tier-1 in well under a second (``tests/unit/test_lint.py``). CLI:

@@ -1,4 +1,4 @@
-"""Built-in checkers. Importing this package registers GL01–GL07."""
+"""Built-in checkers. Importing this package registers GL01–GL08."""
 
 from tools.lint.checkers import (  # noqa: F401
     gl01_jax_free,
@@ -8,4 +8,5 @@ from tools.lint.checkers import (  # noqa: F401
     gl05_event_kinds,
     gl06_config_docs,
     gl07_injectable_clock,
+    gl08_metric_names,
 )

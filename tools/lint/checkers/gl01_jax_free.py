@@ -38,6 +38,9 @@ JAX_FREE_MODULES = (
     "deepspeed_tpu/telemetry/events.py",
     "deepspeed_tpu/telemetry/tracing.py",
     "deepspeed_tpu/telemetry/metrics.py",
+    "deepspeed_tpu/telemetry/registry.py",
+    "deepspeed_tpu/telemetry/prom.py",
+    "deepspeed_tpu/telemetry/flightrec.py",
     "deepspeed_tpu/autotuning/artifact.py",
 )
 

@@ -1,0 +1,103 @@
+"""GL08 metric-name-registry.
+
+Every literal metric name at a registry ``counter``/``gauge``/
+``histogram`` call site must be registered in
+``telemetry/registry.NAMES`` — the GL05 convention applied to the live
+metrics plane: dashboards, alert rules and the capacity model's
+``fit_snapshot`` all address series by these names, so an unregistered
+name is a series nothing will ever scrape for (and the registry raises
+on it at runtime; this checker catches it before any code runs). The
+table is read from the AST of ``deepspeed_tpu/telemetry/registry.py``
+(scan set first, lint root as fallback) — never imported.
+
+Checked call shapes (literal first argument / ``name=`` keyword only —
+dynamic names are the calling wrapper's responsibility)::
+
+    <anything>.counter("name", ...)
+    <anything>.gauge("name", ...)
+    <anything>.histogram("name", ...)
+
+The attribute names are specific enough that the package has no
+colliding call shapes (``gauges()`` — plural — is the serving load
+surface; ``Histogram(...)`` is a constructor, not an attribute call).
+The registry module itself is exempt (its error strings and table ARE
+the registry).
+"""
+
+import ast
+from typing import Iterable, Optional, Tuple
+
+from tools.lint.core import Checker, Finding, LintContext, dotted, register
+from tools.lint.core import str_const
+
+REGISTRY_MODULE = "deepspeed_tpu/telemetry/registry.py"
+
+_METRIC_ATTRS = ("counter", "gauge", "histogram")
+
+
+def registry_names(ctx: LintContext) -> Optional[Tuple[str, ...]]:
+    """The keys of the ``NAMES`` dict literal in the registry module's
+    AST (None when the module or the table cannot be found)."""
+    mod = ctx.parse_under_root(REGISTRY_MODULE)
+    if mod is None or mod.tree() is None:
+        return None
+    for node in mod.tree().body:
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if "NAMES" in targets and isinstance(node.value, ast.Dict):
+                keys = [str_const(k) for k in node.value.keys]
+                if all(k is not None for k in keys):
+                    return tuple(keys)
+    return None
+
+
+def _metric_name_arg(call: ast.Call) -> Optional[ast.expr]:
+    """The metric-name argument of a registry call shape, or None when
+    this call is not one."""
+    d = dotted(call.func)
+    if d is None or "." not in d:
+        return None  # bare counter(...)/gauge(...): not a registry call
+    if d.rsplit(".", 1)[1] not in _METRIC_ATTRS:
+        return None
+    if call.args:
+        return call.args[0]
+    return next((k.value for k in call.keywords if k.arg == "name"), None)
+
+
+@register
+class MetricNameRegistry(Checker):
+    code = "GL08"
+    name = "metric-name-registry"
+    description = ("every literal metric name at a registry counter/"
+                   "gauge/histogram call site is registered in "
+                   "telemetry/registry.NAMES (unregistered series are "
+                   "scraped by nothing)")
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        names = registry_names(ctx)
+        if names is None:
+            return  # no registry in reach (partial scan): nothing to pin
+        for mod in ctx.modules:
+            if mod.relpath.endswith(REGISTRY_MODULE) \
+                    or mod.relpath == "deepspeed_tpu/telemetry/registry.py":
+                continue
+            # raw-source pre-filter: no metric call shape, no parse
+            if not mod.mentions(".counter(", ".gauge(", ".histogram("):
+                continue
+            for node in mod.nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                arg = _metric_name_arg(node)
+                if arg is None:
+                    continue
+                name = str_const(arg)
+                if name is None or name in names:
+                    continue  # dynamic name: the wrapper's responsibility
+                yield Finding(
+                    code=self.code, path=mod.relpath, line=node.lineno,
+                    col=node.col_offset,
+                    message=(f"metric call uses unregistered name "
+                             f"{name!r} — register it in telemetry/"
+                             f"registry.NAMES (the table dashboards and "
+                             f"fit_snapshot address series by)"))

@@ -1,0 +1,103 @@
+"""Scrape (or read) the live metrics plane and print it.
+
+The CLI side of ``telemetry/registry.py`` + ``telemetry/prom.py``: pull
+one exposition from a running process's ``/metrics`` endpoint
+(``telemetry.metrics_port``) or from a ``telemetry.metrics_file``
+dump / ``flightrec-*/metrics.prom``, and print it raw, filtered, or
+parsed to a JSON snapshot (the same shape
+``MetricRegistry.snapshot()`` produces — feedable to
+``tools/telemetry_report.py --prom`` and
+``CapacityModel.fit_snapshot``). Run::
+
+    python tools/metrics_dump.py --url http://127.0.0.1:9100/metrics
+    python tools/metrics_dump.py --port 9100            # localhost
+    python tools/metrics_dump.py --file telemetry/metrics.prom
+    python tools/metrics_dump.py --port 9100 --grep ds_slo --json
+
+Exit codes: 0 ok, 1 unreachable/unreadable/parse failure, 2 usage.
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.telemetry.prom import parse_exposition  # noqa: E402
+
+
+def fetch(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8", errors="replace")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--url", help="full /metrics URL to scrape")
+    src.add_argument("--port", type=int,
+                     help="scrape http://<host>:<port>/metrics")
+    src.add_argument("--file", help="exposition text file "
+                                    "(telemetry.metrics_file dump or a "
+                                    "flight recorder's metrics.prom)")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="host for --port (default 127.0.0.1)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--grep", default=None,
+                    help="only lines containing this substring (plus "
+                         "their # HELP/# TYPE headers)")
+    ap.add_argument("--json", action="store_true",
+                    help="parse the exposition into a registry-snapshot "
+                         "JSON object instead of printing text")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        url = args.url
+    elif args.port is not None:
+        url = f"http://{args.host}:{args.port}/metrics"
+    elif args.file:
+        url = None
+    else:
+        ap.print_usage(sys.stderr)
+        print("metrics_dump: one of --url/--port/--file is required",
+              file=sys.stderr)
+        return 2
+
+    try:
+        if url is not None:
+            text = fetch(url, args.timeout)
+        else:
+            with open(args.file, encoding="utf-8") as f:
+                text = f.read()
+    except (OSError, urllib.error.URLError) as e:
+        print(f"metrics_dump: cannot read "
+              f"{url or args.file}: {e}", file=sys.stderr)
+        return 1
+
+    if args.json:
+        try:
+            snap = parse_exposition(text)
+        except Exception as e:  # noqa: BLE001 — report, don't trace
+            print(f"metrics_dump: exposition parse failed: {e}",
+                  file=sys.stderr)
+            return 1
+        if args.grep:
+            snap = {k: v for k, v in snap.items() if args.grep in k}
+        print(json.dumps(snap, indent=2, sort_keys=True))
+        return 0
+
+    if args.grep:
+        out = []
+        for line in text.splitlines():
+            if args.grep in line:
+                out.append(line)
+        text = "\n".join(out) + ("\n" if out else "")
+    sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -374,10 +374,17 @@ def _router_lines(agg: Dict, markdown: bool) -> List[str]:
     return out
 
 
-def _fleet_lines(agg: Dict, markdown: bool) -> List[str]:
+def _prom_series(prom: Dict, name: str) -> List[Dict]:
+    return (prom or {}).get(name, {}).get("series") or []
+
+
+def _fleet_lines(agg: Dict, markdown: bool,
+                 prom: Dict = None) -> List[str]:
     """Elastic fleet: scaling decisions, drains parked/lost, factory
     failures, and the last fleet gauge snapshot (per-state replica
-    counts + SLO budget remaining)."""
+    counts + SLO budget remaining). With ``--prom`` the error-budget
+    numbers come from the registry snapshot — the autoscaler's own
+    gauges — instead of being re-read from raw events."""
     f = agg.get("fleet") or {}
     if not f.get("events"):
         return []
@@ -403,11 +410,28 @@ def _fleet_lines(agg: Dict, markdown: bool) -> List[str]:
             f"{g.get('parked', 0)} parked, queue "
             f"{g.get('queue_depth', '?')}/{g.get('queue_capacity', '?')}, "
             f"overload {g.get('overload', '?')}")
-        budget = g.get("budget_remaining") or {}
-        if budget:
-            out.append(f"{pad}SLO budget remaining: "
-                       + ", ".join(f"{k}: {v}" for k, v in
-                                   sorted(budget.items())))
+        budget_rows = _prom_series(prom, "ds_slo_budget_remaining")
+        if budget_rows:
+            # the registry snapshot is the autoscaler's own gauge —
+            # prefer it over re-reading the event stream
+            out.append(f"{pad}SLO budget remaining (registry): "
+                       + ", ".join(
+                           f"{r['labels'].get('slo')}: {r.get('value')}"
+                           for r in budget_rows))
+            burn_rows = _prom_series(prom, "ds_slo_burn_rate")
+            if burn_rows:
+                out.append(f"{pad}SLO burn rates (registry): "
+                           + ", ".join(
+                               f"{r['labels'].get('slo')}/"
+                               f"{r['labels'].get('window')}: "
+                               f"{r.get('value')}"
+                               for r in burn_rows))
+        else:
+            budget = g.get("budget_remaining") or {}
+            if budget:
+                out.append(f"{pad}SLO budget remaining: "
+                           + ", ".join(f"{k}: {v}" for k, v in
+                                       sorted(budget.items())))
     if markdown and f.get("decisions"):
         out.append("\n| step | action | reason | source | fleet |")
         out.append("|---|---|---|---|---|")
@@ -421,6 +445,83 @@ def _fleet_lines(agg: Dict, markdown: bool) -> List[str]:
                        f"({d['reason']}"
                        + (f", {d['source']}" if d.get("source") else "")
                        + f") {d['from']} -> {d['to']}")
+    return out
+
+
+def _prom_lines(prom: Dict, markdown: bool) -> List[str]:
+    """Live metrics plane (``--prom``): one row per family from a
+    registry snapshot (a ``metrics_dump.py --json`` payload, a
+    ``telemetry.metrics_file`` / ``metrics.prom`` exposition text, or
+    ``MetricRegistry.snapshot()`` JSON)."""
+    if not prom:
+        return []
+    out = [""]
+    out.append(("### " if markdown else "")
+               + f"metrics registry: {len(prom)} families")
+    pad = "" if markdown else "  "
+    if markdown:
+        out.append("\n| metric | type | series | value(s) |")
+        out.append("|---|---|---|---|")
+    for name in sorted(prom):
+        fam = prom[name] or {}
+        series = fam.get("series") or []
+        vals = []
+        for row in series[:4]:
+            labels = row.get("labels") or {}
+            tag = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            if "count" in row or "counts" in row:
+                v = f"count={row.get('count', 0)}"
+            else:
+                v = row.get("value")
+            vals.append(f"{tag}: {v}" if tag else f"{v}")
+        more = f" (+{len(series) - 4} more)" if len(series) > 4 else ""
+        if markdown:
+            out.append(f"| `{name}` | {fam.get('type', '?')} "
+                       f"| {len(series)} | {'; '.join(map(str, vals))}"
+                       f"{more} |")
+        else:
+            out.append(f"{pad}{name} [{fam.get('type', '?')}]: "
+                       + "; ".join(map(str, vals)) + more)
+    return out
+
+
+def _flightrec_lines(dump_dirs: List[str], markdown: bool) -> List[str]:
+    """Flight recorder: one block per dump dir — trigger reason, ring
+    counts, the event tail, and whether a metrics exposition rode
+    along."""
+    from deepspeed_tpu.telemetry.flightrec import load_dump
+
+    out = []
+    pad = "" if markdown else "  "
+    for path in dump_dirs:
+        d = load_dump(path)
+        meta = d.get("meta") or {}
+        out.append("")
+        out.append(("### " if markdown else "")
+                   + f"flight recorder dump: {os.path.basename(path)}")
+        out.append(f"{pad}reason: {meta.get('reason')} | "
+                   f"{meta.get('events', len(d['events']))} event(s), "
+                   f"{meta.get('snapshots', len(d['snapshots']))} metric "
+                   f"snapshot(s), last step {meta.get('last_step')}"
+                   + (" | metrics.prom attached"
+                      if d.get("metrics_text") else ""))
+        trigger = meta.get("trigger") or {}
+        if trigger:
+            out.append(f"{pad}trigger event: {trigger.get('kind')}/"
+                       f"{trigger.get('name')} at step "
+                       f"{trigger.get('step')}")
+        tail = d["events"][-8:]
+        if tail:
+            out.append(f"{pad}event tail:")
+            for e in tail:
+                out.append(f"{pad}  step {e.get('step')}: "
+                           f"{e.get('kind')}/{e.get('name')}")
+        snaps = d.get("snapshots") or []
+        if snaps:
+            last = snaps[-1].get("snapshot") or {}
+            out.append(f"{pad}last metric snapshot (step "
+                       f"{snaps[-1].get('step')}): "
+                       f"{len(last)} families")
     return out
 
 
@@ -688,9 +789,15 @@ def _step_cost_lines(agg: Dict, markdown: bool) -> List[str]:
 
 
 def render(path: str, markdown: bool = False,
-           tuned_artifact: Dict = None) -> str:
+           tuned_artifact: Dict = None, prom: Dict = None,
+           flightrec: List[str] = None) -> str:
     events = load_all_events(path)
     agg = aggregate(events)
+    if flightrec is None:
+        # auto-discover dumps the flight recorder left next to the sink
+        from deepspeed_tpu.telemetry.flightrec import find_dumps
+
+        flightrec = find_dumps(os.path.dirname(path) or ".")
     lines = []
     title = (f"Telemetry report — {os.path.basename(path)} "
              f"({len(events)} events, {agg['steps']['count']} steps)")
@@ -726,8 +833,10 @@ def render(path: str, markdown: bool = False,
     lines.extend(_fault_lines(agg, markdown))
     lines.extend(_serving_lines(agg, markdown))
     lines.extend(_router_lines(agg, markdown))
-    lines.extend(_fleet_lines(agg, markdown))
+    lines.extend(_fleet_lines(agg, markdown, prom))
     lines.extend(_span_lines(agg, markdown))
+    lines.extend(_prom_lines(prom, markdown))
+    lines.extend(_flightrec_lines(flightrec or [], markdown))
     lines.extend(_aot_lines(agg, markdown))
     lines.extend(_tuning_lines(agg, markdown, tuned_artifact))
     return "\n".join(lines)
@@ -743,6 +852,17 @@ def main(argv=None):
     ap.add_argument("--tuned", default=None,
                     help="tuned.json artifact: render the live-tuner "
                          "trial measurements alongside the event stream")
+    ap.add_argument("--prom", default=None,
+                    help="metrics-plane snapshot: exposition text "
+                         "(telemetry.metrics_file / a flight recorder's "
+                         "metrics.prom) or snapshot JSON "
+                         "(metrics_dump.py --json) — renders a metrics "
+                         "section and feeds the fleet section's "
+                         "error-budget gauges")
+    ap.add_argument("--flightrec", action="append", default=None,
+                    help="flight-recorder dump dir (flightrec-<ts>) to "
+                         "render; repeatable. Default: auto-discover "
+                         "next to the sink")
     args = ap.parse_args(argv)
     path = args.path
     if os.path.isdir(path):
@@ -751,14 +871,22 @@ def main(argv=None):
     if args.tuned:
         with open(args.tuned) as f:
             tuned = json.load(f)
+    prom = None
+    if args.prom:
+        from deepspeed_tpu.telemetry.prom import snapshot_from_file
+
+        prom = snapshot_from_file(args.prom)
     if args.json:
         payload = {"metric": "telemetry_report", "path": path,
                    **aggregate(load_all_events(path))}
         if tuned is not None:
             payload["tuned_artifact"] = tuned
+        if prom is not None:
+            payload["metrics_registry"] = prom
         print(json.dumps(payload, default=str))
     else:
-        print(render(path, markdown=args.markdown, tuned_artifact=tuned))
+        print(render(path, markdown=args.markdown, tuned_artifact=tuned,
+                     prom=prom, flightrec=args.flightrec))
 
 
 if __name__ == "__main__":

@@ -194,6 +194,7 @@ def main():
     emit_result(_startup_series(cfg, batch, seq, on_tpu))
     emit_result(_tracing_series(cfg, batch, seq, on_tpu))
     emit_result(_metrics_series(cfg, batch, seq, on_tpu))
+    emit_result(_tp_series(cfg, batch, seq, on_tpu))
 
 
 def _telemetry_series(warm_mark, steps):
@@ -596,6 +597,49 @@ def _train_step_series(cfg, batch, seq, on_tpu, steps=3, ds_overrides=None,
     }
 
 
+def _tp_series(cfg, batch, seq, on_tpu, steps=3):
+    """Optional extra series (after the headline JSON): tensor
+    parallelism on the 3-axis mesh. Runs the SAME train-step
+    measurement at tp=1 (pure DP baseline) and tp=2 (SpecLayout
+    column/row-parallel weights, ZeRO-2 over data) and reports
+    tokens/s plus the compiled step's collective wire bytes for each —
+    on the CPU smoke mesh the numbers prove the plumbing and make the
+    tp collectives' wire cost visible; on real chips they answer
+    whether trading data width for tp pays at this model size."""
+    import jax
+
+    if jax.device_count() < 2:
+        return {"metric": METRIC + "_tp", "value": None,
+                "unit": "tokens_per_sec",
+                "error": "needs >= 2 devices for a tp=2 mesh"}
+    try:
+        base = _train_step_series(
+            cfg, batch, seq, on_tpu, steps=steps,
+            ds_overrides={"mesh": {"data": -1, "fsdp": 1, "tp": 1},
+                          "zero_optimization": {"stage": 2}})
+        tp2 = _train_step_series(
+            cfg, batch, seq, on_tpu, steps=steps,
+            ds_overrides={"mesh": {"data": -1, "fsdp": 1, "tp": 2},
+                          "zero_optimization": {"stage": 2}})
+        return {
+            "metric": METRIC + "_tp",
+            "value": tp2["tokens_per_sec"],
+            "unit": "tokens_per_sec",
+            "vs_baseline": (round(tp2["tokens_per_sec"]
+                                  / base["tokens_per_sec"], 4)
+                            if base["tokens_per_sec"] else None),
+            "tp1_tokens_per_sec": base["tokens_per_sec"],
+            "tp2_tokens_per_sec": tp2["tokens_per_sec"],
+            "tp1_collective_wire_bytes": base["collective_wire_bytes"],
+            "tp2_collective_wire_bytes": tp2["collective_wire_bytes"],
+        }
+    except Exception as e:  # noqa: BLE001 — extras never kill the headline
+        print(f"# tp series failed: {e}", file=sys.stderr, flush=True)
+        return {"metric": METRIC + "_tp", "value": None,
+                "unit": "tokens_per_sec", "vs_baseline": None,
+                "error": str(e)[:300]}
+
+
 def _tracing_series(cfg, batch, seq, on_tpu, steps=3):
     """Optional extra series (after the headline JSON): the span-tracing
     overhead bound. Two identical telemetry-enabled measured windows —
@@ -954,12 +998,14 @@ def run_series(name, config=None):
         return _tracing_series(cfg, batch, seq, on_tpu, steps=ctx["steps"])
     if name == "metrics":
         return _metrics_series(cfg, batch, seq, on_tpu, steps=ctx["steps"])
+    if name == "tp":
+        return _tp_series(cfg, batch, seq, on_tpu, steps=ctx["steps"])
     raise KeyError(f"unknown bench series {name!r}; available: "
                    f"{sorted(SERIES)}")
 
 
 SERIES = ("train_step", "startup", "telemetry", "resilience",
-          "comm_compression", "elastic_resume", "tracing", "metrics")
+          "comm_compression", "elastic_resume", "tracing", "metrics", "tp")
 
 
 if __name__ == "__main__":

@@ -737,6 +737,147 @@ def _spec_decode_series(ctx):
 
 # ---------------------------------------------------------------------------
 # span tracing: serving tokens/s with the span layer off vs on
+def _tp_series(ctx):
+    """Optional extra series (after the headline JSON): paged-decode
+    serving under tensor parallelism. Builds the SAME serving engine at
+    tp=1 and tp=2 (SpecLayout weight sharding, KV pools head-sharded
+    per shard by ``decode_cache_specs``), runs the same decode
+    workload, and reports tokens/s plus the compiled single-step decode
+    program's collective operand bytes at each tp — TP's decode comm
+    cost next to its throughput, on the CPU smoke mesh or real chips
+    alike."""
+    import jax
+
+    if jax.device_count() < 2:
+        return {"metric": f"{METRIC}_tp", "value": None,
+                "unit": "tokens_per_sec",
+                "error": "needs >= 2 devices for a tp=2 mesh"}
+
+    def measure(tp):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+        from deepspeed_tpu.parallel.topology import reset_topology
+        from deepspeed_tpu.serving import ServingEngine
+
+        cfg, srv_rng = ctx["cfg"], np.random.default_rng(7)
+        reset_topology()
+        srv = ServingEngine(deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype=cfg.dtype,
+            tensor_parallel={"tp_size": tp},
+            max_out_tokens=cfg.n_positions, serving=dict(ctx["scfg"])))
+        lens, srv_new = ctx["lens"], ctx["srv_new"]
+        n_requests = max(4, ctx["n_requests"] // 2)
+
+        def run():
+            pending = [srv_rng.integers(0, cfg.vocab_size,
+                                        lens[i % len(lens)]).astype(
+                np.int32) for i in range(n_requests)]
+            t0 = time.perf_counter()
+            while pending or srv.pending:
+                if pending:
+                    srv.submit(pending.pop(0), max_new_tokens=srv_new)
+                srv.step()
+            srv.drain()
+            return time.perf_counter() - t0
+
+        run()  # warm: compile the bucket set + decode program
+        srv.reset_stats()
+        elapsed = run()
+        tokens_out = sum(r["new_tokens"] for r in srv.records
+                         if r["state"] != "shed")
+        tok_s = round(tokens_out / elapsed, 1) if elapsed > 0 else None
+        srv.destroy()
+        return tok_s
+
+    try:
+        tp1_tok = measure(1)
+        tp2_tok = measure(2)
+        wire = _tp_decode_wire_bytes(ctx)
+        return {
+            "metric": f"{METRIC}_tp",
+            "value": tp2_tok,
+            "unit": "tokens_per_sec",
+            "vs_baseline": (round(tp2_tok / tp1_tok, 4)
+                            if tp1_tok and tp2_tok else None),
+            "tp1_tokens_per_sec": tp1_tok,
+            "tp2_tokens_per_sec": tp2_tok,
+            "tp1_decode_wire_bytes": wire.get(1),
+            "tp2_decode_wire_bytes": wire.get(2),
+        }
+    except Exception as e:  # noqa: BLE001 — extras never kill the headline
+        print(f"# tp series failed: {e}", file=sys.stderr, flush=True)
+        return {"metric": f"{METRIC}_tp", "value": None,
+                "unit": "tokens_per_sec", "vs_baseline": None,
+                "error": str(e)[:300]}
+
+
+def _tp_decode_wire_bytes(ctx):
+    """Collective operand bytes of ONE compiled decode step at tp=1 and
+    tp=2: params sharded by the live policy, paged KV pools head-sharded
+    by ``decode_cache_specs`` — the decode program the serving loop
+    dispatches, lowered standalone so its HLO is readable."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.module_inject.policies import (decode_cache_specs,
+                                                      get_tp_policy,
+                                                      specs_from_policy)
+    from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology
+    from deepspeed_tpu.runtime.zero.partition import replicated
+    from deepspeed_tpu.utils.hlo_inspect import parse_collectives
+
+    cfg = ctx["cfg"]
+    bs = int(ctx["scfg"].get("block_size", 8))
+    out = {}
+    for tp in (1, 2):
+        reset_topology()
+        topo = MeshTopology(axis_sizes={"tp": tp})
+        mesh = topo.mesh
+        dcfg = cfg.for_paged_decode(num_blocks=8, block_size=bs)
+        from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+
+        dmodel = GPT2LMHeadModel(dcfg)
+        B = 2
+        pg = {"block_tables": jnp.zeros((B, 4), jnp.int32),
+              "lengths": jnp.zeros((B,), jnp.int32),
+              "num_valid": jnp.ones((B,), jnp.int32), "prefill": False}
+        abstract = jax.eval_shape(
+            lambda: dmodel.init(jax.random.PRNGKey(0),
+                                jnp.zeros((B, 1), jnp.int32), paging=pg))
+        params_abs, cache_abs = abstract["params"], abstract["cache"]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        specs = specs_from_policy(get_tp_policy("gpt2"), params_abs, mesh)
+        psh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s if s is not None else P()),
+            specs, is_leaf=lambda s: s is None or isinstance(s, P))
+        csh = decode_cache_specs(cache_abs, mesh)
+
+        def step(p, c, tok, tables, lengths):
+            o, vars_ = dmodel.apply(
+                {"params": p, "cache": c}, tok, mutable=["cache"],
+                paging={"block_tables": tables, "lengths": lengths,
+                        "num_valid": jnp.ones_like(lengths),
+                        "prefill": False})
+            o = o[0] if isinstance(o, tuple) else o
+            return jnp.argmax(o[:, -1], axis=-1), vars_["cache"]
+
+        hlo = jax.jit(step, in_shardings=(psh, csh, replicated(mesh),
+                                          replicated(mesh),
+                                          replicated(mesh)),
+                      out_shardings=(replicated(mesh), csh)) \
+            .lower(params_abs, cache_abs,
+                   jax.ShapeDtypeStruct((B, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((B, 4), jnp.int32),
+                   jax.ShapeDtypeStruct((B,), jnp.int32)) \
+            .compile().as_text()
+        colls = [c for c in parse_collectives(hlo)
+                 if c["operand_bytes"] >= 16]
+        out[tp] = sum(c["operand_bytes"] for c in colls)
+    reset_topology()
+    return out
+
+
 def _serving_tracing_series(ctx):
     """Optional extra series (after the headline JSON): the span-tracing
     overhead bound on the serving side — the SAME mixed-arrival workload
@@ -832,13 +973,15 @@ def run_series(name, config=None):
         return _serving_tracing_series(ctx)
     if name == "spec_decode":
         return _spec_decode_series(ctx)
+    if name == "tp":
+        return _tp_series(ctx)
     raise KeyError(f"unknown decode series {name!r}; available: "
                    f"{sorted(SERIES)}")
 
 
 SERIES = ("headline", "serving", "serving_fastpath", "router", "fleet",
           "decode_attention", "serving_chunk", "serving_tracing",
-          "spec_decode")
+          "spec_decode", "tp")
 
 
 def main():
@@ -856,6 +999,7 @@ def main():
     emit_result(_fleet_series(ctx))
     emit_result(_spec_decode_series(ctx))
     emit_result(_serving_tracing_series(ctx))
+    emit_result(_tp_series(ctx))
 
 
 if __name__ == "__main__":

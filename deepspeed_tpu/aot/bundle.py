@@ -88,20 +88,45 @@ def verify_manifest(manifest: Dict, current: Dict) -> List[Dict]:
     (``current``: the dict :func:`deepspeed_tpu.aot.capture.
     current_bundle_identity` builds). Returns a list of structured
     mismatches — empty means the bundle may pre-populate dispatch."""
+    from deepspeed_tpu.utils.fingerprint import (fingerprint_hash,
+                                                 normalize_mesh_axes)
+
+    def norm_fp(fp: Optional[Dict]) -> Dict:
+        # mesh axes compare in normalized form (alias-folded, size-1
+        # dropped): a bundle stamped under the pre-3-axis names
+        # ("model", no "fsdp") still names the same physical
+        # partitioning today, and must not be rejected for the rename
+        fp = dict(fp or {})
+        if "mesh_axes" in fp:
+            fp["mesh_axes"] = normalize_mesh_axes(fp["mesh_axes"])
+        return fp
+
     mismatches: List[Dict] = []
     if manifest.get("version") != AOT_BUNDLE_VERSION:
         mismatches.append({"field": "version",
                            "saved": manifest.get("version"),
                            "current": AOT_BUNDLE_VERSION})
-    for field in ("fingerprint_hash", "tuned_hash"):
-        if manifest.get(field) != current.get(field):
-            mismatches.append({"field": field,
-                               "saved": manifest.get(field),
-                               "current": current.get(field)})
+    saved_fp = norm_fp(manifest.get("fingerprint"))
+    cur_fp = norm_fp(current.get("fingerprint"))
+    # hash equality is judged over the NORMALIZED fingerprints (the
+    # stored hash strings bind the axis spelling of whoever wrote
+    # them), BUT the manifest's own hash must still agree with its own
+    # fingerprint dict — a doctored/foreign hash is an identity
+    # mismatch even when the dicts happen to line up
+    stored_ok = manifest.get("fingerprint_hash") == fingerprint_hash(
+        manifest.get("fingerprint") or {})
+    if not stored_ok or fingerprint_hash(saved_fp) != \
+            fingerprint_hash(cur_fp):
+        mismatches.append({"field": "fingerprint_hash",
+                           "saved": manifest.get("fingerprint_hash"),
+                           "current": current.get("fingerprint_hash")})
+    if manifest.get("tuned_hash") != current.get("tuned_hash"):
+        mismatches.append({"field": "tuned_hash",
+                           "saved": manifest.get("tuned_hash"),
+                           "current": current.get("tuned_hash")})
     # the fingerprint dict itself, field by field, so the log names WHAT
     # changed (jaxlib? mesh axes? device kind?) instead of two hashes
-    fp_diff = diff_fingerprint(manifest.get("fingerprint") or {},
-                               current.get("fingerprint") or {})
+    fp_diff = diff_fingerprint(saved_fp, cur_fp)
     for k, v in fp_diff.items():
         mismatches.append({"field": f"fingerprint.{k}", **v})
     return mismatches

@@ -156,6 +156,13 @@ def _expand_section_target(section: str, key: str, value):
     if section == "comm_quantization" and key == "tier":
         return ({"enabled": False} if value == "off"
                 else {"enabled": True, "dtype": value})
+    if section == "mesh" and key == "shape":
+        # one measured (data, fsdp, tp) factorization of the device
+        # count (the autotuning/live.py mesh.shape axis) expands into
+        # the three SpecLayout axis knobs as a unit — filling a single
+        # axis from a triple measured jointly would mix factorizations
+        d, f, t = (int(v) for v in value)
+        return {"data": d, "fsdp": f, "tp": t}
     if section == "serving" and key == "speculative.num_speculative_tokens":
         # same contract as comm.tier: the axis grid measured the
         # machinery-off default ("off"), so the chosen value owns the

@@ -108,6 +108,25 @@ def _tile_on_backend(value) -> Tuple[bool, str]:
     return False, f"no Pallas path on backend {jax.default_backend()!r}"
 
 
+def _mesh_shape_valid(value):
+    """(data, fsdp, tp) candidate: data = -1 (fill), fsdp*tp must divide
+    the device count with at least one device left for data. tp=2 also
+    needs the bench model's head count divisible — the smoke GPT-2 has
+    4+ heads, so any tp <= 4 power of two is head-legal."""
+    import jax
+
+    _, f, t = (int(v) for v in value)
+    n = jax.device_count()
+    if f * t == 1:
+        return True, ""  # the pure-DP default is always measurable
+    if n % (f * t) != 0 or n // (f * t) < 1:
+        return False, (f"device count {n} not divisible by "
+                       f"fsdp*tp = {f * t}")
+    if n == 1:
+        return False, "needs >1 device (nothing to factor at n=1)"
+    return True, ""
+
+
 # ----------------------------------------------------------------------
 # built-in axes
 _DEFAULT_ORDER = (
@@ -115,6 +134,7 @@ _DEFAULT_ORDER = (
     "flash_attention.tiles",
     "zero.reduce_bucket_bytes",
     "comm.tier",
+    "mesh.shape",
     "serving.prefill_chunk_tokens",
     "serving.prompt_buckets",
     "serving.num_speculative_tokens",
@@ -175,6 +195,27 @@ register_axis(LiveAxis(
                               else {"enabled": True, "dtype": str(v)}),
         "zero_optimization": {"stage": 2}}},
     validity=_needs_multichip,
+))
+
+register_axis(LiveAxis(
+    # (data, fsdp, tp) factorizations of the device count — the mesh
+    # shape the SpecLayout partitions over (data = -1 fills the
+    # remainder). Measured against the REAL train_step series: whether
+    # trading data-parallel width for fsdp memory headroom or tp
+    # latency pays is workload- and interconnect-dependent, exactly
+    # what a roofline cannot rank (GSPMD, arXiv:2105.04663). The triple
+    # is one choice — its consumption (artifact._expand_section_target)
+    # expands it into the three mesh axis knobs as a unit, and only
+    # when the user pinned no mesh axis themselves. ROADMAP: "the PR 8
+    # autotuner should gain a mesh-shape axis the day this lands".
+    name="mesh.shape",
+    target="mesh.shape",
+    grid=((-1, 1, 1), (-1, 1, 2), (-1, 2, 1), (-1, 2, 2)),
+    bench="train", series="train_step",
+    objective="steps_per_sec",
+    overrides=lambda v: {"ds_config": {"mesh": {
+        "data": int(v[0]), "fsdp": int(v[1]), "tp": int(v[2])}}},
+    validity=_mesh_shape_valid,
 ))
 
 register_axis(LiveAxis(

@@ -378,18 +378,19 @@ class CausalSelfAttention(nn.Module):
         if use_decode_kernel() and not alibi and not self.window:
             if quant:
                 from deepspeed_tpu.ops.decode_attention import (
-                    decode_attention_paged_int8)
+                    decode_attention_paged_int8_tp)
 
-                y4 = decode_attention_paged_int8(
+                y4 = decode_attention_paged_int8_tp(
                     q4, ck.value, cv.value, cks.value, cvs.value, tables,
                     lengths, softmax_scale=cfg.attn_scale)
             else:
                 from deepspeed_tpu.ops.decode_attention import (
-                    decode_attention_paged)
+                    decode_attention_paged_tp)
 
-                y4 = decode_attention_paged(q4, ck.value, cv.value, tables,
-                                            lengths,
-                                            softmax_scale=cfg.attn_scale)
+                # heads partitioned over tp; per-shard KV pools
+                y4 = decode_attention_paged_tp(q4, ck.value, cv.value,
+                                               tables, lengths,
+                                               softmax_scale=cfg.attn_scale)
             y = y4.transpose(0, 2, 1, 3)
         else:
             from deepspeed_tpu.ops.decode_attention import (
@@ -497,10 +498,10 @@ class CausalSelfAttention(nn.Module):
                     # [B, S, H, D] layout (no per-token cache transpose) and
                     # only the valid [0, idx+T) prefix does compute
                     from deepspeed_tpu.ops.decode_attention import (
-                        decode_attention)
+                        decode_attention_tp)
 
-                    y4 = decode_attention(q4, ck.value, cv.value, idx,
-                                          softmax_scale=cfg.attn_scale)
+                    y4 = decode_attention_tp(q4, ck.value, cv.value, idx,
+                                             softmax_scale=cfg.attn_scale)
                     y = y4.transpose(0, 2, 1, 3)
                 else:
                     kc = ck.value.transpose(0, 2, 1, 3)
@@ -530,10 +531,10 @@ class CausalSelfAttention(nn.Module):
                     and attention_mask is None and not self.window
                     and cfg.use_flash is not False and _bthd_serves()):
                 from deepspeed_tpu.ops.flash_attention import (
-                    flash_attention_bthd)
+                    flash_attention_bthd_tp)
 
                 try:
-                    y_btc = flash_attention_bthd(
+                    y_btc = flash_attention_bthd_tp(
                         q4, k, v, causal=True,
                         softmax_scale=cfg.attn_scale).reshape(B, T, C)
                 except ValueError:

@@ -181,9 +181,12 @@ class LlamaAttention(nn.Module):
 
                 if use_decode_kernel() and not cfg.padded:
                     from deepspeed_tpu.ops.decode_attention import (
-                        decode_attention)
+                        decode_attention_tp)
 
-                    y = decode_attention(q, kc, vc, idx).transpose(0, 2, 1, 3)
+                    # heads partitioned over the tp axis (plain kernel
+                    # when tp is inactive)
+                    y = decode_attention_tp(q, kc, vc,
+                                            idx).transpose(0, 2, 1, 3)
                 else:
                     mask = cache_attn_mask(S, idx, T,
                                             pad if cfg.padded else None)

@@ -1,6 +1,13 @@
+from deepspeed_tpu.module_inject.layers import (
+    column_parallel_linear,
+    injected_mlp,
+    row_parallel_linear,
+    tp_all_reduce,
+)
 from deepspeed_tpu.module_inject.policies import (
     AUTO_POLICY,
     TPPolicy,
+    family_for,
     get_tp_policy,
     register_tp_policy,
     specs_from_policy,
@@ -9,7 +16,12 @@ from deepspeed_tpu.module_inject.policies import (
 __all__ = [
     "AUTO_POLICY",
     "TPPolicy",
+    "column_parallel_linear",
+    "family_for",
     "get_tp_policy",
+    "injected_mlp",
     "register_tp_policy",
+    "row_parallel_linear",
     "specs_from_policy",
+    "tp_all_reduce",
 ]

@@ -6,9 +6,11 @@ weights across ranks (``module_inject/replace_module.py:20``) and swaps
 ``nn.Linear`` for ``LinearLayer``/``LinearAllreduce`` (``module_inject/
 layers.py:9,25``) guided by per-architecture ``replace_policy.py`` classes —
 the TPU-native design only *annotates*: a policy maps parameter paths to
-``PartitionSpec``s over the ``model`` mesh axis, and GSPMD inserts the
+``PartitionSpec``s over the ``tp`` mesh axis, and GSPMD inserts the
 column/row-parallel collectives (the row-parallel output ``all_reduce``
-becomes an XLA ``psum`` chosen by the partitioner).
+becomes an XLA ``psum`` chosen by the partitioner). The explicit
+injected form — shard_map bodies that OWN their collective, which is
+what lets the int8 tier ride the tp wire — lives in ``layers.py``.
 
 Roles:
 - ``column``: output-dim sharded (reference ``LinearLayer``) — no collective
@@ -29,12 +31,54 @@ from typing import Dict, Optional, Sequence, Tuple
 
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.parallel.topology import AXIS_MODEL
+from deepspeed_tpu.parallel.topology import AXIS_TP
 
 COLUMN = "column"
 ROW = "row"
 VOCAB = "vocab"
 REPLICATE = "replicate"
+
+# --- parameter families (the SpecLayout vocabulary) -------------------
+# Every parameter belongs to exactly one family; the family determines
+# its canonical tp-axis PartitionSpec (runtime/zero/partition.SpecLayout)
+# in BOTH training and serving:
+#   embedding -> vocab dim over tp;  attn_qkv / mlp_in -> output dim
+#   (column-parallel);  attn_proj / mlp_out -> input dim (row-parallel,
+#   GSPMD places the tp all-reduce);  norm / other -> replicated.
+FAMILY_EMBED = "embedding"
+FAMILY_ATTN_QKV = "attn_qkv"
+FAMILY_ATTN_PROJ = "attn_proj"
+FAMILY_MLP_IN = "mlp_in"
+FAMILY_MLP_OUT = "mlp_out"
+FAMILY_NORM = "norm"
+FAMILY_OTHER = "other"
+
+# path segments that mark the attention submodule (splits the column/row
+# roles into their attn vs MLP families)
+_ATTN_PARENTS = {"attn", "attention", "self_attn", "self_attention",
+                 "crossattention", "cross_attn"}
+_NORM_SEGMENTS = {"ln", "ln_1", "ln_2", "ln_f", "emb_ln", "norm",
+                  "layernorm", "layer_norm", "input_layernorm",
+                  "post_attention_layernorm", "final_layer_norm",
+                  "ln_attn", "ln_mlp"}
+
+
+def family_for(path: str, shape: Tuple[int, ...], policy) -> str:
+    """Parameter family of ``path`` under ``policy`` (docstring above).
+    Purely descriptive — ``TPPolicy.spec_for`` stays the spec authority;
+    this names WHY a param got its spec (docs, manifest, tests)."""
+    segments = path.split("/")
+    if _NORM_SEGMENTS & set(segments):
+        return FAMILY_NORM
+    role = policy.role_for(path)
+    if role == VOCAB:
+        return FAMILY_EMBED
+    in_attn = bool(_ATTN_PARENTS & set(segments))
+    if role == COLUMN:
+        return FAMILY_ATTN_QKV if in_attn else FAMILY_MLP_IN
+    if role == ROW:
+        return FAMILY_ATTN_PROJ if in_attn else FAMILY_MLP_OUT
+    return FAMILY_OTHER
 
 
 class TPPolicy:
@@ -58,7 +102,7 @@ class TPPolicy:
         return REPLICATE
 
     def spec_for(self, path: str, shape: Tuple[int, ...], tp_size: int,
-                 axis: str = AXIS_MODEL) -> Optional[P]:
+                 axis: str = AXIS_TP) -> Optional[P]:
         """PartitionSpec for one param, or None (replicated)."""
         role = self.role_for(path)
         if role == REPLICATE or tp_size <= 1 or not shape:
@@ -185,7 +229,7 @@ def get_tp_policy(name: str = "auto") -> TPPolicy:
 
 
 def specs_from_policy(policy: TPPolicy, params_abstract, mesh,
-                      axis: str = AXIS_MODEL):
+                      axis: str = AXIS_TP):
     """Pytree of base PartitionSpecs (or None) for each param.
 
     Feed as ``param_specs`` to ``build_zero_shardings`` — ZeRO layers its
@@ -193,8 +237,10 @@ def specs_from_policy(policy: TPPolicy, params_abstract, mesh,
     """
     import jax
 
+    from deepspeed_tpu.parallel.topology import resolve_axis_name
     from deepspeed_tpu.utils.pytree import flatten_with_path_strings
 
+    axis = resolve_axis_name(mesh, axis)  # legacy "model"-named meshes
     tp_size = int(mesh.shape.get(axis, 1))
     flat, treedef = flatten_with_path_strings(params_abstract)
     specs = [policy.spec_for(path, tuple(leaf.shape), tp_size, axis)
@@ -202,28 +248,40 @@ def specs_from_policy(policy: TPPolicy, params_abstract, mesh,
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 
-def decode_cache_specs(cache_abstract, mesh, axis: str = AXIS_MODEL):
+def decode_cache_specs(cache_abstract, mesh, axis: str = AXIS_TP):
     """PartitionSpecs for a decode KV cache under tensor parallelism.
 
     The cache is the decode working set the TP layout must keep sharded:
     ``cached_key``/``cached_value`` leaves carry the layout
     ``[..., positions, heads, head_dim]`` (models/gpt2.py decode cache,
-    optionally with a leading stacked-layer axis), and the HEAD axis
-    follows the attention heads the QKV column-split distributed — so it
-    shards over ``axis`` exactly like the reference splits its inference
-    KV workspace per TP rank (``inference_context.h`` workspace carved
-    per ``mp_size``). Scalars/per-row bookkeeping (``cache_index``,
-    ``position``, ``pad_len``) replicate.
+    optionally with a leading stacked-layer axis), and the serving block
+    pools (``key_pool``/``value_pool`` ``[..., blocks, block_size,
+    heads, head_dim]`` plus their int8 ``key_scale``/``value_scale``
+    side pools ``[..., heads, 1]``) carry heads at the same -2 slot —
+    the HEAD axis follows the attention heads the QKV column-split
+    distributed, so it shards over ``axis`` exactly like the reference
+    splits its inference KV workspace per TP rank
+    (``inference_context.h`` workspace carved per ``mp_size``): each tp
+    shard owns a per-shard KV pool. Scalars/per-row bookkeeping
+    (``cache_index``, ``position``, ``pad_len``) replicate, as do
+    head-indivisible caches.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from deepspeed_tpu.parallel.topology import resolve_axis_name
     from deepspeed_tpu.utils.pytree import flatten_with_path_strings
 
+    axis = resolve_axis_name(mesh, axis)  # legacy "model"-named meshes
+    tp = int(mesh.shape.get(axis, 1))
     flat, treedef = flatten_with_path_strings(cache_abstract)
 
     def spec(path, leaf):
-        if path.rsplit("/", 1)[-1] in ("cached_key", "cached_value"):
+        leaf_name = path.rsplit("/", 1)[-1]
+        if leaf_name in ("cached_key", "cached_value", "key_pool",
+                         "value_pool", "key_scale", "value_scale") \
+                and tp > 1 and len(leaf.shape) >= 2 \
+                and leaf.shape[-2] % tp == 0:
             parts = [None] * len(leaf.shape)
             parts[-2] = axis  # heads
             return P(*parts)
@@ -233,7 +291,7 @@ def decode_cache_specs(cache_abstract, mesh, axis: str = AXIS_MODEL):
         treedef, [NamedSharding(mesh, spec(p, l)) for p, l in flat])
 
 
-def shard_params_with_policy(params, policy, mesh, axis: str = AXIS_MODEL):
+def shard_params_with_policy(params, policy, mesh, axis: str = AXIS_TP):
     """Place a param pytree per the policy's TP specs.
 
     The one sharding entry point serving engines share (InferenceEngine

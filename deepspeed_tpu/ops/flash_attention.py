@@ -729,3 +729,46 @@ def _fa_bwd(causal, softmax_scale, block_q, block_k, res, g):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention_bthd_tp(q, k, v, causal=True, softmax_scale=None,
+                            block_q=None, block_k=None, mesh=None,
+                            axis=None):
+    """TP-aware :func:`flash_attention_bthd`: heads (dim 2 of the
+    [B, T, H, D] layout) partitioned over the ``tp`` mesh axis — each
+    shard runs the kernel (forward AND custom-vjp backward) on its
+    local head group. Attention never reduces across heads, so no tp
+    collective is emitted here; the head-sharded output feeds the
+    row-parallel output projection, whose all-reduce the SpecLayout
+    places. Falls back to the plain kernel when tp is inactive or the
+    head count does not divide."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.parallel.topology import (AXIS_TP, axis_spec_entry,
+                                                 get_topology,
+                                                 resolve_axis_name)
+    from deepspeed_tpu.runtime.zero.partition import BATCH_AXES
+    from deepspeed_tpu.utils.compat import shard_map
+
+    axis = axis or AXIS_TP
+    if mesh is None:
+        topo = get_topology(create_if_missing=False)
+        mesh = topo.mesh if topo is not None else None
+    if mesh is not None:
+        axis = resolve_axis_name(mesh, axis)
+    tp = int(mesh.shape.get(axis, 1)) if mesh is not None else 1
+    heads = q.shape[2]
+    if tp <= 1 or heads % tp:
+        return flash_attention_bthd(q, k, v, causal=causal,
+                                    softmax_scale=softmax_scale,
+                                    block_q=block_q, block_k=block_k)
+    # batch stays data-sharded INSIDE the shard_map (omitting the entry
+    # would all-gather the batch whenever tp composes with data>1)
+    batch = axis_spec_entry(mesh, BATCH_AXES, q.shape[0])
+    hs = P(batch, None, axis, None)
+    fn = shard_map(
+        lambda qs, ks, vs: flash_attention_bthd(
+            qs, ks, vs, causal=causal, softmax_scale=softmax_scale,
+            block_q=block_q, block_k=block_k),
+        mesh=mesh, in_specs=(hs, hs, hs), out_specs=hs, check_vma=False)
+    return fn(q, k, v)

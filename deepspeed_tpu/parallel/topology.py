@@ -9,11 +9,15 @@ is simply the mesh axis name ``"X"``, and collectives over it are
 ``jax.lax.*`` ops bound to that name (or shardings referencing it).
 
 Axis names (canonical order, outermost first):
-    pipe > data > expert > seq > model
+    pipe > data > fsdp > expert > seq > tp
 
-- ``data``: ZeRO/DP axis — batch sharded, grads reduced here.
-- ``model``: tensor parallelism — weight dims sharded here (innermost: TP
+- ``data``: pure DP axis — batch sharded, grads reduced here.
+- ``fsdp``: weight/optimizer-state sharding axis (GSPMD, arXiv:2105.04663):
+  ZeRO partitions params/opt-state over ``data x fsdp``, but the BATCH never
+  shards here — fsdp buys memory headroom beyond the data axis.
+- ``tp``: tensor parallelism — weight dims sharded here (innermost: TP
   collectives are latency-sensitive, so they ride the fastest ICI loops).
+  ``model`` is the accepted pre-3-axis-mesh alias.
 - ``expert``: MoE all-to-all axis (folds into ``data`` for batch math).
 - ``seq``: sequence/context parallelism (ring attention).
 - ``pipe``: pipeline stages (outermost: only p2p neighbor traffic).
@@ -30,11 +34,33 @@ from deepspeed_tpu.utils.logging import logger
 
 AXIS_PIPE = "pipe"
 AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
 AXIS_EXPERT = "expert"
 AXIS_SEQ = "seq"
-AXIS_MODEL = "model"
+AXIS_TP = "tp"
+# deprecated alias: the pre-3-axis-mesh name for the TP axis. Code keyed on
+# the constant follows the rename automatically; dicts/configs carrying the
+# literal "model" are normalized through AXIS_ALIASES.
+AXIS_MODEL = AXIS_TP
 
-CANONICAL_AXIS_ORDER = (AXIS_PIPE, AXIS_DATA, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
+CANONICAL_AXIS_ORDER = (AXIS_PIPE, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT,
+                        AXIS_SEQ, AXIS_TP)
+
+AXIS_ALIASES = {"model": AXIS_TP}
+
+
+def normalize_axis_dict(axis_sizes: Dict[str, int]) -> Dict[str, int]:
+    """Fold alias axis names ("model" -> "tp") into canonical ones,
+    loudly rejecting a dict that names both an alias and its target."""
+    out: Dict[str, int] = {}
+    for name, size in (axis_sizes or {}).items():
+        canon = AXIS_ALIASES.get(name, name)
+        if canon in out and int(out[canon]) != int(size):
+            raise ValueError(
+                f"mesh axis {canon!r} given twice (via alias {name!r}) "
+                f"with conflicting sizes {out[canon]} and {size}")
+        out[canon] = int(size)
+    return out
 
 ProcessCoord = collections.namedtuple  # built per-topology below
 
@@ -133,19 +159,23 @@ class PipeDataParallelTopology(ProcessTopology):
 
 
 class PipeModelDataParallelTopology(ProcessTopology):
-    """Reference ``topology.py:243`` — pipe > data > model."""
+    """Reference ``topology.py:243`` — pipe > data > model. Keeps the
+    reference's literal ``model`` coordinate name (this is the rank-math
+    parity class, not the jax mesh — the mesh's TP axis is ``tp``)."""
 
     def __init__(self, num_pp, num_mp, num_dp):
-        super().__init__(axes=[AXIS_PIPE, AXIS_DATA, AXIS_MODEL], dims=[num_pp, num_dp, num_mp])
+        super().__init__(axes=[AXIS_PIPE, AXIS_DATA, "model"],
+                         dims=[num_pp, num_dp, num_mp])
 
 
 def _normalize_axis_sizes(axis_sizes: Dict[str, int], n_devices: int) -> Dict[str, int]:
     """Resolve -1 (fill) entries and validate the product against n_devices."""
+    axis_sizes = normalize_axis_dict(axis_sizes)
     unknown = set(axis_sizes) - set(CANONICAL_AXIS_ORDER)
     if unknown:
         raise ValueError(
             f"Unknown mesh axis name(s) {sorted(unknown)}; valid axes are "
-            f"{list(CANONICAL_AXIS_ORDER)}")
+            f"{list(CANONICAL_AXIS_ORDER)} (alias: model -> tp)")
     sizes = {a: int(axis_sizes.get(a, 1)) for a in CANONICAL_AXIS_ORDER}
     fill_axes = [a for a, s in sizes.items() if s == -1]
     if len(fill_axes) > 1:
@@ -184,7 +214,10 @@ class MeshTopology:
 
         if mesh is not None:
             self.mesh = mesh
-            self.axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            # a user-built mesh may carry the legacy "model" axis name:
+            # canonical accessors (axis_size(AXIS_TP)) still see it
+            self.axis_sizes = normalize_axis_dict(
+                dict(zip(mesh.axis_names, mesh.devices.shape)))
             for a in CANONICAL_AXIS_ORDER:
                 self.axis_sizes.setdefault(a, 1)
         else:
@@ -202,7 +235,8 @@ class MeshTopology:
             sizes = _normalize_axis_sizes(axis_sizes, len(devices))
             self.axis_sizes = sizes
             shape = tuple(sizes[a] for a in CANONICAL_AXIS_ORDER)
-            unknown = set(dcn_axis_sizes or {}) - set(CANONICAL_AXIS_ORDER)
+            dcn_axis_sizes = normalize_axis_dict(dcn_axis_sizes or {})
+            unknown = set(dcn_axis_sizes) - set(CANONICAL_AXIS_ORDER)
             if unknown:
                 raise ValueError(
                     f"unknown dcn axis names {sorted(unknown)}; valid axes: "
@@ -227,7 +261,8 @@ class MeshTopology:
 
         self.topology = ProcessTopology(
             axes=list(self.mesh.axis_names),
-            dims=[self.axis_sizes[a] for a in self.mesh.axis_names])
+            dims=[self.axis_sizes[AXIS_ALIASES.get(a, a)]
+                  for a in self.mesh.axis_names])
 
     @staticmethod
     def _hybrid_device_mesh(sizes: Dict[str, int], dcn: Dict[str, int],
@@ -277,10 +312,19 @@ class MeshTopology:
     # ------------------------------------------------------------------
     # group-query API (reference deepspeed/utils/groups.py surface)
     def get_data_parallel_world_size(self) -> int:
+        """Batch-parallel world: the axes the batch dim shards over.
+        fsdp deliberately does NOT count — it shards weights/opt-state,
+        never the batch (SpecLayout.batch_axes is the single contract)."""
         return self.axis_sizes[AXIS_DATA] * self.axis_sizes[AXIS_EXPERT]
 
     def get_model_parallel_world_size(self) -> int:
-        return self.axis_sizes[AXIS_MODEL]
+        return self.axis_sizes[AXIS_TP]
+
+    def get_tensor_parallel_world_size(self) -> int:  # canonical name
+        return self.axis_sizes[AXIS_TP]
+
+    def get_fsdp_world_size(self) -> int:
+        return self.axis_sizes[AXIS_FSDP]
 
     def get_pipe_parallel_world_size(self) -> int:
         return self.axis_sizes[AXIS_PIPE]
@@ -299,7 +343,7 @@ class MeshTopology:
         return (AXIS_DATA, AXIS_EXPERT)
 
     def get_model_parallel_group(self):
-        return AXIS_MODEL
+        return AXIS_TP
 
     def get_pipe_parallel_group(self):
         return AXIS_PIPE
@@ -315,11 +359,26 @@ class MeshTopology:
         return self.mesh.size
 
     def axis_size(self, axis: str) -> int:
-        return self.axis_sizes.get(axis, 1)
+        return self.axis_sizes.get(AXIS_ALIASES.get(axis, axis), 1)
 
     def __repr__(self):
         live = {a: s for a, s in self.axis_sizes.items() if s > 1}
         return f"MeshTopology({live or {AXIS_DATA: 1}}, world_size={self.world_size})"
+
+
+def resolve_axis_name(mesh, axis: str) -> str:
+    """The name ``axis`` goes by on THIS mesh: the canonical name when
+    present, else a legacy alias that maps to it (a user-built mesh may
+    still carry the pre-rename ``model`` axis — specs built against it
+    must name the axis the mesh actually has). Falls back to ``axis``
+    (absent axes read as size 1 either way)."""
+    names = tuple(getattr(mesh, "axis_names", ()) or ())
+    if axis in names:
+        return axis
+    for alias, canon in AXIS_ALIASES.items():
+        if canon == axis and alias in names:
+            return alias
+    return axis
 
 
 def axis_spec_entry(mesh, axes: Sequence[str], dim_size: Optional[int] = None):

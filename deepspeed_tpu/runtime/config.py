@@ -3,8 +3,9 @@
 Capability parity with the reference ``deepspeed/runtime/config.py``
 (``DeepSpeedConfig``, batch-size triangle at ``:918-989``, ~70 ``get_*``
 helpers), re-based on a pydantic tree plus a TPU-native ``mesh`` section that
-declares named mesh axis sizes (data/model/pipe/expert/seq) instead of the
-reference's implicit world-size + mpu plumbing.
+declares named mesh axis sizes (data/fsdp/tp/pipe/expert/seq; ``model`` is
+the deprecated alias of ``tp``) instead of the reference's implicit
+world-size + mpu plumbing.
 """
 
 import json
@@ -30,19 +31,42 @@ class DeepSpeedConfigError(Exception):
 class MeshConfig(DeepSpeedConfigModel):
     """TPU-native: named mesh axis sizes. ``data`` may be -1 (fill remaining
     devices). The reference derives parallel dims from world size + an external
-    mpu (``deepspeed/utils/groups.py``); here the mesh is declared."""
+    mpu (``deepspeed/utils/groups.py``); here the mesh is declared.
+
+    The 3-axis training/serving layout is ``{data: D, fsdp: F, tp: T}``
+    (SpecLayout, ``runtime/zero/partition.py``): ``fsdp`` shards
+    weights/optimizer state beyond the data axis (never the batch), ``tp``
+    shards weight dims per parameter family. ``model`` is the accepted
+    pre-3-axis alias for ``tp``."""
 
     data: int = -1
-    model: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    # deprecated alias for tp (pre-3-axis-mesh configs); folded into tp
+    # by the validator below
+    model: int = Field(1, json_schema_extra={"deprecated": "alias of tp"})
     pipe: int = 1
     expert: int = 1
     seq: int = 1
-    axis_order: tuple = ("pipe", "data", "expert", "seq", "model")
+    axis_order: tuple = ("pipe", "data", "fsdp", "expert", "seq", "tp")
     # multi-slice/multi-pod: per-axis factor that crosses the DCN (slice)
     # boundary, e.g. {"data": 4} trains 4 pods data-parallel with all other
     # axes riding ICI inside each pod (reference: multinode NCCL topology;
     # here jax mesh_utils.create_hybrid_device_mesh places the axes)
     dcn: dict = Field(default_factory=dict)
+
+    @model_validator(mode="after")
+    def _fold_model_alias(self):
+        if self.model != 1:
+            if self.tp not in (1, self.model):
+                raise ValueError(
+                    f"mesh names both tp={self.tp} and its deprecated "
+                    f"alias model={self.model} with different sizes — "
+                    "keep only tp")
+            # object.__setattr__: plain assignment would re-enter this
+            # validator via validate_assignment
+            object.__setattr__(self, "tp", self.model)
+        return self
 
 
 class ActivationCheckpointingConfig(DeepSpeedConfigModel):
@@ -598,7 +622,7 @@ class DeepSpeedConfig:
         self.bf16 = BF16Config(**d.get(C.BF16, d.get("bfloat16", {})))
         self.amp = AMPConfig(**d.get(C.AMP, {}))
         self.zero_config = DeepSpeedZeroConfig(**d.get(C.ZERO_OPTIMIZATION, {}))
-        self.mesh = MeshConfig(**d.get(C.MESH, {}))
+        mesh_raw = d.get(C.MESH, {})
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **d.get("activation_checkpointing", {}))
         # only an explicit enabled/policy key drives model reconfiguration in
@@ -648,11 +672,22 @@ class DeepSpeedConfig:
             # on, and an explicit user `enabled` key always wins
             cq_raw = apply_section(cq_raw, self.tuned_artifact,
                                    "comm_quantization")
+            # measured mesh factorization (the autotuner's mesh.shape
+            # axis): the (data, fsdp, tp) triple was measured as a UNIT,
+            # so it applies only when the user pinned no axis at all —
+            # mixing a user-pinned axis with two tuned ones would run a
+            # factorization nobody measured
+            if not any(k in mesh_raw for k in
+                       ("data", "fsdp", "tp", "model", "pipe", "expert",
+                        "seq")):
+                mesh_raw = apply_section(mesh_raw, self.tuned_artifact,
+                                         "mesh")
             # Pallas tile choices: the engine installs these into the
             # kernel-default registry at build (and removes them at
             # destroy) — kernels resolve explicit arg > tuned > default
             self.tuned_ops = ops_choices(self.tuned_artifact)
         self.comm_quantization = CommQuantizationConfig(**cq_raw)
+        self.mesh = MeshConfig(**mesh_raw)
         self.telemetry_config = TelemetryConfig(**d.get("telemetry", {}))
         self.resilience_config = ResilienceConfig(**d.get("resilience", {}))
         self.aot_config = AOTConfig(**d.get("aot", {}))
@@ -718,7 +753,7 @@ class DeepSpeedConfig:
         self.sparse_attention = d.get(C.SPARSE_ATTENTION)
         self.autotuning_config = d.get(C.AUTOTUNING, {})
         # TP policy selection (reference: injection_policy / replace_policy);
-        # TP *degree* comes from mesh.model
+        # TP *degree* comes from mesh.tp
         self.tensor_parallel_config = d.get("tensor_parallel", {})
         self.elasticity_config = d.get(C.ELASTICITY, {})
         self.compression_config = d.get("compression_training", {})
@@ -729,10 +764,13 @@ class DeepSpeedConfig:
             if mpu is not None:
                 world_size = mpu.get_data_parallel_world_size()
             else:
-                # Data-parallel world = devices not consumed by model/pipe/seq.
-                # (The expert axis folds into data for batch purposes: ep <= dp,
-                # as in the reference's expert+data group factory.)
-                non_data = self.mesh.model * self.mesh.pipe * self.mesh.seq
+                # Data-parallel world = devices not consumed by
+                # tp/pipe/seq/fsdp (fsdp shards weights, not the batch —
+                # SpecLayout.batch_axes). (The expert axis folds into data
+                # for batch purposes: ep <= dp, as in the reference's
+                # expert+data group factory.)
+                non_data = (self.mesh.tp * self.mesh.pipe * self.mesh.seq
+                            * self.mesh.fsdp)
                 world_size = int(os.environ.get("WORLD_SIZE", 1)) // max(1, non_data)
                 world_size = max(1, world_size)
         self.world_size = world_size

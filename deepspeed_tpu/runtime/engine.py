@@ -133,7 +133,8 @@ class DeepSpeedEngine:
             self.topology = MeshTopology(
                 axis_sizes=dict(
                     data=self._config.mesh.data,
-                    model=self._config.mesh.model,
+                    fsdp=self._config.mesh.fsdp,
+                    tp=self._config.mesh.tp,   # mesh.model folded in
                     pipe=self._config.mesh.pipe,
                     expert=self._config.mesh.expert,
                     seq=self._config.mesh.seq),
@@ -611,28 +612,46 @@ class DeepSpeedEngine:
         with self.mesh:
             return init_fn(jax.random.PRNGKey(self._config._param_dict.get("seed", 42)))
 
+    @property
+    def spec_layout(self):
+        """The engine's :class:`SpecLayout` — the ONE authority over the
+        data x fsdp x tp mesh layout, shared by the training shardings,
+        the topology manifest and the AOT fingerprint (and by the serving
+        engines on their side of the same class)."""
+        if getattr(self, "_spec_layout_cache", None) is None:
+            from deepspeed_tpu.module_inject import get_tp_policy
+            from deepspeed_tpu.runtime.zero.partition import SpecLayout
+
+            self._spec_layout_cache = SpecLayout(
+                self.mesh,
+                policy=get_tp_policy(self._config.tensor_parallel_config.get(
+                    "policy", "auto")),
+                persistence_threshold=(
+                    self._config.zero_config.param_persistence_threshold
+                    if self.zero_optimization_stage() >= 3 else 0))
+        return self._spec_layout_cache
+
     def _tp_base_specs(self, params_abstract):
-        """Model-parallel base PartitionSpecs: TP (model axis) via
-        module_inject policy and EP (expert axis) via the ``experts`` path
-        rule. Returns None when neither axis is active.
+        """Model-parallel base PartitionSpecs: TP (tp axis) per the
+        SpecLayout's policy families and EP (expert axis) via the
+        ``experts`` path rule. Returns None when neither axis is active.
 
         The model may supply its own (``model.param_specs(abstract)``); else a
         module_inject policy maps param paths to specs (reference
         ``module_inject/replace_policy.py`` per-arch classes)."""
-        from deepspeed_tpu.parallel.topology import AXIS_EXPERT, AXIS_MODEL
+        from deepspeed_tpu.parallel.topology import AXIS_EXPERT
 
-        tp = self.topology.axis_size(AXIS_MODEL)
+        layout = self.spec_layout
+        tp = layout.tp_size
         ep = self.topology.axis_size(AXIS_EXPERT)
         if tp <= 1 and ep <= 1:
             return None
         if hasattr(self.module, "param_specs"):
             return self.module.param_specs(params_abstract)
-        from deepspeed_tpu.module_inject import get_tp_policy
         from deepspeed_tpu.moe.utils import is_moe_param_path
         from deepspeed_tpu.utils.pytree import flatten_with_path_strings
 
-        policy = get_tp_policy(self._config.tensor_parallel_config.get(
-            "policy", "auto"))
+        policy = layout.policy
         flat, treedef = flatten_with_path_strings(params_abstract)
         specs = []
         for path, leaf in flat:
@@ -640,22 +659,23 @@ class DeepSpeedEngine:
                     and leaf.shape[0] % ep == 0:
                 # expert params: leading E dim over the expert axis; TP can
                 # still shard the remaining dims
-                inner = policy.spec_for(path, tuple(leaf.shape[1:]), tp) if tp > 1 else None
+                inner = policy.spec_for(path, tuple(leaf.shape[1:]), tp,
+                                        layout.tp_axis) if tp > 1 else None
                 inner_entries = list(inner) if inner is not None else \
                     [None] * (leaf.ndim - 1)
                 specs.append(P(AXIS_EXPERT, *inner_entries))
             else:
-                specs.append(policy.spec_for(path, tuple(leaf.shape), tp)
+                specs.append(layout.base_spec(path, tuple(leaf.shape))
                              if tp > 1 else None)
         return jax.tree_util.tree_unflatten(treedef, specs)
 
     def _shardings_for(self, params_abstract):
+        layout = self.spec_layout
         return build_zero_shardings(
             params_abstract, self.mesh,
             stage=self.zero_optimization_stage(),
             param_specs=self._tp_base_specs(params_abstract),
-            persistence_threshold=self._config.zero_config.param_persistence_threshold
-            if self.zero_optimization_stage() >= 3 else 0)
+            persistence_threshold=layout.persistence_threshold)
 
     def _build_state(self, params):
         params = jax.tree_util.tree_map(jnp.asarray, params)
@@ -843,15 +863,21 @@ class DeepSpeedEngine:
         cq = self._config.comm_quantization
         if not cq.enabled or cq.dtype == "1bit" or self._onebit:
             return None  # 1-bit: the optimizer owns the collective
-        from deepspeed_tpu.parallel.topology import (AXIS_EXPERT, AXIS_MODEL,
-                                                     AXIS_PIPE, AXIS_SEQ)
+        from deepspeed_tpu.parallel.topology import (AXIS_EXPERT, AXIS_FSDP,
+                                                     AXIS_PIPE, AXIS_SEQ,
+                                                     AXIS_TP)
 
-        for axis in (AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, AXIS_EXPERT):
+        # the bucketed shard_map reduction assumes grads live purely on
+        # the data axis; tp/fsdp runs fall back to GSPMD here — the int8
+        # tier still applies to tp collectives through the injected
+        # serving layers (module_inject/layers.tp_all_reduce)
+        for axis in (AXIS_TP, AXIS_FSDP, AXIS_PIPE, AXIS_SEQ, AXIS_EXPERT):
             if self.topology.axis_size(axis) > 1:
                 logger.warning(
-                    f"comm_quantization is data-parallel only (mesh axis "
-                    f"{axis!r} has size {self.topology.axis_size(axis)}); "
-                    "falling back to the full-width GSPMD reduction")
+                    f"the bucketed comm_quantization reduction is "
+                    f"data-axis only (mesh axis {axis!r} has size "
+                    f"{self.topology.axis_size(axis)}); falling back to "
+                    "the full-width GSPMD reduction")
                 return None
         if self._host_offload:
             logger.warning(
@@ -2165,10 +2191,13 @@ class DeepSpeedEngine:
     # resume so a same-topology restart never recompiles them
     def _aot_identity(self):
         from deepspeed_tpu.aot import current_bundle_identity
+        from deepspeed_tpu.utils.fingerprint import normalize_mesh_axes
 
+        # normalized (alias-folded, size-1-dropped) axes: a bundle
+        # compiled under the pre-3-axis mesh names still matches the
+        # same physical partitioning after the tp rename
         return current_bundle_identity(
-            mesh_axes={a: int(s)
-                       for a, s in self.topology.axis_sizes.items()},
+            mesh_axes=normalize_mesh_axes(self.topology.axis_sizes),
             tuned_hash=self._config.tuned_artifact_hash)
 
     def _aot_supported(self, what: str) -> bool:

@@ -94,16 +94,35 @@ def _mesh_desc(manifest: Dict) -> Dict:
     return manifest.get("mesh", {}) or {}
 
 
+def _normalize_axes(axes: Optional[Dict]) -> Dict[str, int]:
+    """Canonical view of a manifest's mesh-axes dict: alias names fold
+    ("model" -> "tp" — pre-3-axis checkpoints restore onto the renamed
+    axis without a phantom diff) and size-1 axes drop (a 5-axis-era
+    manifest without "fsdp" equals a new one carrying fsdp=1)."""
+    from deepspeed_tpu.utils.fingerprint import normalize_mesh_axes
+
+    return normalize_mesh_axes(axes)
+
+
 def diff_topology(saved: Dict, current: Dict) -> Dict:
     """Structured saved-vs-current comparison. ``changed`` lists benign
-    shifts (mesh axes, world size, ZeRO stage, batch geometry — the
-    reshard path handles those); ``fatal`` lists differences no reshard
-    can bridge (tensor set/shape/dtype mismatches)."""
+    shifts (mesh axes — rendered axis-by-axis, world size, ZeRO stage,
+    batch geometry — the reshard path handles those); ``fatal`` lists
+    differences no reshard can bridge (tensor set/shape/dtype
+    mismatches)."""
     changed: Dict[str, Any] = {}
     fatal: Dict[str, Any] = {}
 
     s_mesh, c_mesh = _mesh_desc(saved), _mesh_desc(current)
-    for field in ("axes", "world_size", "process_count"):
+    s_axes = _normalize_axes(s_mesh.get("axes"))
+    c_axes = _normalize_axes(c_mesh.get("axes"))
+    # axis-by-axis: a tp=1 -> tp=2 restore renders as "mesh.axes.tp",
+    # not an opaque whole-dict swap
+    for axis in sorted(set(s_axes) | set(c_axes)):
+        sv, cv = s_axes.get(axis, 1), c_axes.get(axis, 1)
+        if sv != cv:
+            changed[f"mesh.axes.{axis}"] = {"saved": sv, "current": cv}
+    for field in ("world_size", "process_count"):
         sv, cv = s_mesh.get(field), c_mesh.get(field)
         if sv != cv:
             changed[f"mesh.{field}"] = {"saved": sv, "current": cv}

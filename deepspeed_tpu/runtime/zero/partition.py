@@ -22,15 +22,33 @@ gather/release hooks (``runtime/zero/partition_parameters.py:1042``,
 Sharding rule: shard the largest dimension divisible by the axis size; params
 smaller than ``param_persistence_threshold`` stay replicated (mirrors
 ``stage3_param_persistence_threshold``).
+
+Since the 3-axis mesh (``data x fsdp x tp``, GSPMD arXiv:2105.04663) the
+one authority over *which axis shards what* is :class:`SpecLayout`:
+canonical PartitionSpecs per parameter family (embeddings, attention
+QKV/proj, MLP in/out, norms) on the ``tp`` axis, ZeRO layering over
+``data x fsdp x expert``, and batch arrays over ``data x expert`` ONLY —
+``fsdp``/``tp`` never shard the batch dimension. Training shardings,
+the topology manifest, the AOT fingerprint and the serving engines all
+consume the same layout, so the partitioning of a tensor family cannot
+diverge between training and inference.
 """
 
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.parallel.topology import AXIS_DATA, AXIS_EXPERT
+from deepspeed_tpu.parallel.topology import (AXIS_DATA, AXIS_EXPERT,
+                                             AXIS_FSDP, AXIS_SEQ, AXIS_TP)
+
+# ZeRO partitions optimizer state / ZeRO-3 params over these axes (the
+# flattened product is the reference's "partition count"); the batch only
+# ever shards over BATCH_AXES — fsdp buys param/opt-state memory headroom
+# without forcing more data parallelism, tp never touches the batch.
+ZERO_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
+BATCH_AXES = (AXIS_DATA, AXIS_EXPERT)
 
 
 def _shardable_dim(shape: Tuple[int, ...], axis_size: int,
@@ -45,7 +63,7 @@ def _shardable_dim(shape: Tuple[int, ...], axis_size: int,
 
 def zero_partition_spec(shape: Tuple[int, ...],
                         mesh: Mesh,
-                        data_axes: Sequence[str] = (AXIS_DATA, AXIS_EXPERT),
+                        data_axes: Optional[Sequence[str]] = None,
                         base_spec: Optional[P] = None,
                         persistence_threshold: int = 0) -> P:
     """PartitionSpec sharding ``shape`` over the (flattened) data axes,
@@ -54,6 +72,8 @@ def zero_partition_spec(shape: Tuple[int, ...],
     Returns ``base_spec`` unchanged if the array is too small (persistence
     threshold) or no dim divides evenly.
     """
+    if data_axes is None:
+        data_axes = ZERO_AXES
     entries = list(base_spec) if base_spec is not None else []
     entries += [None] * (len(shape) - len(entries))
     used = {a for e in entries for a in (e if isinstance(e, tuple) else (e,)) if a}
@@ -161,6 +181,167 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 # ----------------------------------------------------------------------
+# SpecLayout: the one authority over the data x fsdp x tp mesh layout
+class SpecLayout:
+    """Canonical named-axis partition layout (GSPMD, arXiv:2105.04663).
+
+    ONE object answers every "which axis shards this tensor?" question
+    for a mesh, consumed identically by training and inference:
+
+    - **parameter families** (embeddings, attention QKV, attention
+      output proj, MLP in, MLP out, norms) get tp-axis base
+      PartitionSpecs from a ``module_inject`` policy;
+    - **ZeRO** (stages 1-3) layers ``data x fsdp x expert`` sharding on
+      the dims TP left alone (:func:`zero_partition_spec`);
+    - **batch arrays** shard over ``batch_axes`` ONLY — by contract
+      ``fsdp`` and ``tp`` never appear in a batch spec (they shard
+      weights/heads, so putting them on the batch would silently change
+      the global batch size).
+
+    ``policy`` may be a TPPolicy, a policy name, or None (name "auto").
+    """
+
+    def __init__(self, mesh: Mesh, policy="auto",
+                 tp_axis: str = AXIS_TP,
+                 zero_axes: Sequence[str] = ZERO_AXES,
+                 batch_axes: Sequence[str] = BATCH_AXES,
+                 persistence_threshold: int = 0):
+        forbidden = {tp_axis, AXIS_FSDP} & set(batch_axes)
+        if forbidden:
+            raise ValueError(
+                f"batch_axes {tuple(batch_axes)} must not contain the "
+                f"tp/fsdp axes {sorted(forbidden)}: they shard weights, "
+                "never the batch dimension")
+        from deepspeed_tpu.parallel.topology import resolve_axis_name
+
+        self.mesh = mesh
+        # a user-built mesh may still carry the legacy "model" axis name
+        # — specs must name the axis the mesh actually has, or TP would
+        # silently replicate
+        self.tp_axis = resolve_axis_name(mesh, tp_axis)
+        self.zero_axes = tuple(zero_axes)
+        self.batch_axes = tuple(batch_axes)
+        self.persistence_threshold = int(persistence_threshold)
+        self._policy = policy
+
+    # -- policy / families ------------------------------------------------
+    @property
+    def policy(self):
+        from deepspeed_tpu.module_inject.policies import get_tp_policy
+
+        if isinstance(self._policy, str) or self._policy is None:
+            self._policy = get_tp_policy(self._policy or "auto")
+        return self._policy
+
+    @property
+    def tp_size(self) -> int:
+        return int(self.mesh.shape.get(self.tp_axis, 1))
+
+    def family_of(self, path: str, shape: Tuple[int, ...] = ()) -> str:
+        """Parameter family of one param path (module docstring list)."""
+        from deepspeed_tpu.module_inject.policies import family_for
+
+        return family_for(path, shape, self.policy)
+
+    def base_spec(self, path: str, shape: Tuple[int, ...]) -> Optional[P]:
+        """TP base PartitionSpec for one param (None = replicated)."""
+        return self.policy.spec_for(path, tuple(shape), self.tp_size,
+                                    self.tp_axis)
+
+    def base_specs(self, params_abstract):
+        """Pytree of tp-axis base specs for a whole param tree."""
+        from deepspeed_tpu.module_inject.policies import specs_from_policy
+
+        return specs_from_policy(self.policy, params_abstract, self.mesh,
+                                 axis=self.tp_axis)
+
+    # -- ZeRO layering ----------------------------------------------------
+    def param_spec(self, shape, base_spec=None, stage: int = 3) -> P:
+        """Final spec of a parameter under ``stage`` (TP ⊕ ZeRO-3)."""
+        if stage >= 3:
+            return zero_partition_spec(
+                tuple(shape), self.mesh, data_axes=self.zero_axes,
+                base_spec=base_spec,
+                persistence_threshold=self.persistence_threshold)
+        return base_spec if base_spec is not None else P()
+
+    def opt_spec(self, shape, base_spec=None, stage: int = 1) -> P:
+        """Final spec of an optimizer-state leaf under ``stage``."""
+        if stage >= 1:
+            return zero_partition_spec(tuple(shape), self.mesh,
+                                       data_axes=self.zero_axes,
+                                       base_spec=base_spec)
+        return base_spec if base_spec is not None else P()
+
+    def shardings(self, params_abstract, stage: int):
+        """(param_shardings, opt_shardings) — build_zero_shardings fed
+        by this layout's policy/axes/threshold."""
+        return build_zero_shardings(
+            params_abstract, self.mesh, stage=stage,
+            param_specs=self.base_specs(params_abstract),
+            persistence_threshold=self.persistence_threshold)
+
+    # -- batch ------------------------------------------------------------
+    def batch_spec(self, ndim: int = 2,
+                   shape: Optional[Tuple[int, ...]] = None) -> P:
+        """Batch arrays: leading dim over ``batch_axes``; with sequence
+        parallelism active, dim 1 (tokens) additionally shards over
+        ``seq``. Dims not divisible by their axis product stay unsharded
+        (requires ``shape``). Never names fsdp/tp (class contract)."""
+        from deepspeed_tpu.parallel.topology import axis_spec_entry
+
+        entries = [None] * ndim
+        entries[0] = axis_spec_entry(self.mesh, self.batch_axes,
+                                     shape[0] if shape is not None else None)
+        if ndim >= 2:
+            entries[1] = axis_spec_entry(
+                self.mesh, (AXIS_SEQ,),
+                shape[1] if shape is not None else None)
+        return P(*entries)
+
+    def batch_sharding(self, ndim: int = 2,
+                       shape: Optional[Tuple[int, ...]] = None
+                       ) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec(ndim, shape))
+
+    # -- identity ---------------------------------------------------------
+    def describe(self) -> Dict:
+        """JSON-safe identity of this layout: the axis roles plus one
+        canonical spec per parameter family at the live tp size — what
+        the docs render and the fingerprint/manifest can embed."""
+        tp = self.tp_size
+        families = {
+            "embedding": spec_entries(P(self.tp_axis, None) if tp > 1
+                                      else None),
+            "attn_qkv": spec_entries(P(None, self.tp_axis) if tp > 1
+                                     else None),
+            "attn_proj": spec_entries(P(self.tp_axis, None) if tp > 1
+                                      else None),
+            "mlp_in": spec_entries(P(None, self.tp_axis) if tp > 1
+                                   else None),
+            "mlp_out": spec_entries(P(self.tp_axis, None) if tp > 1
+                                    else None),
+            "norm": spec_entries(None),
+        }
+        return {
+            "policy": getattr(self.policy, "name", "auto"),
+            "tp_axis": self.tp_axis,
+            "tp_size": tp,
+            "zero_axes": list(self.zero_axes),
+            "batch_axes": list(self.batch_axes),
+            "families": families,
+        }
+
+
+def default_layout(mesh: Mesh, policy="auto",
+                   persistence_threshold: int = 0) -> SpecLayout:
+    """The repo-wide default SpecLayout for a mesh (canonical axis
+    roles; the knobs engines thread through come from their configs)."""
+    return SpecLayout(mesh, policy=policy,
+                      persistence_threshold=persistence_threshold)
+
+
+# ----------------------------------------------------------------------
 # PartitionSpec <-> JSON (the topology-manifest wire format: a checkpoint
 # must record how every logical tensor was partitioned at save time so a
 # restore onto a DIFFERENT mesh can validate and reshard deliberately)
@@ -187,18 +368,14 @@ def sharding_spec_entries(sharding) -> list:
     return spec_entries(spec)
 
 
-def batch_sharding(mesh: Mesh, data_axes: Sequence[str] = (AXIS_DATA, AXIS_EXPERT),
+def batch_sharding(mesh: Mesh, data_axes: Optional[Sequence[str]] = None,
                    ndim: int = 2, shape: Optional[Tuple[int, ...]] = None) -> NamedSharding:
-    """Batch arrays: leading dim sharded over the data axes; with sequence
-    parallelism active, dim 1 (tokens) additionally shards over ``seq``.
-    Dims not divisible by their axis product stay unsharded (requires
-    ``shape``)."""
-    from deepspeed_tpu.parallel.topology import AXIS_SEQ, axis_spec_entry
-
-    entries = [None] * ndim
-    entries[0] = axis_spec_entry(mesh, data_axes,
-                                 shape[0] if shape is not None else None)
-    if ndim >= 2:
-        entries[1] = axis_spec_entry(mesh, (AXIS_SEQ,),
-                                     shape[1] if shape is not None else None)
-    return NamedSharding(mesh, P(*entries))
+    """Batch arrays, per the default :class:`SpecLayout`: leading dim
+    over the layout's ``batch_axes`` (data x expert — NEVER fsdp/tp,
+    which shard weights); with sequence parallelism active, dim 1
+    (tokens) additionally shards over ``seq``. Dims not divisible by
+    their axis product stay unsharded (requires ``shape``). An explicit
+    ``data_axes`` builds a one-off layout with those batch axes."""
+    layout = SpecLayout(mesh) if data_axes is None \
+        else SpecLayout(mesh, batch_axes=tuple(data_axes))
+    return layout.batch_sharding(ndim=ndim, shape=shape)

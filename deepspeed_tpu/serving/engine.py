@@ -211,14 +211,17 @@ class ServingEngine:
     def _init_cache(self):
         """Zeroed per-layer KV pools, shaped by tracing the paged decode
         module's init without running it (eval_shape: no compute, no
-        params materialized). Placed with the replicated mesh sharding
-        the compiled programs emit, so the FIRST prefill's argument
-        signature already matches steady state — a `jnp.zeros` pool
-        would carry SingleDeviceSharding and cost that bucket one
-        spurious retrace when the post-step pool comes back NamedSharded
-        (TP-sharding the pool over the model axis is the follow-up)."""
+        params materialized). Placed with the mesh shardings the
+        compiled programs emit — ``decode_cache_specs``: on a tp>1 mesh
+        the key/value pools (and their int8 scale side pools) live
+        HEAD-SHARDED over the tp axis, a per-shard KV pool per device
+        group, exactly the layout the TP-aware paged Pallas kernel
+        consumes — so the FIRST prefill's argument signature already
+        matches steady state (a `jnp.zeros` pool would carry
+        SingleDeviceSharding and cost that bucket one spurious
+        retrace)."""
         jax, jnp = self._jax, self._jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deepspeed_tpu.module_inject.policies import decode_cache_specs
 
         pg = {"block_tables": jnp.zeros((1, self.blocks_per_seq), jnp.int32),
               "lengths": jnp.zeros((1,), jnp.int32),
@@ -227,10 +230,10 @@ class ServingEngine:
             lambda: self._dmodule.init(jax.random.PRNGKey(0),
                                        jnp.zeros((1, 1), jnp.int32),
                                        paging=pg))
-        sharding = NamedSharding(self.engine.mesh, P())
+        shardings = decode_cache_specs(shapes["cache"], self.engine.mesh)
         return jax.tree_util.tree_map(
-            lambda s: jax.device_put(jnp.zeros(s.shape, s.dtype), sharding),
-            shapes["cache"])
+            lambda s, sh: jax.device_put(jnp.zeros(s.shape, s.dtype), sh),
+            shapes["cache"], shardings)
 
     def _donate(self):
         # the old pool is dead after every call — donate it so steady-state

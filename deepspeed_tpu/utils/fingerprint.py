@@ -31,6 +31,18 @@ def jaxlib_version() -> str:
         return "unknown"
 
 
+def normalize_mesh_axes(axes: Optional[Dict]) -> Dict[str, int]:
+    """Canonical mesh-axes identity: alias names fold ("model" -> "tp",
+    the pre-3-axis-mesh name) and size-1 axes drop, so a fingerprint
+    stamped before an axis existed (or under the old name) still equals
+    the same physical partitioning today. Shared by the AOT bundle
+    identity and the checkpoint topology manifest diff."""
+    from deepspeed_tpu.parallel.topology import AXIS_ALIASES
+
+    return {AXIS_ALIASES.get(str(a), str(a)): int(s)
+            for a, s in (axes or {}).items() if int(s) != 1}
+
+
 def topology_fingerprint(mesh_axes: Optional[Dict[str, int]] = None) -> Dict:
     """JSON-safe identity of the live runtime (module docstring)."""
     import jax

@@ -39,7 +39,7 @@ RUN_LIST = ["getting-started.md", "parallelism.md", "inference.md",
             "training-efficiency.md", "checkpointing.md",
             "comm-quantization.md", "telemetry.md", "resilience.md",
             "serving.md", "elasticity.md", "aot.md", "lint.md",
-            "fleet.md", "metrics.md"]
+            "fleet.md", "metrics.md", "tensor-parallel.md"]
 
 
 @pytest.mark.heavy

@@ -72,9 +72,9 @@ class TestPolicyZoo:
         p = get_tp_policy("llama")
         # column: output dim sharded
         assert p.spec_for("layers/block/self_attn/q_proj/kernel",
-                          (64, 64), tp_size=2) == P(None, "model")
+                          (64, 64), tp_size=2) == P(None, "tp")
         # row: input dim sharded, bias replicated
         assert p.spec_for("layers/block/self_attn/o_proj/kernel",
-                          (64, 64), tp_size=2) == P("model", None)
+                          (64, 64), tp_size=2) == P("tp", None)
         assert p.spec_for("embed_tokens", (256, 64), tp_size=2) == \
-            P("model", None)
+            P("tp", None)

@@ -46,14 +46,14 @@ class TestTPPolicy:
     def test_gpt2_roles(self):
         pol = get_tp_policy("gpt2")
         assert pol.spec_for("transformer/h/block/attn/c_attn/kernel",
-                            (2, 64, 192), 4) == P(None, None, "model")
+                            (2, 64, 192), 4) == P(None, None, "tp")
         assert pol.spec_for("transformer/h/block/attn/c_proj/kernel",
-                            (2, 64, 64), 4) == P(None, "model", None)
+                            (2, 64, 64), 4) == P(None, "tp", None)
         assert pol.spec_for("transformer/h/block/attn/c_proj/bias",
                             (2, 64), 4) is None  # row bias replicated
         assert pol.spec_for("transformer/h/block/mlp/c_fc/bias",
-                            (2, 256), 4) == P(None, "model")
-        assert pol.spec_for("wte", (256, 64), 4) == P("model", None)
+                            (2, 256), 4) == P(None, "tp")
+        assert pol.spec_for("wte", (256, 64), 4) == P("tp", None)
         assert pol.spec_for("ln_f/scale", (64,), 4) is None
 
     def test_indivisible_dim_replicates(self):
@@ -77,7 +77,7 @@ class TestTPPolicy:
             "ln": {"scale": jax.ShapeDtypeStruct((64,), jnp.float32)},
         }
         specs = specs_from_policy(get_tp_policy("gpt2"), abstract, topo.mesh)
-        assert specs["attn"]["c_attn"]["kernel"] == P(None, "model")
+        assert specs["attn"]["c_attn"]["kernel"] == P(None, "tp")
         assert specs["ln"]["scale"] is None
 
 
@@ -107,7 +107,7 @@ class TestTPTraining:
         engine({"input_ids": ids})
         k = engine.state.params["transformer"]["h"]["block"]["attn"]["c_attn"]["kernel"]
         spec = k.sharding.spec
-        assert "model" in jax.tree_util.tree_leaves(list(spec)), spec
+        assert "tp" in jax.tree_util.tree_leaves(list(spec)), spec
         # opt state mirrors the param sharding
         m = engine.state.opt_state.exp_avg["transformer"]["h"]["block"]["attn"]["c_attn"]["kernel"]
-        assert "model" in jax.tree_util.tree_leaves(list(m.sharding.spec))
+        assert "tp" in jax.tree_util.tree_leaves(list(m.sharding.spec))

@@ -56,10 +56,23 @@ class TestMeshTopology:
         assert t.world_size == 8
 
     def test_data_model_split(self):
+        # "model" is the accepted alias of the canonical "tp" axis
         t = MeshTopology(axis_sizes={"data": 2, "model": 4})
         assert t.get_data_parallel_world_size() == 2
         assert t.get_model_parallel_world_size() == 4
-        assert t.mesh.shape["model"] == 4
+        assert t.mesh.shape["tp"] == 4
+        assert t.axis_size("model") == 4  # alias reads keep working
+
+    def test_three_axis_mesh(self):
+        t = MeshTopology(axis_sizes={"data": 2, "fsdp": 2, "tp": 2})
+        assert t.get_data_parallel_world_size() == 2  # fsdp ∉ batch axes
+        assert t.get_fsdp_world_size() == 2
+        assert t.get_tensor_parallel_world_size() == 2
+        assert t.mesh.shape["fsdp"] == 2 and t.mesh.shape["tp"] == 2
+
+    def test_model_tp_conflict_raises(self):
+        with pytest.raises(ValueError):
+            MeshTopology(axis_sizes={"model": 2, "tp": 4})
 
     def test_fill_axis(self):
         t = MeshTopology(axis_sizes={"model": 2})
@@ -79,13 +92,13 @@ class TestMeshTopology:
         t = MeshTopology(axis_sizes={"data": 4, "model": 2},
                          dcn_axis_sizes={"data": 2})
         assert t.mesh.shape["data"] == 4
-        assert t.mesh.shape["model"] == 2
+        assert t.mesh.shape["tp"] == 2
         devs = list(jax.devices()[:8])
-        arr = t.mesh.devices  # [pipe, data, expert, seq, model]
+        arr = t.mesh.devices  # [pipe, data, fsdp, expert, seq, tp]
         # dcn-major along data: data rows 0-1 come from slice 0 (devices
         # 0-3), rows 2-3 from slice 1 (devices 4-7)
         first_half = {d.id for d in devs[:4]}
-        assert {d.id for d in arr[0, :2, 0, 0, :].ravel()} == first_half
+        assert {d.id for d in arr[0, :2, 0, 0, 0, :].ravel()} == first_half
 
     def test_hybrid_dcn_indivisible_raises(self):
         with pytest.raises(ValueError):

@@ -9,12 +9,15 @@ the resume topology is compatible::
     python tools/ckpt_topology.py /ckpts              # latest tag, summary
     python tools/ckpt_topology.py /ckpts --tag t0     # specific tag
     python tools/ckpt_topology.py /ckpts --json       # machine-readable
-    python tools/ckpt_topology.py /ckpts --diff data=4,model=2
+    python tools/ckpt_topology.py /ckpts --diff data=4,tp=2
+    python tools/ckpt_topology.py /ckpts --diff data=2,fsdp=2,tp=2
     python tools/ckpt_topology.py /ckpts --diff data=4 --world 4 --batch 16
 
 ``--diff`` compares the manifest against a hypothetical resume mesh and
 exits 2 when the shift is impossible (1 on other errors, 0 when clean or
-merely resharding) — usable directly as a launch-script gate.
+merely resharding) — usable directly as a launch-script gate. Mesh
+shifts render axis-by-axis (``mesh.axes.tp: saved=1 -> current=2``);
+the legacy ``model`` axis name is accepted as an alias of ``tp``.
 """
 
 import argparse
@@ -99,7 +102,7 @@ def main(argv=None) -> int:
                         help="emit the manifest (and diff) as JSON")
     parser.add_argument("--diff", default=None, metavar="AXES",
                         help="compare against a resume mesh, e.g. "
-                        "'data=4,model=2'")
+                        "'data=2,fsdp=2,tp=2' ('model' = alias of tp)")
     parser.add_argument("--world", type=int, default=None,
                         help="resume world size (default: product of "
                         "--diff axes)")

@@ -9,6 +9,13 @@ can't disagree). Run::
     JAX_PLATFORMS=cpu python tools/perf_comm_wire.py [--elems N]
 
 Prints a markdown table (for PERF.md) followed by one JSON line.
+
+A second table breaks the wire down PER MESH AXIS on the 3-axis
+``data x fsdp x tp`` 2x2x2 mesh: the data-axis gradient reduction, the
+fsdp-axis ZeRO-3 param all-gather, and the tp-axis row-parallel
+all-reduce (dense and int8-tier via ``module_inject.layers``) — so TP's
+comm cost is visible in the same units as ZeRO's. One more JSON line
+(``comm_wire_bytes_per_axis``) follows it.
 """
 
 import argparse
@@ -100,6 +107,95 @@ def main():
     print()
     print(json.dumps({"metric": "comm_wire_bytes_per_bucket", "elems": n,
                       "bf16_dense_bytes": bf16_dense, "tiers": rows}))
+    print()
+    per_axis_table()
+
+
+def per_axis_table(elems: int = 65_536):
+    """Collective operand bytes per mesh axis on the 2x2x2
+    data x fsdp x tp mesh (module docstring). Each program exercises
+    exactly ONE axis's canonical collective, so attribution is by
+    construction, not by parsing replica groups."""
+    from jax.experimental import mesh_utils  # noqa: F401 (device count)
+
+    from deepspeed_tpu.module_inject.layers import (injected_mlp,
+                                                    row_parallel_linear)
+    from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology
+
+    reset_topology()
+    topo = MeshTopology(axis_sizes={"data": 2, "fsdp": 2, "tp": 2},
+                        devices=jax.devices()[:8])
+    mesh3 = topo.mesh
+    n = elems
+    d = 256                      # feature width of the tp toy matmul
+    rows_n = n // d
+
+    # data axis: the ZeRO gradient mean-reduction (what every step ships)
+    def grad_reduce(g):
+        return reduce_gradients(g.reshape(n), "data", 2,
+                                comm_dtype="none", bucket_bytes=1 << 62)
+
+    data_hlo = lower(shard_map(grad_reduce, mesh=mesh3,
+                               in_specs=P("data"), out_specs=P(),
+                               check_vma=False),
+                     jax.ShapeDtypeStruct((2, n), jnp.float32))
+
+    # fsdp axis: the ZeRO-3 param all-gather (per-use weight fetch)
+    def param_gather(w):
+        from jax import lax
+
+        return lax.all_gather(w, "fsdp", axis=0, tiled=True)
+
+    fsdp_hlo = lower(shard_map(param_gather, mesh=mesh3,
+                               in_specs=P("fsdp"), out_specs=P(),
+                               check_vma=False),
+                     jax.ShapeDtypeStruct((n,), jnp.float32))
+
+    # tp axis: the row-parallel output all-reduce (dense vs int8 tier)
+    x = jax.ShapeDtypeStruct((rows_n, d), jnp.float32)
+    w = jax.ShapeDtypeStruct((d, d), jnp.float32)
+    b = jax.ShapeDtypeStruct((d,), jnp.float32)
+    tp_dense_hlo = lower(
+        lambda xs, ws, bs: row_parallel_linear(xs, ws, bs, mesh3,
+                                               comm_dtype="none"),
+        x, w, b)
+    tp_int8_hlo = lower(
+        lambda xs, ws, bs: row_parallel_linear(xs, ws, bs, mesh3,
+                                               comm_dtype="int8"),
+        x, w, b)
+    mlp_int8_hlo = lower(
+        lambda xs, wi, bi, wo, bo: injected_mlp(
+            xs, wi, bi, wo, bo, mesh3, comm_dtype="int8"),
+        x, jax.ShapeDtypeStruct((d, 4 * d), jnp.float32),
+        jax.ShapeDtypeStruct((4 * d,), jnp.float32),
+        jax.ShapeDtypeStruct((4 * d, d), jnp.float32), b)
+
+    rows = []
+    for axis, role, hlo in [
+            ("data", "ZeRO grad reduce (psum)", data_hlo),
+            ("fsdp", "ZeRO-3 param all-gather", fsdp_hlo),
+            ("tp", "row-parallel all-reduce (dense)", tp_dense_hlo),
+            ("tp", "row-parallel all-reduce (int8 tier)", tp_int8_hlo),
+            ("tp", "injected MLP, one int8 reduce", mlp_int8_hlo)]:
+        total, colls = wire_bytes(hlo)
+        ops = "+".join(sorted({c["op"] for c in colls})) or "-"
+        dtypes = "+".join(sorted({dt for c in colls
+                                  for dt, _ in c["operands"]})) or "-"
+        rows.append({"axis": axis, "role": role, "ops": ops,
+                     "dtypes": dtypes, "operand_bytes": total})
+
+    print(f"Per-AXIS collective operand bytes on the data x fsdp x tp "
+          f"2x2x2 mesh ({n} f32 elements per tensor, compiled HLO):\n")
+    print("| mesh axis | collective | ops | operand dtypes | "
+          "bytes/member |")
+    print("|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['axis']} | {r['role']} | {r['ops']} | {r['dtypes']} "
+              f"| {r['operand_bytes']:,} |")
+    print()
+    print(json.dumps({"metric": "comm_wire_bytes_per_axis", "elems": n,
+                      "mesh": {"data": 2, "fsdp": 2, "tp": 2},
+                      "axes": rows}))
 
 
 if __name__ == "__main__":

@@ -110,7 +110,7 @@ def _bloom176b_setup(decode: bool = False):
     from deepspeed_tpu.module_inject import get_tp_policy, specs_from_policy
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    topo = _mesh({"model": 8})
+    topo = _mesh({"tp": 8})
     mesh = topo.mesh
     cfg = GPT2Config(vocab_size=250880, n_positions=2048, n_embd=14336,
                      n_layer=70, n_head=112, position_embedding="alibi",
@@ -194,7 +194,7 @@ def bloom176b_tp8_decode():
 
     cfg, dmodel, mesh, abstract, n_params, psh = _bloom176b_setup(
         decode=True)
-    tp = int(mesh.shape["model"])  # single-sourced from the setup's mesh
+    tp = int(mesh.shape["tp"])  # single-sourced from the setup's mesh
     B, T = 1, 2048
     # cache abstractions come from the prefill program itself (the same
     # flax variables the engine's generate creates)

@@ -195,6 +195,7 @@ def main():
     emit_result(_tracing_series(cfg, batch, seq, on_tpu))
     emit_result(_metrics_series(cfg, batch, seq, on_tpu))
     emit_result(_tp_series(cfg, batch, seq, on_tpu))
+    emit_result(_overlap_series(cfg, batch, seq, on_tpu))
 
 
 def _telemetry_series(warm_mark, steps):
@@ -566,9 +567,14 @@ def _train_step_series(cfg, batch, seq, on_tpu, steps=3, ds_overrides=None,
         jax.block_until_ready(engine.state.params)
         dt = time.perf_counter() - t0
         summary = engine.telemetry.summary()
-        wire = max((e["data"].get("collective_operand_bytes") or 0
-                    for e in engine.telemetry.tail(200)
-                    if e["kind"] == "step_cost"), default=0)
+        costs = [e["data"] for e in engine.telemetry.tail(200)
+                 if e["kind"] == "step_cost"]
+        wire = max((c.get("collective_operand_bytes") or 0 for c in costs),
+                   default=0)
+        per_axis = (max(costs, key=lambda c:
+                        c.get("collective_operand_bytes") or 0)
+                    .get("collective_bytes_per_axis") or {}) if costs else {}
+        est = engine.telemetry.exposed_comm_estimate()
     finally:
         # a failed candidate is tuner EVIDENCE, not a crash — the next
         # candidate must not measure against this one's leaked engine
@@ -591,6 +597,9 @@ def _train_step_series(cfg, batch, seq, on_tpu, steps=3, ds_overrides=None,
                                   summary["per_function"].values()), 3),
         "retraces_in_timed_window": int(retraces),
         "collective_wire_bytes": int(wire),
+        "collective_bytes_per_axis": {k: int(v) for k, v in per_axis.items()},
+        "exposed_comm_fraction": (est.get("exposed_comm_fraction")
+                                  if est else None),
         "n_dev": n_dev, "batch": batch, "seq": seq, "steps": steps,
         "ds_overrides": ds_overrides or {},
         "tunables": dict(tunables or {}),
@@ -637,6 +646,77 @@ def _tp_series(cfg, batch, seq, on_tpu, steps=3):
         print(f"# tp series failed: {e}", file=sys.stderr, flush=True)
         return {"metric": METRIC + "_tp", "value": None,
                 "unit": "tokens_per_sec", "vs_baseline": None,
+                "error": str(e)[:300]}
+
+
+def _overlap_series(cfg, batch, seq, on_tpu, steps=3):
+    """Optional extra series (after the headline JSON): the
+    overlap-everything knobs. (1) ZeRO-3 param gather flat vs
+    hierarchical (`zero_optimization.hierarchical_gather`, ZeRO++ hpZ)
+    on a data x fsdp mesh — the SAME train-step measurement twice.
+    Note the wire-bytes column is summed OPERAND bytes: the hpZ gather
+    ships a larger operand over a smaller group, so that column can
+    rise while per-member received bytes drop — the received-bytes
+    comparison is pinned in `tests/unit/test_zero_hierarchical.py`
+    and measured in `tools/perf_comm_wire.py`.
+    (2) The pipeline-schedule bubble fractions (1F1B / interleaved v=2
+    / ZB-H1) from the validated instruction streams — pure schedule
+    algebra, no devices, so they report even on a 1-chip host."""
+    import jax
+
+    from deepspeed_tpu.runtime.pipe.schedule import (InterleavedSchedule,
+                                                     TrainSchedule,
+                                                     ZeroBubbleSchedule,
+                                                     validate_schedule)
+
+    bubbles = {
+        name: round(validate_schedule(sched, 8, 4,
+                                      **kw)["bubble_fraction"], 4)
+        for name, sched, kw in (
+            ("1f1b", TrainSchedule, {}),
+            ("interleaved_v2", InterleavedSchedule, {"virtual_stages": 2}),
+            ("zero_bubble", ZeroBubbleSchedule, {}),
+        )}
+    out = {"metric": METRIC + "_overlap", "unit": "tokens_per_sec",
+           "bubble_fraction": bubbles}
+    if jax.device_count() < 4:
+        return {**out, "value": None,
+                "error": "needs >= 4 devices for a data x fsdp mesh"}
+    try:
+        zero3 = {"stage": 3, "stage3_param_persistence_threshold": 0}
+        tracing = {"telemetry": {"tracing": {"enabled": True}}}
+        flat = _train_step_series(
+            cfg, batch, seq, on_tpu, steps=steps,
+            ds_overrides={"mesh": {"data": -1, "fsdp": 2},
+                          "zero_optimization": zero3, **tracing})
+        hier = _train_step_series(
+            cfg, batch, seq, on_tpu, steps=steps,
+            ds_overrides={"mesh": {"data": -1, "fsdp": 2},
+                          "zero_optimization": {**zero3,
+                                                "hierarchical_gather": True},
+                          **tracing})
+        return {
+            **out,
+            "value": hier["tokens_per_sec"],
+            "vs_baseline": (round(hier["tokens_per_sec"]
+                                  / flat["tokens_per_sec"], 4)
+                            if flat["tokens_per_sec"] else None),
+            "flat_tokens_per_sec": flat["tokens_per_sec"],
+            "hierarchical_tokens_per_sec": hier["tokens_per_sec"],
+            "flat_collective_wire_bytes": flat["collective_wire_bytes"],
+            "hierarchical_collective_wire_bytes":
+                hier["collective_wire_bytes"],
+            "flat_collective_bytes_per_axis":
+                flat["collective_bytes_per_axis"],
+            "hierarchical_collective_bytes_per_axis":
+                hier["collective_bytes_per_axis"],
+            "flat_exposed_comm_fraction": flat["exposed_comm_fraction"],
+            "hierarchical_exposed_comm_fraction":
+                hier["exposed_comm_fraction"],
+        }
+    except Exception as e:  # noqa: BLE001 — extras never kill the headline
+        print(f"# overlap series failed: {e}", file=sys.stderr, flush=True)
+        return {**out, "value": None, "vs_baseline": None,
                 "error": str(e)[:300]}
 
 
@@ -1000,12 +1080,15 @@ def run_series(name, config=None):
         return _metrics_series(cfg, batch, seq, on_tpu, steps=ctx["steps"])
     if name == "tp":
         return _tp_series(cfg, batch, seq, on_tpu, steps=ctx["steps"])
+    if name == "overlap":
+        return _overlap_series(cfg, batch, seq, on_tpu, steps=ctx["steps"])
     raise KeyError(f"unknown bench series {name!r}; available: "
                    f"{sorted(SERIES)}")
 
 
 SERIES = ("train_step", "startup", "telemetry", "resilience",
-          "comm_compression", "elastic_resume", "tracing", "metrics", "tp")
+          "comm_compression", "elastic_resume", "tracing", "metrics", "tp",
+          "overlap")
 
 
 if __name__ == "__main__":

@@ -733,42 +733,80 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 def flash_attention_bthd_tp(q, k, v, causal=True, softmax_scale=None,
                             block_q=None, block_k=None, mesh=None,
-                            axis=None):
-    """TP-aware :func:`flash_attention_bthd`: heads (dim 2 of the
-    [B, T, H, D] layout) partitioned over the ``tp`` mesh axis — each
-    shard runs the kernel (forward AND custom-vjp backward) on its
-    local head group. Attention never reduces across heads, so no tp
-    collective is emitted here; the head-sharded output feeds the
-    row-parallel output projection, whose all-reduce the SpecLayout
-    places. Falls back to the plain kernel when tp is inactive or the
-    head count does not divide."""
+                            axis=None, seq_axis=None):
+    """TP- and SP-aware :func:`flash_attention_bthd`: heads (dim 2 of
+    the [B, T, H, D] layout) partitioned over the ``tp`` mesh axis AND,
+    when the mesh carries a live ``seq`` axis, tokens (dim 1)
+    partitioned over it Ulysses-style (arXiv:2309.14509) — each shard
+    runs the kernel (forward AND custom-vjp backward) on its local
+    slice. Attention never reduces across heads, so tp emits no
+    collective here; the head-sharded output feeds the row-parallel
+    output projection, whose all-reduce the SpecLayout places.
+
+    Sequence parallelism needs the FULL sequence inside the softmax, so
+    the sp legs bracket the kernel with two seq-axis ``all_to_all``s:
+    [B, T/sp, H/tp, D] → (split heads, concat tokens) →
+    [B, T, H/(tp·sp), D] → kernel → (split tokens, concat heads) back.
+    Both redistributions are linear, so autodiff transposes them to the
+    mirror all_to_all in the backward pass. sp participates only when
+    the post-tp head group divides by sp and the sequence divides by sp;
+    with sp inactive the emitted program is the exact tp-only one (and
+    with tp also inactive, the plain kernel) — zero-overhead fallbacks
+    pinned by the parity tests."""
     from jax.sharding import PartitionSpec as P
 
-    from deepspeed_tpu.parallel.topology import (AXIS_TP, axis_spec_entry,
+    from deepspeed_tpu.parallel.topology import (AXIS_SEQ, AXIS_TP,
+                                                 axis_spec_entry,
                                                  get_topology,
                                                  resolve_axis_name)
     from deepspeed_tpu.runtime.zero.partition import BATCH_AXES
     from deepspeed_tpu.utils.compat import shard_map
 
     axis = axis or AXIS_TP
+    seq_axis = seq_axis or AXIS_SEQ
     if mesh is None:
         topo = get_topology(create_if_missing=False)
         mesh = topo.mesh if topo is not None else None
     if mesh is not None:
         axis = resolve_axis_name(mesh, axis)
+        seq_axis = resolve_axis_name(mesh, seq_axis)
     tp = int(mesh.shape.get(axis, 1)) if mesh is not None else 1
-    heads = q.shape[2]
-    if tp <= 1 or heads % tp:
+    sp = int(mesh.shape.get(seq_axis, 1)) if mesh is not None else 1
+    heads, seqlen = q.shape[2], q.shape[1]
+    if tp > 1 and heads % tp:
+        tp = 1
+    local_heads = heads // tp
+    # sp joins only when both the post-tp head group and the tokens
+    # divide; otherwise it degrades to the tp-only (or plain) program
+    if sp > 1 and (local_heads % sp or seqlen % sp):
+        sp = 1
+    if tp <= 1 and sp <= 1:
         return flash_attention_bthd(q, k, v, causal=causal,
                                     softmax_scale=softmax_scale,
                                     block_q=block_q, block_k=block_k)
+
+    def local_attn(qs, ks, vs):
+        if sp > 1:
+            # Ulysses leg 1: trade local heads for the full sequence
+            qs, ks, vs = (jax.lax.all_to_all(
+                t, seq_axis, split_axis=2, concat_axis=1, tiled=True)
+                for t in (qs, ks, vs))
+        o = flash_attention_bthd(qs, ks, vs, causal=causal,
+                                 softmax_scale=softmax_scale,
+                                 block_q=block_q, block_k=block_k)
+        if sp > 1:
+            # Ulysses leg 2: give the sequence back, regain the heads
+            o = jax.lax.all_to_all(o, seq_axis, split_axis=1,
+                                   concat_axis=2, tiled=True)
+        return o
+
     # batch stays data-sharded INSIDE the shard_map (omitting the entry
-    # would all-gather the batch whenever tp composes with data>1)
+    # would all-gather the batch whenever tp/sp compose with data>1)
     batch = axis_spec_entry(mesh, BATCH_AXES, q.shape[0])
-    hs = P(batch, None, axis, None)
-    fn = shard_map(
-        lambda qs, ks, vs: flash_attention_bthd(
-            qs, ks, vs, causal=causal, softmax_scale=softmax_scale,
-            block_q=block_q, block_k=block_k),
-        mesh=mesh, in_specs=(hs, hs, hs), out_specs=hs, check_vma=False)
+    hs = P(batch,
+           seq_axis if sp > 1 else None,
+           axis if tp > 1 else None,
+           None)
+    fn = shard_map(local_attn, mesh=mesh, in_specs=(hs, hs, hs),
+                   out_specs=hs, check_vma=False)
     return fn(q, k, v)

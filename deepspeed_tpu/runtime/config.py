@@ -231,6 +231,42 @@ class CommQuantizationConfig(DeepSpeedConfigModel):
         return self
 
 
+class PipelineConfig(DeepSpeedConfigModel):
+    """``pipeline`` section: which instruction schedule the pipeline
+    engine compiles (``runtime/pipe/schedule.py``).
+
+    - ``schedule``: ``"1f1b"`` (default — the existing schedule, byte-
+      identical HLO when this section is absent), ``"interleaved"``
+      (``virtual_stages`` round-robin layer chunks per physical stage,
+      bubble shrinks toward ``(P-1)/(Mv+P-1)`` for ``v``x activation
+      buffers), or ``"zero_bubble"`` (ZB-H1 split backward — the
+      instruction stream models ``BackwardInput``/``BackwardWeight``;
+      the compiled program is unchanged because XLA's scan transpose
+      already owns the backward ordering, so losses stay bit-identical
+      to 1F1B).
+    - ``virtual_stages``: chunks per physical stage; only meaningful
+      with ``schedule: interleaved``; layers must divide stages *
+      virtual_stages.
+    """
+
+    schedule: str = "1f1b"
+    virtual_stages: int = 1
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.schedule not in ("1f1b", "interleaved", "zero_bubble"):
+            raise ValueError(
+                "pipeline.schedule must be one of 1f1b/interleaved/"
+                f"zero_bubble, got {self.schedule!r}")
+        if self.virtual_stages < 1:
+            raise ValueError("pipeline.virtual_stages must be >= 1")
+        if self.virtual_stages > 1 and self.schedule != "interleaved":
+            raise ValueError(
+                "pipeline.virtual_stages > 1 requires "
+                "pipeline.schedule == 'interleaved'")
+        return self
+
+
 class TelemetryTraceConfig(DeepSpeedConfigModel):
     """``telemetry.trace``: capture a ``jax.profiler`` XPlane trace for
     exactly ``num_steps`` optimizer steps starting once ``start_step``
@@ -265,12 +301,21 @@ class TelemetryTracingConfig(DeepSpeedConfigModel):
     exposed_comm: bool = True
     ici_gbps: float = 90.0
     peak_tflops: float = 0.0
+    # per-mesh-axis link-rate overrides (GB/s), e.g. {"data": 25.0} to
+    # price a DCN data axis below the ICI default; axes not listed fall
+    # back to ici_gbps, so {} is numerically the existing single-rate
+    # estimate
+    axis_gbps: Dict[str, float] = Field(default_factory=dict)
 
     @model_validator(mode="after")
     def _check(self):
         if self.ici_gbps < 0 or self.peak_tflops < 0:
             raise ValueError("telemetry.tracing.ici_gbps/peak_tflops must "
                              "be >= 0")
+        for axis, rate in self.axis_gbps.items():
+            if rate <= 0:
+                raise ValueError(
+                    f"telemetry.tracing.axis_gbps[{axis!r}] must be > 0")
         return self
 
 
@@ -688,6 +733,7 @@ class DeepSpeedConfig:
             self.tuned_ops = ops_choices(self.tuned_artifact)
         self.comm_quantization = CommQuantizationConfig(**cq_raw)
         self.mesh = MeshConfig(**mesh_raw)
+        self.pipeline_config = PipelineConfig(**d.get("pipeline", {}))
         self.telemetry_config = TelemetryConfig(**d.get("telemetry", {}))
         self.resilience_config = ResilienceConfig(**d.get("resilience", {}))
         self.aot_config = AOTConfig(**d.get("aot", {}))

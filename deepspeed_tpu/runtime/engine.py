@@ -427,6 +427,10 @@ class DeepSpeedEngine:
 
         self.telemetry = Telemetry(self._config.telemetry_config,
                                    monitor=self.monitor, name="engine")
+        # mesh identity (ordered axis, size pairs) → per-axis wire
+        # attribution of every compiled program's collectives
+        self.telemetry.axis_sizes = [
+            (a, int(s)) for a, s in self.mesh.shape.items()]
 
 
         # --- resilience (checkpoint integrity + fallback, step sentinel,
@@ -622,13 +626,23 @@ class DeepSpeedEngine:
             from deepspeed_tpu.module_inject import get_tp_policy
             from deepspeed_tpu.runtime.zero.partition import SpecLayout
 
-            self._spec_layout_cache = SpecLayout(
+            stage3 = self.zero_optimization_stage() >= 3
+            hpz = bool(self._config.zero_config.hierarchical_gather) and stage3
+            layout = SpecLayout(
                 self.mesh,
                 policy=get_tp_policy(self._config.tensor_parallel_config.get(
                     "policy", "auto")),
                 persistence_threshold=(
                     self._config.zero_config.param_persistence_threshold
-                    if self.zero_optimization_stage() >= 3 else 0))
+                    if stage3 else 0),
+                hierarchical_gather=hpz)
+            if hpz and not layout.hierarchical_active:
+                logger.warning(
+                    "zero_optimization.hierarchical_gather ignored: the mesh "
+                    "has no secondary ZeRO axis (fsdp/expert) of size > 1, so "
+                    "there is no in-replica group to gather over; params keep "
+                    "the flat data-axis partition")
+            self._spec_layout_cache = layout
         return self._spec_layout_cache
 
     def _tp_base_specs(self, params_abstract):
@@ -675,7 +689,8 @@ class DeepSpeedEngine:
             params_abstract, self.mesh,
             stage=self.zero_optimization_stage(),
             param_specs=self._tp_base_specs(params_abstract),
-            persistence_threshold=layout.persistence_threshold)
+            persistence_threshold=layout.persistence_threshold,
+            hierarchical=layout.hierarchical_active)
 
     def _build_state(self, params):
         params = jax.tree_util.tree_map(jnp.asarray, params)

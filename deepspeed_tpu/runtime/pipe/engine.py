@@ -33,9 +33,12 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.parallel.topology import AXIS_PIPE
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
-from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
+from deepspeed_tpu.runtime.pipe.schedule import (InterleavedSchedule,
+                                                 TrainSchedule,
+                                                 ZeroBubbleSchedule)
 from deepspeed_tpu.runtime.zero.partition import replicated
-from deepspeed_tpu.utils.compat import shard_map
+from deepspeed_tpu.utils.compat import (partial_auto_shard_map_safe,
+                                        shard_map)
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -74,14 +77,26 @@ def _cond_skip(pred, fn, false_val, operands):
     return run(pred, false_val, operands)
 
 
-def pipeline_loss_fn(module: PipelineModule, mesh, n_micro: int):
+def pipeline_loss_fn(module: PipelineModule, mesh, n_micro: int,
+                     virtual_stages: int = 1):
     """Build ``loss(params, (inputs, labels), rng) -> mean loss`` running the
     pipelined schedule over ``n_micro`` micro-batches.
 
     ``inputs``/``labels`` are [M, mb, ...]; blocks params are [L, ...] sharded
     over ``pipe`` (L/P per stage).
-    """
+
+    ``virtual_stages > 1`` compiles the interleaved schedule: each physical
+    stage owns ``v`` round-robin layer chunks (virtual stage ``u = j*P + s``
+    holds layers ``[u*Lc, (u+1)*Lc)``); a micro-batch rides the same
+    ppermute ring ``v`` times, advancing one *virtual* stage per tick, so
+    warmup/cooldown ramps fill ``v``x faster — the bubble shrinks toward
+    ``(P-1)/(Mv+P-1)`` for ``v``x the per-stage activation traffic. Micro-
+    batch ``m`` injects at tick ``(m % P) + (m // P)*v*P``; at tick ``t``
+    stage ``s`` computes chunk ``j = ((t-s) // P) % v`` of micro-batch
+    ``((t-s)//P//v)*P + (t-s)%P``. ``virtual_stages == 1`` traces the
+    exact 1F1B program (HLO byte-identity pinned in tests)."""
     n_stages = mesh.shape[AXIS_PIPE]
+    v = int(virtual_stages)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     use_rngs = module.use_rngs
     # micro-batches live SHARDED over the pipe axis (stage s holds the
@@ -118,12 +133,19 @@ def pipeline_loss_fn(module: PipelineModule, mesh, n_micro: int):
 
             return jax.tree_util.tree_map(sel, chunk)
 
-        def run_blocks(x, t):
+        def run_blocks(x, t, chunk=None):
+            bp_stack = blocks
+            if v > 1:
+                # local blocks are [v, Lc, ...]; run this tick's chunk
+                bp_stack = jax.tree_util.tree_map(
+                    lambda b: jax.lax.dynamic_index_in_dim(
+                        b, chunk, axis=0, keepdims=False), blocks)
+
             def blk(x, bp):
                 return module.block_apply(bp, x,
                                           rngs=rngs_of(t, stage, rng)), None
 
-            x, _ = jax.lax.scan(blk, x, blocks)
+            x, _ = jax.lax.scan(blk, x, bp_stack)
             return x
 
         mb0 = jax.tree_util.tree_map(lambda a: a[0], inputs)  # local shape
@@ -146,9 +168,14 @@ def pipeline_loss_fn(module: PipelineModule, mesh, n_micro: int):
 
         def loss_of(ops):
             extras_, y_, lab_, t_, st_, r_ = ops
-            return module.loss_fn(
+            loss = module.loss_fn(
                 module.post_apply(extras_, y_, rngs=rngs_of(t_, st_, r_)),
                 lab_).astype(jnp.float32)
+            # the per-tick loss is carried as [1], not a scalar: jax < 0.5's
+            # shard_map transpose mis-names scalar float32 scan carries
+            # ({0: all_axes} on a rank-0 aval) and grad fails to trace;
+            # rank-1 is spec-legal on every path and numerically identical
+            return loss.reshape((1,))
 
         def stage_select(pred, fn, false_val, operands):
             # lax.cond skips the untaken branch's FLOPs at runtime —
@@ -164,19 +191,43 @@ def pipeline_loss_fn(module: PipelineModule, mesh, n_micro: int):
         @jax.checkpoint
         def tick(carry, t):
             state, loss_sum, count = carry
-            # micro-batch t lives in chunk slot t//P on stage t%P
-            mb = fetch(inputs, t // n_stages, jnp.mod(t, n_stages))
-            # LoadMicroBatch on stage 0; other stages use the received act
-            x = stage_select(stage == 0, pre_fn, state,
-                             (extras, mb, t, stage, rng))
-            y = run_blocks(x, t)
-            # last stage: loss of micro-batch t-(P-1) (if one has arrived)
-            out_idx = t - (n_stages - 1)
-            lab = fetch(labels, out_idx // n_stages,
-                        jnp.mod(out_idx, n_stages))
-            take = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
-            loss_t = stage_select(take, loss_of,
-                                  jnp.zeros((), jnp.float32),
+            if v == 1:
+                # micro-batch t lives in chunk slot t//P on stage t%P
+                mb = fetch(inputs, t // n_stages, jnp.mod(t, n_stages))
+                # LoadMicroBatch on stage 0; other stages use received act
+                x = stage_select(stage == 0, pre_fn, state,
+                                 (extras, mb, t, stage, rng))
+                y = run_blocks(x, t)
+                # last stage: loss of micro-batch t-(P-1) (if one arrived)
+                out_idx = t - (n_stages - 1)
+                lab = fetch(labels, out_idx // n_stages,
+                            jnp.mod(out_idx, n_stages))
+                take = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+            else:
+                # interleaved: stage s at tick t runs chunk
+                # j = ((t-s)//P) % v of micro-batch g*P + r where
+                # r = (t-s)%P, g = (t-s)//P//v (docstring algebra)
+                a = t - stage
+                chunk = jnp.mod(a // n_stages, v)
+                # chunk-0 injection on stage 0: mb ((t//P)//v)*P + t%P,
+                # held by chunk slot (t//P)//v of its owner stage t%P
+                inject = jnp.logical_and(
+                    stage == 0, jnp.mod(t // n_stages, v) == 0)
+                mb = fetch(inputs, (t // n_stages) // v,
+                           jnp.mod(t, n_stages))
+                x = stage_select(inject, pre_fn, state,
+                                 (extras, mb, t, stage, rng))
+                y = run_blocks(x, t, chunk=chunk)
+                # loss leg: last stage, deepest chunk v-1
+                a_out = t - (n_stages - 1)
+                r_out = jnp.mod(a_out, n_stages)
+                g_out = (a_out // n_stages) // v
+                m_out = g_out * n_stages + r_out
+                lab = fetch(labels, g_out, r_out)
+                take = ((stage == n_stages - 1)
+                        & (jnp.mod(a_out // n_stages, v) == v - 1)
+                        & (a_out >= 0) & (m_out < n_micro))
+            loss_t = stage_select(take, loss_of, jnp.zeros((1,), jnp.float32),
                                   (extras, y, lab, t, stage, rng))
             loss_sum = loss_sum + loss_t
             count = count + take.astype(jnp.int32)
@@ -184,16 +235,27 @@ def pipeline_loss_fn(module: PipelineModule, mesh, n_micro: int):
             state = jax.lax.ppermute(y, AXIS_PIPE, perm)
             return (state, loss_sum, count), None
 
-        total_ticks = n_micro + n_stages - 1
+        if v == 1:
+            total_ticks = n_micro + n_stages - 1
+        else:
+            # last micro-batch injects at tau = (M-1)%P + ((M-1)//P)*v*P
+            # and needs v*P more ticks to clear all virtual stages
+            tau_last = ((n_micro - 1) % n_stages
+                        + ((n_micro - 1) // n_stages) * v * n_stages)
+            total_ticks = tau_last + v * n_stages
         (_, loss_sum, count), _ = jax.lax.scan(
-            tick, (zero_act, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+            tick, (zero_act, jnp.zeros((1,), jnp.float32),
+                   jnp.zeros((), jnp.int32)),
             jnp.arange(total_ticks))
         # broadcast the last stage's mean loss to all stages
-        loss_sum = jax.lax.psum(loss_sum, AXIS_PIPE)
+        loss_sum = jax.lax.psum(loss_sum, AXIS_PIPE)[0]
         count = jax.lax.psum(count, AXIS_PIPE)
         return loss_sum / count.astype(jnp.float32)
 
-    spec_params = {"pre": P(), "blocks": P(AXIS_PIPE), "post": P(), "tied": P()}
+    # v > 1: blocks arrive pre-reshaped [v, L/v, ...] (loss_fn below), so
+    # the pipe axis shards dim 1 — stage s owns chunk rows [j, s*Lc:(s+1)*Lc)
+    blocks_spec = P(AXIS_PIPE) if v == 1 else P(None, AXIS_PIPE)
+    spec_params = {"pre": P(), "blocks": blocks_spec, "post": P(), "tied": P()}
     smapped = shard_map(
         body, mesh=mesh,
         in_specs=(spec_params, P(AXIS_PIPE), P(AXIS_PIPE), P()),
@@ -212,6 +274,15 @@ def pipeline_loss_fn(module: PipelineModule, mesh, n_micro: int):
 
     def loss_fn(params, batch, rngs=None):
         inputs, labels = batch
+        if v > 1:
+            # [L, ...] -> [v, L/v, ...]: row [j, s*Lc + i] is layer
+            # (j*P + s)*Lc + i, i.e. virtual stage j*P + s owns a
+            # round-robin chunk (free reshape; the pipe resharding of
+            # dim 1 is the interleaving's extra param traffic)
+            params = dict(params)
+            params["blocks"] = jax.tree_util.tree_map(
+                lambda b: b.reshape((v, b.shape[0] // v) + b.shape[1:]),
+                params["blocks"])
         inputs = jax.tree_util.tree_map(stride, inputs)
         labels = jax.tree_util.tree_map(stride, labels)
         rng = rngs["dropout"] if isinstance(rngs, dict) else (
@@ -255,13 +326,31 @@ class PipelineEngine(DeepSpeedEngine):
                 "ZeRO-3 is incompatible with pipeline parallelism "
                 "(reference parity: engine.py asserts the same); use stage<=2")
         n_stages = self.topology.get_pipe_parallel_world_size()
-        self._pipe_module.validate_stages(n_stages)
+        auto_extent = [f"{ax}={n}" for ax, n in self.mesh.shape.items()
+                       if ax != AXIS_PIPE and n > 1]
+        if auto_extent and not partial_auto_shard_map_safe():
+            # jax < 0.5 cannot compile the pipe-manual shard_map next to
+            # live auto axes — the backward pass SIGABRTs inside XLA
+            # (IsManualSubgroup CHECK) instead of raising. Refuse with a
+            # Python error before any compile is attempted.
+            raise RuntimeError(
+                "pipeline parallelism composed with other mesh axes "
+                f"({', '.join(auto_extent)}) requires jax >= 0.5; this "
+                "runtime hard-crashes compiling the partially-manual "
+                "program. Use a pipe-only mesh or upgrade jax.")
+        pipe_cfg = self._config.pipeline_config
+        self.pipe_schedule = pipe_cfg.schedule
+        self.virtual_stages = (pipe_cfg.virtual_stages
+                               if pipe_cfg.schedule == "interleaved" else 1)
+        self._pipe_module.validate_stages(
+            n_stages, virtual_stages=self.virtual_stages)
         self.num_stages = n_stages
         self.micro_batches = self.gradient_accumulation_steps()
         self._pipe_ready = True
         log_dist(
             f"PipelineEngine: stages={n_stages} micro_batches="
-            f"{self.micro_batches} blocks/stage="
+            f"{self.micro_batches} schedule={self.pipe_schedule} "
+            f"virtual_stages={self.virtual_stages} blocks/stage="
             f"{self._pipe_module.n_blocks // n_stages}", ranks=[0])
 
     # the PipelineModule is not a plain loss fn — the pipelined loss is
@@ -313,7 +402,8 @@ class PipelineEngine(DeepSpeedEngine):
         self._finalize_pipe_setup()
         n_micro = self.micro_batches
         mesh = self.mesh
-        pipe_loss = pipeline_loss_fn(self._pipe_module, mesh, n_micro)
+        pipe_loss = pipeline_loss_fn(self._pipe_module, mesh, n_micro,
+                                     virtual_stages=self.virtual_stages)
         fp16 = self.fp16_enabled_
         grad_shardings = self._state_shardings.grad_acc
         mb_rows = self._micro_batch_rows()
@@ -400,6 +490,18 @@ class PipelineEngine(DeepSpeedEngine):
 
     def train_schedule(self, stage_id: int = 0) -> TrainSchedule:
         """The instruction schedule this engine's compiled program realizes
-        (for inspection/validation — reference ``TrainSchedule``)."""
+        (for inspection/validation — reference ``TrainSchedule``), selected
+        by ``pipeline.schedule``. ``zero_bubble`` models the B/W split XLA's
+        scan transpose already performs (losses stay bit-identical to 1f1b);
+        ``interleaved`` mirrors the virtual-stage program compiled above."""
+        if self.pipe_schedule == "interleaved":
+            return InterleavedSchedule(micro_batches=self.micro_batches,
+                                       stages=self.num_stages,
+                                       stage_id=stage_id,
+                                       virtual_stages=self.virtual_stages)
+        if self.pipe_schedule == "zero_bubble":
+            return ZeroBubbleSchedule(micro_batches=self.micro_batches,
+                                      stages=self.num_stages,
+                                      stage_id=stage_id)
         return TrainSchedule(micro_batches=self.micro_batches,
                              stages=self.num_stages, stage_id=stage_id)

@@ -221,12 +221,16 @@ class PipelineModule:
         self.n_blocks = len(self.block_layers)
         self._block_module = self.block_layers[0].module
 
-    def validate_stages(self, num_stages: int):
+    def validate_stages(self, num_stages: int, virtual_stages: int = 1):
         self.num_stages = num_stages
-        if self.n_blocks % num_stages != 0:
+        if self.n_blocks % (num_stages * virtual_stages) != 0:
+            detail = (f"{num_stages} pipeline stages"
+                      if virtual_stages == 1 else
+                      f"{num_stages} stages x {virtual_stages} virtual "
+                      f"stages (interleaved chunks)")
             raise ValueError(
                 f"{self.n_blocks} pipelined layers not divisible by "
-                f"{num_stages} pipeline stages")
+                f"{detail}")
 
     # ------------------------------------------------------------------
     def layer_weights(self, params=None) -> List[float]:

@@ -91,6 +91,12 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     ignore_unused_parameters: bool = True
     legacy_stage1: bool = False
     round_robin_gradients: bool = False
+    # ZeRO++ hpZ (arXiv:2306.10209): keep a secondary copy of the ZeRO-3
+    # parameter shards inside each data replica so the per-use param
+    # all-gather runs over the (small, fast) fsdp axis instead of the full
+    # data x fsdp group. Opt-in; ignored (with a warning) unless the mesh
+    # has an fsdp axis of size > 1 at stage 3.
+    hierarchical_gather: bool = False
 
     @model_validator(mode="after")
     def _legacy_offload_flags(self):

@@ -51,6 +51,17 @@ ZERO_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
 BATCH_AXES = (AXIS_DATA, AXIS_EXPERT)
 
 
+def hierarchical_param_axes(zero_axes: Sequence[str] = ZERO_AXES
+                            ) -> Tuple[str, ...]:
+    """The ZeRO axes a *hierarchical* (ZeRO++ hpZ, arXiv:2306.10209) param
+    shard spans: everything but ``data`` — i.e. the shard lives inside one
+    data replica, so the per-use all-gather crosses only the fsdp/expert
+    wire instead of the full data x fsdp group. Optimizer and gradient
+    state keep the full ``zero_axes`` partition (the once-per-step update
+    path), only the per-layer-per-tick param fetch shrinks."""
+    return tuple(a for a in zero_axes if a != AXIS_DATA)
+
+
 def _shardable_dim(shape: Tuple[int, ...], axis_size: int,
                    taken: Sequence[Optional[str]]) -> Optional[int]:
     """Largest dim divisible by axis_size and not already sharded."""
@@ -97,11 +108,15 @@ def build_zero_shardings(params_shapes,
                          mesh: Mesh,
                          stage: int,
                          param_specs=None,
-                         persistence_threshold: int = 0):
+                         persistence_threshold: int = 0,
+                         hierarchical: bool = False):
     """Shardings for (params, optimizer state) given a ZeRO stage.
 
     ``params_shapes``: pytree of ``jax.ShapeDtypeStruct`` (or arrays).
     ``param_specs``: optional pytree of base PartitionSpecs (TP rules).
+    ``hierarchical``: hpZ — stage-3 *params* shard over
+    :func:`hierarchical_param_axes` only (inside a data replica);
+    optimizer state keeps the full :data:`ZERO_AXES` partition.
     Returns ``(param_shardings, opt_shardings)`` pytrees of NamedSharding.
     """
 
@@ -111,10 +126,13 @@ def build_zero_shardings(params_shapes,
     if param_specs is None:
         param_specs = jax.tree_util.tree_map(lambda _: None, params_shapes)
 
+    param_axes = hierarchical_param_axes() if hierarchical else ZERO_AXES
+
     def param_sharding(leaf, spec):
         base = base_spec_of(spec)
         if stage >= 3:
             s = zero_partition_spec(leaf.shape, mesh,
+                                    data_axes=param_axes,
                                     base_spec=base,
                                     persistence_threshold=persistence_threshold)
         else:
@@ -205,7 +223,8 @@ class SpecLayout:
                  tp_axis: str = AXIS_TP,
                  zero_axes: Sequence[str] = ZERO_AXES,
                  batch_axes: Sequence[str] = BATCH_AXES,
-                 persistence_threshold: int = 0):
+                 persistence_threshold: int = 0,
+                 hierarchical_gather: bool = False):
         forbidden = {tp_axis, AXIS_FSDP} & set(batch_axes)
         if forbidden:
             raise ValueError(
@@ -222,7 +241,18 @@ class SpecLayout:
         self.zero_axes = tuple(zero_axes)
         self.batch_axes = tuple(batch_axes)
         self.persistence_threshold = int(persistence_threshold)
+        self.hierarchical_gather = bool(hierarchical_gather)
         self._policy = policy
+
+    @property
+    def hierarchical_active(self) -> bool:
+        """hpZ in effect: requested AND the mesh has a secondary (non-data)
+        ZeRO axis of size > 1 to hold the replica-local shard. On a flat
+        data-only mesh the flag is a no-op — the caller (engine) warns."""
+        if not self.hierarchical_gather:
+            return False
+        return any(self.mesh.shape.get(a, 1) > 1
+                   for a in hierarchical_param_axes(self.zero_axes))
 
     # -- policy / families ------------------------------------------------
     @property
@@ -257,10 +287,14 @@ class SpecLayout:
 
     # -- ZeRO layering ----------------------------------------------------
     def param_spec(self, shape, base_spec=None, stage: int = 3) -> P:
-        """Final spec of a parameter under ``stage`` (TP ⊕ ZeRO-3)."""
+        """Final spec of a parameter under ``stage`` (TP ⊕ ZeRO-3).
+        With :attr:`hierarchical_active`, the ZeRO layer spans only the
+        non-data axes (hpZ — the per-use gather stays in-replica)."""
         if stage >= 3:
+            axes = hierarchical_param_axes(self.zero_axes) \
+                if self.hierarchical_active else self.zero_axes
             return zero_partition_spec(
-                tuple(shape), self.mesh, data_axes=self.zero_axes,
+                tuple(shape), self.mesh, data_axes=axes,
                 base_spec=base_spec,
                 persistence_threshold=self.persistence_threshold)
         return base_spec if base_spec is not None else P()
@@ -279,7 +313,8 @@ class SpecLayout:
         return build_zero_shardings(
             params_abstract, self.mesh, stage=stage,
             param_specs=self.base_specs(params_abstract),
-            persistence_threshold=self.persistence_threshold)
+            persistence_threshold=self.persistence_threshold,
+            hierarchical=self.hierarchical_active)
 
     # -- batch ------------------------------------------------------------
     def batch_spec(self, ndim: int = 2,
@@ -329,6 +364,7 @@ class SpecLayout:
             "tp_size": tp,
             "zero_axes": list(self.zero_axes),
             "batch_axes": list(self.batch_axes),
+            "hierarchical_gather": self.hierarchical_active,
             "families": families,
         }
 

@@ -86,21 +86,50 @@ COMM_EVENT_STEMS = ("all-reduce", "all-gather", "all-to-all",
                     "reduce-scatter", "collective-permute")
 
 
-def static_estimate(cost: Dict, ici_gbps: float,
-                    peak_tflops: float) -> Optional[Dict]:
+def _axis_rate(key: str, axis_gbps: Dict[str, float],
+               ici_gbps: float) -> float:
+    """Wire rate for one attribution key: a single axis reads its
+    configured override (default ``ici_gbps``); a joint ``"a+b"`` key
+    (one collective spanning several axes) is bounded by its SLOWEST
+    link, so it takes the min of the parts."""
+    parts = [p for p in key.split("+") if p] or [key]
+    return min(float(axis_gbps.get(p, ici_gbps)) for p in parts)
+
+
+def static_estimate(cost: Dict, ici_gbps: float, peak_tflops: float,
+                    axis_gbps: Optional[Dict[str, float]] = None
+                    ) -> Optional[Dict]:
     """Zero-overlap upper bound from a compiled program's ``step_cost``
     payload: comm time = collective operand bytes at ``ici_gbps``,
     compute time = FLOPs at ``peak_tflops``. Returns None when the cost
-    model carries neither (cost analysis unavailable on this backend)."""
+    model carries neither (cost analysis unavailable on this backend).
+
+    With ``axis_gbps`` overrides AND a per-axis attribution in the cost
+    payload (``collective_bytes_per_axis``, received-bytes units), comm
+    time is instead summed per mesh axis at each axis's own rate — the
+    per-axis wire model a hierarchical (in-replica) gather or a slow DCN
+    data axis needs to be priced honestly. An empty/absent ``axis_gbps``
+    leaves the single-rate arithmetic untouched (numerically identical
+    output)."""
     comm_bytes = cost.get("collective_operand_bytes") or 0
     flops = cost.get("flops") or 0.0
+    per_axis = cost.get("collective_bytes_per_axis") or {}
     if comm_bytes <= 0 and flops <= 0:
         return None
-    comm_secs = comm_bytes / (float(ici_gbps) * 1e9) if ici_gbps > 0 else 0.0
+    comm_secs_by_axis = None
+    if axis_gbps and per_axis:
+        comm_secs_by_axis = {
+            key: (b / (_axis_rate(key, axis_gbps, ici_gbps) * 1e9)
+                  if _axis_rate(key, axis_gbps, ici_gbps) > 0 else 0.0)
+            for key, b in per_axis.items()}
+        comm_secs = sum(comm_secs_by_axis.values())
+    else:
+        comm_secs = (comm_bytes / (float(ici_gbps) * 1e9)
+                     if ici_gbps > 0 else 0.0)
     compute_secs = (float(flops) / (float(peak_tflops) * 1e12)
                     if peak_tflops > 0 else 0.0)
     denom = comm_secs + compute_secs
-    return {
+    out = {
         "exposed_comm_fraction": round(comm_secs / denom, 4) if denom
         else 0.0,
         "comm_secs_est": round(comm_secs, 6),
@@ -108,6 +137,12 @@ def static_estimate(cost: Dict, ici_gbps: float,
         "collective_operand_bytes": int(comm_bytes),
         "source": "static_estimate",
     }
+    if per_axis:
+        out["collective_bytes_per_axis"] = dict(per_axis)
+    if comm_secs_by_axis is not None:
+        out["comm_secs_by_axis"] = {
+            k: round(v, 6) for k, v in comm_secs_by_axis.items()}
+    return out
 
 
 def default_peak_tflops() -> float:

@@ -76,10 +76,15 @@ def signature_fingerprint(key) -> str:
     return hashlib.sha256("\x00".join(parts).encode()).hexdigest()[:16]
 
 
-def compiled_cost_summary(compiled, hlo_text: Optional[str] = None) -> Dict:
+def compiled_cost_summary(compiled, hlo_text: Optional[str] = None,
+                          axis_sizes=None) -> Dict:
     """Static cost model of a compiled executable: FLOPs + bytes accessed
     (XLA cost analysis), executable memory analysis, and per-collective
-    operand bytes read out of the optimized HLO."""
+    operand bytes read out of the optimized HLO. With ``axis_sizes``
+    (ordered mesh ``(axis, size)`` pairs) the collectives are additionally
+    ATTRIBUTED per mesh axis from their replica groups
+    (``collective_bytes_per_axis``, received-bytes units) — which axis's
+    wire a step's comm actually rides."""
     out: Dict[str, Any] = {}
     try:
         ca = compiled.cost_analysis()
@@ -126,6 +131,14 @@ def compiled_cost_summary(compiled, hlo_text: Optional[str] = None) -> Dict:
                  "dtypes": sorted(v["dtypes"])}
             for op, v in sorted(per_op.items())}
         out["collective_operand_bytes"] = total
+        if axis_sizes:
+            from deepspeed_tpu.utils.hlo_inspect import attribute_collectives
+
+            try:
+                out["collective_bytes_per_axis"] = attribute_collectives(
+                    hlo_text, list(axis_sizes))
+            except Exception as e:  # malformed groups must not kill telemetry
+                out["axis_attribution_error"] = str(e)[:200]
     return out
 
 

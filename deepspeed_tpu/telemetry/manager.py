@@ -59,6 +59,10 @@ class Telemetry:
         self._compile_totals: Dict[str, Dict] = {}
         self._steps_seen = 0
         self._peak_bytes_seen = 0
+        # mesh identity as ordered (axis, size) pairs — set by the engine
+        # once the mesh exists; feeds the per-axis wire attribution of
+        # compiled collectives (hlo_inspect.attribute_collectives)
+        self.axis_sizes = None
         self._tracing = False
         self._trace_done = False
         self._trace_count = 0
@@ -311,7 +315,8 @@ class Telemetry:
                 hlo_text = compiled.as_text()
             except Exception:
                 hlo_text = None
-            cost = compiled_cost_summary(compiled, hlo_text)
+            cost = compiled_cost_summary(compiled, hlo_text,
+                                         axis_sizes=self.axis_sizes)
             self._latest_costs[family] = cost
             self.emit("step_cost", name, step=self._steps_seen, **cost)
             self._mirror_to_comms_logger(name, cost)
@@ -487,7 +492,8 @@ class Telemetry:
         cost = max(self._latest_costs.values(),
                    key=lambda c: c.get("flops") or 0.0)
         peak = self.config.tracing.peak_tflops or xc.default_peak_tflops()
-        est = xc.static_estimate(cost, self.config.tracing.ici_gbps, peak)
+        est = xc.static_estimate(cost, self.config.tracing.ici_gbps, peak,
+                                 axis_gbps=self.config.tracing.axis_gbps)
         self._exposed_cache = (key, est)
         return est
 

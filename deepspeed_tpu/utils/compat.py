@@ -58,6 +58,30 @@ def aot_serialization_safe() -> bool:
     return persistent_compilation_cache_safe()
 
 
+def partial_auto_shard_map_safe() -> bool:
+    """Whether a *partially manual* ``shard_map`` (manual over ``pipe``,
+    auto/GSPMD over data/model axes of size > 1) lowers and compiles here.
+
+    jax < 0.5 cannot build that program: the forward lowers
+    ``axis_index`` to a bare ``partition-id`` HLO that the SPMD
+    partitioner rejects (``UNIMPLEMENTED: PartitionId instruction is not
+    supported``), and the backward dies harder — a CHECK failure
+    (``sharding.IsManualSubgroup()`` in hlo_sharding_util.cc) that
+    SIGABRTs the whole process rather than raising. Probed empirically on
+    0.4.37: pipe-only meshes (every non-pipe axis size 1) are fine on the
+    same runtime; any auto axis of size > 1 next to the manual pipe axis
+    is fatal. Callers composing the pipelined shard_map with live
+    data/model axes must consult this and refuse loudly BEFORE compile —
+    a Python error beats an uncatchable native abort."""
+    import jax
+
+    try:
+        version = tuple(int(p) for p in jax.__version__.split(".")[:2])
+    except ValueError:
+        return True
+    return version >= (0, 5)
+
+
 def tpu_compiler_params(**kwargs):
     """``pltpu.CompilerParams`` under its current name; older runtimes
     (< 0.5) ship the same dataclass as ``TPUCompilerParams``. Every

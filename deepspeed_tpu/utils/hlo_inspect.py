@@ -11,7 +11,7 @@ and the published table can never disagree on parsing.
 """
 
 import re
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 COLLECTIVE_OPS = ("all-reduce", "all-gather", "all-to-all",
                   "reduce-scatter", "collective-permute")
@@ -21,6 +21,72 @@ _SHAPE_RE = re.compile(r"\b(pred|[suf]\d+|bf16)\[([\d,]*)\]")
 _OP_RE = re.compile(
     r"=\s*(?:\([^)]*\)|\S+)\s+(" + "|".join(COLLECTIVE_OPS) +
     r")(?:-start)?\(")
+
+# the two spellings XLA prints for replica_groups:
+#   literal    `replica_groups={{0,1},{2,3}}`
+#   iota form  `replica_groups=[2,2]<=[4]` / `[4,2]<=[2,4]T(1,0)`
+_GROUPS_LITERAL_RE = re.compile(
+    r"replica_groups=\{(\{\d+(?:,\d+)*\}(?:,\{\d+(?:,\d+)*\})*)\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[(\d+(?:,\d+)*)\]"
+    r"(?:T\((\d+(?:,\d+)*)\))?")
+
+
+def parse_replica_groups(line: str) -> Optional[List[List[int]]]:
+    """The replica groups of one HLO collective line as a list of member
+    lists, or ``None`` when the line carries no ``replica_groups=``.
+
+    Handles both the literal form and the iota ("v2") form — the latter
+    means: take ``iota(prod(dims))``, reshape to ``dims``, transpose by
+    the optional ``T(perm)``, flatten, and cut into ``num_groups`` rows of
+    ``group_size``. That is exactly how GSPMD prints subgroup collectives
+    over the non-major mesh axes, so a parser without it would misread
+    every fsdp/tp-axis collective on a multi-axis mesh."""
+    m = _GROUPS_LITERAL_RE.search(line)
+    if m:
+        return [[int(x) for x in g.split(",")]
+                for g in m.group(1)[1:-1].split("},{")]
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        num_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        ids = list(range(int(_prod(dims))))
+        if m.group(4):
+            perm = [int(x) for x in m.group(4).split(",")]
+            ids = _transpose_flat(ids, dims, perm)
+        return [ids[i * group_size:(i + 1) * group_size]
+                for i in range(num_groups)]
+    return None
+
+
+def _prod(xs) -> int:
+    n = 1
+    for x in xs:
+        n *= int(x)
+    return n
+
+
+def _transpose_flat(ids: List[int], dims: List[int],
+                    perm: List[int]) -> List[int]:
+    """Flattened row-major transpose of ``ids`` viewed as shape ``dims``."""
+    strides = [0] * len(dims)
+    s = 1
+    for i in reversed(range(len(dims))):
+        strides[i] = s
+        s *= dims[i]
+    out_dims = [dims[p] for p in perm]
+    out = []
+    idx = [0] * len(out_dims)
+    total = _prod(dims)
+    for _ in range(total):
+        src = sum(idx[j] * strides[perm[j]] for j in range(len(perm)))
+        out.append(ids[src])
+        for j in reversed(range(len(out_dims))):
+            idx[j] += 1
+            if idx[j] < out_dims[j]:
+                break
+            idx[j] = 0
+    return out
 
 
 def _dtype_bits(dtype: str) -> int:
@@ -67,11 +133,70 @@ def parse_collectives(hlo_text: str) -> List[Dict]:
                     break
         operands = [(d, _shape_bytes(d, dims))
                     for d, dims in _SHAPE_RE.findall(args)]
+        groups = parse_replica_groups(line)
         out.append({
             "op": m.group(1),
             "operands": operands,
             "operand_bytes": sum(b for _, b in operands),
+            "groups": groups,
+            "group_size": len(groups[0]) if groups else None,
         })
+    return out
+
+
+def received_bytes(coll: Dict) -> int:
+    """Per-member *received* wire bytes of one parsed collective:
+    ``operand_bytes x (group_size - 1)``. This is the honest comparator
+    when group sizes differ — a hierarchical all-gather ships a LARGER
+    operand over a SMALLER group, so comparing operand bytes alone would
+    call the cheaper program more expensive. A collective with no (or
+    trivial) replica groups costs zero wire."""
+    g = coll.get("group_size") or 1
+    return coll["operand_bytes"] * max(0, g - 1)
+
+
+def attribute_collectives(hlo_text: str,
+                          axis_sizes: Sequence[Tuple[str, int]],
+                          min_bytes: int = 0) -> Dict[str, int]:
+    """Per-mesh-axis wire attribution of a compiled module:
+    ``{"data": bytes, "fsdp": bytes, "data+fsdp": bytes, ...}`` of
+    per-member :func:`received_bytes`, keyed by the '+'-joined (mesh-order)
+    axes each collective's replica groups span.
+
+    ``axis_sizes`` is the mesh's ``(axis, size)`` list in major-to-minor
+    order — device id = row-major multi-index, the same convention
+    ``Mesh(devices.reshape(sizes), names)`` uses. A collective whose
+    groups vary a coordinate on some axis spans that axis; one with no
+    replica_groups (single-device or full-world default) is keyed
+    ``"all"``."""
+    names = [a for a, _ in axis_sizes]
+    sizes = [int(s) for _, s in axis_sizes]
+    strides = [0] * len(sizes)
+    s = 1
+    for i in reversed(range(len(sizes))):
+        strides[i] = s
+        s *= sizes[i]
+
+    def coords(dev: int) -> Tuple[int, ...]:
+        return tuple((dev // strides[i]) % sizes[i]
+                     for i in range(len(sizes)))
+
+    out: Dict[str, int] = {}
+    for c in parse_collectives(hlo_text):
+        if c["operand_bytes"] < min_bytes:
+            continue
+        groups = c.get("groups")
+        if not groups:
+            key = "all"
+        else:
+            varying = set()
+            for g in groups:
+                cs = [coords(d) for d in g]
+                for i in range(len(sizes)):
+                    if len({x[i] for x in cs}) > 1:
+                        varying.add(i)
+            key = "+".join(names[i] for i in sorted(varying)) or "none"
+        out[key] = out.get(key, 0) + received_bytes(c)
     return out
 
 

@@ -14,11 +14,22 @@ from deepspeed_tpu.models.gpt2 import GPT2Config, gpt2_pipe
 from deepspeed_tpu.parallel.topology import MeshTopology, reset_topology
 from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass,
                                                  InferenceSchedule,
+                                                 InterleavedSchedule,
                                                  LoadMicroBatch, OptimizerStep,
                                                  RecvActivation, RecvGrad,
                                                  SendActivation, SendGrad,
-                                                 TrainSchedule)
+                                                 TrainSchedule,
+                                                 ZeroBubbleSchedule)
 from deepspeed_tpu.runtime.pipe.module import partition_balanced
+from deepspeed_tpu.utils.compat import partial_auto_shard_map_safe
+
+# jax < 0.5 cannot compile the pipe-manual shard_map composed with live
+# auto axes (data > 1): the engine refuses with RuntimeError before XLA
+# gets a chance to SIGABRT. Pipe-only meshes work on every runtime.
+needs_partial_auto = pytest.mark.skipif(
+    not partial_auto_shard_map_safe(),
+    reason="pipe x data composition requires jax >= 0.5 "
+           "(partial-auto shard_map lowering)")
 
 
 def _collect(schedule):
@@ -110,20 +121,22 @@ class TestInferenceSchedule:
         assert not any(isinstance(c, BackwardPass) for c in flat)
 
 
-def _make_engine(pipe, data, devices, zero_stage=0, gas=4, micro=2):
+def _make_engine(pipe, data, devices, zero_stage=0, gas=4, micro=2,
+                 pipeline=None):
     model = gpt2_pipe(GPT2Config.tiny(n_layer=4, dtype=np.float32))
     topo = MeshTopology(axis_sizes={"pipe": pipe, "data": data},
                         devices=devices)
-    engine, *_ = deepspeed_tpu.initialize(
-        model=model,
-        mesh=topo,
-        config={
-            "train_micro_batch_size_per_gpu": micro,
-            "gradient_accumulation_steps": gas,
-            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
-            "zero_optimization": {"stage": zero_stage},
-            "steps_per_print": 10_000,
-        })
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": zero_stage},
+        "steps_per_print": 10_000,
+    }
+    if pipeline is not None:
+        config["pipeline"] = pipeline
+    engine, *_ = deepspeed_tpu.initialize(model=model, mesh=topo,
+                                          config=config)
     return engine
 
 
@@ -133,6 +146,7 @@ def _batch(rows, seq=32, seed=0):
 
 
 class TestPipelineEngine:
+    @needs_partial_auto
     def test_matches_single_stage(self):
         reset_topology()
         devs = jax.devices()
@@ -153,6 +167,7 @@ class TestPipelineEngine:
                         jax.tree_util.tree_leaves(p1)):
             np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-5)
 
+    @needs_partial_auto
     def test_train_batch_decreases_loss(self):
         reset_topology()
         engine = _make_engine(pipe=2, data=2, devices=jax.devices()[:4],
@@ -168,6 +183,7 @@ class TestPipelineEngine:
             _make_engine(pipe=2, data=2, devices=jax.devices()[:4],
                          zero_stage=3)
 
+    @needs_partial_auto
     def test_model_parameters_eager_init(self):
         # regression: state built inside super().__init__ (model_parameters
         # given) must not crash on pipeline setup ordering
@@ -225,13 +241,13 @@ class TestInputResidency:
         import deepspeed_tpu.runtime.pipe.engine as pe
 
         captured = {}
-        orig = jax.shard_map
+        orig = pe.shard_map  # compat-resolved (jax.shard_map on >= 0.5)
 
         def spy(body, **kw):
             captured["in_specs"] = kw.get("in_specs")
             return orig(body, **kw)
 
-        monkeypatch.setattr(jax, "shard_map", spy)
+        monkeypatch.setattr(pe, "shard_map", spy)
         reset_topology()
         topo = MeshTopology(axis_sizes={"pipe": 4, "data": 2},
                             devices=jax.devices()[:8])
@@ -245,7 +261,10 @@ class TestInputResidency:
 
         # the strided layout puts micro-batch t in chunk slot t//P of
         # stage t%P, and the loss still computes (parity covered by
-        # tests/model pipeline gate)
+        # tests/model pipeline gate). The behavioral compile needs the
+        # partial-auto lowering (data=2 rides along as an auto axis).
+        if not partial_auto_shard_map_safe():
+            return
         ids = np.random.default_rng(0).integers(
             0, cfg.vocab_size, (8, 2, 16)).astype(np.int32)
         params = module.init_params(jax.random.PRNGKey(0), ids[0])
@@ -253,3 +272,81 @@ class TestInputResidency:
 
         loss = jax.jit(loss_fn)(params, (jnp.asarray(ids), jnp.asarray(ids)))
         assert np.isfinite(float(loss))
+
+
+class TestScheduleConfig:
+    """`pipeline: {schedule, virtual_stages}` config block: engine schedule
+    selection, loss parity across schedules, and the zero-overhead pin
+    (absent block == explicit defaults, HLO byte-identical)."""
+
+    def test_schedule_selection(self):
+        reset_topology()
+        e = _make_engine(pipe=2, data=1, devices=jax.devices()[:2],
+                         pipeline={"schedule": "zero_bubble"})
+        assert isinstance(e.train_schedule(stage_id=0), ZeroBubbleSchedule)
+
+        reset_topology()
+        e = _make_engine(pipe=2, data=1, devices=jax.devices()[:2],
+                         pipeline={"schedule": "interleaved",
+                                   "virtual_stages": 2})
+        sched = e.train_schedule(stage_id=1)
+        assert isinstance(sched, InterleavedSchedule)
+        assert sched.virtual_stages == 2
+        assert e.virtual_stages == 2
+
+    def test_bad_schedule_rejected(self):
+        reset_topology()
+        with pytest.raises(ValueError, match="schedule"):
+            _make_engine(pipe=2, data=1, devices=jax.devices()[:2],
+                         pipeline={"schedule": "gpipe"})
+
+    def test_virtual_stages_must_divide(self):
+        # 4 blocks cannot split into 2 stages x 3 chunks
+        reset_topology()
+        with pytest.raises(ValueError, match="virtual"):
+            _make_engine(pipe=2, data=1, devices=jax.devices()[:2],
+                         pipeline={"schedule": "interleaved",
+                                   "virtual_stages": 3})
+
+    def test_loss_parity_across_schedules(self):
+        """Same batch, same init: zero-bubble compiles the *same* program
+        as 1F1B (XLA's scan transpose already owns the backward ordering;
+        the B/W split lives in the instruction stream), and interleaved
+        v=2 runs every layer on the same micro-batches in a different
+        order — all three must produce the same loss."""
+        batch = _batch(rows=4 * 2, seed=3)
+        losses = {}
+        for name, pipeline in [("1f1b", None),
+                               ("zero_bubble", {"schedule": "zero_bubble"}),
+                               ("interleaved", {"schedule": "interleaved",
+                                                "virtual_stages": 2})]:
+            reset_topology()
+            e = _make_engine(pipe=2, data=1, devices=jax.devices()[:2],
+                             pipeline=pipeline)
+            losses[name] = float(e.forward(batch))
+            e.step()  # the backward compiles and runs, too
+        # same program -> bitwise equal
+        assert losses["zero_bubble"] == losses["1f1b"], losses
+        # measured bitwise-equal on CPU; rtol guards other backends'
+        # reduction-order drift
+        assert np.isclose(losses["interleaved"], losses["1f1b"],
+                          rtol=1e-6), losses
+
+    def test_zero_overhead_hlo_pin(self):
+        """Absent `pipeline` block vs explicit defaults vs zero_bubble:
+        the compiled train-step HLO is byte-identical — the new knobs are
+        free until actually turned on (and zero-bubble's split is an
+        instruction-stream concept, not a different compiled program)."""
+        texts = {}
+        for name, pipeline in [("absent", None),
+                               ("default", {"schedule": "1f1b",
+                                            "virtual_stages": 1}),
+                               ("zero_bubble", {"schedule": "zero_bubble"})]:
+            reset_topology()
+            e = _make_engine(pipe=2, data=1, devices=jax.devices()[:2],
+                             pipeline=pipeline)
+            e.forward(_batch(rows=4 * 2))  # builds state + micro-step jit
+            lowered = e._jit_micro.lower(e.state, _batch(rows=4 * 2))
+            texts[name] = lowered.as_text()
+        assert texts["default"] == texts["absent"]
+        assert texts["zero_bubble"] == texts["absent"]

@@ -223,6 +223,95 @@ class TestExposedComm:
 
 
 # ---------------------------------------------------------------------------
+class TestPerAxisAttribution:
+    """The static estimate learns per-axis wire attribution: each
+    collective's replica groups name the mesh axis whose wire it rides,
+    and ``tracing.axis_gbps`` prices each axis at its own rate."""
+
+    COST = {
+        "collective_operand_bytes": 10_000_000,
+        "flops": 1e12,
+        "collective_bytes_per_axis": {"data": 8_000_000,
+                                      "fsdp": 1_000_000,
+                                      "data+fsdp": 1_000_000},
+    }
+
+    def test_axis_rate_joint_is_min_of_parts(self):
+        rates = {"data": 25.0, "fsdp": 100.0}
+        assert xc._axis_rate("data", rates, 90.0) == 25.0
+        assert xc._axis_rate("tp", rates, 90.0) == 90.0  # unconfigured
+        # a joint collective is bounded by its slowest link
+        assert xc._axis_rate("data+fsdp", rates, 90.0) == 25.0
+        assert xc._axis_rate("fsdp+tp", rates, 90.0) == 90.0
+
+    def test_unconfigured_is_numerically_identical(self):
+        """No axis_gbps (or an empty dict) must leave the single-rate
+        arithmetic untouched — same fraction, same comm seconds."""
+        base = xc.static_estimate(self.COST, 90.0, 275.0)
+        for axis_gbps in (None, {}):
+            est = xc.static_estimate(self.COST, 90.0, 275.0,
+                                     axis_gbps=axis_gbps)
+            assert est["exposed_comm_fraction"] == \
+                base["exposed_comm_fraction"]
+            assert est["comm_secs_est"] == base["comm_secs_est"]
+        # the attribution itself still renders (it's free information)
+        assert base["collective_bytes_per_axis"][
+            "data"] == 8_000_000
+
+    def test_per_axis_rates_reprice_the_wire(self):
+        est = xc.static_estimate(self.COST, 90.0, 275.0,
+                                 axis_gbps={"data": 10.0, "fsdp": 100.0})
+        by = est["comm_secs_by_axis"]
+        assert abs(by["data"] - 8e6 / 10e9) < 1e-9
+        assert abs(by["fsdp"] - 1e6 / 100e9) < 1e-9
+        assert abs(by["data+fsdp"] - 1e6 / 10e9) < 1e-9  # min(10, 100)
+        assert abs(est["comm_secs_est"] - sum(by.values())) < 1e-6
+
+    def test_compiled_attribution_keys_match_mesh_axes(self):
+        """End-to-end: a compiled sharded program's collectives land on
+        the axes their replica groups actually span."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu.telemetry.jit_watch import compiled_cost_summary
+
+        devs = np.array(jax.devices()[:4]).reshape(2, 2)
+        mesh = Mesh(devs, ("data", "fsdp"))
+        w = jax.ShapeDtypeStruct((256, 64), jnp.float32)
+        compiled = jax.jit(
+            lambda v: v + 0.0,
+            in_shardings=NamedSharding(mesh, P("fsdp")),
+            out_shardings=NamedSharding(mesh, P())).lower(w).compile()
+        cost = compiled_cost_summary(compiled, compiled.as_text(),
+                                     axis_sizes=[("data", 2), ("fsdp", 2)])
+        per_axis = cost["collective_bytes_per_axis"]
+        assert set(per_axis) == {"fsdp"}
+        assert per_axis["fsdp"] == 256 * 64 * 4 // 2  # shard x (group-1)
+
+    def test_engine_hands_mesh_identity_to_telemetry(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.parallel.topology import reset_topology
+
+        from tests.unit.simple_model import simple_loss_fn, simple_params
+
+        reset_topology()
+        try:
+            engine, *_ = deepspeed_tpu.initialize(
+                model=simple_loss_fn,
+                model_parameters=simple_params(),
+                config={"train_batch_size": 32,
+                        "optimizer": {"type": "Adam",
+                                      "params": {"lr": 0.01}},
+                        "mesh": {"data": 4, "fsdp": 2}})
+            sizes = dict(engine.telemetry.axis_sizes)
+            assert sizes["data"] == 4 and sizes["fsdp"] == 2
+        finally:
+            reset_topology()
+
+
+# ---------------------------------------------------------------------------
 class TestSinkRotation:
     def _sink(self, tmp_path, rotate_bytes, keep=2):
         from deepspeed_tpu.telemetry.sink import JsonlSink

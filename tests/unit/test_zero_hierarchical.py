@@ -1,0 +1,228 @@
+"""Hierarchical (ZeRO++ hpZ, arXiv:2306.10209) param gather tests.
+
+With ``zero_optimization.hierarchical_gather`` on a mesh whose fsdp axis
+is > 1, the per-use ZeRO-3 parameter all-gather runs INSIDE one data
+replica (over fsdp/expert only) instead of over the full data x fsdp
+group — a secondary, larger shard traded for a smaller, faster gather
+group. Optimizer and gradient state keep the full ``ZERO_AXES``
+partition. The wire claim is HLO-pinned in RECEIVED bytes
+(operand x (group-1)): per-member operand bytes alone would invert the
+verdict, since the hierarchical shard is larger per member.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.topology import reset_topology
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.partition import (ZERO_AXES, SpecLayout,
+                                                  build_zero_shardings,
+                                                  hierarchical_param_axes)
+from deepspeed_tpu.utils.hlo_inspect import (attribute_collectives,
+                                             parse_collectives,
+                                             parse_replica_groups,
+                                             received_bytes)
+
+from tests.unit.simple_model import (random_dataset, simple_loss_fn,
+                                     simple_params)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    reset_topology()
+    yield
+    reset_topology()
+
+
+def _mesh_df(data=2, fsdp=2):
+    devs = np.array(jax.devices()[:data * fsdp]).reshape(data, fsdp)
+    return Mesh(devs, ("data", "fsdp"))
+
+
+class TestReplicaGroupParsing:
+    def test_literal_form(self):
+        assert parse_replica_groups(
+            "x = f32[4] all-gather(y), replica_groups={{0,1},{2,3}}"
+        ) == [[0, 1], [2, 3]]
+
+    def test_iota_form(self):
+        assert parse_replica_groups(
+            "x = f32[4] all-gather(y), replica_groups=[2,2]<=[4]"
+        ) == [[0, 1], [2, 3]]
+        assert parse_replica_groups(
+            "x = f32[8] all-reduce(y), replica_groups=[1,4]<=[4]"
+        ) == [[0, 1, 2, 3]]
+
+    def test_iota_transpose_form(self):
+        # iota(4).reshape(2,2).T.flatten() = [0,2,1,3] → column groups
+        assert parse_replica_groups(
+            "x = f32[4] all-gather(y), replica_groups=[2,2]<=[2,2]T(1,0)"
+        ) == [[0, 2], [1, 3]]
+
+    def test_no_groups(self):
+        assert parse_replica_groups("x = f32[4] add(y, z)") is None
+
+    def test_received_bytes(self):
+        c = {"operand_bytes": 100, "group_size": 4}
+        assert received_bytes(c) == 300
+        assert received_bytes({"operand_bytes": 100, "group_size": None}) == 0
+
+
+class TestHierarchicalSpecs:
+    def test_param_axes_drop_data(self):
+        axes = hierarchical_param_axes()
+        assert "data" not in axes
+        assert "fsdp" in axes and "expert" in axes
+
+    def test_config_flag_parses(self):
+        assert DeepSpeedZeroConfig(hierarchical_gather=True).hierarchical_gather
+        assert not DeepSpeedZeroConfig().hierarchical_gather
+
+    def test_layout_param_vs_opt_split(self):
+        """hpZ params shard over fsdp only; opt state keeps data x fsdp."""
+        lay = SpecLayout(_mesh_df(), hierarchical_gather=True)
+        assert lay.hierarchical_active
+        pspec = lay.param_spec((256, 64), stage=3)
+        pflat = [a for e in pspec for a in
+                 (e if isinstance(e, tuple) else (e,)) if a]
+        assert pflat == ["fsdp"], pspec
+        ospec = lay.opt_spec((256, 64), stage=1)
+        oflat = [a for e in ospec for a in
+                 (e if isinstance(e, tuple) else (e,)) if a]
+        assert "data" in oflat and "fsdp" in oflat, ospec
+
+    def test_inactive_without_secondary_axis(self):
+        """On a data-only mesh the flag is a no-op: params keep the flat
+        data partition (there is no in-replica group to hold a shard)."""
+        devs = np.array(jax.devices()[:4]).reshape(4, 1)
+        mesh = Mesh(devs, ("data", "fsdp"))
+        lay = SpecLayout(mesh, hierarchical_gather=True)
+        assert not lay.hierarchical_active
+        pspec = lay.param_spec((256, 64), stage=3)
+        pflat = [a for e in pspec for a in
+                 (e if isinstance(e, tuple) else (e,)) if a]
+        assert "data" in pflat
+
+    def test_build_zero_shardings_split(self):
+        mesh = _mesh_df()
+        shapes = {"w": jax.ShapeDtypeStruct((256, 64), jnp.float32)}
+        psh, osh = build_zero_shardings(shapes, mesh, stage=3,
+                                        hierarchical=True)
+        assert "data" not in str(psh["w"].spec)
+        assert "fsdp" in str(psh["w"].spec)
+        assert "data" in str(osh["w"].spec) and "fsdp" in str(osh["w"].spec)
+
+    def test_describe_records_flag(self):
+        assert SpecLayout(_mesh_df(),
+                          hierarchical_gather=True).describe()[
+                              "hierarchical_gather"] is True
+        assert SpecLayout(_mesh_df()).describe()[
+            "hierarchical_gather"] is False
+
+
+class TestHierarchicalWirePin:
+    """The win metric, pinned in compiled HLO on the 2x2 data x fsdp mesh."""
+
+    W = (256, 64)  # 64 KiB f32
+
+    def _gather_hlo(self, spec):
+        mesh = _mesh_df()
+        w = jax.ShapeDtypeStruct(self.W, jnp.float32)
+        f = jax.jit(lambda v: v + 0.0,
+                    in_shardings=NamedSharding(mesh, spec),
+                    out_shardings=NamedSharding(mesh, P()))
+        return f.lower(w).compile().as_text()
+
+    def _recv(self, hlo):
+        return sum(received_bytes(c) for c in parse_collectives(hlo)
+                   if c["operand_bytes"] >= 16)
+
+    def test_hierarchical_cuts_gather_wire(self):
+        nbytes = int(np.prod(self.W)) * 4      # 65536
+        flat = self._recv(self._gather_hlo(P(("data", "fsdp"))))
+        hier = self._recv(self._gather_hlo(P("fsdp")))
+        # flat: shard N/4 received x3 members; hier: shard N/2 received x1
+        assert flat == nbytes // 4 * 3         # 49152
+        assert hier == nbytes // 2 * 1         # 32768
+        assert hier < flat
+
+    def test_axis_attribution(self):
+        axes = [("data", 2), ("fsdp", 2)]
+        flat = attribute_collectives(self._gather_hlo(P(("data", "fsdp"))),
+                                     axes, min_bytes=16)
+        hier = attribute_collectives(self._gather_hlo(P("fsdp")),
+                                     axes, min_bytes=16)
+        assert set(flat) == {"data+fsdp"}
+        assert set(hier) == {"fsdp"}
+
+
+class TestEngineHierarchical:
+    def _cfg(self, hierarchical, fsdp=2):
+        return {
+            "train_batch_size": 32,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.05}},
+            "mesh": {"data": 8 // fsdp, "fsdp": fsdp},
+            "zero_optimization": {
+                "stage": 3,
+                "stage3_param_persistence_threshold": 0,
+                "hierarchical_gather": hierarchical,
+            },
+            "steps_per_print": 10_000,
+        }
+
+    def _run(self, hierarchical, n_steps=5, hidden=16):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn,
+            model_parameters=simple_params(hidden_dim=hidden),
+            config=self._cfg(hierarchical))
+        x, y = random_dataset(256, hidden)
+        losses = []
+        for i in range(n_steps):
+            b0 = (i * 32) % (len(x) - 32)
+            loss = engine((x[b0:b0 + 32], y[b0:b0 + 32]))
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        return engine, losses
+
+    def test_param_and_opt_shardings_split(self):
+        engine, _ = self._run(True, n_steps=1)
+        pspec = str(engine.state.params["w0"].sharding.spec)
+        assert "fsdp" in pspec and "data" not in pspec, pspec
+        ospec = str(engine.state.opt_state.exp_avg["w0"].sharding.spec)
+        assert "data" in ospec and "fsdp" in ospec, ospec
+
+    def test_trajectory_matches_flat(self):
+        """Param placement must not change the math — same losses as the
+        flat ZeRO-3 run on the same mesh."""
+        _, flat = self._run(False)
+        reset_topology()
+        _, hier = self._run(True)
+        np.testing.assert_allclose(flat, hier, rtol=1e-5, atol=1e-6)
+
+    def test_flag_warns_and_ignored_without_fsdp(self):
+        import logging
+
+        from deepspeed_tpu.utils.logging import logger as ds_logger
+
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn,
+            model_parameters=simple_params(hidden_dim=16),
+            config=self._cfg(True, fsdp=1))
+        # the framework logger sets propagate=False; attach a handler
+        # directly and re-trigger the (cached) layout build
+        records = []
+        handler = logging.Handler()
+        handler.emit = lambda r: records.append(r.getMessage())
+        ds_logger.addHandler(handler)
+        try:
+            engine._spec_layout_cache = None
+            layout = engine.spec_layout
+        finally:
+            ds_logger.removeHandler(handler)
+        assert not layout.hierarchical_active
+        assert any("hierarchical_gather" in m for m in records)

@@ -151,6 +151,24 @@ def per_axis_table(elems: int = 65_536):
                                check_vma=False),
                      jax.ShapeDtypeStruct((n,), jnp.float32))
 
+    # flat vs hierarchical (hpZ) param gather: same logical tensor, shard
+    # over data x fsdp vs fsdp-only (in-replica). Compared in RECEIVED
+    # bytes (operand x (group-1)) — the hierarchical shard is LARGER per
+    # member but crosses a smaller group, so operand bytes alone would
+    # invert the verdict.
+    from jax.sharding import NamedSharding  # noqa: E402
+
+    wfull = jax.ShapeDtypeStruct((n,), jnp.float32)
+
+    def reshard(spec):
+        return jax.jit(lambda v: v + 0.0,
+                       in_shardings=NamedSharding(mesh3, spec),
+                       out_shardings=NamedSharding(mesh3, P())
+                       ).lower(wfull).compile().as_text()
+
+    flat_gather_hlo = reshard(P(("data", "fsdp")))
+    hier_gather_hlo = reshard(P("fsdp"))
+
     # tp axis: the row-parallel output all-reduce (dense vs int8 tier)
     x = jax.ShapeDtypeStruct((rows_n, d), jnp.float32)
     w = jax.ShapeDtypeStruct((d, d), jnp.float32)
@@ -170,10 +188,19 @@ def per_axis_table(elems: int = 65_536):
         jax.ShapeDtypeStruct((4 * d,), jnp.float32),
         jax.ShapeDtypeStruct((4 * d, d), jnp.float32), b)
 
+    from deepspeed_tpu.utils.hlo_inspect import (parse_collectives,
+                                                 received_bytes)
+
+    def recv_bytes(hlo):
+        return sum(received_bytes(c) for c in parse_collectives(hlo)
+                   if c["operand_bytes"] >= 16)
+
     rows = []
     for axis, role, hlo in [
             ("data", "ZeRO grad reduce (psum)", data_hlo),
             ("fsdp", "ZeRO-3 param all-gather", fsdp_hlo),
+            ("data+fsdp", "flat ZeRO-3 param gather", flat_gather_hlo),
+            ("fsdp", "hierarchical (hpZ) param gather", hier_gather_hlo),
             ("tp", "row-parallel all-reduce (dense)", tp_dense_hlo),
             ("tp", "row-parallel all-reduce (int8 tier)", tp_int8_hlo),
             ("tp", "injected MLP, one int8 reduce", mlp_int8_hlo)]:
@@ -182,16 +209,17 @@ def per_axis_table(elems: int = 65_536):
         dtypes = "+".join(sorted({dt for c in colls
                                   for dt, _ in c["operands"]})) or "-"
         rows.append({"axis": axis, "role": role, "ops": ops,
-                     "dtypes": dtypes, "operand_bytes": total})
+                     "dtypes": dtypes, "operand_bytes": total,
+                     "received_bytes": recv_bytes(hlo)})
 
     print(f"Per-AXIS collective operand bytes on the data x fsdp x tp "
           f"2x2x2 mesh ({n} f32 elements per tensor, compiled HLO):\n")
     print("| mesh axis | collective | ops | operand dtypes | "
-          "bytes/member |")
-    print("|---|---|---|---|---|")
+          "bytes/member | received bytes/member |")
+    print("|---|---|---|---|---|---|")
     for r in rows:
         print(f"| {r['axis']} | {r['role']} | {r['ops']} | {r['dtypes']} "
-              f"| {r['operand_bytes']:,} |")
+              f"| {r['operand_bytes']:,} | {r['received_bytes']:,} |")
     print()
     print(json.dumps({"metric": "comm_wire_bytes_per_axis", "elems": n,
                       "mesh": {"data": 2, "fsdp": 2, "tp": 2},

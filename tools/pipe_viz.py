@@ -1,0 +1,130 @@
+"""Stage x clock grid of a pipeline schedule, straight from ``steps()``.
+
+Renders the per-stage instruction streams of a schedule as an ASCII (or
+markdown) grid — one row per physical stage, one column per clock —
+after running the schedule-algebra validator over the full stage set.
+The cells use the compute vocabulary (``F3`` forward of micro-batch 3,
+``B3`` backward, ``I3``/``W3`` the zero-bubble input/weight split;
+interleaved chunks carry a ``'`` per extra chunk), so the warmup ramp,
+steady 1F1B cadence, and cooldown fill are visible at a glance. Run::
+
+    python tools/pipe_viz.py --schedule zero_bubble --stages 4 --micro-batches 8
+    python tools/pipe_viz.py --schedule interleaved --virtual-stages 2 --markdown
+
+No devices are touched — schedules are pure Python. Exit 0 when the
+grid rendered and the validator passed, 1 when the schedule violates
+the algebra (violations printed), 2 on a usage error (bad counts, or
+``--virtual-stages`` on a schedule that has no virtual stages).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.runtime.pipe.schedule import (  # noqa: E402
+    BackwardInput, BackwardPass, BackwardWeight, ForwardPass,
+    InferenceSchedule, InterleavedSchedule, ScheduleValidationError,
+    TrainSchedule, ZeroBubbleSchedule, validate_schedule)
+
+SCHEDULES = {
+    "1f1b": TrainSchedule,
+    "inference": InferenceSchedule,
+    "interleaved": InterleavedSchedule,
+    "zero_bubble": ZeroBubbleSchedule,
+}
+
+_SYMBOL = ((ForwardPass, "F"), (BackwardInput, "I"),
+           (BackwardWeight, "W"), (BackwardPass, "B"))
+
+
+def cell_grid(streams):
+    """streams[s] (per-clock instruction lists) -> grid[s][clock] str."""
+    grid = []
+    for stream in streams:
+        row = []
+        for cmds in stream:
+            label = ""
+            for c in cmds:
+                for cls, sym in _SYMBOL:
+                    if type(c) is cls:
+                        label = (f"{sym}{c.micro_batch_id}"
+                                 + "'" * getattr(c, "chunk", 0))
+                        break
+            row.append(label)
+        grid.append(row)
+    return grid
+
+
+def render(grid, markdown=False):
+    span = max(len(r) for r in grid)
+    width = max(2, max((len(c) for r in grid for c in r), default=2))
+    idle = "." if not markdown else ""
+    lines = []
+    if markdown:
+        header = ["stage \\ clock"] + [str(c) for c in range(span)]
+        lines.append("| " + " | ".join(header) + " |")
+        lines.append("|" + "---|" * len(header))
+        for s, row in enumerate(grid):
+            cells = [c or idle for c in row] + [""] * (span - len(row))
+            lines.append(f"| {s} | " + " | ".join(cells) + " |")
+    else:
+        gutter = len(f"stage {len(grid) - 1}")
+        lines.append(" " * gutter + "  clock 0 -> " + str(span - 1))
+        for s, row in enumerate(grid):
+            cells = [(c or idle).ljust(width) for c in row]
+            lines.append(f"stage {s}".ljust(gutter) + "  " + " ".join(cells))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render a pipeline schedule as a stage x clock grid")
+    ap.add_argument("--schedule", choices=sorted(SCHEDULES), default="1f1b")
+    ap.add_argument("--stages", type=int, default=4, metavar="P")
+    ap.add_argument("--micro-batches", type=int, default=8, metavar="M")
+    ap.add_argument("--virtual-stages", type=int, default=None, metavar="V",
+                    help="interleaved only (default 2)")
+    ap.add_argument("--markdown", action="store_true",
+                    help="emit a markdown table instead of ASCII")
+    args = ap.parse_args(argv)
+
+    if args.stages < 1 or args.micro_batches < 1:
+        print("pipe_viz: --stages and --micro-batches must be >= 1",
+              file=sys.stderr)
+        return 2
+    kwargs = {}
+    if args.schedule == "interleaved":
+        kwargs["virtual_stages"] = args.virtual_stages or 2
+        if kwargs["virtual_stages"] < 1:
+            print("pipe_viz: --virtual-stages must be >= 1", file=sys.stderr)
+            return 2
+    elif args.virtual_stages is not None:
+        print(f"pipe_viz: --virtual-stages is meaningless for "
+              f"--schedule {args.schedule}", file=sys.stderr)
+        return 2
+
+    cls = SCHEDULES[args.schedule]
+    try:
+        stats = validate_schedule(cls, args.micro_batches, args.stages,
+                                  **kwargs)
+    except ScheduleValidationError as e:
+        print(f"pipe_viz: VALIDATION FAILED\n{e}", file=sys.stderr)
+        return 1
+
+    streams = [list(cls(micro_batches=args.micro_batches, stages=args.stages,
+                        stage_id=s, **kwargs).steps())
+               for s in range(args.stages)]
+    print(render(cell_grid(streams), markdown=args.markdown))
+    print()
+    print(f"schedule={args.schedule} P={args.stages} M={args.micro_batches}"
+          + (f" v={kwargs['virtual_stages']}" if kwargs else "")
+          + f" span={stats['span']}"
+          f" bubble_fraction={stats['bubble_fraction']:.4f}"
+          f" peak_buffers={stats['peak_buffers']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

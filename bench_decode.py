@@ -538,6 +538,126 @@ def _fleet_series(ctx):
 
 
 # ---------------------------------------------------------------------------
+# live KV migration: moving state vs replaying work
+def _migration_series(ctx):
+    """Optional extra series (after the headline JSON): what moving KV
+    blocks instead of replaying work buys, in three numbers:
+
+    - **failover** — time from a breaker trip to the first RESUMED
+      token of the moved stream, migrate vs full replay (replay pays a
+      fresh prefill plus regenerating every delivered token just to
+      swallow them);
+    - **drain** — sweeps for a scale-down drain to empty the replica,
+      migrate-based vs finishing the work in place;
+    - **wire** — exported bytes per sequence at the full KV dtype vs
+      ``kv_cache_dtype: "int8"`` (side pools + scales ride the same
+      block indices, so the quantized move ships ~4x fewer bytes from
+      f32 pools)."""
+    import sys
+
+    from deepspeed_tpu.runtime.resilience.chaos import ChaosReplica
+    from deepspeed_tpu.serving.router import ReplicaRouter
+
+    cfg = ctx["cfg"]
+    srv_new, srv_rng = ctx["srv_new"], ctx["srv_rng"]
+    L = max(ctx["lens"])
+
+    def prompt():
+        return srv_rng.integers(0, cfg.vocab_size, L).astype(np.int32)
+
+    def warmed_pair():
+        pair = (_build_serving(ctx), _build_serving(ctx))
+        for s in pair:
+            s.submit(prompt(), max_new_tokens=2)
+            s.drain()
+            s.reset_stats()
+        return pair
+
+    def failover_leg(migration):
+        # replica 0 trips its breaker after the first decode step; the
+        # gap between the stream's first and second token timestamps IS
+        # the time-to-first-resumed-token (with migration the survivor
+        # lands the blocks and decodes; with replay it re-prefills and
+        # regenerates the delivered prefix, which the shim swallows)
+        s0, s1 = warmed_pair()
+        router = ReplicaRouter(
+            [ChaosReplica(s0, fail_step_at=2, fail_step_times=3), s1],
+            config={"failure_threshold": 3, "max_failovers": 2},
+            migration=migration)
+        stamps = []
+        r = router.submit(prompt(), max_new_tokens=srv_new,
+                          stream=lambda _r, t, d:
+                          stamps.append(time.perf_counter()))
+        router.drain(max_steps=500)
+        moved = router.stats()["migrations"]
+        router.destroy()
+        gap = (round(1e3 * (stamps[1] - stamps[0]), 2)
+               if r.state == "finished" and len(stamps) > 1 else None)
+        return gap, moved
+
+    def drain_leg(migration):
+        # the fleet drain sweep, one step at a time: how many sweeps
+        # until the draining replica is empty
+        s0, s1 = warmed_pair()
+        router = ReplicaRouter([s0, s1],
+                               config={"failure_threshold": 3},
+                               migration=migration)
+        router.submit(prompt(), max_new_tokens=srv_new)
+        router.step()                     # running, first token out
+        router.start_drain(0)
+        t0 = time.perf_counter()
+        steps = 0
+        while router.assigned(0) and steps < 500:
+            router.migrate_work(0, "drain")
+            if router.assigned(0):
+                router.step()
+            steps += 1
+        ms = round(1e3 * (time.perf_counter() - t0), 2)
+        router.drain(max_steps=200)       # finish moved/remaining work
+        router.destroy()
+        return steps, ms
+
+    def wire_leg(extra):
+        srv = _build_serving(ctx, extra)
+        r = srv.submit(prompt(), max_new_tokens=srv_new)
+        for _ in range(2):
+            srv.step()
+        export = srv.export_sequence(r.request_id)
+        wire = int(export["wire_bytes"]) if export else None
+        srv.destroy()
+        return wire
+
+    try:
+        mig_gap, moved = failover_leg({"enabled": True})
+        replay_gap, _ = failover_leg(None)
+        mig_steps, mig_ms = drain_leg({"enabled": True})
+        yield_steps, yield_ms = drain_leg(None)
+        wire_full = wire_leg(None)
+        wire_int8 = wire_leg({"kv_cache_dtype": "int8"})
+        return {
+            "metric": f"{METRIC}_migration",
+            "migrations_in_window": moved,
+            "migrate_resume_gap_ms": mig_gap,
+            "replay_resume_gap_ms": replay_gap,
+            "migrate_drain_steps": mig_steps,
+            "yield_drain_steps": yield_steps,
+            "migrate_drain_ms": mig_ms,
+            "yield_drain_ms": yield_ms,
+            "export_wire_bytes": wire_full,
+            "export_wire_bytes_int8": wire_int8,
+            "wire_ratio": (round(wire_full / wire_int8, 2)
+                           if wire_full and wire_int8 else None),
+            "prompt_len": L, "new_tokens": srv_new,
+        }
+    except Exception as e:  # noqa: BLE001 — extras never kill the headline
+        print(f"# migration series failed: {e}", file=sys.stderr,
+              flush=True)
+        return {"metric": f"{METRIC}_migration", "value": None,
+                "unit": "ms", "vs_baseline": None,
+                "error": str(e)[:300]}
+
+
+# ---------------------------------------------------------------------------
 # tuner series: the live autotuner's decode-side measurement hooks
 def _decode_attention_series(ctx, block_k=None, reps=None):
     """Microbench of the dense decode-attention kernel at one ``block_k``
@@ -964,6 +1084,8 @@ def run_series(name, config=None):
         return _router_series(ctx)
     if name == "fleet":
         return _fleet_series(ctx)
+    if name == "migration":
+        return _migration_series(ctx)
     if name == "decode_attention":
         return _decode_attention_series(ctx, block_k=config.get("block_k"))
     if name == "serving_chunk":
@@ -980,8 +1102,8 @@ def run_series(name, config=None):
 
 
 SERIES = ("headline", "serving", "serving_fastpath", "router", "fleet",
-          "decode_attention", "serving_chunk", "serving_tracing",
-          "spec_decode", "tp")
+          "migration", "decode_attention", "serving_chunk",
+          "serving_tracing", "spec_decode", "tp")
 
 
 def main():
@@ -997,6 +1119,7 @@ def main():
     emit_result(_serving_fastpath_series(ctx))
     emit_result(_router_series(ctx))
     emit_result(_fleet_series(ctx))
+    emit_result(_migration_series(ctx))
     emit_result(_spec_decode_series(ctx))
     emit_result(_serving_tracing_series(ctx))
     emit_result(_tp_series(ctx))

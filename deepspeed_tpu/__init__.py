@@ -219,7 +219,14 @@ def init_serving(model, config=None, replicas=None, factory=None,
         fleet = _carried("fleet")
         if fleet is not None and not getattr(fleet, "enabled", True):
             fleet = None
-    front = ReplicaRouter(engines, config=router, **clock_kwargs)
+    # live KV migration block (same carry rules): absent/disabled means
+    # the router's failover/drain behavior is byte-for-byte pre-PR-18
+    migration = (serving.get("migration") if isinstance(serving, dict)
+                 else getattr(serving, "migration", None))
+    if migration is None:
+        migration = _carried("migration")
+    front = ReplicaRouter(engines, config=router, migration=migration,
+                          **clock_kwargs)
     if fleet is None:
         if factory is not None:
             raise ValueError(

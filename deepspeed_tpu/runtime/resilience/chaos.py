@@ -229,6 +229,20 @@ class ChaosReplica:
       tokens (the fault is armed only around this one delegated call,
       so a co-resident replica stepping through the same seam is never
       hit).
+    - ``crash_during_migration=N`` — the Nth ``export_sequence()`` call
+      (one-shot) performs the REAL export, then raises
+      :class:`ReplicaCrashed`: the source dies between export and the
+      target's table commit — the hardest migration moment, where the
+      move must abort with the target's allocation released and the
+      orchestrator falls back to replay with exactly-once delivery.
+      The replica is dead from that point (later ``step()`` calls
+      crash, as a killed process would).
+    - ``flaky_transfer_at=N, flaky_transfer_times=M`` — M consecutive
+      migrations starting with the Nth export lose their wire transfer:
+      a one-shot transient :class:`ChaosIOError` armed at the
+      ``"serving.migration.transfer"`` seam right after each export, so
+      the fault lands between export and import — source untouched, the
+      caller retries or replays.
 
     ``sleep`` is injectable so host-side tests drive stalls through a
     fake clock instead of wall time.
@@ -239,7 +253,10 @@ class ChaosReplica:
                  fail_submit_at: int = 0, fail_submit_times: int = 1,
                  stall_at_step: int = 0, stall_secs: float = 0.0,
                  slow_decode_secs: float = 0.0,
-                 crash_between_draft_and_commit: int = 0, sleep=time.sleep):
+                 crash_between_draft_and_commit: int = 0,
+                 crash_during_migration: int = 0,
+                 flaky_transfer_at: int = 0, flaky_transfer_times: int = 1,
+                 sleep=time.sleep):
         self.replica = replica
         self.crash_at_step = int(crash_at_step)
         self.crash_between_draft_and_commit = int(
@@ -251,9 +268,13 @@ class ChaosReplica:
         self.stall_at_step = int(stall_at_step)
         self.stall_secs = float(stall_secs)
         self.slow_decode_secs = float(slow_decode_secs)
+        self.crash_during_migration = int(crash_during_migration)
+        self.flaky_transfer_at = int(flaky_transfer_at)
+        self.flaky_transfer_times = int(flaky_transfer_times)
         self.sleep = sleep
         self.steps = 0
         self.submits = 0
+        self.migration_exports = 0
 
     def submit(self, *args, **kwargs):
         self.submits += 1
@@ -287,8 +308,35 @@ class ChaosReplica:
         return self.replica.step()
 
     def __getattr__(self, name):
-        # gauges/stats/pending/buckets/telemetry/... delegate untouched
-        return getattr(self.replica, name)
+        # gauges/stats/pending/buckets/telemetry/... delegate untouched.
+        # getattr-first keeps hasattr() semantics honest: a wrapped
+        # replica WITHOUT the migration surface must still read as not
+        # having one (the router's migrate-vs-replay probe depends on it)
+        attr = getattr(self.replica, name)
+        if name == "export_sequence" and (self.crash_during_migration
+                                          or self.flaky_transfer_at):
+            def export(request_id):
+                self.migration_exports += 1
+                n = self.migration_exports
+                out = attr(request_id)
+                if n == self.crash_during_migration:
+                    # the export left the process; the process died —
+                    # the fault lands between export and the target's
+                    # table commit, and the replica stays dead
+                    self.crash_at_step = max(1, self.steps)
+                    raise ReplicaCrashed(
+                        f"chaos: replica crashed mid-migration "
+                        f"[export {n}]")
+                if (self.flaky_transfer_at and self.flaky_transfer_at
+                        <= n < self.flaky_transfer_at
+                        + self.flaky_transfer_times):
+                    # scoped one-shot: the orchestrator's very next
+                    # "serving.migration.transfer" seam is THIS move's
+                    io_errors("serving.migration.transfer", at_call=1)
+                return out
+
+            return export
+        return attr
 
 
 class FlakyFactory:

@@ -42,7 +42,8 @@ Pieces:
 from deepspeed_tpu.serving.autoscaler import Autoscaler, BudgetWindow
 from deepspeed_tpu.serving.blocks import BlockManager
 from deepspeed_tpu.serving.capacity import CapacityModel
-from deepspeed_tpu.serving.config import (FleetConfig, ReplayConfig,
+from deepspeed_tpu.serving.config import (FleetConfig, MigrationConfig,
+                                          ReplayConfig,
                                           RouterConfig, ServingConfig,
                                           SpeculativeConfig, bucket_for,
                                           resolve_buckets)
@@ -50,6 +51,7 @@ from deepspeed_tpu.serving.engine import ServingEngine
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.health import (DEAD, DEGRADED, DRAINING, HEALTHY,
                                           TRIPPED, ReplicaHealth)
+from deepspeed_tpu.serving.migration import Migrator, resolve_migration
 from deepspeed_tpu.serving.replay import (Arrival, ReplayClock,
                                           TraceReplayer, burst_trace,
                                           diurnal_trace, load_trace,
@@ -68,6 +70,7 @@ __all__ = ["Arrival", "Autoscaler", "BlockManager", "BudgetWindow",
            "CallableReplicaFactory", "CapacityModel",
            "ContinuousBatchingScheduler",
            "DraftModelProposer", "FleetConfig", "FleetManager",
+           "MigrationConfig", "Migrator", "resolve_migration",
            "PrefixCache", "PromptLookupProposer",
            "Proposer", "ReplayClock", "ReplayConfig", "ReplicaFactory",
            "ReplicaHealth",

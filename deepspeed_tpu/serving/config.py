@@ -186,11 +186,21 @@ class FleetConfig(DeepSpeedConfigModel):
     # to survivors and parks anyway (0 = wait forever) — scale-down must
     # never deadlock drain() behind one wedged replica
     drain_timeout_steps: int = 0
+    # ---- migrate-based defragmentation (needs serving.migration) ----
+    # pool-fragmentation gauge (1 - committed/allocated-capacity) at or
+    # above this triggers a migrate-based rebalance of the worst replica
+    # (0 = rebalance off)
+    rebalance_fragmentation: float = 0.0
+    # steps between rebalance sweeps — defrag must not thrash the pools
+    rebalance_cooldown_steps: int = 16
+    # in-flight requests moved off the fragmented replica per sweep
+    rebalance_max_requests: int = 1
 
     @field_validator("min_replicas", "max_replicas", "fast_window_steps",
                      "slow_window_steps", "scale_up_cooldown_steps",
                      "scale_down_cooldown_steps", "scale_down_quiet_steps",
-                     "factory_backoff_steps")
+                     "factory_backoff_steps", "rebalance_cooldown_steps",
+                     "rebalance_max_requests")
     @classmethod
     def _positive(cls, v, info):
         if v <= 0:
@@ -207,6 +217,15 @@ class FleetConfig(DeepSpeedConfigModel):
                 f"serving.fleet.{info.field_name} must be >= 0, got {v}")
         return v
 
+    @field_validator("rebalance_fragmentation")
+    @classmethod
+    def _frag(cls, v):
+        if not (0.0 <= v <= 1.0):
+            raise ValueError(
+                "serving.fleet.rebalance_fragmentation must be in [0, 1] "
+                f"(0 = rebalance off), got {v}")
+        return v
+
     @model_validator(mode="after")
     def _bounds(self):
         if self.min_replicas > self.max_replicas:
@@ -219,6 +238,42 @@ class FleetConfig(DeepSpeedConfigModel):
                 f"<= 1 (load hysteresis), got down={self.scale_down_load} "
                 f"up={self.scale_up_load}")
         return self
+
+
+class MigrationConfig(DeepSpeedConfigModel):
+    """The ``serving.migration`` block: live KV-block migration — move a
+    running sequence's committed pool blocks (plus int8 side pools and
+    scales, riding the same block indices) to a peer replica and splice
+    the request into a free decode slot there, mid-stream, with no
+    prefill dispatch. Absent (the default) the migration layer does not
+    exist: failover replays, drains wait in place, and the compiled
+    decode HLO is byte-identical (the standard zero-overhead pin).
+    Present, three consumers use the one primitive: router failover on a
+    breaker trip or host-observed stall whose source pool is still
+    readable (a hard crash keeps the deterministic-replay path),
+    fleet-manager drain/scale-down (``drain_timeout_steps`` becomes the
+    fallback, not the plan), and autoscaler-triggered defragmentation of
+    the most fragmented replica."""
+
+    enabled: bool = True
+    # migrate-first on breaker trip / stall failover (source pool still
+    # readable); off = PR 6 deterministic replay exactly as before
+    failover: bool = True
+    # fleet drains move in-flight work to survivors instead of waiting
+    drain: bool = True
+    # autoscaler-triggered migrate-based rebalance of fragmented pools
+    rebalance: bool = True
+    # cap on requests moved per drain/rebalance sweep (0 = all of them)
+    max_requests_per_sweep: int = 0
+
+    @field_validator("max_requests_per_sweep")
+    @classmethod
+    def _sweep(cls, v):
+        if v < 0:
+            raise ValueError(
+                "serving.migration.max_requests_per_sweep must be >= 0 "
+                f"(0 = move everything), got {v}")
+        return v
 
 
 class RouterConfig(DeepSpeedConfigModel):
@@ -366,6 +421,9 @@ class ServingConfig(DeepSpeedConfigModel):
     # ---- workload-replay defaults (None = no defaults; the replay
     # harness takes explicit arguments). Never touches the engines. ----
     replay: Optional[ReplayConfig] = None
+    # ---- live KV-block migration (None = migration does not exist:
+    # failover replays, drains wait, compiled HLO byte-identical) ----
+    migration: Optional[MigrationConfig] = None
 
     @field_validator("block_size", "decode_slots")
     @classmethod

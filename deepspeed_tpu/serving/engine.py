@@ -170,6 +170,9 @@ class ServingEngine:
         self._pf_next = 0  # round-robin cursor over prefilling slots
         self._chunk_fns: Dict[int, object] = {}
         self._cow_fn = None
+        # live KV migration import programs, one per covered-block count
+        # (built lazily — a fleet that never migrates compiles nothing)
+        self._migrate_fns: Dict[int, object] = {}
         # speculative decoding: host-side proposer + the ONE compiled
         # k-token verify program (replaces the decode program in the
         # step loop; None => the decode path is exactly as before)
@@ -370,6 +373,33 @@ class ServingEngine:
         donate = (0,) if self._jax.default_backend() != "cpu" else ()
         return self.engine.telemetry.watch_jit(
             jax.jit(fn, donate_argnums=donate), "serving.cow")
+
+    def _build_migrate(self, B: int):
+        """Scatter ``B`` migrated pool blocks (every cache leaf — K/V
+        pools and, under int8 KV, their scale side pools ride the same
+        block indices) onto this replica's pool at the freshly allocated
+        destination blocks. The import half of live KV migration: rows
+        land on exactly the pool rows every later ``paged_write_rows``/
+        paged-gather computation addresses through the rewritten block
+        table, so the resumed decode is bit-identical to never having
+        moved. Same axis convention as the cow program: pool leaves all
+        end in ``[num_blocks, block_size, H, *]`` (optional leading
+        scanned-layer axis), so the block axis is always ``ndim - 4``."""
+        jax, jnp = self._jax, self._jnp
+
+        def fn(cache, rows, dst):
+            def scatter(p, r):
+                ax = p.ndim - 4
+                pm = jnp.moveaxis(p, ax, 0)
+                rm = jnp.moveaxis(r, ax, 0)
+                return jnp.moveaxis(pm.at[dst].set(rm), 0, ax)
+
+            return jax.tree_util.tree_map(scatter, cache, rows)
+
+        donate = (0,) if self._jax.default_backend() != "cpu" else ()
+        return self.engine.telemetry.watch_jit(
+            jax.jit(fn, donate_argnums=donate),
+            f"serving.migrate[blocks={B}]")
 
     def _next_rng(self):
         self._rng, sub = self._jax.random.split(self._rng)
@@ -850,6 +880,189 @@ class ServingEngine:
         self._record(req, shed=True, began=True)
         return True
 
+    # ------------------------------------------------------------------
+    # live KV-block migration seams (serving/migration.py orchestrates;
+    # the router/fleet manager are the consumers — failover, drain and
+    # fragmentation rebalance move committed state instead of replaying)
+    def export_sequence(self, request_id: str) -> Optional[dict]:
+        """Snapshot one decode-ready sequence's committed state for
+        import on another replica: the request's identity and counters,
+        its pending last token, and the per-block KV rows of every cache
+        leaf (int8 side pools and their scales ride the same block
+        indices), gathered on the block axis and split into per-TP-shard
+        chunks along the head axis — the transfer unit PR 15's
+        head-sharded pools define. Read-only on the source (an open
+        speculative window is dropped first — it is uncommitted by
+        definition), so a transfer that dies downstream leaves this
+        replica able to keep decoding or to serve a replay. Returns None
+        when the request is not migratable (unknown, queued, or still
+        mid-prefill — those replay/resubmit cheaply)."""
+        raise_if("serving.migration.export", detail=request_id)
+        req = next((r for _, r in self.sched.running()
+                    if r.request_id == request_id), None)
+        if req is None or req.slot in self._prefilling or req.length <= 0:
+            return None
+        if self.block_mgr.speculating(request_id):
+            self.block_mgr.drop_speculative(request_id)
+        jax, jnp = self._jax, self._jnp
+        bs = self.config.block_size
+        covered = self.block_mgr.owned(request_id)[
+            :blocks_for_tokens(req.length, bs)]
+        tp = 1
+        try:
+            tp = int(dict(self.engine.mesh.shape).get("tp", 1))
+        except Exception:
+            tp = 1
+        leaves, treedef = jax.tree_util.tree_flatten(self.cache)
+        idx = jnp.asarray(np.asarray(covered, np.int32))
+        rows, wire_bytes = [], 0
+        for leaf in leaves:
+            r = np.asarray(jnp.take(leaf, idx, axis=leaf.ndim - 4))
+            h = r.ndim - 2
+            if tp > 1 and r.shape[h] % tp == 0:
+                chunks = [np.ascontiguousarray(c)
+                          for c in np.split(r, tp, axis=h)]
+            else:
+                chunks = [r]
+            wire_bytes += sum(c.nbytes for c in chunks)
+            rows.append(chunks)
+        return {
+            "request_id": req.request_id,
+            "prompt": list(req.prompt),
+            "tokens": list(req.tokens),
+            "max_new_tokens": int(req.max_new_tokens),
+            "eos_token_id": int(req.eos_token_id),
+            "deadline_ms": float(req.deadline_ms),
+            "length": int(req.length),
+            "last_token": int(self._last_tokens[req.slot]),
+            "do_sample": bool(self.config.do_sample),
+            "block_size": bs,
+            "kv_cache_dtype": self.config.kv_cache_dtype or None,
+            "tp_shards": tp,
+            "blocks": len(covered),
+            "rows": rows,
+            "treedef": str(treedef),
+            "wire_bytes": int(wire_bytes),
+            "draft_tokens": int(req.draft_tokens),
+            "accepted_tokens": int(req.accepted_tokens),
+        }
+
+    def import_sequence(self, export: Optional[dict],
+                        deadline_ms: Optional[float] = None,
+                        stream=None,
+                        request_id: Optional[str] = None,
+                        trace: Optional[dict] = None
+                        ) -> Optional[Request]:
+        """Splice an exported sequence into a free decode slot: allocate
+        blocks, scatter the migrated rows onto this pool at exactly the
+        rows every later ``paged_write_rows``-indexed program addresses
+        through the rewritten table, seed the request's token/sampling
+        counters, and resume decoding mid-stream — NO prefill program
+        dispatch. Returns None when the export cannot land here (pool
+        geometry/dtype/sampling mismatch, no free slot, duplicate id, or
+        not enough blocks) so the caller can fall back to replay. The
+        block table commit happens last: a fault before it (the
+        ``serving.migration.commit`` chaos seam) releases every block
+        this call allocated and leaves the scheduler untouched."""
+        if export is None:
+            return None
+        rid = request_id or export["request_id"]
+        if (export["block_size"] != self.config.block_size
+                or (export.get("kv_cache_dtype") or None)
+                != (self.config.kv_cache_dtype or None)
+                or bool(export["do_sample"]) != bool(self.config.do_sample)
+                or rid in self.sched._live_ids):
+            return None
+        slot = self.sched.free_slot()
+        if slot is None:
+            return None
+        jax, jnp = self._jax, self._jnp
+        mnt = int(export["max_new_tokens"]
+                  or self.config.default_max_new_tokens)
+        cost = len(export["prompt"]) + mnt
+        if (cost > self.max_len or int(export["length"]) > cost
+                or not self.block_mgr.can_allocate_shared(cost, (), None)):
+            return None
+        leaves, treedef = jax.tree_util.tree_flatten(self.cache)
+        if str(treedef) != export["treedef"]:
+            return None
+        now = self.clock()
+        req = Request(prompt=list(export["prompt"]),
+                      max_new_tokens=mnt, request_id=rid,
+                      eos_token_id=int(export["eos_token_id"]),
+                      deadline_ms=(deadline_ms if deadline_ms is not None
+                                   else export["deadline_ms"]),
+                      stream=stream)
+        # delivered prefix rides along verbatim — seeded directly, NOT
+        # via emit_token (the client already holds these tokens; the
+        # stream fires only for tokens decoded after the splice)
+        req.tokens = list(export["tokens"])
+        req.draft_tokens = int(export.get("draft_tokens") or 0)
+        req.accepted_tokens = int(export.get("accepted_tokens") or 0)
+        req.submit_ts = now
+        if req.tokens:
+            req.first_token_ts = now
+        # router-stamped trace context: the spliced request's replica
+        # spans join the CLIENT's trace under the migration attempt
+        req.trace = dict(trace) if trace is not None else None
+        table = self.block_mgr.allocate(rid, cost)
+        try:
+            B = int(export["blocks"])
+            if B:
+                rows_leaves = []
+                for chunks in export["rows"]:
+                    r = (chunks[0] if len(chunks) == 1 else np.concatenate(
+                        chunks, axis=chunks[0].ndim - 2))
+                    rows_leaves.append(jnp.asarray(r))
+                rows = jax.tree_util.tree_unflatten(treedef, rows_leaves)
+                if B not in self._migrate_fns:
+                    self._migrate_fns[B] = self._build_migrate(B)
+                dst = jnp.asarray(np.asarray(table[:B], np.int32))
+                self.cache = self._migrate_fns[B](self.cache, rows, dst)
+            raise_if("serving.migration.commit", detail=rid)
+            self.sched.splice(req, slot, now)
+        except Exception:
+            # rows already scattered are stale bytes in blocks the pool
+            # no longer maps — harmless; the scheduler never saw us
+            self.block_mgr.release(rid)
+            raise
+        req.length = int(export["length"])
+        self._tables[slot] = table
+        self._lengths[slot] = req.length
+        self._last_tokens[slot] = int(export["last_token"])
+        self.resilience.serving_request_begin()
+        self.telemetry.emit("serving", "request.migrated_in",
+                            step=self._step_count, request_id=rid,
+                            blocks=int(export["blocks"]),
+                            wire_bytes=int(export["wire_bytes"]),
+                            length=req.length)
+        return req
+
+    def migrate_out(self, request_id: str) -> bool:
+        """Detach a migrated-away request from this replica: free its
+        slot, blocks and token budget WITHOUT a shed record — the
+        request is still live, on another replica, in the same client
+        trace. Call only after the target committed its import."""
+        now = self.clock()
+        req = self.sched.migrate_out(request_id, now)
+        if req is None:
+            return False
+        if 0 <= req.slot < len(self._tables):
+            self._tables[req.slot] = 0
+            self._lengths[req.slot] = 0
+            self._last_tokens[req.slot] = 0
+            self._prefilling.pop(req.slot, None)
+            self._pf_tables.pop(req.slot, None)
+            self._pf_pos.pop(req.slot, None)
+        if self._tracer.enabled and req.trace is not None:
+            end_span(req.trace.pop("serve", None), end_ns=to_ns(now),
+                     state="migrated", tokens=len(req.tokens))
+        self.resilience.serving_request_abandon()
+        self.telemetry.emit("serving", "request.migrated_out",
+                            step=self._step_count, request_id=request_id,
+                            tokens=len(req.tokens))
+        return True
+
     def gauges(self) -> dict:
         """Instantaneous load gauges (queue depth, busy slots, free
         blocks): the payload of the per-step ``serving``/``step.gauges``
@@ -858,6 +1071,12 @@ class ServingEngine:
         g = {**self.sched.gauges(), "free_blocks": self.block_mgr.num_free}
         if self.prefix is not None:
             g["cached_blocks"] = self.block_mgr.num_cached
+        # decode-side fragmentation (same formula as the PR 14
+        # ds_kv_pool_fragmentation gauge): the rebalance trigger
+        committed = int(g.get("committed_tokens", 0))
+        capacity = self.block_mgr.num_allocated * self.config.block_size
+        g["kv_fragmentation"] = (round(1.0 - committed / capacity, 4)
+                                 if capacity else 0.0)
         return g
 
     @property
@@ -939,6 +1158,8 @@ class ServingEngine:
             "finished": s["finished"], "shed": s["shed"],
             "shed_reasons": dict(s["shed_reasons"]),
             "shed_rate": round(s["shed"] / total, 4),
+            "migrated_in": s["migrated_in"],
+            "migrated_out": s["migrated_out"],
             "queue_peak": s["queue_peak"],
             "decode_steps": self._step_count,
             "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 3)
@@ -956,6 +1177,7 @@ class ServingEngine:
         self._chunk_fns.clear()
         self._decode_fn = None
         self._cow_fn = None
+        self._migrate_fns.clear()
         self._verify_fn = None
         self.cache = None
         if self._owns_engine:

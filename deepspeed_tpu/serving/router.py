@@ -49,6 +49,7 @@ from deepspeed_tpu.serving.autoscaler import (SCALE_DOWN, SCALE_UP,
 from deepspeed_tpu.serving.config import FleetConfig, RouterConfig
 from deepspeed_tpu.serving.health import (DEAD, DEGRADED, DRAINING, HEALTHY,
                                           STATES, TRIPPED, ReplicaHealth)
+from deepspeed_tpu.serving.migration import Migrator, resolve_migration
 from deepspeed_tpu.telemetry.registry import NULL_REGISTRY
 from deepspeed_tpu.telemetry.tracing import (NULL_TRACER, end_span, span_id,
                                              to_ns, trace_ctx)
@@ -127,7 +128,7 @@ class _NullTelemetry:
 
 class ReplicaRouter:
     def __init__(self, replicas, config=None, clock=time.monotonic,
-                 telemetry=None):
+                 telemetry=None, migration=None):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         self.replicas = list(replicas)
@@ -146,6 +147,16 @@ class ReplicaRouter:
         # it through the context stamped on their proxy requests.
         self._tracer = (getattr(self.telemetry, "tracer", None)
                         or NULL_TRACER)
+        # live KV migration (serving.migration): move a failed/draining
+        # replica's in-flight state to a survivor instead of replaying
+        # it. None (the default) = migration does not exist — failover
+        # replays, drains wait, behavior byte-for-byte pre-migration.
+        self.migration = resolve_migration(migration)
+        self._migrator = (Migrator(
+            self.migration, tracer=self._tracer,
+            metrics=getattr(self.telemetry, "metrics", None), clock=clock)
+            if self.migration is not None and self.migration.enabled
+            else None)
         self.health = [ReplicaHealth(config, i, clock, emit=self._emit)
                        for i in range(len(self.replicas))]
         self.tier = 0
@@ -157,7 +168,8 @@ class ReplicaRouter:
         self._done_this_step: List[RouterRequest] = []
         self.finished = deque(maxlen=1024)
         self._counters = {"submitted": 0, "finished": 0, "shed": 0,
-                          "failovers": 0, "deduped_tokens": 0,
+                          "failovers": 0, "migrations": 0,
+                          "deduped_tokens": 0,
                           "replay_divergence": 0, "tier_transitions": 0,
                           "shed_reasons": {}}
 
@@ -523,18 +535,32 @@ class ReplicaRouter:
             self._failover_replica(idx, f"drain:{reason}")
 
     def _failover_replica(self, idx: int, reason: str):
-        """Reroute everything in flight on a tripped/dead replica.
-        Deterministic replay makes this transparent: the survivor
-        regenerates the greedy stream from the full prompt and the shim
-        dedupes already-delivered positions."""
+        """Reroute everything in flight on a tripped/dead replica. With
+        migration on and the source pool still readable (TRIPPED/stalled
+        /DRAINING — anything short of a hard crash) each sequence's
+        committed KV MOVES to a survivor and decoding resumes mid-stream
+        with zero prefill dispatches; otherwise deterministic replay
+        makes the reroute transparent: the survivor regenerates the
+        greedy stream from the full prompt and the shim dedupes
+        already-delivered positions."""
         rids = sorted(self._assigned[idx])
         self._assigned[idx].clear()
         self._probe_req.pop(idx, None)
         cancel = getattr(self.replicas[idx], "cancel", None)
         now = self.clock()
+        consumer = "drain" if reason.startswith("drain") else "failover"
         for rid in rids:
             rreq = self.requests.get(rid)
             if rreq is None:
+                continue
+            # migrate-first: a hard crash (DEAD — pool unreadable) keeps
+            # the replay path; anything else moves the state instead of
+            # redoing the work
+            mig = "off"
+            if self.health[idx].state != DEAD:
+                mig = self._migrate_request(rreq, idx, now, reason,
+                                            consumer=consumer)
+            if mig == "ok":
                 continue
             if rreq.proxy is not None and cancel is not None:
                 # best-effort: release the abandoned proxy's decode slot
@@ -555,12 +581,106 @@ class ReplicaRouter:
                 continue
             if rreq.tokens and self._sampling(idx):
                 # the delivered prefix was SAMPLED — no survivor can
-                # regenerate it bit-identically, so the splice contract
-                # is unsatisfiable: fail loudly instead of streaming a
-                # garbled continuation of a different sample
-                self._shed(rreq, "nondeterministic_replay")
+                # regenerate it bit-identically, so the replay-splice
+                # contract is unsatisfiable. With migration available
+                # the KV (and the sampling counters) would have MOVED;
+                # reaching here means the move was attempted and failed
+                # (`migration_failed` — a fault) or was never possible
+                # (`nondeterministic_replay` — policy): dashboards must
+                # tell the two apart, so shed loudly with the reason
+                # split instead of streaming a garbled continuation
+                self._shed(rreq, "migration_failed" if mig == "failed"
+                           else "nondeterministic_replay")
                 continue
             self._dispatch(rreq, now, exclude={idx})
+
+    def _migrate_request(self, rreq: RouterRequest, src_idx: int,
+                         now: float, reason: str,
+                         consumer: str = "failover") -> str:
+        """Try to MOVE one in-flight request's committed KV off
+        ``src_idx`` onto the best candidate replica. Returns ``"ok"``
+        (target committed; proxy/assignment/attempt subtree swapped),
+        ``"off"`` (migration disabled for ``consumer``, or structurally
+        impossible — no export/import surface, queued-only work, no
+        candidate), or ``"failed"`` (attempted, fell through — the
+        caller replays)."""
+        mig = self._migrator
+        if (mig is None or not mig.allows(consumer)
+                or rreq.proxy is None
+                or not hasattr(self.replicas[src_idx], "export_sequence")):
+            return "off"
+        deadline_ms = None
+        if rreq.deadline_ms:
+            # same contract as dispatch: the client's deadline does not
+            # restart on a move — hand the target the REMAINING budget
+            deadline_ms = rreq.deadline_ms - 1e3 * (now - rreq.submit_ts)
+            if deadline_ms <= 0:
+                return "off"  # the deadline sweep/shed path owns this
+        tgt = next((i for i in self._candidates(now, exclude={src_idx})
+                    if hasattr(self.replicas[i], "import_sequence")
+                    and self._sampling(i) == self._sampling(src_idx)),
+                   None)
+        if tgt is None:
+            return "off"
+        new_span = ictx = None
+        if self._tracer.enabled:
+            new_span = self._tracer.begin(
+                "attempt", rreq.trace_id, parent=span_id(rreq.root_span),
+                start_ns=to_ns(now), attempt=rreq.attempt + 1,
+                replica=tgt, migrated=True)
+            ictx = trace_ctx(rreq.trace_id, parent=span_id(new_span),
+                             attempt=rreq.attempt + 1)
+        info = mig.migrate(
+            self.replicas[src_idx], self.replicas[tgt],
+            rreq.proxy.request_id,
+            import_id=f"{rreq.request_id}#a{rreq.attempt + 1}",
+            deadline_ms=deadline_ms, stream=self._shim(rreq),
+            trace=rreq.trace_id, parent=span_id(rreq.root_span),
+            import_trace=ictx, src=src_idx, dst=tgt, reason=reason)
+        if info is None:
+            end_span(new_span, end_ns=to_ns(self.clock()),
+                     outcome="migrate_failed")
+            return "failed"
+        self._assigned[src_idx].discard(rreq.request_id)
+        if self._probe_req.get(src_idx) == rreq.request_id:
+            del self._probe_req[src_idx]
+        self._close_attempt(rreq, f"migrate:{reason}")
+        rreq.attempt += 1
+        rreq.proxy, rreq.replica = info["request"], tgt
+        rreq.state = rq.RUNNING if rreq.tokens else rq.QUEUED
+        self._assigned[tgt].add(rreq.request_id)
+        rreq.attempt_span = new_span
+        rreq.attempt_start_pos = len(rreq.tokens)
+        rreq.deliver_t0 = rreq.deliver_t1 = None
+        self._counters["migrations"] += 1
+        self._emit("migrate", request_id=rreq.request_id,
+                   from_replica=src_idx, to_replica=tgt, reason=reason,
+                   attempt=rreq.attempt, blocks=info["blocks"],
+                   wire_bytes=info["wire_bytes"],
+                   delivered=len(rreq.tokens))
+        return "ok"
+
+    def migrate_work(self, idx: int, reason: str = "drain",
+                     consumer: str = "drain", limit: int = 0) -> int:
+        """Migrate replica ``idx``'s in-flight work to survivors (the
+        fleet manager's drain-via-migration and rebalance entry point).
+        Returns how many requests moved; work that cannot move stays
+        put — the caller's fallback (drain timeout, yield) still owns
+        it. ``limit`` bounds one sweep (0 = everything)."""
+        if self._migrator is None or not self._migrator.allows(consumer):
+            return 0
+        moved = 0
+        now = self.clock()
+        for rid in sorted(self._assigned[idx]):
+            if limit and moved >= limit:
+                break
+            rreq = self.requests.get(rid)
+            if rreq is None or rreq.proxy is None:
+                continue
+            if self._migrate_request(rreq, idx, now, reason,
+                                     consumer=consumer) == "ok":
+                moved += 1
+        return moved
 
     # ------------------------------------------------------------------
     # soft health + degradation ladder
@@ -721,7 +841,8 @@ class ReplicaRouter:
         requests and health state are untouched."""
         self.finished.clear()
         self._counters = {"submitted": 0, "finished": 0, "shed": 0,
-                          "failovers": 0, "deduped_tokens": 0,
+                          "failovers": 0, "migrations": 0,
+                          "deduped_tokens": 0,
                           "replay_divergence": 0, "tier_transitions": 0,
                           "shed_reasons": {}}
 
@@ -737,6 +858,7 @@ class ReplicaRouter:
             "finished": s["finished"], "shed": s["shed"],
             "shed_reasons": dict(s["shed_reasons"]),
             "failovers": s["failovers"],
+            "migrations": s["migrations"],
             "deduped_tokens": s["deduped_tokens"],
             "replay_divergence": s["replay_divergence"],
             "tier_transitions": s["tier_transitions"],
@@ -846,6 +968,7 @@ class FleetManager:
         self._factory_fails = 0
         self._factory_next_step = 0
         self._last_step_ts = self.clock()
+        self._last_rebalance_step: Optional[int] = None
         self._counters = self._fresh_counters()
 
     @staticmethod
@@ -853,7 +976,8 @@ class FleetManager:
         return {"scale_ups": 0, "scale_downs": 0, "parks": 0,
                 "unparks": 0, "drains_cancelled": 0, "drains_lost": 0,
                 "drain_timeouts": 0, "factory_builds": 0,
-                "factory_failures": 0}
+                "factory_failures": 0, "drain_migrations": 0,
+                "rebalances": 0}
 
     # ------------------------------------------------------------------
     def _emit(self, name: str, **data):
@@ -936,6 +1060,7 @@ class FleetManager:
                 can_shrink=not self._draining)
             if decision is not None:
                 self._execute(decision)
+        self._maybe_rebalance()
         if self.telemetry.enabled:
             self._emit("fleet.gauges", **self.gauges())
             self._metrics_step(overload)
@@ -1004,6 +1129,17 @@ class FleetManager:
                 self._counters["drains_lost"] += 1
                 self._emit("drain.lost", replica=idx)
                 continue
+            if self.router.assigned(idx):
+                # drain-via-migration: MOVE the in-flight work to
+                # survivors instead of waiting it out — the timeout
+                # below demotes from the plan to the fallback. Work
+                # that cannot move (no capacity, mid-prefill, fault)
+                # stays put and keeps draining in place.
+                moved = self.router.migrate_work(idx, "drain")
+                if moved:
+                    self._counters["drain_migrations"] += moved
+                    self._emit("drain.migrated", replica=idx,
+                               moved=moved)
             if self.router.assigned(idx) == 0:
                 self._park(idx)
                 continue
@@ -1015,6 +1151,42 @@ class FleetManager:
                 self._counters["drain_timeouts"] += 1
                 self._emit("drain.timeout", replica=idx, steps=age)
                 self._park(idx)
+
+    def _maybe_rebalance(self):
+        """Migrate-based decode-side defragmentation: when the most
+        fragmented routable replica's ``kv_fragmentation`` gauge (the
+        PR 14 pool-waste signal — reserved-but-uncommitted token rows
+        over reserved capacity) crosses ``rebalance_fragmentation``,
+        move up to ``rebalance_max_requests`` of its sequences to
+        less-fragmented survivors, then cool down — one bounded sweep
+        per ``rebalance_cooldown_steps``, never a migration storm."""
+        c = self.config
+        if not c.rebalance_fragmentation:
+            return
+        if (self._last_rebalance_step is not None
+                and self._step_count - self._last_rebalance_step
+                < c.rebalance_cooldown_steps):
+            return
+        worst, frag = None, 0.0
+        for idx, h in enumerate(self.router.health):
+            if not h.routable or self.router.assigned(idx) == 0:
+                continue
+            f = float(self.router._gauges(idx).get("kv_fragmentation",
+                                                   0.0))
+            if f > frag:
+                worst, frag = idx, f
+        if worst is None or frag < c.rebalance_fragmentation:
+            return
+        # cooldown stamps on TRIGGER, not on success: a rebalance whose
+        # every move fell through must back off, not hammer every step
+        self._last_rebalance_step = self._step_count
+        moved = self.router.migrate_work(
+            worst, "rebalance", consumer="rebalance",
+            limit=c.rebalance_max_requests)
+        if moved:
+            self._counters["rebalances"] += moved
+            self._emit("rebalance", replica=worst,
+                       fragmentation=round(frag, 4), moved=moved)
 
     def _park(self, idx: int):
         self._draining.pop(idx, None)

@@ -62,7 +62,8 @@ class ContinuousBatchingScheduler:
     @staticmethod
     def _fresh_stats():
         return {"submitted": 0, "admitted": 0, "finished": 0,
-                "shed": 0, "shed_reasons": {}, "queue_peak": 0}
+                "shed": 0, "shed_reasons": {}, "queue_peak": 0,
+                "migrated_in": 0, "migrated_out": 0}
 
     def reset_stats(self):
         """Zero the counters (a bench epoch boundary); queue/slots/block
@@ -281,6 +282,79 @@ class ContinuousBatchingScheduler:
                 self.committed_tokens -= self._cost(r)
                 self._live_ids.discard(request_id)
                 self._shed(r, reason, now)
+                return r
+        return None
+
+    # ------------------------------------------------------------------
+    # live KV-block migration seams (serving/migration.py)
+    def free_slot(self) -> Optional[int]:
+        """Lowest free decode slot index, or None when all are busy —
+        the import-side capacity probe (a migrated-in request bypasses
+        the queue: it is already mid-decode, so it needs a slot NOW or
+        the migration does not happen)."""
+        for slot, r in enumerate(self.slots):
+            if r is None:
+                return slot
+        return None
+
+    def splice(self, req: rq.Request, slot: int,
+               now: Optional[float] = None):
+        """Register a migrated-in request directly into a free decode
+        slot, mid-stream: no queue pass, no prefill — its KV blocks were
+        already scattered into the pool and its table allocated by the
+        engine's import path. Mirrors admission's accounting (committed
+        tokens, live ids) so finish/cancel/migrate-out release exactly
+        what admission-or-splice reserved."""
+        now = self.clock() if now is None else now
+        if self.slots[slot] is not None:
+            raise ValueError(f"splice into busy slot {slot}")
+        if req.request_id in self._live_ids:
+            raise ValueError(f"splice of live id {req.request_id!r}")
+        if req.max_new_tokens <= 0:
+            req.max_new_tokens = self.config.default_max_new_tokens
+        self.committed_tokens += self._cost(req)
+        self._live_ids.add(req.request_id)
+        req.state = rq.RUNNING
+        req.slot = slot
+        req.admit_ts = now
+        self.slots[slot] = req
+        self.stats["migrated_in"] += 1
+        if self.tracer.enabled:
+            # continue the request's ONE trace on this replica: a fresh
+            # `serve` root under the router-stamped parent (the queue leg
+            # is skipped — a spliced request never queued here)
+            if req.trace is None:
+                req.trace = {"trace": self.tracer.new_trace(
+                    hint=req.request_id)}
+            if "serve_id" not in req.trace:
+                h = self.tracer.begin(
+                    "serve", req.trace["trace"],
+                    parent=req.trace.get("parent"), start_ns=to_ns(now),
+                    request_id=req.request_id,
+                    attempt=req.trace.get("attempt", 0))
+                req.trace["serve"] = h
+                req.trace["serve_id"] = h.span
+
+    def migrate_out(self, request_id: str,
+                    now: Optional[float] = None) -> Optional[rq.Request]:
+        """Release a RUNNING request's slot + blocks + token budget after
+        its state committed on a migration target. NOT a shed (no shed
+        stats, no shed span — the request lives on, elsewhere) and not a
+        finish: the terminal state here is ``shed``/``migrated`` purely
+        so the abandoned source proxy reads as done to anything still
+        holding it. Returns the request, or None when the id is not
+        running here (queued requests migrate by plain resubmit)."""
+        now = self.clock() if now is None else now
+        for slot, r in self.running():
+            if r.request_id == request_id:
+                self.slots[slot] = None
+                self.blocks.release(request_id)
+                self.committed_tokens -= self._cost(r)
+                self._live_ids.discard(request_id)
+                r.state = rq.SHED
+                r.finish_reason = "migrated"
+                r.finish_ts = now
+                self.stats["migrated_out"] += 1
                 return r
         return None
 

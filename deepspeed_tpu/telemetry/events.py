@@ -83,6 +83,9 @@ SPANS = (
     "shed",           # admission/deadline shed (zero-work terminal span)
     "autoscale",      # one fleet scaling action: decision -> executed
     #                   (attrs: action, reason, from_size, to_size, source)
+    "migrate",        # one live KV-block migration: export -> transfer ->
+    #                   import-commit (attrs: src, dst, reason, outcome,
+    #                   blocks, wire_bytes)
     # training step level: one trace per optimizer step
     "step",           # root — first observed phase -> step boundary
     "data",           # host-side batch fetch/assembly

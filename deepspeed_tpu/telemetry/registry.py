@@ -138,6 +138,19 @@ NAMES = {
                  "fast|slow); 1.0 = spending exactly the budget"),
     "ds_fleet_scale_events_total": (
         "counter", "autoscaler scaling actions executed, by action"),
+    "ds_migration_attempts_total": (
+        "counter", "live KV migration attempts, by outcome (ok|"
+                   "no_surface|export_none|import_none|error)"),
+    "ds_migration_fallbacks_total": (
+        "counter", "migrations that fell through to replay/drain-wait"),
+    "ds_migration_blocks_moved_total": (
+        "counter", "KV pool blocks moved by committed migrations"),
+    "ds_migration_wire_bytes_total": (
+        "counter", "bytes of KV rows (all cache leaves) moved by "
+                   "committed migrations"),
+    "ds_migration_stall_ms": (
+        "histogram", "host walltime of one migration attempt, export "
+                     "through source detach"),
 }
 
 # the label set a family folds excess cardinality into

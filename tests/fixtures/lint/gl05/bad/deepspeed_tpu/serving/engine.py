@@ -23,3 +23,7 @@ class ServingEngine:
         self._tracer.record_span("drafts", "t1", 0, 1)       # near-miss
         with self._tracer.span("commit", "t1"):              # unregistered
             pass
+
+    def migrate_step(self):
+        # migration near-miss: the registered name is `migrate`
+        self._tracer.record_span("migrat", "t1", 0, 1)       # near-miss

@@ -9,4 +9,4 @@ def make_event(kind, name, step, rank, data):
 
 
 SPANS = ("request", "queue", "decode", "draft", "verify",
-         "spec_commit")
+         "spec_commit", "migrate")

@@ -27,3 +27,7 @@ class ServingEngine:
         self._tracer.record_span("verify", "t1", 0, 1)
         with self._tracer.span("spec_commit", "t1"):
             pass
+
+    def migrate_step(self):
+        # live KV migration's registered span name
+        self._tracer.record_span("migrate", "t1", 0, 1)

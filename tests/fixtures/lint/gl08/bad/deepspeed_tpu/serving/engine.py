@@ -17,3 +17,8 @@ class ServingEngine:
         # ds_slo_burn_rate — drift stays pinned
         self._metrics.gauge("ds_slo_burnrate", ("slo",)).labels(
             slo="ttft").set(1.0)
+
+    def migrate(self):
+        # near-miss on the migration family: the registered name is
+        # ds_migration_attempts_total — drift stays pinned
+        self._metrics.counter("ds_migration_attempt_total").inc()

@@ -6,4 +6,6 @@ NAMES = {
     "ds_serving_ttft_ms": ("histogram", "time to first token (ms)"),
     "ds_fleet_overload": ("gauge", "router overload score"),
     "ds_slo_burn_rate": ("gauge", "error-budget burn rate"),
+    "ds_migration_attempts_total": ("counter",
+                                    "live KV migration attempts"),
 }

@@ -29,6 +29,7 @@ import sys
 import numpy as np
 import pytest
 
+from deepspeed_tpu.runtime.resilience import chaos
 from deepspeed_tpu.runtime.resilience.chaos import (ChaosIOError,
                                                     ChaosReplica,
                                                     FlakyFactory)
@@ -131,6 +132,74 @@ class GaugeStub(FakeReplica):
         return g
 
 
+class MigratableReplica(FakeReplica):
+    """FakeReplica plus the engine's live-migration surface (the
+    test_router.py twin): export hands out the host-visible sequence
+    state with block/wire accounting, import SEEDS the delivered prefix
+    without re-emitting it, migrate_out detaches the source copy."""
+
+    block_size = 8
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.imports = self.outs = 0
+
+    def export_sequence(self, request_id):
+        req = next((r for r in self.running
+                    if r.request_id == request_id), None)
+        if req is None:
+            return None
+        covered = len(req.prompt) + len(req.tokens)
+        blocks = max(1, -(-covered // self.block_size))
+        return {"request_id": req.request_id, "prompt": list(req.prompt),
+                "tokens": list(req.tokens),
+                "max_new_tokens": req.max_new_tokens,
+                "eos_token_id": req.eos_token_id,
+                "deadline_ms": req.deadline_ms,
+                "blocks": blocks, "wire_bytes": 512 * blocks}
+
+    def import_sequence(self, export, deadline_ms=None, stream=None,
+                        request_id=None, trace=None):
+        if len(self.running) >= self.slots:
+            return None
+        self.imports += 1
+        req = rq.Request(prompt=list(export["prompt"]),
+                         max_new_tokens=int(export["max_new_tokens"]),
+                         request_id=request_id or export["request_id"],
+                         eos_token_id=export["eos_token_id"],
+                         deadline_ms=(export["deadline_ms"]
+                                      if deadline_ms is None
+                                      else deadline_ms),
+                         stream=stream)
+        req.tokens = list(export["tokens"])  # seeded, NOT re-emitted
+        req.state = rq.RUNNING
+        self.running.append(req)
+        return req
+
+    def migrate_out(self, request_id):
+        req = next((r for r in self.running
+                    if r.request_id == request_id), None)
+        if req is None:
+            return False
+        req.state, req.finish_reason = rq.SHED, "migrated"
+        self.running.remove(req)
+        self.outs += 1
+        return True
+
+
+class FragStub(MigratableReplica):
+    """Fragmentation dial for the migrate-based rebalance legs."""
+
+    def __init__(self, frag=0.0, **kw):
+        super().__init__(**kw)
+        self.frag = frag
+
+    def gauges(self):
+        g = super().gauges()
+        g["kv_fragmentation"] = self.frag
+        return g
+
+
 class FakeTelemetry:
     enabled = True
 
@@ -147,13 +216,13 @@ class FakeTelemetry:
 
 
 def _fleet(replicas, clock=None, telemetry=None, factory=None,
-           capacity=None, router_cfg=None, **cfg):
+           capacity=None, router_cfg=None, migration=None, **cfg):
     clock = clock or ReplayClock()
     router = ReplicaRouter(replicas,
                            config={"failure_threshold": 3,
                                    **(router_cfg or {})},
                            clock=clock, telemetry=telemetry
-                           or FakeTelemetry())
+                           or FakeTelemetry(), migration=migration)
     cfg.setdefault("min_replicas", 1)
     cfg.setdefault("max_replicas", 4)
     return FleetManager(router, factory=factory, config=cfg,
@@ -1106,6 +1175,151 @@ class TestChaosDuringScaling:
         assert st["drain_timeouts"] == 1 and st["parks"] == 1
         assert telem.of("drain.timeout", kind="fleet")
         assert not fm.pending
+
+
+# ---------------------------------------------------------------------------
+# live KV migration: drain-via-migration + migrate-based rebalance
+# ---------------------------------------------------------------------------
+class TestFleetMigration:
+    """The fleet manager's two migration consumers: scale-down drains
+    MOVE in-flight work to survivors (``drain_timeout_steps`` demotes to
+    the fallback), and the ``kv_fragmentation`` gauge triggers bounded
+    migrate-based rebalance sweeps."""
+
+    @pytest.fixture(autouse=True)
+    def _no_chaos_leak(self):
+        yield
+        chaos.clear()
+
+    def test_drain_migrates_work_then_parks_without_timeout(self):
+        telem = FakeTelemetry()
+        fm, _ = _fleet([MigratableReplica(), MigratableReplica()],
+                       telemetry=telem, migration={"enabled": True},
+                       drain_timeout_steps=50)
+        streams = []
+        r = fm.submit([1, 2], max_new_tokens=6,
+                      stream=lambda rr, t, d: streams.append(t))
+        assert r.replica == 0
+        fm.step()                          # running, one token delivered
+        fm.scale_down(0)
+        fm.drain(max_steps=30)
+        expected = [_greedy([1, 2], p) for p in range(6)]
+        assert r.state == rq.FINISHED and r.replica == 1
+        # the stream continued mid-sequence on the survivor: each
+        # position exactly once, nothing replayed, nothing lost
+        assert r.tokens == expected and streams == expected
+        st = fm.stats()
+        assert st["drain_migrations"] == 1
+        assert st["drain_timeouts"] == 0   # the timeout stayed a fallback
+        assert st["parks"] == 1            # drained slot parked at once
+        assert telem.of("drain.migrated", kind="fleet")
+        assert fm.router.stats()["migrations"] == 1
+
+    def test_drain_falls_back_to_timeout_when_move_impossible(self):
+        """A draining replica with NO export surface cannot migrate:
+        the wedged-drain timeout keeps the scale-down from deadlocking
+        exactly as before migration existed."""
+        fm, _ = _fleet([StuckReplica(), MigratableReplica()],
+                       migration={"enabled": True}, drain_timeout_steps=3)
+        r = fm.submit([1, 2], max_new_tokens=3)
+        assert r.replica == 0
+        fm.scale_down(0)
+        fm.drain(max_steps=30)
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert r.tokens == [_greedy([1, 2], p) for p in range(3)]
+        st = fm.stats()
+        assert st["drain_migrations"] == 0 and st["drain_timeouts"] == 1
+
+    def test_crash_during_drain_migration_falls_back_exactly_once(self):
+        """Chaos kill between the drain sweep's export and the target
+        commit: the move aborts with the source untouched, the crash
+        then surfaces as a real DEAD verdict, and the router's replay
+        finishes the stream bit-identical with exactly-once delivery."""
+        telem = FakeTelemetry()
+        fm, _ = _fleet(
+            [ChaosReplica(MigratableReplica(), crash_during_migration=1),
+             MigratableReplica()],
+            telemetry=telem, migration={"enabled": True},
+            drain_timeout_steps=5)
+        streams = []
+        r = fm.submit([1, 2], max_new_tokens=6,
+                      stream=lambda rr, t, d: streams.append(t))
+        assert r.replica == 0
+        fm.step()                          # one token delivered pre-drain
+        fm.scale_down(0)
+        fm.drain(max_steps=40)
+        expected = [_greedy([1, 2], p) for p in range(6)]
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert r.tokens == expected and streams == expected
+        st = fm.stats()
+        assert st["drain_migrations"] == 0
+        assert st["drains_lost"] == 1      # the crash was a real death
+        assert telem.of("drain.lost", kind="fleet")
+        assert fm.router.replicas[1].imports == 0
+
+    def test_rebalance_moves_work_off_fragmented_replica(self):
+        telem = FakeTelemetry()
+        fm, _ = _fleet([FragStub(frag=0.8), FragStub(frag=0.1)],
+                       telemetry=telem, migration={"enabled": True},
+                       rebalance_fragmentation=0.5,
+                       rebalance_cooldown_steps=4)
+        r1 = fm.submit([1, 2], max_new_tokens=8)
+        r2 = fm.submit([3], max_new_tokens=8)
+        assert r1.replica == 0 and r2.replica == 1
+        fm.step()
+        st = fm.stats()
+        assert st["rebalances"] == 1
+        ev = telem.of("rebalance", kind="fleet")
+        assert ev and ev[0]["data"]["replica"] == 0
+        assert ev[0]["data"]["fragmentation"] == pytest.approx(0.8)
+        assert fm.router.assigned(0) == 0 and fm.router.assigned(1) == 2
+        fm.drain(max_steps=30)
+        assert r1.state == rq.FINISHED and r1.replica == 1
+        assert r1.tokens == [_greedy([1, 2], p) for p in range(8)]
+        assert r2.state == rq.FINISHED
+
+    def test_rebalance_cooldown_and_limit_bound_the_sweep(self):
+        """One bounded sweep per cooldown window, never a migration
+        storm: with two sequences on the fragmented replica and
+        ``rebalance_max_requests: 1``, exactly one moves."""
+        fm, _ = _fleet([FragStub(frag=0.9), FragStub(frag=0.0)],
+                       migration={"enabled": True},
+                       rebalance_fragmentation=0.5,
+                       rebalance_cooldown_steps=100,
+                       rebalance_max_requests=1)
+        r1 = fm.submit([1, 2], max_new_tokens=12)
+        r2 = fm.submit([3, 4], max_new_tokens=12)
+        r3 = fm.submit([5], max_new_tokens=12)
+        assert (r1.replica, r2.replica, r3.replica) == (0, 1, 0)
+        fm.drain(max_steps=40)
+        st = fm.stats()
+        assert st["rebalances"] == 1       # limit 1, then cooldown holds
+        assert fm.router.stats()["migrations"] == 1
+        for r in (r1, r2, r3):
+            assert r.state == rq.FINISHED
+
+    def test_rebalance_respects_consumer_gate(self):
+        """`rebalance: false` turns only that consumer off — work stays
+        put and finishes in place."""
+        fm, _ = _fleet([FragStub(frag=0.9), FragStub(frag=0.0)],
+                       migration={"enabled": True, "rebalance": False},
+                       rebalance_fragmentation=0.5)
+        r = fm.submit([1, 2], max_new_tokens=4)
+        fm.step()
+        assert fm.stats()["rebalances"] == 0
+        assert fm.router.assigned(0) == 1
+        fm.drain(max_steps=20)
+        assert r.state == rq.FINISHED and r.replica == 0
+
+    def test_rebalance_off_by_default(self):
+        """`rebalance_fragmentation: 0` (the default) never sweeps,
+        even with migration on and a fragmented replica."""
+        fm, _ = _fleet([FragStub(frag=0.9), FragStub(frag=0.0)],
+                       migration={"enabled": True})
+        r = fm.submit([1, 2], max_new_tokens=4)
+        fm.drain(max_steps=20)
+        assert fm.stats()["rebalances"] == 0
+        assert r.state == rq.FINISHED and r.replica == 0
 
 
 # ---------------------------------------------------------------------------

@@ -160,7 +160,7 @@ class TestGL05:
                  if "unregistered span name" in f.message]
         names = {f.message.split("'")[1] for f in found}
         assert names == {"prefil", "dequeue", "warmup", "fwdbwd",
-                         "drafts", "commit"}
+                         "drafts", "commit", "migrat"}
         assert all("request, queue, decode, draft, verify, spec_commit"
                    in f.message for f in found)
 
@@ -229,9 +229,9 @@ class TestGL08:
         msgs = " | ".join(f.message for f in found)
         for name in ("ds_step_total", "ds_fleet_overlod",
                      "ds_serving_ttft_millis", "ds_decode_stats_total",
-                     "ds_slo_burnrate"):
+                     "ds_slo_burnrate", "ds_migration_attempt_total"):
             assert name in msgs, f"GL08 missed {name!r}"
-        assert len(found) == 5
+        assert len(found) == 6
 
     def test_registered_dynamic_and_non_registry_shapes_are_legal(self):
         """Registered literals pass; dynamic names are the wrapper's

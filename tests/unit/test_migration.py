@@ -1,0 +1,637 @@
+"""Live KV-block migration (serving/migration.py + the engine seams).
+
+Host tier (tier-1, no jax):
+
+- config: the ``serving.migration`` block's defaults/validation and
+  ``resolve_migration``;
+- :class:`Migrator` orchestration: every outcome of the
+  export -> transfer -> import -> detach chain, the commit contract
+  (None ALWAYS means the source was not detached), consumer gating,
+  the ``migrate`` span and the ``ds_migration_*`` metric family;
+- the PR 6/7/12 randomized accounting fuzz extended with
+  export/import/migrate-cancel ops across TWO ``BlockManager``s —
+  refcount / free-list / evictable / spec-ledger / ``committed_tokens``
+  mutual consistency on BOTH sides, with migration dropping any open
+  speculative window first.
+
+Device tier (heavy, real tiny engines): export/import round-trip
+bit-identity with zero prefill dispatches on the target, refusal paths,
+the commit-seam chaos contract (target allocation released, source
+able to finish), per-block-count program caching, int8 wire-bytes cut,
+and the zero-overhead HLO pin (a migration block compiles the exact
+same decode program as none).
+
+The router/fleet consumers' chaos legs live in tests/unit/
+test_router.py and tests/unit/test_fleet.py.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.resilience import chaos
+from deepspeed_tpu.runtime.resilience.chaos import (ChaosIOError,
+                                                    ChaosReplica,
+                                                    ReplicaCrashed)
+from deepspeed_tpu.serving.blocks import BlockManager
+from deepspeed_tpu.serving.config import MigrationConfig, ServingConfig
+from deepspeed_tpu.serving.migration import Migrator, resolve_migration
+from deepspeed_tpu.serving.prefix_cache import PrefixCache
+from deepspeed_tpu.serving.request import Request
+from deepspeed_tpu.serving.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.telemetry.registry import MetricRegistry
+import deepspeed_tpu.serving.request as rq
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos_leak():
+    yield
+    chaos.clear()
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+class TestMigrationConfig:
+    def test_defaults_every_consumer_on(self):
+        c = MigrationConfig()
+        assert c.enabled and c.failover and c.drain and c.rebalance
+        assert c.max_requests_per_sweep == 0
+
+    def test_serving_block_round_trip(self):
+        s = ServingConfig(block_size=8, migration={"rebalance": False})
+        assert s.migration is not None and s.migration.enabled
+        assert not s.migration.rebalance
+        assert ServingConfig(block_size=8).migration is None
+
+    def test_negative_sweep_cap_rejected(self):
+        with pytest.raises(Exception):
+            MigrationConfig(max_requests_per_sweep=-1)
+
+    def test_resolve_migration(self):
+        assert resolve_migration(None) is None
+        c = resolve_migration({"enabled": False})
+        assert isinstance(c, MigrationConfig) and not c.enabled
+        assert resolve_migration(c) is c
+
+
+# ---------------------------------------------------------------------------
+# Migrator orchestration (fake replicas: the seam contract, not the KV)
+# ---------------------------------------------------------------------------
+class _Source:
+    """Export/detach surface; records whether detach ever ran."""
+
+    def __init__(self, export=None, raise_on_export=None):
+        self._export = export
+        self._raise = raise_on_export
+        self.detached = []
+
+    def export_sequence(self, request_id):
+        if self._raise is not None:
+            raise self._raise
+        return self._export
+
+    def migrate_out(self, request_id):
+        self.detached.append(request_id)
+        return True
+
+
+class _Target:
+    def __init__(self, accept=True, raise_on_import=None):
+        self.accept = accept
+        self._raise = raise_on_import
+        self.imported = []
+
+    def import_sequence(self, export, deadline_ms=None, stream=None,
+                        request_id=None, trace=None):
+        if self._raise is not None:
+            raise self._raise
+        if not self.accept:
+            return None
+        req = Request(prompt=list(export["prompt"]),
+                      max_new_tokens=export["max_new_tokens"],
+                      request_id=request_id or export["request_id"],
+                      stream=stream)
+        req.tokens = list(export["tokens"])
+        self.imported.append(req)
+        return req
+
+
+def _export(rid="r-1", blocks=3, wire=3 * 512):
+    return {"request_id": rid, "prompt": [1, 2, 3], "tokens": [7, 8],
+            "max_new_tokens": 6, "eos_token_id": -1, "deadline_ms": 0.0,
+            "length": 4, "last_token": 8, "do_sample": False,
+            "block_size": 8, "kv_cache_dtype": None, "tp_shards": 1,
+            "blocks": blocks, "rows": [], "treedef": "t",
+            "wire_bytes": wire, "draft_tokens": 0, "accepted_tokens": 0}
+
+
+def _attempts(reg):
+    fam = reg.snapshot().get("ds_migration_attempts_total")
+    if fam is None:
+        return {}
+    return {row["labels"]["outcome"]: row["value"]
+            for row in fam["series"]}
+
+
+class TestMigrator:
+    def _mig(self, **cfg):
+        reg = MetricRegistry()
+        clk = _Clock()
+        m = Migrator(MigrationConfig(**cfg), metrics=reg, clock=clk)
+        return m, reg, clk
+
+    def test_ok_commits_then_detaches_source(self):
+        m, reg, clk = self._mig()
+        src, tgt = _Source(export=_export()), _Target()
+        clk.t = 1.0
+        info = m.migrate(src, tgt, "r-1", import_id="r-1#a1")
+        assert info is not None and info["outcome"] == "ok"
+        assert info["blocks"] == 3 and info["wire_bytes"] == 1536
+        assert info["request"] is tgt.imported[0]
+        assert info["request"].request_id == "r-1#a1"
+        assert info["request"].tokens == [7, 8]   # prefix rode along
+        assert src.detached == ["r-1"]            # detach AFTER commit
+        assert _attempts(reg) == {"ok": 1}
+        snap = reg.snapshot()
+        assert snap["ds_migration_blocks_moved_total"]["series"][0][
+            "value"] == 3
+        assert snap["ds_migration_wire_bytes_total"]["series"][0][
+            "value"] == 1536
+        assert snap["ds_migration_stall_ms"]["series"][0]["count"] == 1
+        assert "ds_migration_fallbacks_total" not in snap
+
+    def test_no_surface_and_export_none_fall_back(self):
+        m, reg, _ = self._mig()
+        assert m.migrate(object(), _Target(), "r-1") is None
+        assert m.migrate(_Source(export=None), _Target(), "r-1") is None
+        assert _attempts(reg) == {"no_surface": 1, "export_none": 1}
+        assert reg.snapshot()["ds_migration_fallbacks_total"]["series"][
+            0]["value"] == 2
+
+    def test_import_declined_leaves_source_attached(self):
+        m, reg, _ = self._mig()
+        src = _Source(export=_export())
+        assert m.migrate(src, _Target(accept=False), "r-1") is None
+        assert src.detached == []               # the replay path owns it
+        assert _attempts(reg) == {"import_none": 1}
+
+    def test_exception_anywhere_is_error_not_detach(self):
+        m, reg, _ = self._mig()
+        dead = _Source(raise_on_export=ReplicaCrashed("chaos"))
+        assert m.migrate(dead, _Target(), "r-1") is None
+        assert dead.detached == []
+        src = _Source(export=_export())
+        assert m.migrate(src, _Target(raise_on_import=RuntimeError("x")),
+                         "r-1") is None
+        assert src.detached == []
+        assert _attempts(reg) == {"error": 2}
+
+    def test_flaky_transfer_seam_fires_between_export_and_import(self):
+        m, reg, _ = self._mig()
+        src, tgt = _Source(export=_export()), _Target()
+        chaos.io_errors("serving.migration.transfer", at_call=1)
+        assert m.migrate(src, tgt, "r-1") is None
+        assert tgt.imported == [] and src.detached == []
+        assert _attempts(reg) == {"error": 1}
+        # the fault was one-shot: the retry goes through
+        assert m.migrate(src, tgt, "r-1") is not None
+        assert src.detached == ["r-1"]
+
+    def test_consumer_gates(self):
+        m, _, _ = self._mig(drain=False)
+        assert m.enabled
+        assert m.allows("failover") and m.allows("rebalance")
+        assert not m.allows("drain")
+        assert not m.allows("bogus")
+        off = Migrator(MigrationConfig(enabled=False))
+        assert not off.enabled and not off.allows("failover")
+        absent = Migrator(None)
+        assert not absent.enabled and not absent.allows("failover")
+
+    def test_migrate_span_in_the_request_trace(self):
+        class Tracer:
+            enabled = True
+
+            def __init__(self):
+                self.spans = []
+
+            def record_span(self, name, trace, start_ns, end_ns,
+                            parent=None, **attrs):
+                self.spans.append((name, trace, parent, attrs))
+
+        tr = Tracer()
+        m = Migrator(MigrationConfig(), tracer=tr)
+        m.migrate(_Source(export=_export()), _Target(), "r-1",
+                  trace="t-1", parent="sp-9", src=0, dst=1,
+                  reason="failover")
+        m.migrate(_Source(export=None), _Target(), "r-2", trace="t-2")
+        assert [s[0] for s in tr.spans] == ["migrate", "migrate"]
+        name, trace, parent, attrs = tr.spans[0]
+        assert trace == "t-1" and parent == "sp-9"
+        assert attrs["src"] == 0 and attrs["dst"] == 1
+        assert attrs["outcome"] == "ok" and attrs["blocks"] == 3
+        assert tr.spans[1][3]["outcome"] == "export_none"
+
+
+# ---------------------------------------------------------------------------
+# randomized fuzz: export/import/migrate-cancel across TWO managers
+# ---------------------------------------------------------------------------
+class TestTwoManagerMigrationFuzz:
+    """The PR 6/7/12 accounting fuzz extended with migration ops across
+    two scheduler+BlockManager pairs: a committed move splices on the
+    target and detaches on the source; a cancelled move releases the
+    target's allocation and leaves the source untouched; migrating a
+    sequence with an open speculative window drops the window first.
+    Host-only, tier-1."""
+
+    def _invariants(self, sched, blocks, prefix):
+        live = list(sched.queue) + [r for r in sched.slots if r is not None]
+        assert sched.committed_tokens == sum(
+            r.prompt_len + r.max_new_tokens for r in live)
+        assert sched._live_ids == {r.request_id for r in live}
+        free = set(blocks._free)
+        evictable = set(blocks._evictable)
+        referenced = set(blocks._ref)
+        assert not (free & evictable) and not (free & referenced) \
+            and not (evictable & referenced)
+        assert free | evictable | referenced == \
+            set(range(1, blocks.num_blocks))
+        expect = {}
+        for blocks_list in blocks._owned.values():
+            for b in blocks_list:
+                expect[b] = expect.get(b, 0) + 1
+        for b in blocks._cow_pending.values():
+            expect[b] = expect.get(b, 0) + 1
+        assert blocks._ref == expect
+        assert evictable <= blocks._cached
+        assert set(prefix._by_block) == blocks._cached
+        assert set(blocks._owned) == {
+            r.request_id for r in sched.slots if r is not None}
+        assert set(blocks._spec_base) <= set(blocks._owned)
+
+    def _migrate(self, src, dst, rng, clk, cancel=False):
+        """One export/import walk against the real scheduler seams,
+        mirroring the engine's order of operations: spec-window drop ->
+        target capacity probe -> target allocate -> (cancel: release |
+        commit: splice then detach the source)."""
+        sched_s, blocks_s, _ = src
+        sched_d, blocks_d, _ = dst
+        running = [r for r in sched_s.slots if r is not None]
+        if not running:
+            return
+        r = running[int(rng.integers(len(running)))]
+        # export drops an open speculative window: uncommitted by
+        # definition, and the target only receives committed state
+        if blocks_s.speculating(r.request_id):
+            blocks_s.drop_speculative(r.request_id)
+        cost = r.prompt_len + r.max_new_tokens
+        slot = sched_d.free_slot()
+        if (slot is None or r.request_id in sched_d._live_ids
+                or not blocks_d.can_allocate_shared(cost, (), None)):
+            return
+        blocks_d.allocate(r.request_id, cost)
+        if cancel:
+            # fault between allocation and table commit: the target
+            # releases everything, the source never knows
+            blocks_d.release(r.request_id)
+            return
+        r2 = Request(prompt=list(r.prompt),
+                     max_new_tokens=r.max_new_tokens,
+                     request_id=r.request_id,
+                     eos_token_id=r.eos_token_id)
+        r2.tokens = list(r.tokens)
+        sched_d.splice(r2, slot, now=clk.t)
+        r2.length = r.length
+        out = sched_s.migrate_out(r.request_id, now=clk.t)
+        assert out is r and r.state == rq.SHED
+        assert r.finish_reason == "migrated"
+
+    def test_random_walk_across_two_managers(self):
+        rng = np.random.default_rng(23)
+        clk = _Clock()
+        sides = []
+        for _ in range(2):
+            cfg = ServingConfig(block_size=8, decode_slots=2,
+                                max_queue_depth=6, deadline_ms=200.0,
+                                default_max_new_tokens=4,
+                                prefix_cache=True,
+                                speculative={"num_speculative_tokens": 4})
+            blocks = BlockManager(14, cfg.block_size, 10)
+            prefix = PrefixCache(blocks)
+            sides.append((ContinuousBatchingScheduler(
+                cfg, blocks, max_len=64, clock=clk, prefix_cache=prefix),
+                blocks, prefix))
+        families = [list(rng.integers(1, 99, 40)) for _ in range(3)]
+        next_id = 0
+        for step in range(1200):
+            side = int(rng.integers(2))
+            sched, blocks, prefix = sides[side]
+            op = rng.choice(["submit", "admit", "speculate", "commit",
+                             "drop", "finish", "cancel", "tick",
+                             "migrate", "migrate_cancel"])
+            running = [r for r in sched.slots if r is not None]
+            if op == "submit":
+                fam = families[int(rng.integers(len(families)))]
+                cut = int(rng.integers(1, len(fam)))
+                prompt = fam[:cut] + list(rng.integers(100, 200, int(
+                    rng.integers(0, 6))))
+                rid, next_id = f"m-{next_id}", next_id + 1
+                sched.submit(Request(
+                    prompt=prompt,
+                    max_new_tokens=int(rng.integers(1, 10)),
+                    request_id=rid,
+                    deadline_ms=float(rng.choice([0.0, 50.0, 500.0]))),
+                    now=clk.t)
+            elif op == "admit":
+                admitted, _ = sched.admit(now=clk.t)
+                for _, r, table in admitted:
+                    blocks.cow_done(r.request_id)
+                    prefix.insert(r.prompt, table)
+                    r.length = r.prompt_len
+            elif op == "speculate" and running:
+                r = running[int(rng.integers(len(running)))]
+                window = r.length + 1 + int(rng.integers(0, 24))
+                try:
+                    blocks.speculate(r.request_id, window)
+                except (RuntimeError, ValueError):
+                    pass
+            elif op == "commit" and running:
+                r = running[int(rng.integers(len(running)))]
+                accepted = int(rng.integers(0, 5))
+                r.length = min(r.length + accepted, 63)
+                blocks.commit_speculative(r.request_id, r.length + 1)
+            elif op == "drop" and running:
+                r = running[int(rng.integers(len(running)))]
+                blocks.drop_speculative(r.request_id)
+            elif op == "finish" and running:
+                pick = running[int(rng.integers(len(running)))]
+                sched.finish(pick, "eos", now=clk.t)
+            elif op == "cancel" and sched._live_ids:
+                ids = sorted(sched._live_ids)
+                sched.cancel(ids[int(rng.integers(len(ids)))],
+                             "cancelled", now=clk.t)
+            elif op == "tick":
+                clk.t += float(rng.random() * 0.2)
+            elif op in ("migrate", "migrate_cancel"):
+                self._migrate(sides[side], sides[1 - side], rng, clk,
+                              cancel=(op == "migrate_cancel"))
+            for s in sides:
+                self._invariants(*s)
+        # every committed move has exactly one splice and one detach
+        outs = sum(s[0].stats["migrated_out"] for s in sides)
+        ins = sum(s[0].stats["migrated_in"] for s in sides)
+        assert outs == ins > 0
+        # drain both sides: live accounting returns to zero everywhere
+        clk.t += 10.0
+        for sched, blocks, prefix in sides:
+            for _ in range(80):
+                admitted, _ = sched.admit(now=clk.t)
+                for _, r, table in admitted:
+                    blocks.cow_done(r.request_id)
+                    prefix.insert(r.prompt, table)
+                for r in [r for r in sched.slots if r is not None]:
+                    sched.finish(r, "eos", now=clk.t)
+            assert not sched.pending
+            assert sched.committed_tokens == 0 and not sched._live_ids
+            assert not blocks._ref and not blocks._spec_base
+            assert blocks.num_free == blocks.num_blocks - 1
+
+    def test_splice_refuses_busy_slot_and_live_id(self):
+        cfg = ServingConfig(block_size=8, decode_slots=2,
+                            default_max_new_tokens=4)
+        blocks = BlockManager(10, 8, 8)
+        clk = _Clock()
+        sched = ContinuousBatchingScheduler(cfg, blocks, max_len=64,
+                                            clock=clk)
+        sched.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                             request_id="a"), now=0.0)
+        sched.admit(now=0.0)
+        assert sched.free_slot() == 1
+        with pytest.raises(ValueError, match="busy slot"):
+            sched.splice(Request(prompt=[3], max_new_tokens=1,
+                                 request_id="b"), 0)
+        with pytest.raises(ValueError, match="live id"):
+            sched.splice(Request(prompt=[3], max_new_tokens=1,
+                                 request_id="a"), 1)
+        assert sched.migrate_out("nope") is None
+
+
+# ---------------------------------------------------------------------------
+# device tier: real tiny engines
+# ---------------------------------------------------------------------------
+def _tiny_serving(serving, seed=0):
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.parallel.topology import reset_topology
+
+    reset_topology()
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    return deepspeed_tpu.init_serving(
+        GPT2LMHeadModel(cfg), dtype="fp32", seed=seed, serving=serving)
+
+
+_SERVING = {"block_size": 8, "decode_slots": 2,
+            "default_max_new_tokens": 6}
+_PROMPT = [5, 17, 42, 7, 8, 9, 10, 11, 12]
+
+
+@pytest.mark.heavy
+class TestMigrationEngine:
+    def test_export_import_resumes_bit_identical_zero_prefill(self):
+        """The tentpole acceptance at engine level: the moved sequence
+        resumes mid-stream on the target with NO prefill program — the
+        target's prefill/chunk caches stay empty — and finishes
+        bit-identical to a never-migrated run, each post-move token
+        streamed exactly once."""
+        ref = _tiny_serving(_SERVING)
+        r_ref = ref.submit(_PROMPT, max_new_tokens=6)
+        ref.drain()
+        ref.destroy()
+
+        src = _tiny_serving(_SERVING)
+        dst = _tiny_serving(_SERVING)
+        r = src.submit(_PROMPT, max_new_tokens=6)
+        for _ in range(3):
+            src.step()
+        assert 0 < len(r.tokens) < 6
+        export = src.export_sequence(r.request_id)
+        assert export is not None
+        assert export["blocks"] == 2 and export["length"] == len(
+            _PROMPT) + len(r.tokens) - 1
+        streamed = []
+        r2 = dst.import_sequence(
+            export, stream=lambda q, t, d: streamed.append(t))
+        assert r2 is not None
+        assert src.migrate_out(r.request_id)
+        assert r.state == rq.SHED and r.finish_reason == "migrated"
+        dst.drain()
+        assert r2.state == rq.FINISHED
+        assert r2.tokens == r_ref.tokens           # bit-identical
+        assert r.tokens + streamed == r_ref.tokens  # exactly once
+        # zero prefill dispatches for the migrated request: the target
+        # never compiled a prefill or chunk program at all
+        assert not dst._prefill_fns and not dst._chunk_fns
+        assert len(dst._migrate_fns) == 1
+        assert dst.stats()["migrated_in"] == 1
+        assert src.stats()["migrated_out"] == 1
+        assert src.stats()["shed"] == 0            # a move is not a shed
+        src.destroy()
+        dst.destroy()
+
+    def test_export_refuses_unknown_and_queued(self):
+        srv = _tiny_serving(_SERVING)
+        assert srv.export_sequence("nope") is None
+        a = srv.submit([1, 2, 3], max_new_tokens=2)
+        b = srv.submit([4, 5, 6], max_new_tokens=2)
+        queued = srv.submit([7, 8, 9], max_new_tokens=2)
+        srv.step()
+        assert queued.state == rq.QUEUED
+        # queued work has no committed KV: it migrates by plain resubmit
+        assert srv.export_sequence(queued.request_id) is None
+        srv.drain()
+        srv.destroy()
+
+    def test_import_refuses_mismatch_dup_and_full(self):
+        src = _tiny_serving(_SERVING)
+        r = src.submit(_PROMPT, max_new_tokens=6)
+        src.step()
+        export = src.export_sequence(r.request_id)
+        assert export is not None
+        # pool-geometry mismatch: a block_size-16 pool cannot take
+        # block_size-8 rows
+        other = _tiny_serving({**_SERVING, "block_size": 16})
+        assert other.import_sequence(export) is None
+        other.destroy()
+        dst = _tiny_serving(_SERVING)
+        assert dst.import_sequence(None) is None
+        assert dst.import_sequence(export) is not None
+        # the id is now live on the target: a duplicate import declines
+        assert dst.import_sequence(export) is None
+        # free slots exhausted -> decline
+        assert dst.import_sequence(
+            export, request_id="fill-1") is not None
+        assert dst.import_sequence(
+            export, request_id="fill-2") is None
+        src.destroy()
+        dst.destroy()
+
+    def test_commit_fault_releases_target_source_finishes(self):
+        """The chaos contract: a fault between export and the target's
+        table commit leaves the target's pool exactly as it was and the
+        source still owns the sequence — it finishes in place,
+        bit-identical to an unfaulted run."""
+        ref = _tiny_serving(_SERVING)
+        r_ref = ref.submit(_PROMPT, max_new_tokens=6)
+        ref.drain()
+        ref.destroy()
+
+        src = _tiny_serving(_SERVING)
+        dst = _tiny_serving(_SERVING)
+        mig = Migrator(MigrationConfig())
+        r = src.submit(_PROMPT, max_new_tokens=6)
+        for _ in range(3):
+            src.step()
+        free0 = dst.gauges()["free_blocks"]
+        chaos.io_errors("serving.migration.commit", at_call=1)
+        assert mig.migrate(src, dst, r.request_id) is None
+        assert dst.gauges()["free_blocks"] == free0  # allocation released
+        assert dst.gauges()["slots_busy"] == 0       # scheduler untouched
+        # the source was never detached: decoding continues in place
+        assert r.state == rq.RUNNING
+        src.drain()
+        assert r.state == rq.FINISHED and r.tokens == r_ref.tokens
+        src.destroy()
+        dst.destroy()
+
+    def test_migrate_program_cached_per_block_count(self):
+        src = _tiny_serving(_SERVING)
+        dst = _tiny_serving(_SERVING)
+        for i, prompt in enumerate((_PROMPT, list(_PROMPT))):
+            r = src.submit(prompt, max_new_tokens=6,
+                           request_id=f"pc-{i}")
+            src.step()
+        for i in range(2):
+            export = src.export_sequence(f"pc-{i}")
+            assert dst.import_sequence(export) is not None
+            assert src.migrate_out(f"pc-{i}")
+        # same covered-block count -> ONE compiled migrate program
+        assert len(dst._migrate_fns) == 1
+        dst.drain()
+        src.destroy()
+        dst.destroy()
+
+    def test_int8_kv_cuts_wire_bytes(self):
+        """The bench's headline: int8 side pools and their scales ride
+        the same block indices, so the migration wire for the same
+        sequence is ~4x smaller than f32 KV."""
+        wire = {}
+        for dtype in ("", "int8"):
+            srv = _tiny_serving({**_SERVING, "kv_cache_dtype": dtype})
+            r = srv.submit(_PROMPT, max_new_tokens=6)
+            for _ in range(3):
+                srv.step()
+            export = srv.export_sequence(r.request_id)
+            assert export is not None
+            wire[dtype or "f32"] = export["wire_bytes"]
+            srv.destroy()
+        assert wire["int8"] < 0.35 * wire["f32"]
+
+    def test_migration_block_leaves_decode_hlo_byte_identical(self):
+        """Zero-overhead pin: a serving config WITH a migration block
+        compiles the exact same decode program as one without — and a
+        replica that never migrates builds no migrate program at all."""
+        import jax.numpy as jnp
+
+        texts = []
+        for extra in ({}, {"migration": {"enabled": True}}):
+            srv = _tiny_serving({**_SERVING, **extra})
+            fn = srv._build_decode()
+            lowered = fn.lower(
+                srv.engine.params, srv.cache,
+                jnp.zeros((2, 1), jnp.int32),
+                jnp.asarray(srv._tables), jnp.asarray(srv._lengths),
+                srv._next_rng())
+            texts.append(lowered.compile().as_text())
+            assert not srv._migrate_fns
+            srv.destroy()
+        assert texts[0] == texts[1]
+
+    def test_chaos_replica_crash_during_migration_is_one_shot(self):
+        """ChaosReplica's migration injector: the Nth export performs
+        the real export then dies — and the replica stays dead, like a
+        killed process."""
+        src = _tiny_serving(_SERVING)
+        wrapped = ChaosReplica(src, crash_during_migration=1)
+        r = wrapped.submit(_PROMPT, max_new_tokens=6)
+        wrapped.step()
+        with pytest.raises(ReplicaCrashed):
+            wrapped.export_sequence(r.request_id)
+        with pytest.raises(ReplicaCrashed):
+            wrapped.step()
+        src.destroy()
+
+    def test_chaos_replica_flaky_transfer_arms_the_seam(self):
+        src = _tiny_serving(_SERVING)
+        dst = _tiny_serving(_SERVING)
+        mig = Migrator(MigrationConfig())
+        wrapped = ChaosReplica(src, flaky_transfer_at=1)
+        r = wrapped.submit(_PROMPT, max_new_tokens=6)
+        wrapped.step()
+        assert mig.migrate(wrapped, dst, r.request_id) is None
+        assert r.state == rq.RUNNING       # source untouched
+        # one-shot: the next attempt lands
+        assert mig.migrate(wrapped, dst, r.request_id) is not None
+        assert r.state == rq.SHED and r.finish_reason == "migrated"
+        dst.drain()
+        src.destroy()
+        dst.destroy()

@@ -19,6 +19,7 @@ Four tiers, the first three pure host-side (tier-1 fast — fake replicas
 import numpy as np
 import pytest
 
+from deepspeed_tpu.runtime.resilience import chaos
 from deepspeed_tpu.runtime.resilience.chaos import (ChaosIOError,
                                                     ChaosReplica,
                                                     ReplicaCrashed)
@@ -137,11 +138,68 @@ class FakeTelemetry:
         return [e for e in self.events if e["name"] == name]
 
 
-def _router(replicas, clock=None, telemetry=None, **cfg):
+class MigratableReplica(FakeReplica):
+    """FakeReplica plus the engine's live-migration surface. The fake
+    mirrors ServingEngine's contract: export hands out the host-visible
+    sequence state (with block/wire accounting), import SEEDS the
+    delivered prefix without re-emitting it (only post-move tokens flow
+    through the stream shim), migrate_out detaches the source copy."""
+
+    block_size = 8
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.imports = self.outs = 0
+
+    def export_sequence(self, request_id):
+        req = next((r for r in self.running
+                    if r.request_id == request_id), None)
+        if req is None:
+            return None
+        covered = len(req.prompt) + len(req.tokens)
+        blocks = max(1, -(-covered // self.block_size))
+        return {"request_id": req.request_id, "prompt": list(req.prompt),
+                "tokens": list(req.tokens),
+                "max_new_tokens": req.max_new_tokens,
+                "eos_token_id": req.eos_token_id,
+                "deadline_ms": req.deadline_ms,
+                "blocks": blocks, "wire_bytes": 512 * blocks}
+
+    def import_sequence(self, export, deadline_ms=None, stream=None,
+                        request_id=None, trace=None):
+        if len(self.running) >= self.slots:
+            return None
+        self.imports += 1
+        req = rq.Request(prompt=list(export["prompt"]),
+                         max_new_tokens=int(export["max_new_tokens"]),
+                         request_id=request_id or export["request_id"],
+                         eos_token_id=export["eos_token_id"],
+                         deadline_ms=(export["deadline_ms"]
+                                      if deadline_ms is None
+                                      else deadline_ms),
+                         stream=stream)
+        req.tokens = list(export["tokens"])  # seeded, NOT re-emitted
+        req.state = rq.RUNNING
+        self.running.append(req)
+        return req
+
+    def migrate_out(self, request_id):
+        req = next((r for r in self.running
+                    if r.request_id == request_id), None)
+        if req is None:
+            return False
+        req.state, req.finish_reason = rq.SHED, "migrated"
+        self.running.remove(req)
+        self.outs += 1
+        return True
+
+
+def _router(replicas, clock=None, telemetry=None, migration=None, **cfg):
     cfg.setdefault("probe_backoff_secs", 0.5)
     return ReplicaRouter(replicas, config=RouterConfig(**cfg),
                          clock=clock or _Clock(),
-                         telemetry=telemetry or FakeTelemetry())
+                         telemetry=telemetry or FakeTelemetry(),
+                         migration=migration)
 
 
 # ---------------------------------------------------------------------------
@@ -702,6 +760,226 @@ class TestFailoverDeterministicReplay:
         router.drain(max_steps=10)
         assert r.state == rq.FINISHED and r.replica == 1
         assert r.attempt == 1
+
+
+class SamplingMigratable(MigratableReplica):
+    class config:
+        do_sample = True
+
+
+class TestMigrationFailover:
+    """Migrate-first failover: a breaker trip or stall verdict (pool
+    still readable) MOVES each sequence's committed KV to a survivor and
+    decoding resumes mid-stream with zero replay; a hard crash (DEAD)
+    keeps deterministic replay; and any fault between export and the
+    target's commit falls back to replay with exactly-once delivery."""
+
+    @pytest.fixture(autouse=True)
+    def _no_chaos_leak(self):
+        yield
+        chaos.clear()
+
+    def test_breaker_trip_migrates_instead_of_replaying(self):
+        seen = []
+        flaky = ChaosReplica(MigratableReplica(), fail_step_at=2,
+                             fail_step_times=3)
+        telem = FakeTelemetry()
+        router = _router([flaky, MigratableReplica()], telemetry=telem,
+                         failure_threshold=3, migration={"enabled": True})
+        r = router.submit([1, 2], max_new_tokens=4,
+                          stream=lambda _r, t, d: seen.append((t, d)))
+        router.step()                      # one token streams pre-trip
+        assert len(r.tokens) == 1
+        router.drain(max_steps=30)
+        assert router.health[0].state == TRIPPED
+        assert r.state == rq.FINISHED and r.replica == 1
+        # the KV moved: the stream continued mid-sequence, bit-identical
+        # to an unfaulted run, each position delivered exactly once with
+        # NO replay and therefore nothing to dedupe
+        assert r.tokens == [_greedy([1, 2], p) for p in range(4)]
+        assert [t for t, _ in seen] == r.tokens
+        assert [d for _, d in seen] == [False, False, False, True]
+        st = router.stats()
+        assert st["migrations"] == 1 and st["failovers"] == 0
+        assert st["deduped_tokens"] == 0
+        assert r.attempt == 1              # the move IS attempt 1
+        tgt = router.replicas[1]
+        assert tgt.imports == 1 and tgt.submits == 0  # never re-prefilled
+        assert flaky.outs == 1 and not flaky.running  # source detached
+        ev = telem.of("migrate")
+        assert ev and ev[0]["data"]["from_replica"] == 0 \
+            and ev[0]["data"]["to_replica"] == 1
+
+    def test_stall_verdict_migrates(self):
+        clk = _Clock()
+        stalled = ChaosReplica(MigratableReplica(), stall_at_step=2,
+                               stall_secs=2.0, sleep=clk.advance)
+        router = _router([stalled, MigratableReplica()], clock=clk,
+                         stall_timeout_secs=1.0,
+                         migration={"enabled": True})
+        r = router.submit([1, 2], max_new_tokens=3)
+        router.drain(max_steps=20)
+        assert router.health[0].last_reason == "stall"
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert r.tokens == [_greedy([1, 2], p) for p in range(3)]
+        st = router.stats()
+        assert st["migrations"] == 1 and st["deduped_tokens"] == 0
+
+    def test_hard_crash_keeps_replay_path(self):
+        router = _router(
+            [ChaosReplica(MigratableReplica(), crash_at_step=2),
+             MigratableReplica()], migration={"enabled": True})
+        r = router.submit([1, 2], max_new_tokens=4)
+        router.drain(max_steps=20)
+        assert router.health[0].state == DEAD  # pool unreadable
+        assert r.state == rq.FINISHED
+        assert r.tokens == [_greedy([1, 2], p) for p in range(4)]
+        st = router.stats()
+        assert st["migrations"] == 0 and st["failovers"] == 1
+        assert st["deduped_tokens"] > 0        # the prefix was replayed
+        assert router.replicas[1].imports == 0
+
+    def test_migration_disabled_keeps_replay_on_trip(self):
+        """`enabled: false` restores pre-migration behavior verbatim —
+        even a readable (TRIPPED) pool replays."""
+        router = _router(
+            [ChaosReplica(MigratableReplica(), fail_step_at=2,
+                          fail_step_times=3), MigratableReplica()],
+            failure_threshold=3, migration={"enabled": False})
+        r = router.submit([1, 2], max_new_tokens=4)
+        router.drain(max_steps=30)
+        assert r.state == rq.FINISHED
+        assert r.tokens == [_greedy([1, 2], p) for p in range(4)]
+        st = router.stats()
+        assert st["migrations"] == 0 and st["failovers"] == 1
+        assert router.replicas[1].imports == 0
+
+    def test_sampled_prefix_survives_migration_eligible_failover(self):
+        """THE sampling-failover fix: a do_sample request with a
+        delivered prefix used to shed unconditionally on failover; with
+        migration the KV (and the sampling counters) MOVE, so the
+        stream survives a breaker trip."""
+        seen = []
+        router = _router(
+            [ChaosReplica(SamplingMigratable(), fail_step_at=2,
+                          fail_step_times=3), SamplingMigratable()],
+            failure_threshold=3, migration={"enabled": True})
+        r = router.submit([1, 2], max_new_tokens=4,
+                          stream=lambda _r, t, d: seen.append(t))
+        router.drain(max_steps=30)
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert r.finish_reason == "max_tokens"
+        assert seen == r.tokens and len(r.tokens) == 4
+        assert router.stats()["migrations"] == 1
+
+    def test_sampled_prefix_sheds_nondeterministic_when_move_impossible(self):
+        """No survivor has an import surface: the move was never
+        possible (policy, not fault) — the shed reason stays
+        `nondeterministic_replay`."""
+
+        class SamplingPlain(FakeReplica):
+            class config:
+                do_sample = True
+
+        router = _router(
+            [ChaosReplica(SamplingMigratable(), fail_step_at=2,
+                          fail_step_times=3), SamplingPlain()],
+            failure_threshold=3, migration={"enabled": True})
+        r = router.submit([1, 2], max_new_tokens=4)
+        router.drain(max_steps=30)
+        assert len(r.tokens) == 1          # the delivered prefix
+        assert r.state == rq.SHED
+        assert r.finish_reason == "nondeterministic_replay"
+
+    def test_sampled_prefix_sheds_migration_failed_on_faulted_move(self):
+        """The move was attempted and fell through (target declined):
+        that is a FAULT, and dashboards must tell it apart from policy —
+        the shed reason is `migration_failed`."""
+
+        class Declining(SamplingMigratable):
+            def import_sequence(self, *args, **kwargs):
+                return None
+
+        router = _router(
+            [ChaosReplica(SamplingMigratable(), fail_step_at=2,
+                          fail_step_times=3), Declining()],
+            failure_threshold=3, migration={"enabled": True})
+        r = router.submit([1, 2], max_new_tokens=4)
+        router.drain(max_steps=30)
+        assert r.state == rq.SHED
+        assert r.finish_reason == "migration_failed"
+
+    def test_crash_during_migration_falls_back_to_replay_exactly_once(self):
+        """Chaos kill between export and the target's commit: the
+        target holds nothing, the source copy is never detached, and the
+        greedy request falls back to deterministic replay with
+        exactly-once delivery — no token lost, none duplicated."""
+        seen = []
+        flaky = ChaosReplica(MigratableReplica(), fail_step_at=2,
+                             fail_step_times=3, crash_during_migration=1)
+        router = _router([flaky, MigratableReplica()],
+                         failure_threshold=3, migration={"enabled": True})
+        r = router.submit([1, 2], max_new_tokens=4,
+                          stream=lambda _r, t, d: seen.append(t))
+        router.drain(max_steps=30)
+        assert flaky.migration_exports == 1
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert r.tokens == [_greedy([1, 2], p) for p in range(4)]
+        assert seen == r.tokens            # exactly once, in order
+        st = router.stats()
+        assert st["migrations"] == 0 and st["failovers"] == 1
+        assert st["deduped_tokens"] > 0    # replay regenerated the prefix
+        assert st["replay_divergence"] == 0
+        assert router.replicas[1].imports == 0  # target never touched
+
+    def test_flaky_transfer_falls_back_to_replay(self):
+        """Transient wire fault between export and import: the armed
+        transfer seam fires once, the move aborts pre-import, replay
+        finishes the stream."""
+        flaky = ChaosReplica(MigratableReplica(), fail_step_at=2,
+                             fail_step_times=3, flaky_transfer_at=1)
+        router = _router([flaky, MigratableReplica()],
+                         failure_threshold=3, migration={"enabled": True})
+        r = router.submit([1, 2], max_new_tokens=4)
+        router.drain(max_steps=30)
+        assert r.state == rq.FINISHED
+        assert r.tokens == [_greedy([1, 2], p) for p in range(4)]
+        st = router.stats()
+        assert st["migrations"] == 0 and st["failovers"] == 1
+        assert router.replicas[1].imports == 0  # fault fired pre-import
+        assert flaky.outs == 0             # migrate_out never ran: the
+        # source copy was NOT detached (None always means not detached)
+
+    def test_migrate_work_moves_assigned_requests(self):
+        """The drain/rebalance entry point: in-flight work moves to
+        survivors and the drained replica empties without waiting."""
+        telem = FakeTelemetry()
+        router = _router([MigratableReplica(), MigratableReplica()],
+                         telemetry=telem, migration={"enabled": True})
+        r1 = router.submit([1, 2], max_new_tokens=5)
+        r2 = router.submit([3], max_new_tokens=5)
+        router.step()
+        assert r1.replica == 0 and r2.replica == 1
+        router.start_drain(0)
+        assert router.migrate_work(0, "drain") == 1
+        assert router.assigned(0) == 0
+        router.drain(max_steps=20)
+        assert r1.state == rq.FINISHED and r1.replica == 1
+        assert r1.tokens == [_greedy([1, 2], p) for p in range(5)]
+        assert r2.state == rq.FINISHED
+        assert telem.of("migrate")
+
+    def test_migrate_work_respects_consumer_gate(self):
+        """`drain: false` turns only the drain consumer off — the
+        yield-based drain fallback still finishes the stream."""
+        router = _router([MigratableReplica(), MigratableReplica()],
+                         migration={"enabled": True, "drain": False})
+        r = router.submit([1, 2], max_new_tokens=4)
+        router.step()
+        router.start_drain(0)
+        assert router.migrate_work(0, "drain") == 0
+        router.drain(max_steps=20)
+        assert r.state == rq.FINISHED and r.replica == 0
 
 
 class TestBreakerProbes:
@@ -1270,6 +1548,68 @@ class TestRouterOverRealEngines:
             assert req.tokens == clean.tokens
             assert streams[i] == clean_streams[i] == req.tokens
         assert router.stats()["replay_divergence"] == 0
+
+    def test_breaker_trip_migrates_kv_zero_prefill_bit_identical(self):
+        """THE migration acceptance on the real substrate: replica 0
+        trips its breaker mid-decode (transient step faults — its pool
+        is still readable), so with migration on its in-flight request
+        MOVES instead of replaying. The survivor resumes the stream
+        mid-sequence from the imported KV with ZERO prefill dispatches
+        for the moved request (pinned by the prefill program cache:
+        the long prompt's bucket is never compiled on the survivor),
+        token streams are bit-identical to an unfaulted run with each
+        position delivered exactly once, and nothing was deduped —
+        because nothing was replayed."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        rng = np.random.default_rng(13)
+        long_p = [int(t) for t in rng.integers(1, 256, 12)]   # bucket 16
+        short_p = [int(t) for t in rng.integers(1, 256, 5)]   # bucket 8
+
+        def run(replicas, migration=None):
+            router = ReplicaRouter(replicas,
+                                   config={"failure_threshold": 3,
+                                           "max_failovers": 2},
+                                   migration=migration)
+            streams = ([], [])
+            reqs = (router.submit(long_p, max_new_tokens=5,
+                                  stream=lambda _r, t, d:
+                                  streams[0].append(t)),
+                    router.submit(short_p, max_new_tokens=4,
+                                  stream=lambda _r, t, d:
+                                  streams[1].append(t)))
+            router.drain(max_steps=200)
+            return router, reqs, streams
+
+        _, e0 = _tiny_engine()
+        _, e1 = _tiny_engine()
+        e1.params = e0.params
+        clean, clean_reqs, clean_streams = run(
+            [ServingEngine(e0), ServingEngine(e1)])
+        clean.destroy()
+        _, f0 = _tiny_engine()
+        _, f1 = _tiny_engine()
+        f1.params = f0.params
+        s0, s1 = ServingEngine(f0), ServingEngine(f1)
+        router, reqs, streams = run(
+            [ChaosReplica(s0, fail_step_at=2, fail_step_times=3), s1],
+            migration={"enabled": True})
+        st = router.stats()
+        assert st["migrations"] >= 1, st
+        assert st["replica_states"][0] == "tripped"
+        for req, cln, seen, cseen in zip(reqs, clean_reqs, streams,
+                                         clean_streams):
+            assert req.state == rq.FINISHED, req.finish_reason
+            assert req.tokens == cln.tokens
+            assert seen == cseen == req.tokens   # exactly once, in order
+        # zero prefill for the moved request: the source compiled the
+        # long prompt's bucket, the survivor never did — it landed the
+        # blocks through one migrate program and kept decoding
+        assert 16 in s0._prefill_fns
+        assert 16 not in s1._prefill_fns
+        assert len(s1._migrate_fns) == 1
+        assert st["deduped_tokens"] == 0 and st["replay_divergence"] == 0
+        router.destroy()
 
     def test_spec_replica_killed_between_draft_and_commit(self):
         """Chaos regression for the speculative x failover interplay: a

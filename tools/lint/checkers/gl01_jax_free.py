@@ -35,6 +35,7 @@ JAX_FREE_MODULES = (
     "deepspeed_tpu/serving/autoscaler.py",
     "deepspeed_tpu/serving/replay.py",
     "deepspeed_tpu/serving/capacity.py",
+    "deepspeed_tpu/serving/migration.py",
     "deepspeed_tpu/telemetry/events.py",
     "deepspeed_tpu/telemetry/tracing.py",
     "deepspeed_tpu/telemetry/metrics.py",

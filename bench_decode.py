@@ -658,6 +658,152 @@ def _migration_series(ctx):
 
 
 # ---------------------------------------------------------------------------
+# gateway: the HTTP/SSE front door's cost + quota-shed correctness
+def _gateway_series(ctx):
+    """Two questions, measured: (1) what does the HTTP hop cost —
+    tokens/s and TTFT p95 for the SAME mixed workload submitted
+    directly vs POSTed through a running ``ServingGateway``; (2) do
+    per-tenant quotas actually isolate — a two-tenant concurrent burst
+    where the gold tenant must come through clean while the
+    rate-capped best_effort tenant sheds at the door."""
+    import json as _json
+    import sys
+    import urllib.error
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from deepspeed_tpu.serving.gateway import ServingGateway
+
+    cfg = ctx["cfg"]
+    n_requests = ctx["n_requests"]
+    lens, srv_new, srv_rng = ctx["lens"], ctx["srv_new"], ctx["srv_rng"]
+
+    def prompts():
+        return [srv_rng.integers(0, cfg.vocab_size,
+                                 lens[i % len(lens)]).astype(np.int32)
+                for i in range(n_requests)]
+
+    def post(gw, prompt, key=None, timeout=120.0):
+        headers = {"Content-Type": "application/json"}
+        if key:
+            headers["Authorization"] = f"Bearer {key}"
+        body = _json.dumps({"prompt": [int(t) for t in prompt],
+                            "max_new_tokens": srv_new,
+                            "stream": False}).encode("utf-8")
+        resp = urllib.request.urlopen(urllib.request.Request(
+            gw.url + "/v1/generate", data=body, headers=headers,
+            method="POST"), timeout=timeout)
+        return _json.loads(resp.read().decode("utf-8"))
+
+    try:
+        # leg 1: direct submit/step, the Python-path floor
+        srv = _build_serving(ctx)
+        work = prompts()
+
+        def run_direct():
+            pending = list(work)
+            t0 = time.perf_counter()
+            while pending or srv.pending:
+                if pending:
+                    srv.submit(pending.pop(0), max_new_tokens=srv_new)
+                srv.step()
+            srv.drain()
+            return time.perf_counter() - t0
+
+        run_direct()  # warm bucket set + decode program
+        srv.reset_stats()
+        elapsed = run_direct()
+        st = srv.stats()
+        direct_tokens = sum(r["new_tokens"] for r in srv.records
+                            if r["state"] != "shed")
+        direct_rate = (round(direct_tokens / elapsed, 1)
+                       if elapsed > 0 else None)
+        direct_ttft = st["ttft_ms_p95"]
+        srv.destroy()
+
+        # leg 2: the SAME workload through the gateway (pump thread
+        # steps; concurrent JSON posts; TTFT observed server-side)
+        srv = _build_serving(ctx)
+        gw = ServingGateway(srv, {"pump": True,
+                                  "poll_secs": 0.002}).start()
+        try:
+            post(gw, work[0])  # warm through the full HTTP path
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=n_requests) as pool:
+                outs = list(pool.map(lambda p: post(gw, p), work))
+            elapsed = time.perf_counter() - t0
+            gw_tokens = sum(len(o["tokens"]) for o in outs
+                            if o["state"] == "finished")
+            gw_rate = (round(gw_tokens / elapsed, 1)
+                       if elapsed > 0 else None)
+            ttfts = sorted(o["record"]["ttft_ms"] for o in outs
+                           if o["record"].get("ttft_ms") is not None)
+            gw_ttft = (round(ttfts[min(len(ttfts) - 1,
+                                       int(0.95 * len(ttfts)))], 2)
+                       if ttfts else None)
+        finally:
+            gw.destroy()
+
+        # leg 3: two-tenant concurrent burst — gold unlimited,
+        # best_effort capped at 1 req/s with burst 1
+        srv = _build_serving(ctx)
+        gw = ServingGateway(srv, {
+            "pump": True, "poll_secs": 0.002,
+            "tenants": [
+                {"name": "gold", "api_key": "gold-key",
+                 "slo_class": "gold", "requests_per_sec": 10000.0},
+                {"name": "be", "api_key": "be-key",
+                 "slo_class": "best_effort", "requests_per_sec": 1.0,
+                 "burst_requests": 1},
+            ]}).start()
+        try:
+            def burst_one(args):
+                key, prompt = args
+                try:
+                    out = post(gw, prompt, key=key)
+                    return key, out["state"]
+                except urllib.error.HTTPError as e:
+                    code = e.code
+                    e.close()
+                    return key, f"http_{code}"
+
+            jobs = [("gold-key" if i % 2 == 0 else "be-key", p)
+                    for i, p in enumerate(prompts())]
+            with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
+                results = list(pool.map(burst_one, jobs))
+            gold_n = sum(1 for k, _ in results if k == "gold-key")
+            gold_ok = sum(1 for k, s in results
+                          if k == "gold-key" and s == "finished")
+            be_429 = sum(1 for k, s in results
+                         if k == "be-key" and s == "http_429")
+            be_ok = sum(1 for k, s in results
+                        if k == "be-key" and s == "finished")
+        finally:
+            gw.destroy()
+
+        return {
+            "metric": f"{METRIC}_gateway",
+            "direct_tokens_per_sec": direct_rate,
+            "direct_ttft_ms_p95": direct_ttft,
+            "gateway_tokens_per_sec": gw_rate,
+            "gateway_ttft_ms_p95": gw_ttft,
+            "gateway_overhead_pct": (
+                round(100.0 * (1.0 - gw_rate / direct_rate), 1)
+                if direct_rate and gw_rate else None),
+            "burst_gold_ok": gold_ok, "burst_gold_requests": gold_n,
+            "burst_best_effort_ok": be_ok,
+            "burst_best_effort_429": be_429,
+            "requests": n_requests, "new_tokens": srv_new,
+        }
+    except Exception as e:  # noqa: BLE001 — extras never kill the headline
+        print(f"# gateway series failed: {e}", file=sys.stderr,
+              flush=True)
+        return {"metric": f"{METRIC}_gateway", "value": None,
+                "unit": "tokens/s", "vs_baseline": None,
+                "error": str(e)[:300]}
+
+
+# ---------------------------------------------------------------------------
 # tuner series: the live autotuner's decode-side measurement hooks
 def _decode_attention_series(ctx, block_k=None, reps=None):
     """Microbench of the dense decode-attention kernel at one ``block_k``
@@ -1084,6 +1230,8 @@ def run_series(name, config=None):
         return _router_series(ctx)
     if name == "fleet":
         return _fleet_series(ctx)
+    if name == "gateway":
+        return _gateway_series(ctx)
     if name == "migration":
         return _migration_series(ctx)
     if name == "decode_attention":
@@ -1102,7 +1250,7 @@ def run_series(name, config=None):
 
 
 SERIES = ("headline", "serving", "serving_fastpath", "router", "fleet",
-          "migration", "decode_attention", "serving_chunk",
+          "migration", "gateway", "decode_attention", "serving_chunk",
           "serving_tracing", "spec_decode", "tp")
 
 
@@ -1120,6 +1268,7 @@ def main():
     emit_result(_router_series(ctx))
     emit_result(_fleet_series(ctx))
     emit_result(_migration_series(ctx))
+    emit_result(_gateway_series(ctx))
     emit_result(_spec_decode_series(ctx))
     emit_result(_serving_tracing_series(ctx))
     emit_result(_tp_series(ctx))

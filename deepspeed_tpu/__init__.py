@@ -149,8 +149,36 @@ def init_serving(model, config=None, replicas=None, factory=None,
     whatever the caller's config restores. ``clock`` injects the
     router/fleet timebase (default ``time.monotonic``) — pass the
     trace-replay harness's ``ReplayClock`` to drive the whole front
-    door faster than real time."""
+    door faster than real time.
+
+    With a ``serving.gateway`` block the whole stack goes behind the
+    HTTP/SSE front door: the result is a live
+    :class:`~deepspeed_tpu.serving.gateway.ServingGateway` (already
+    ``start()``-ed — read ``.port``) over whichever backend the other
+    blocks selected, with per-tenant API keys, token-bucket quotas and
+    SLO classes from the block. Without it nothing changes — the
+    gateway does not exist and no socket is opened."""
     from deepspeed_tpu.serving import ServingEngine
+
+    def _on(block):
+        # the standard config off switch: block present, layer disabled
+        # — identical to absent
+        if block is None:
+            return None
+        enabled = (block.get("enabled", True) if isinstance(block, dict)
+                   else getattr(block, "enabled", True))
+        return block if enabled else None
+
+    def _behind_gateway(backend, gateway_block):
+        gateway_block = _on(gateway_block)
+        if gateway_block is None:
+            return backend
+        from deepspeed_tpu.serving.gateway import ServingGateway
+        gw_clock = clock if clock is not None \
+            else getattr(backend, "clock", None)
+        gw_kwargs = {} if gw_clock is None else {"clock": gw_clock}
+        return ServingGateway(backend, config=gateway_block,
+                              **gw_kwargs).start()
 
     # probe ONLY router presence ahead of construction (full coercion
     # lives in ServingConfig); `replicas` alone also selects the router
@@ -175,10 +203,15 @@ def init_serving(model, config=None, replicas=None, factory=None,
                                   if isinstance(fleet, dict)
                                   else getattr(fleet, "enabled", True)):
         fleet = None  # standard off switch, same as the router block
+    gateway = (serving.get("gateway") if isinstance(serving, dict)
+               else getattr(serving, "gateway", None))
     clock_kwargs = {} if clock is None else {"clock": clock}
     if router is None and replicas is None:
-        return ServingEngine(model, config=config, **clock_kwargs,
-                             **kwargs)
+        engine = ServingEngine(model, config=config, **clock_kwargs,
+                               **kwargs)
+        if gateway is None:
+            gateway = getattr(engine.config, "gateway", None)
+        return _behind_gateway(engine, gateway)
 
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.serving.router import (CallableReplicaFactory,
@@ -225,6 +258,8 @@ def init_serving(model, config=None, replicas=None, factory=None,
                  else getattr(serving, "migration", None))
     if migration is None:
         migration = _carried("migration")
+    if gateway is None:
+        gateway = _carried("gateway")
     front = ReplicaRouter(engines, config=router, migration=migration,
                           **clock_kwargs)
     if fleet is None:
@@ -233,14 +268,15 @@ def init_serving(model, config=None, replicas=None, factory=None,
                 "init_serving got a replica `factory` but no "
                 "serving.fleet block — the factory is the fleet "
                 "manager's scale-up seam; add \"fleet\": {...} to use it")
-        return front
+        return _behind_gateway(front, gateway)
     if factory is None and built_from_model:
         # same build as the initial replicas: whatever AOT/tuning warm
         # path the caller's config restores, a scaled-up replica gets too
         factory = CallableReplicaFactory(
             lambda: ServingEngine(model, config=config, **clock_kwargs,
                                   **kwargs))
-    return FleetManager(front, factory=factory, config=fleet)
+    return _behind_gateway(
+        FleetManager(front, factory=factory, config=fleet), gateway)
 
 
 def add_config_arguments(parser):

@@ -36,26 +36,40 @@ Pieces:
   policy), :mod:`~deepspeed_tpu.serving.replay` (trace-driven workload
   replay over fake clocks) and
   :class:`~deepspeed_tpu.serving.capacity.CapacityModel` (latency-vs-
-  load curves + ``fleet_size_for``).
+  load curves + ``fleet_size_for``);
+- :class:`~deepspeed_tpu.serving.gateway.ServingGateway` +
+  :mod:`~deepspeed_tpu.serving.tenancy` — the HTTP/SSE front door over
+  any of the above: ``POST /v1/generate`` token streaming, per-tenant
+  API keys, token-bucket quotas and SLO classes mapped onto the
+  scheduler's priority floor, with ``/healthz`` and ``/metrics`` on the
+  same port; :class:`~deepspeed_tpu.serving.replay.HttpReplayDriver`
+  replays JSONL traces through it end to end.
 """
 
 from deepspeed_tpu.serving.autoscaler import Autoscaler, BudgetWindow
 from deepspeed_tpu.serving.blocks import BlockManager
 from deepspeed_tpu.serving.capacity import CapacityModel
-from deepspeed_tpu.serving.config import (FleetConfig, MigrationConfig,
+from deepspeed_tpu.serving.config import (FleetConfig, GatewayConfig,
+                                          GatewayTenantConfig,
+                                          MigrationConfig,
                                           ReplayConfig,
                                           RouterConfig, ServingConfig,
+                                          SloClassConfig,
                                           SpeculativeConfig, bucket_for,
                                           resolve_buckets)
 from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.gateway import ServingGateway
 from deepspeed_tpu.serving.prefix_cache import PrefixCache
 from deepspeed_tpu.serving.health import (DEAD, DEGRADED, DRAINING, HEALTHY,
                                           TRIPPED, ReplicaHealth)
 from deepspeed_tpu.serving.migration import Migrator, resolve_migration
-from deepspeed_tpu.serving.replay import (Arrival, ReplayClock,
+from deepspeed_tpu.serving.replay import (Arrival, HttpReplayDriver,
+                                          ReplayClock,
                                           TraceReplayer, burst_trace,
                                           diurnal_trace, load_trace,
                                           save_trace, synthesize_trace)
+from deepspeed_tpu.serving.tenancy import (Tenant, TenantTable,
+                                           TokenBucket)
 from deepspeed_tpu.serving.request import (FINISHED, QUEUED, RUNNING, SHED,
                                            Request)
 from deepspeed_tpu.serving.router import (CallableReplicaFactory,
@@ -70,12 +84,15 @@ __all__ = ["Arrival", "Autoscaler", "BlockManager", "BudgetWindow",
            "CallableReplicaFactory", "CapacityModel",
            "ContinuousBatchingScheduler",
            "DraftModelProposer", "FleetConfig", "FleetManager",
+           "GatewayConfig", "GatewayTenantConfig", "HttpReplayDriver",
            "MigrationConfig", "Migrator", "resolve_migration",
            "PrefixCache", "PromptLookupProposer",
            "Proposer", "ReplayClock", "ReplayConfig", "ReplicaFactory",
            "ReplicaHealth",
            "ReplicaRouter", "Request", "RouterConfig", "RouterRequest",
-           "ServingConfig", "ServingEngine", "SpeculativeConfig",
+           "ServingConfig", "ServingEngine", "ServingGateway",
+           "SloClassConfig", "SpeculativeConfig",
+           "Tenant", "TenantTable", "TokenBucket",
            "TraceReplayer", "bucket_for", "build_proposer", "burst_trace",
            "diurnal_trace", "load_trace", "resolve_buckets", "save_trace",
            "synthesize_trace",

@@ -25,6 +25,12 @@ QUEUE = "queue"
 PROMPT_LOOKUP = "prompt_lookup"
 DRAFT_MODEL = "draft_model"
 
+# SLO classes the gateway maps onto the scheduler's priority floor
+SLO_GOLD = "gold"
+SLO_BEST_EFFORT = "best_effort"
+SLO_BATCH = "batch"
+SLO_CLASSES = (SLO_GOLD, SLO_BEST_EFFORT, SLO_BATCH)
+
 
 class SpeculativeConfig(DeepSpeedConfigModel):
     """The ``serving.speculative`` block: draft-and-verify decoding on
@@ -350,6 +356,202 @@ class RouterConfig(DeepSpeedConfigModel):
         return self
 
 
+class SloClassConfig(DeepSpeedConfigModel):
+    """One SLO class (``serving.gateway.gold`` / ``best_effort`` /
+    ``batch``): the knobs a tenant inherits from its class. ``priority``
+    feeds the scheduler/router priority floor (the PR 6 degradation
+    ladder sheds submits below ``serving.router.shed_priority_floor``),
+    ``deadline_ms`` is the class default per-request deadline, and
+    ``ttft_ms``/``error_budget`` define the class' error budget: a
+    finished request burns budget when it was shed or its TTFT exceeded
+    ``ttft_ms`` (0 = shed-only budget)."""
+
+    # scheduler/router priority this class submits at
+    priority: int = 0
+    # class-default per-request deadline; 0 = engine default
+    deadline_ms: float = 0.0
+    # TTFT target the error budget counts against; 0 = shed-only
+    ttft_ms: float = 0.0
+    # fraction of recent requests allowed to violate the SLO
+    error_budget: float = 0.05
+
+    @field_validator("priority")
+    @classmethod
+    def _priority(cls, v):
+        if v < 0:
+            raise ValueError(
+                f"serving.gateway SLO class priority must be >= 0, got {v}")
+        return v
+
+    @field_validator("deadline_ms", "ttft_ms", "error_budget")
+    @classmethod
+    def _nonneg(cls, v, info):
+        if v < 0:
+            raise ValueError(
+                f"serving.gateway SLO class {info.field_name} must be "
+                f">= 0, got {v}")
+        return v
+
+
+class GatewayTenantConfig(DeepSpeedConfigModel):
+    """One row of ``serving.gateway.tenants``: an API-key identity plus
+    its quotas. Rates of 0 mean unlimited; ``burst_*`` of 0 sizes the
+    token bucket at one second of the rate (minimum 1)."""
+
+    # tenant identity (the metrics/traces label)
+    name: str = ""
+    # the shared secret clients present (Authorization: Bearer <key>
+    # or X-API-Key header)
+    api_key: str = ""
+    # SLO class: "gold" | "best_effort" | "batch"
+    slo_class: str = SLO_BEST_EFFORT
+    # token-bucket rate limits (0 = unlimited)
+    requests_per_sec: float = 0.0
+    tokens_per_sec: float = 0.0
+    # bucket depths; 0 = one second of the rate (minimum 1)
+    burst_requests: float = 0.0
+    burst_tokens: float = 0.0
+    # concurrent admitted-but-unfinished requests (0 = unlimited)
+    max_inflight: int = 0
+    # per-tenant deadline override; 0 = the SLO class default
+    deadline_ms: float = 0.0
+    # fraction of this tenant's requests that get a full request trace
+    # with a `gateway` root span (0 = never, 1 = every request)
+    trace_sample_rate: float = 0.0
+
+    @field_validator("name", "api_key")
+    @classmethod
+    def _required(cls, v, info):
+        if not v:
+            raise ValueError(
+                f"serving.gateway.tenants[].{info.field_name} is required")
+        return v
+
+    @field_validator("slo_class")
+    @classmethod
+    def _slo(cls, v):
+        if v not in SLO_CLASSES:
+            raise ValueError(
+                "serving.gateway.tenants[].slo_class must be one of "
+                f"{SLO_CLASSES}, got {v!r}")
+        return v
+
+    @field_validator("requests_per_sec", "tokens_per_sec",
+                     "burst_requests", "burst_tokens", "deadline_ms")
+    @classmethod
+    def _nonneg(cls, v, info):
+        if v < 0:
+            raise ValueError(
+                f"serving.gateway.tenants[].{info.field_name} must be "
+                f">= 0, got {v}")
+        return v
+
+    @field_validator("max_inflight")
+    @classmethod
+    def _inflight(cls, v):
+        if v < 0:
+            raise ValueError(
+                "serving.gateway.tenants[].max_inflight must be >= 0 "
+                f"(0 = unlimited), got {v}")
+        return v
+
+    @field_validator("trace_sample_rate")
+    @classmethod
+    def _sample(cls, v):
+        if not 0.0 <= v <= 1.0:
+            raise ValueError(
+                "serving.gateway.tenants[].trace_sample_rate must be in "
+                f"[0, 1], got {v}")
+        return v
+
+
+class GatewayConfig(DeepSpeedConfigModel):
+    """The ``serving.gateway`` block: the HTTP/SSE front door
+    (:class:`deepspeed_tpu.serving.gateway.ServingGateway`). Absent (the
+    default) the gateway does not exist — requests enter via Python
+    ``submit()`` calls and the compiled programs are byte-identical (the
+    standard zero-overhead pin; the gateway is pure host code and never
+    imports jax, GL01-gated). With no ``tenants`` rows the gateway is
+    open: requests need no API key and run as the anonymous tenant at
+    the ``best_effort`` class with no quotas."""
+
+    enabled: bool = True
+    # bind address; port 0 = ephemeral (read it back from .port)
+    host: str = "127.0.0.1"
+    port: int = 0
+    # request hardening: bodies above this are refused with 413
+    max_body_bytes: int = 1048576
+    # per-connection bounded SSE send queue (tokens); a slow reader that
+    # overflows it sheds THAT request only, never the step loop
+    send_queue_tokens: int = 256
+    # Retry-After seconds attached to 429/503 responses (rate sheds use
+    # the bucket's own refill estimate when it is larger)
+    retry_after_secs: float = 1.0
+    # backend overload score (router/fleet ``overload()``) at or above
+    # which new submits get 503 before touching the queue; 0 = off
+    overload_reject_threshold: float = 0.0
+    # recent finished requests per tenant the error budget is burned
+    # over (a bounded sliding window)
+    budget_window: int = 256
+    # handler wait granularity for terminal-state polls and the pump
+    poll_secs: float = 0.05
+    # own the step loop: a daemon thread drives ``gateway.step()`` while
+    # work is pending (off = the caller drives steps, e.g. trace replay)
+    pump: bool = False
+    # ---- SLO classes ----
+    gold: SloClassConfig = SloClassConfig(priority=2)
+    best_effort: SloClassConfig = SloClassConfig(priority=1)
+    batch: SloClassConfig = SloClassConfig(priority=0)
+    # ---- tenant table (empty = open gateway, anonymous tenant) ----
+    tenants: List[GatewayTenantConfig] = []
+
+    @field_validator("port")
+    @classmethod
+    def _port(cls, v):
+        if not 0 <= v <= 65535:
+            raise ValueError(
+                f"serving.gateway.port must be in [0, 65535], got {v}")
+        return v
+
+    @field_validator("max_body_bytes", "send_queue_tokens",
+                     "budget_window")
+    @classmethod
+    def _positive(cls, v, info):
+        if v <= 0:
+            raise ValueError(
+                f"serving.gateway.{info.field_name} must be > 0, got {v}")
+        return v
+
+    @field_validator("retry_after_secs", "overload_reject_threshold")
+    @classmethod
+    def _nonneg(cls, v, info):
+        if v < 0:
+            raise ValueError(
+                f"serving.gateway.{info.field_name} must be >= 0, got {v}")
+        return v
+
+    @field_validator("poll_secs")
+    @classmethod
+    def _poll(cls, v):
+        if v <= 0:
+            raise ValueError(
+                f"serving.gateway.poll_secs must be > 0, got {v}")
+        return v
+
+    @model_validator(mode="after")
+    def _unique_tenants(self):
+        names = [t.name for t in self.tenants]
+        keys = [t.api_key for t in self.tenants]
+        if len(set(names)) != len(names):
+            raise ValueError(
+                f"serving.gateway.tenants names must be unique, got {names}")
+        if len(set(keys)) != len(keys):
+            raise ValueError(
+                "serving.gateway.tenants api_keys must be unique (two "
+                "tenants sharing a key would be one identity)")
+        return self
+
+
 class ServingConfig(DeepSpeedConfigModel):
     enabled: bool = True
     # ---- paged KV cache ----
@@ -424,6 +626,9 @@ class ServingConfig(DeepSpeedConfigModel):
     # ---- live KV-block migration (None = migration does not exist:
     # failover replays, drains wait, compiled HLO byte-identical) ----
     migration: Optional[MigrationConfig] = None
+    # ---- HTTP/SSE front door (None = the gateway does not exist;
+    # requests enter via Python submit() exactly as before) ----
+    gateway: Optional[GatewayConfig] = None
 
     @field_validator("block_size", "decode_slots")
     @classmethod

@@ -31,14 +31,26 @@ deterministically (same seed + same trace = bit-identical prompts —
 tenant prefixes shared, tails unique). :meth:`TraceReplayer.report`
 reduces the collected request handles to SLO attainment: TTFT
 p50/p95, shed rate, tokens/s of simulated time, and the fraction of
-arrivals served within a target.
+arrivals served within a target — aggregate AND per tenant (the
+``tenants`` block feeds per-tenant error budgets).
+
+**HTTP driver** — :class:`HttpReplayDriver` is a replay target that
+submits THROUGH a running serving gateway over real HTTP: each arrival
+becomes a ``POST /v1/generate`` with the tenant's API key, the SSE
+stream is consumed by a reader thread, and ``step()`` drives the
+gateway's backend on the shared fake clock. Admission is serialized
+(``submit`` returns once the gateway answered status + headers), so
+quota decisions and token streams stay bit-deterministic.
 """
 
 import dataclasses
 import json
 import math
+import threading
+import urllib.error
+import urllib.request
 import zlib
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -262,6 +274,7 @@ class TraceReplayer:
         self._routerlike = hasattr(target, "overload") \
             or hasattr(target, "router")
         self.handles: List = []
+        self.tenants: List[str] = []   # aligned with handles
         self.steps = 0
         self._t0 = clock()
 
@@ -292,6 +305,9 @@ class TraceReplayer:
                       deadline_ms=float(arrival.deadline_ms))
         if self._routerlike:
             kwargs["priority"] = int(arrival.priority)
+        if getattr(self.target, "accepts_tenant", False):
+            # the HTTP driver maps the tenant to its API key
+            kwargs["tenant"] = arrival.tenant
         return self.target.submit(self.prompt_for(arrival, index), **kwargs)
 
     def run(self) -> dict:
@@ -302,6 +318,7 @@ class TraceReplayer:
             now = self.clock()
             while i < len(self.trace) and self.trace[i].arrival_ts <= now:
                 self.handles.append(self._submit(self.trace[i], i))
+                self.tenants.append(self.trace[i].tenant or "")
                 i += 1
             done = self.target.step()
             self.steps += 1
@@ -310,15 +327,48 @@ class TraceReplayer:
             self.clock.advance(self.step_secs)
             if self.max_steps and self.steps >= self.max_steps:
                 break
+        # HTTP-driver seam: wait (real time, no simulated steps) for the
+        # reader threads to drain their streams before reporting
+        finish = getattr(self.target, "finish", None)
+        if finish is not None:
+            finish()
         return self.report()
 
     # ------------------------------------------------------------------
+    def _reduce(self, recs: List[dict], slo: Optional[dict]) -> dict:
+        """One TTFT/shed/attainment block over a record subset (the
+        aggregate report and every per-tenant row share this shape)."""
+        finished = [r for r in recs if r["state"] == "finished"]
+        shed = [r for r in recs if r["state"] == "shed"]
+        ttfts = [r["ttft_ms"] for r in finished
+                 if r.get("ttft_ms") is not None]
+        out = {
+            "requests": len(recs),
+            "finished": len(finished),
+            "shed": len(shed),
+            "shed_rate": round(len(shed) / len(recs), 4) if recs else None,
+            "tokens_out": sum(r.get("new_tokens") or 0 for r in finished),
+            "ttft_ms_p50": _pct(ttfts, 50),
+            "ttft_ms_p95": _pct(ttfts, 95),
+        }
+        if slo:
+            target = float(slo.get("ttft_p95_ms") or 0.0)
+            good = [r for r in finished
+                    if not target or (r.get("ttft_ms") is not None
+                                      and r["ttft_ms"] <= target)]
+            out["slo_attainment"] = (round(len(good) / len(recs), 4)
+                                     if recs else None)
+        return out
+
     def report(self, slo: Optional[dict] = None) -> dict:
         """SLO attainment over every replayed arrival. With ``slo``
         (``{"ttft_p95_ms": X}``) adds ``slo_attainment`` — the fraction
         of arrivals that finished with TTFT within the target (a shed
         arrival is a miss by definition) — and ``slo_ok``, whether the
-        aggregate window met both targets."""
+        aggregate window met both targets. Traces with tenant labels get
+        a ``tenants`` block: the same TTFT/shed/attainment breakdown per
+        tenant (one aggregate line would hide a starved tenant behind a
+        healthy mix — this is what per-tenant error budgets read)."""
         recs = [h.record() for h in self.handles]
         finished = [r for r in recs if r["state"] == "finished"]
         shed = [r for r in recs if r["state"] == "shed"]
@@ -353,4 +403,165 @@ class TraceReplayer:
                                 and out["ttft_ms_p95"] <= target))
                 and (shed_target is None
                      or (out["shed_rate"] or 0.0) <= float(shed_target)))
+        if (len(self.tenants) == len(recs)
+                and any(t for t in self.tenants)):
+            by_tenant: Dict[str, List[dict]] = {}
+            for tenant, rec in zip(self.tenants, recs):
+                by_tenant.setdefault(tenant or "", []).append(rec)
+            out["tenants"] = {tenant: self._reduce(by_tenant[tenant], slo)
+                              for tenant in sorted(by_tenant)}
         return out
+
+
+# ---------------------------------------------------------------------------
+# HTTP driver: replay THROUGH the serving gateway
+
+class _HttpHandle:
+    """A replay handle for one HTTP request: quacks like a Request
+    (``state`` / ``done`` / ``record()``) so the replayer's report path
+    is identical either way. Terminal state comes from the server — the
+    ``done`` SSE event carries the backend's own record."""
+
+    def __init__(self, request_id: str, prompt_len: int):
+        self.request_id = request_id
+        self.state = "queued"
+        self.tokens: List[int] = []
+        self.finished = threading.Event()
+        self._record = {"request_id": request_id, "state": self.state,
+                        "reason": None, "prompt_len": prompt_len,
+                        "new_tokens": 0, "ttft_ms": None}
+
+    @property
+    def done(self) -> bool:
+        return self.state in ("finished", "shed")
+
+    def reject(self, status: int, reason: str):
+        self.state = "shed"
+        self._record.update(state="shed", reason=reason,
+                            http_status=status)
+        self.finished.set()
+
+    def finish(self, record: dict):
+        self.state = str(record.get("state") or "finished")
+        self._record.update(record)
+        self._record["state"] = self.state
+        self.finished.set()
+
+    def error(self, reason: str):
+        self.state = "shed"
+        self._record.update(state="shed", reason=reason,
+                            new_tokens=len(self.tokens))
+        self.finished.set()
+
+    def record(self) -> dict:
+        rec = dict(self._record)
+        rec.setdefault("new_tokens", len(self.tokens))
+        return rec
+
+
+class HttpReplayDriver:
+    """Replay target that routes every submit through a running
+    :class:`~deepspeed_tpu.serving.gateway.ServingGateway` over real
+    HTTP. ``submit()`` POSTs to ``/v1/generate`` with the tenant's API
+    key and returns once the gateway answered (status + headers) — so
+    admission/quota decisions interleave deterministically with the
+    fake-clock step loop — then a daemon reader thread consumes the SSE
+    stream into the handle. ``step()`` drives the gateway (deferred
+    cancels + one backend step)."""
+
+    accepts_tenant = True
+
+    def __init__(self, gateway, *, api_keys: Optional[Dict[str, str]] = None,
+                 timeout_secs: float = 60.0):
+        self.gateway = gateway
+        self.url = gateway.url
+        if api_keys is None:
+            api_keys = {t.name: t.api_key
+                        for t in gateway.config.tenants}
+        self.api_keys = api_keys
+        self.timeout_secs = float(timeout_secs)
+        self._threads: List[threading.Thread] = []
+        self._count = 0
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 0, request_id: str = "",
+               deadline_ms: float = 0.0, tenant: str = "",
+               **kwargs) -> _HttpHandle:
+        self._count += 1
+        request_id = request_id or f"http-{self._count}"
+        handle = _HttpHandle(request_id, len(prompt))
+        body = {"prompt": [int(t) for t in prompt],
+                "max_new_tokens": int(max_new_tokens),
+                "request_id": request_id, "stream": True}
+        if deadline_ms:
+            body["deadline_ms"] = float(deadline_ms)
+        headers = {"Content-Type": "application/json"}
+        key = self.api_keys.get(tenant)
+        if key:
+            headers["Authorization"] = f"Bearer {key}"
+        req = urllib.request.Request(self.url + "/v1/generate",
+                                     data=json.dumps(body).encode("utf-8"),
+                                     headers=headers, method="POST")
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout_secs)
+        except urllib.error.HTTPError as exc:
+            try:
+                payload = json.loads(exc.read().decode("utf-8"))
+                reason = payload.get("error", {}).get("reason", "")
+            except Exception:
+                reason = ""
+            exc.close()
+            handle.reject(exc.code, f"gateway_{reason or exc.code}")
+            return handle
+        reader = threading.Thread(target=self._read_sse,
+                                  args=(resp, handle), daemon=True)
+        reader.start()
+        self._threads.append(reader)
+        return handle
+
+    @staticmethod
+    def _read_sse(resp, handle: _HttpHandle):
+        event, data = "", ""
+        try:
+            for raw in resp:
+                line = raw.decode("utf-8").rstrip("\n")
+                if line.startswith("event: "):
+                    event = line[len("event: "):]
+                elif line.startswith("data: "):
+                    data = line[len("data: "):]
+                elif line == "":
+                    if event == "token":
+                        handle.tokens.append(int(json.loads(data)["token"]))
+                    elif event == "done":
+                        handle.finish(json.loads(data))
+                        return
+                    elif event == "error":
+                        handle.error(str(json.loads(data).get("reason")
+                                         or "stream_error"))
+                        return
+                    event, data = "", ""
+        except (OSError, ValueError):
+            pass
+        finally:
+            resp.close()
+            if not handle.finished.is_set():
+                handle.error("stream_closed")
+
+    # ------------------------------------------------------------------
+    def step(self):
+        return self.gateway.step()
+
+    @property
+    def pending(self) -> bool:
+        return self.gateway.pending
+
+    def drain(self, max_steps: Optional[int] = None):
+        return self.gateway.drain(max_steps)
+
+    def finish(self):
+        """Join every reader thread (bounded): streams whose backend
+        work completed finish without further steps; a stuck stream
+        times out and stays incomplete in the report."""
+        for thread in self._threads:
+            thread.join(self.timeout_secs)
+        self._threads = [t for t in self._threads if t.is_alive()]

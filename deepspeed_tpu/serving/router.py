@@ -514,6 +514,25 @@ class ReplicaRouter:
         return rreq
 
     # ------------------------------------------------------------------
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
+        """Client-side cancel of one live request (the gateway's
+        disconnect / slow-reader seam): cancels the replica-side proxy
+        through the engine's ``cancel()`` so the decode slot and its KV
+        blocks free immediately, then sheds the client handle. Returns
+        False when the id is unknown or already terminal."""
+        rreq = self.requests.get(request_id)
+        if rreq is None or rreq.done:
+            return False
+        if rreq.proxy is not None and 0 <= rreq.replica < len(self.replicas):
+            replica_cancel = getattr(self.replicas[rreq.replica],
+                                     "cancel", None)
+            if replica_cancel is not None:
+                replica_cancel(rreq.proxy.request_id, reason)
+            self._assigned[rreq.replica].discard(request_id)
+        self._shed(rreq, reason)
+        return True
+
+    # ------------------------------------------------------------------
     # failure handling + failover
     def _replica_failed(self, idx: int, reason: str, fatal: bool):
         h = self.health[idx]
@@ -1003,6 +1022,9 @@ class FleetManager:
             # the overload sheds it exists to catch
             self.autoscaler.observe_requests([rreq.record()])
         return rreq
+
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
+        return self.router.cancel(request_id, reason)
 
     def _routable_load(self) -> float:
         """Per-replica load over ROUTABLE replicas only — the capacity

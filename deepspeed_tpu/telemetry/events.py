@@ -45,6 +45,10 @@ satellite families that ride the same sink):
                      drains parked/lost/timed out, factory builds and
                      failures, per-step fleet gauges (replica-state
                      counts + SLO budget remaining)
+- ``gateway``      — HTTP/SSE front door: per-tenant admission
+                     (authorized / rejected with status + reason),
+                     quota sheds (rate / tokens / inflight), stream
+                     delivery outcomes, error-budget burn samples
 
 Everything in ``data`` must be JSON-safe; :func:`json_safe` coerces numpy
 scalars and drops device arrays (an event must never pin or sync device
@@ -58,7 +62,7 @@ from typing import Any, Dict, Optional
 
 KINDS = ("compile", "step_cost", "memory", "trace_window", "step",
          "wallclock", "comm", "fault", "serving", "model_time", "topology",
-         "router", "aot", "tuning", "span", "fleet")
+         "router", "aot", "tuning", "span", "fleet", "gateway")
 
 # Registered span names (the ``span`` kind's analog of KINDS): the report
 # tool groups phase tables and waterfalls by these literals and the
@@ -70,6 +74,12 @@ SPANS = (
     "request",        # root — submit to finish/shed, across failovers
     "attempt",        # one dispatch to one replica (attrs: attempt, replica)
     "deliver",        # tokens streamed to the client by one attempt
+    # gateway (HTTP front door) level: one trace per sampled HTTP request
+    "gateway",        # root — request received -> response flushed
+    #                   (attrs: tenant, route, status, streamed)
+    "auth",           # API-key resolution -> tenant identity (or 401/403)
+    "quota",          # token-bucket/inflight admission decision
+    #                   (attrs: tenant, outcome, retry_after_ms)
     # replica/serving-engine level
     "serve",          # one replica serving one attempt (engine-side root)
     "queue",          # submit/dispatch -> decode-slot admission

@@ -151,6 +151,28 @@ NAMES = {
     "ds_migration_stall_ms": (
         "histogram", "host walltime of one migration attempt, export "
                      "through source detach"),
+    # -- gateway (HTTP/SSE front door) --
+    "ds_gateway_requests_total": (
+        "counter", "HTTP requests by tenant and outcome (ok|rejected|"
+                   "shed|error); unknown tenants fold into overflow"),
+    "ds_gateway_rejects_total": (
+        "counter", "requests refused at the front door by tenant and "
+                   "reason (auth|rate|tokens|inflight|overload|"
+                   "bad_request|too_large)"),
+    "ds_gateway_inflight": (
+        "gauge", "requests currently admitted through the gateway and "
+                 "not yet finished, by tenant"),
+    "ds_gateway_ttft_ms": (
+        "histogram", "submit -> first SSE token flushed to the client, "
+                     "by tenant (gateway-observed TTFT)"),
+    "ds_gateway_tokens_total": (
+        "counter", "generated tokens delivered to clients, by tenant"),
+    "ds_gateway_stream_sheds_total": (
+        "counter", "SSE streams terminated early by tenant and cause "
+                   "(backend_shed|slow_reader|disconnect)"),
+    "ds_gateway_budget_remaining": (
+        "gauge", "per-tenant SLO error budget remaining (1.0 = "
+                 "untouched, 0.0 = spent)"),
 }
 
 # the label set a family folds excess cardinality into

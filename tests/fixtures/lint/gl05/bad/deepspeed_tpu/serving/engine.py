@@ -27,3 +27,9 @@ class ServingEngine:
     def migrate_step(self):
         # migration near-miss: the registered name is `migrate`
         self._tracer.record_span("migrat", "t1", 0, 1)       # near-miss
+
+    def gateway_step(self):
+        # gateway near-misses: the registered kind is `gateway`, the
+        # registered span names are gateway / auth / quota
+        self.telemetry.emit("gatway", "request.finished", step=1)  # typo
+        self._tracer.record_span("authz", "t1", 0, 1)            # near-miss

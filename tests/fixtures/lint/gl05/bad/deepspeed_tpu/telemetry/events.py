@@ -1,6 +1,6 @@
 """The fixture registry GL05 resolves (pure AST, never imported)."""
 
-KINDS = ("compile", "serving", "fault", "span")
+KINDS = ("compile", "serving", "fault", "span", "gateway")
 
 
 def make_event(kind, name, step, rank, data):
@@ -9,4 +9,4 @@ def make_event(kind, name, step, rank, data):
 
 
 SPANS = ("request", "queue", "decode", "draft", "verify",
-         "spec_commit", "migrate")
+         "spec_commit", "migrate", "gateway", "auth", "quota")

@@ -31,3 +31,11 @@ class ServingEngine:
     def migrate_step(self):
         # live KV migration's registered span name
         self._tracer.record_span("migrate", "t1", 0, 1)
+
+    def gateway_step(self):
+        # the HTTP front door's registered kind + span names
+        self.telemetry.emit("gateway", "request.finished", step=1)
+        with self._tracer.span("gateway", "t1"):
+            pass
+        self._tracer.record_span("auth", "t1", 0, 1)
+        self._tracer.record_span("quota", "t1", 0, 1)

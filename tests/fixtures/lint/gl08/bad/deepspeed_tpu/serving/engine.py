@@ -22,3 +22,8 @@ class ServingEngine:
         # near-miss on the migration family: the registered name is
         # ds_migration_attempts_total — drift stays pinned
         self._metrics.counter("ds_migration_attempt_total").inc()
+
+    def gateway(self):
+        # near-miss on the gateway family: the registered name is
+        # ds_gateway_requests_total — drift stays pinned
+        self._metrics.counter("ds_gateway_request_total").inc()

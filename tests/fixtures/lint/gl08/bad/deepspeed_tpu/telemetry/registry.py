@@ -8,4 +8,6 @@ NAMES = {
     "ds_slo_burn_rate": ("gauge", "error-budget burn rate"),
     "ds_migration_attempts_total": ("counter",
                                     "live KV migration attempts"),
+    "ds_gateway_requests_total": ("counter",
+                                  "gateway requests by tenant/outcome"),
 }

@@ -15,6 +15,10 @@ class ServingEngine:
         m = self.telemetry.metrics
         m.histogram("ds_serving_ttft_ms").observe(3.0)
         m.gauge("ds_slo_burn_rate", ("slo",)).labels(slo="ttft").set(1.0)
+        # the HTTP front door's registered counter family
+        m.counter("ds_gateway_requests_total",
+                  ("tenant", "outcome")).labels(
+            tenant="acme", outcome="ok").inc()
         # dynamic name: the emitting wrapper's responsibility, not a
         # literal this checker can (or should) judge
         m.counter(_name_for("steps")).inc()

@@ -39,7 +39,8 @@ RUN_LIST = ["getting-started.md", "parallelism.md", "inference.md",
             "training-efficiency.md", "checkpointing.md",
             "comm-quantization.md", "telemetry.md", "resilience.md",
             "serving.md", "elasticity.md", "aot.md", "lint.md",
-            "fleet.md", "metrics.md", "tensor-parallel.md"]
+            "fleet.md", "metrics.md", "tensor-parallel.md",
+            "gateway.md"]
 
 
 @pytest.mark.heavy

@@ -149,7 +149,7 @@ class TestGL05:
         found = [f for f in by_code(fixture_run("gl05", "bad"), "GL05")
                  if "unregistered kind" in f.message]
         kinds = {f.message.split("'")[1] for f in found}
-        assert kinds == {"servign", "decode_stats", "bogus"}
+        assert kinds == {"servign", "decode_stats", "bogus", "gatway"}
         assert all("compile, serving, fault" in f.message for f in found)
 
     def test_unregistered_span_names_flagged(self):
@@ -160,7 +160,7 @@ class TestGL05:
                  if "unregistered span name" in f.message]
         names = {f.message.split("'")[1] for f in found}
         assert names == {"prefil", "dequeue", "warmup", "fwdbwd",
-                         "drafts", "commit", "migrat"}
+                         "drafts", "commit", "migrat", "authz"}
         assert all("request, queue, decode, draft, verify, spec_commit"
                    in f.message for f in found)
 
@@ -217,7 +217,9 @@ class TestGL07:
                 "deepspeed_tpu/serving/scheduler.py",
                 "deepspeed_tpu/serving/autoscaler.py",
                 "deepspeed_tpu/serving/replay.py",
-                "deepspeed_tpu/serving/capacity.py"} \
+                "deepspeed_tpu/serving/capacity.py",
+                "deepspeed_tpu/serving/gateway.py",
+                "deepspeed_tpu/serving/tenancy.py"} \
             <= set(CLOCKED_MODULES)
 
 
@@ -229,9 +231,10 @@ class TestGL08:
         msgs = " | ".join(f.message for f in found)
         for name in ("ds_step_total", "ds_fleet_overlod",
                      "ds_serving_ttft_millis", "ds_decode_stats_total",
-                     "ds_slo_burnrate", "ds_migration_attempt_total"):
+                     "ds_slo_burnrate", "ds_migration_attempt_total",
+                     "ds_gateway_request_total"):
             assert name in msgs, f"GL08 missed {name!r}"
-        assert len(found) == 6
+        assert len(found) == 7
 
     def test_registered_dynamic_and_non_registry_shapes_are_legal(self):
         """Registered literals pass; dynamic names are the wrapper's

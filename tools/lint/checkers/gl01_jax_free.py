@@ -36,6 +36,8 @@ JAX_FREE_MODULES = (
     "deepspeed_tpu/serving/replay.py",
     "deepspeed_tpu/serving/capacity.py",
     "deepspeed_tpu/serving/migration.py",
+    "deepspeed_tpu/serving/gateway.py",
+    "deepspeed_tpu/serving/tenancy.py",
     "deepspeed_tpu/telemetry/events.py",
     "deepspeed_tpu/telemetry/tracing.py",
     "deepspeed_tpu/telemetry/metrics.py",

@@ -33,6 +33,8 @@ CLOCKED_MODULES = (
     "deepspeed_tpu/serving/autoscaler.py",
     "deepspeed_tpu/serving/replay.py",
     "deepspeed_tpu/serving/capacity.py",
+    "deepspeed_tpu/serving/gateway.py",
+    "deepspeed_tpu/serving/tenancy.py",
 )
 
 _TIME_ATTRS = {"time", "monotonic", "perf_counter", "time_ns",

@@ -60,6 +60,7 @@ def aggregate(events: List[Dict]) -> Dict:
     fleet = {"events": 0, "scale_ups": 0, "scale_downs": 0, "parks": 0,
              "drains_lost": 0, "drain_timeouts": 0, "factory_failures": 0,
              "decisions": [], "last_gauges": {}}
+    gateway = {"events": 0, "tenants": {}}
     aot = {"events": 0, "hits": 0, "hit_programs": {}, "captured": 0,
            "captured_bytes": 0, "disabled": [], "load_failed": 0,
            "armed_programs": 0}
@@ -176,6 +177,31 @@ def aggregate(events: List[Dict]) -> Dict:
                 fleet["factory_failures"] += 1
             elif name == "fleet.gauges":
                 fleet["last_gauges"] = data
+        elif kind == "gateway":
+            gateway["events"] += 1
+            t = gateway["tenants"].setdefault(
+                data.get("tenant") or "anonymous",
+                {"finished": 0, "shed": 0, "rejected": 0, "tokens": 0,
+                 "shed_reasons": {}, "reject_reasons": {},
+                 "ttft_ms": [], "budget_remaining": None})
+            if name == "request.finished":
+                if data.get("outcome") == "ok":
+                    t["finished"] += 1
+                else:
+                    t["shed"] += 1
+                    reason = data.get("reason") or "?"
+                    t["shed_reasons"][reason] = \
+                        t["shed_reasons"].get(reason, 0) + 1
+                t["tokens"] += data.get("tokens") or 0
+                if data.get("ttft_ms") is not None:
+                    t["ttft_ms"].append(float(data["ttft_ms"]))
+                if data.get("budget_remaining") is not None:
+                    t["budget_remaining"] = data["budget_remaining"]
+            elif name == "request.rejected":
+                t["rejected"] += 1
+                reason = data.get("reason") or "?"
+                t["reject_reasons"][reason] = \
+                    t["reject_reasons"].get(reason, 0) + 1
         elif kind == "aot":
             aot["events"] += 1
             action = data.get("action")
@@ -206,6 +232,12 @@ def aggregate(events: List[Dict]) -> Dict:
                             "error") if data.get(k) is not None})
         elif kind == "span":
             span_events.append(e)
+    for t in gateway["tenants"].values():
+        ts = sorted(t.pop("ttft_ms"))
+        t["ttft_ms_p50"] = round(ts[(len(ts) - 1) // 2], 3) if ts else None
+        t["ttft_ms_p95"] = (round(ts[min(len(ts) - 1,
+                                         int(0.95 * len(ts)))], 3)
+                            if ts else None)
     return {
         "compile": compile_by_name,
         "step_cost": step_cost_by_name,
@@ -216,6 +248,7 @@ def aggregate(events: List[Dict]) -> Dict:
         "faults": faults,
         "router": router,
         "fleet": fleet,
+        "gateway": gateway,
         "serving": serving,
         "aot": aot,
         "tuning": tuning,
@@ -445,6 +478,58 @@ def _fleet_lines(agg: Dict, markdown: bool,
                        f"({d['reason']}"
                        + (f", {d['source']}" if d.get("source") else "")
                        + f") {d['from']} -> {d['to']}")
+    return out
+
+
+def _gateway_lines(agg: Dict, markdown: bool,
+                   prom: Dict = None) -> List[str]:
+    """HTTP front door: per-tenant request/shed/reject counts, TTFT
+    percentiles and error-budget remaining from the ``gateway`` event
+    stream. With ``--prom`` the budget numbers come from the registry's
+    own ``ds_gateway_budget_remaining`` gauge instead."""
+    g = agg.get("gateway") or {}
+    if not g.get("events"):
+        return []
+    tenants = g.get("tenants") or {}
+    finished = sum(t["finished"] for t in tenants.values())
+    shed = sum(t["shed"] for t in tenants.values())
+    rejected = sum(t["rejected"] for t in tenants.values())
+    out = [""]
+    head = (f"gateway: {finished} finished, {shed} shed mid-stream, "
+            f"{rejected} rejected at the door "
+            f"({len(tenants)} tenant(s))")
+    out.append(("### " if markdown else "") + head)
+    pad = "" if markdown else "  "
+    if markdown and tenants:
+        out.append("\n| tenant | finished | shed | rejected | tokens "
+                   "| ttft p50/p95 (ms) | budget left |")
+        out.append("|---|---|---|---|---|---|---|")
+        for name, t in sorted(tenants.items()):
+            out.append(
+                f"| {name} | {t['finished']} | {t['shed']} "
+                f"| {t['rejected']} | {t['tokens']} "
+                f"| {t['ttft_ms_p50']}/{t['ttft_ms_p95']} "
+                f"| {t['budget_remaining']} |")
+    else:
+        for name, t in sorted(tenants.items()):
+            out.append(
+                f"{pad}tenant {name}: {t['finished']} finished, "
+                f"{t['shed']} shed, {t['rejected']} rejected, "
+                f"{t['tokens']} tokens, ttft p50/p95 "
+                f"{t['ttft_ms_p50']}/{t['ttft_ms_p95']} ms, "
+                f"budget left {t['budget_remaining']}")
+    for name, t in sorted(tenants.items()):
+        reasons = {**t["reject_reasons"], **t["shed_reasons"]}
+        if reasons:
+            chain = ", ".join(f"{k}: {v}"
+                              for k, v in sorted(reasons.items()))
+            out.append(f"{pad}{name} refusals: {chain}")
+    budget_rows = _prom_series(prom, "ds_gateway_budget_remaining")
+    if budget_rows:
+        out.append(f"{pad}budget remaining (registry): "
+                   + ", ".join(f"{r['labels'].get('tenant')}: "
+                               f"{r.get('value')}"
+                               for r in budget_rows))
     return out
 
 
@@ -834,6 +919,7 @@ def render(path: str, markdown: bool = False,
     lines.extend(_serving_lines(agg, markdown))
     lines.extend(_router_lines(agg, markdown))
     lines.extend(_fleet_lines(agg, markdown, prom))
+    lines.extend(_gateway_lines(agg, markdown, prom))
     lines.extend(_span_lines(agg, markdown))
     lines.extend(_prom_lines(prom, markdown))
     lines.extend(_flightrec_lines(flightrec or [], markdown))

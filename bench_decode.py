@@ -1213,6 +1213,118 @@ def _serving_tracing_series(ctx):
 
 
 # ---------------------------------------------------------------------------
+# keyed sampling: in-graph filtering overhead + sampled-stream failover
+def _sampling_series(ctx):
+    """Optional extra series (after the headline JSON): what the
+    reproducible-sampling contract costs and buys — (1) the SAME
+    mixed-arrival workload decoded greedy vs keyed-sampled (the keyed
+    program folds a threefry key and filters logits in-graph every
+    step, so the delta bounds that overhead); (2) failover
+    time-to-first-resumed-token for a SAMPLED stream, migrate vs full
+    replay (keyed replay regenerates the delivered prefix bit-exactly
+    and the shim swallows it — pre-contract, this request was simply
+    shed)."""
+    import sys
+
+    from deepspeed_tpu.runtime.resilience.chaos import ChaosReplica
+    from deepspeed_tpu.serving.router import ReplicaRouter
+
+    cfg = ctx["cfg"]
+    n_requests, arrive_every = ctx["n_requests"], ctx["arrive_every"]
+    lens, srv_new, srv_rng = ctx["lens"], ctx["srv_new"], ctx["srv_rng"]
+    L = max(lens)
+    SAMP = {"sampling": {"enabled": True}}
+
+    def long_prompt():
+        return srv_rng.integers(0, cfg.vocab_size, L).astype(np.int32)
+
+    def run_mixed(srv, sampled):
+        pending = [srv_rng.integers(0, cfg.vocab_size,
+                                    lens[i % len(lens)]).astype(np.int32)
+                   for i in range(n_requests)]
+        i = 0
+        t0 = time.perf_counter()
+        while pending or srv.pending:
+            for _ in range(arrive_every):
+                if pending:
+                    kw = ({"do_sample": True, "seed": 1000 + i,
+                           "temperature": 0.9, "top_p": 0.95}
+                          if sampled else {})
+                    srv.submit(pending.pop(0), max_new_tokens=srv_new,
+                               **kw)
+                    i += 1
+            srv.step()
+        srv.drain()
+        return time.perf_counter() - t0
+
+    def throughput_leg(sampled):
+        srv = _build_serving(ctx, SAMP)
+        run_mixed(srv, sampled)   # warm the bucket set + decode program
+        srv.reset_stats()
+        elapsed = run_mixed(srv, sampled)
+        tokens_out = sum(r["new_tokens"] for r in srv.records
+                         if r["state"] != "shed")
+        srv.destroy()
+        return (round(tokens_out / elapsed, 1) if elapsed > 0 else None)
+
+    def failover_leg(migration):
+        # replica 0 trips after the first decode step of a SAMPLED
+        # stream; first->second stream timestamp gap = time to the
+        # first resumed token (migrate moves the KV and the sampling
+        # counters; replay re-prefills and regenerates the delivered
+        # prefix from (seed, position), deduped by the shim)
+        pair = []
+        for _ in range(2):
+            s = _build_serving(ctx, SAMP)
+            s.submit(long_prompt(), max_new_tokens=2, do_sample=True,
+                     seed=7)
+            s.drain()
+            s.reset_stats()
+            pair.append(s)
+        s0, s1 = pair
+        router = ReplicaRouter(
+            [ChaosReplica(s0, fail_step_at=2, fail_step_times=3), s1],
+            config={"failure_threshold": 3, "max_failovers": 2},
+            migration=migration)
+        stamps = []
+        r = router.submit(long_prompt(), max_new_tokens=srv_new,
+                          do_sample=True, seed=42, temperature=0.9,
+                          stream=lambda _r, t, d:
+                          stamps.append(time.perf_counter()))
+        router.drain(max_steps=500)
+        moved = router.stats()["migrations"]
+        router.destroy()
+        gap = (round(1e3 * (stamps[1] - stamps[0]), 2)
+               if r.state == "finished" and len(stamps) > 1 else None)
+        return gap, moved
+
+    try:
+        greedy_tps = throughput_leg(False)
+        sampled_tps = throughput_leg(True)
+        mig_gap, moved = failover_leg({"enabled": True})
+        replay_gap, _ = failover_leg(None)
+        return {
+            "metric": f"{METRIC}_sampling",
+            "greedy_tokens_per_sec": greedy_tps,
+            "sampled_tokens_per_sec": sampled_tps,
+            "sampling_overhead_pct": round(
+                100.0 * (greedy_tps - sampled_tps) / greedy_tps, 2)
+            if greedy_tps and sampled_tps is not None else None,
+            "migrations_in_window": moved,
+            "sampled_migrate_resume_gap_ms": mig_gap,
+            "sampled_replay_resume_gap_ms": replay_gap,
+            "requests": n_requests, "new_tokens": srv_new,
+            "prompt_len": L,
+        }
+    except Exception as e:  # noqa: BLE001 — extras never kill the headline
+        print(f"# sampling series failed: {e}", file=sys.stderr,
+              flush=True)
+        return {"metric": f"{METRIC}_sampling", "value": None,
+                "unit": "tokens/s", "vs_baseline": None,
+                "error": str(e)[:300]}
+
+
+# ---------------------------------------------------------------------------
 def run_series(name, config=None):
     """Run ONE decode-bench series in-process and return its payload
     dict (never emits). ``config`` keys: ``serving`` (overrides merged
@@ -1241,6 +1353,8 @@ def run_series(name, config=None):
                                      serving_overrides=config.get("serving"))
     if name == "serving_tracing":
         return _serving_tracing_series(ctx)
+    if name == "serving_sampling":
+        return _sampling_series(ctx)
     if name == "spec_decode":
         return _spec_decode_series(ctx)
     if name == "tp":
@@ -1251,7 +1365,7 @@ def run_series(name, config=None):
 
 SERIES = ("headline", "serving", "serving_fastpath", "router", "fleet",
           "migration", "gateway", "decode_attention", "serving_chunk",
-          "serving_tracing", "spec_decode", "tp")
+          "serving_tracing", "serving_sampling", "spec_decode", "tp")
 
 
 def main():
@@ -1271,6 +1385,7 @@ def main():
     emit_result(_gateway_series(ctx))
     emit_result(_spec_decode_series(ctx))
     emit_result(_serving_tracing_series(ctx))
+    emit_result(_sampling_series(ctx))
     emit_result(_tp_series(ctx))
 
 

@@ -568,10 +568,84 @@ class InferenceEngine:
             f"inference.generate[T={prompt_len},new={max_new_tokens},"
             f"sample={do_sample},k={top_k},p={top_p},padded={padded}]")
 
+    def _build_generate_keyed(self, prompt_len: int, max_new_tokens: int,
+                              padded: bool = False):
+        """Reproducible keyed sampling for ``generate()``: every token
+        is drawn from a threefry key folded from ``(seed, absolute
+        position)`` inside the program — the SAME fold-in the serving
+        engine's keyed decode performs — so a request decoded solo here
+        emits bit-identical tokens to the same request decoded under
+        continuous batching, migrated mid-stream, or replayed on
+        failover. Temperature/top-k/top-p are traced (one compiled
+        program covers every knob setting), so the cache keys only on
+        shape."""
+        from deepspeed_tpu.ops.sampling import keyed_sample
+
+        dmodule = self._decode_module(padded)
+        dequant = self._dequantize
+        batch_spec = P(AXIS_DATA) if self.topo.axis_size(AXIS_DATA) > 1 else P()
+
+        def generate_fn(qparams, input_ids, attention_mask, seed,
+                        temperature, top_k, top_p, eos_id):
+            params = dequant(qparams)
+            input_ids = jax.lax.with_sharding_constraint(
+                input_ids, NamedSharding(self.mesh, batch_spec))
+            if padded:
+                attention_mask = jax.lax.with_sharding_constraint(
+                    attention_mask, NamedSharding(self.mesh, batch_spec))
+            kw = {"attention_mask": attention_mask} if padded else {}
+            out, vars_ = dmodule.apply({"params": params}, input_ids,
+                                       mutable=["cache"], **kw)
+            logits = self._logits_of(out)
+            cache = vars_["cache"]
+            B, T = input_ids.shape
+            # the first generated token's absolute position is the REAL
+            # prompt length — per row under left padding (mask sum), so
+            # serving-bucket pads never shift the key stream
+            pos0 = (jnp.sum(attention_mask, axis=1).astype(jnp.int32)
+                    if padded else jnp.full((B,), T, jnp.int32))
+            seeds = jnp.full((B,), seed, jnp.uint32)
+            temps = jnp.full((B,), temperature, jnp.float32)
+            ks = jnp.full((B,), top_k, jnp.int32)
+            ps = jnp.full((B,), top_p, jnp.float32)
+            flags = jnp.ones((B,), jnp.int32)
+
+            def sample(step_logits, pos):
+                return keyed_sample(step_logits, seeds, pos, flags, temps,
+                                    ks, ps)
+
+            first = sample(logits[:, -1], pos0)
+            done = first == eos_id
+
+            def body(carry, _):
+                cache, token, pos, done = carry
+                out, vars_ = dmodule.apply(
+                    {"params": params, "cache": cache}, token[:, None],
+                    mutable=["cache"])
+                logits = self._logits_of(out)
+                cache = vars_["cache"]
+                pos = pos + 1
+                nxt = sample(logits[:, -1], pos)
+                nxt = jnp.where(done, eos_id, nxt)
+                done = done | (nxt == eos_id)
+                return (cache, nxt, pos, done), nxt
+
+            (_, _, _, _), rest = jax.lax.scan(
+                body, (cache, first, pos0, done), None,
+                length=max_new_tokens - 1)
+            tokens = jnp.concatenate([first[:, None], rest.T], axis=1)
+            return tokens
+
+        return self.telemetry.watch_jit(
+            jax.jit(generate_fn),
+            f"inference.generate[T={prompt_len},new={max_new_tokens},"
+            f"keyed=True,padded={padded}]")
+
     def generate(self, input_ids, max_new_tokens: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 0.0, eos_token_id: int = -1,
-                 attention_mask=None, rng=None, **kwargs):
+                 attention_mask=None, rng=None, seed: Optional[int] = None,
+                 **kwargs):
         """Sharded autoregressive generation (reference ``engine.py:524``).
 
         Returns ``[batch, prompt_len + max_new_tokens]`` token ids (prompt
@@ -579,6 +653,11 @@ class InferenceEngine:
         ``attention_mask`` ([B, T], 0 = LEFT padding) batches prompts of
         unequal length: per-row positions start at the first real token and
         padded cache slots are masked throughout decode.
+
+        ``do_sample=True`` with ``seed`` set selects the KEYED sampler:
+        token P is a pure function of (seed, P, logits), bit-identical to
+        the serving engine's keyed decode of the same request — ``rng`` is
+        ignored and the engine's rng stream is left untouched.
         """
         # resilience bracket: the hang-watchdog stall timer runs only
         # while a request is in flight (idle gaps between requests are
@@ -590,7 +669,7 @@ class InferenceEngine:
                 input_ids, max_new_tokens=max_new_tokens,
                 do_sample=do_sample, temperature=temperature, top_k=top_k,
                 top_p=top_p, eos_token_id=eos_token_id,
-                attention_mask=attention_mask, rng=rng, **kwargs)
+                attention_mask=attention_mask, rng=rng, seed=seed, **kwargs)
         except BaseException:
             self.resilience.serving_request_abandon()
             raise
@@ -599,7 +678,7 @@ class InferenceEngine:
                        do_sample: bool = False, temperature: float = 1.0,
                        top_k: int = 0, top_p: float = 0.0,
                        eos_token_id: int = -1, attention_mask=None, rng=None,
-                       **kwargs):
+                       seed: Optional[int] = None, **kwargs):
         input_ids = jnp.asarray(input_ids)
         if input_ids.ndim == 1:
             input_ids = input_ids[None]
@@ -643,18 +722,38 @@ class InferenceEngine:
                 input_ids, attention_mask, limit, max_new_tokens)
             T += trim
         padded = attention_mask is not None
-        key = (T, int(max_new_tokens), bool(do_sample), int(top_k),
-               float(top_p), padded)
-        if key not in self._generate_cache:
-            self._generate_cache[key] = self._build_generate(*key)
-        if rng is None:
-            self._rng, rng = jax.random.split(self._rng)
+        keyed = bool(do_sample) and seed is not None
+        if keyed:
+            # keyed sampler: knobs are TRACED (one program per shape, not
+            # per knob setting) and the rng stream is untouched, so a
+            # keyed call never perturbs a neighbouring greedy caller's
+            # compile cache or reproducibility
+            key = (T, int(max_new_tokens), "keyed", padded)
+            if key not in self._generate_cache:
+                self._generate_cache[key] = self._build_generate_keyed(
+                    T, int(max_new_tokens), padded)
+        else:
+            key = (T, int(max_new_tokens), bool(do_sample), int(top_k),
+                   float(top_p), padded)
+            if key not in self._generate_cache:
+                self._generate_cache[key] = self._build_generate(*key)
+            if rng is None:
+                self._rng, rng = jax.random.split(self._rng)
         t = self._timer("generate")
         t.start()
-        new = self._generate_cache[key](
-            self.params, input_ids, attention_mask, rng,
-            jnp.asarray(temperature, jnp.float32),
-            jnp.asarray(eos_token_id, jnp.int32))
+        if keyed:
+            new = self._generate_cache[key](
+                self.params, input_ids, attention_mask,
+                jnp.asarray(int(seed) & 0xFFFFFFFF, jnp.uint32),
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(int(top_k), jnp.int32),
+                jnp.asarray(float(top_p), jnp.float32),
+                jnp.asarray(eos_token_id, jnp.int32))
+        else:
+            new = self._generate_cache[key](
+                self.params, input_ids, attention_mask, rng,
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(eos_token_id, jnp.int32))
         new.block_until_ready()
         t.stop()
         self._record_model_time("generate", t.elapsed(reset=True))

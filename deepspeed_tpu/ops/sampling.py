@@ -1,0 +1,104 @@
+"""Keyed, batch-invariant next-token sampling.
+
+The reproducible-sampling contract: the token emitted for request R at
+absolute position P is a pure function of ``(R's seed, P, the logits
+row)`` — independent of decode-slot index, batch composition, mesh
+layout, or which replica runs the dispatch. Inside the compiled program
+each row folds ``(seed, position)`` into a threefry key
+(``jax.random.fold_in`` on ``jax.random.PRNGKey(seed)``: counter-based,
+so no sampler state ever needs to be carried, migrated, or replayed —
+the position IS the state), applies temperature / top-k / top-p
+filtering in-graph, and draws one categorical sample. Greedy rows
+(``flags == 0``) take the plain float32 argmax, bit-identical to
+:func:`deepspeed_tpu.inference.engine.sample_logits`'s greedy path, so
+a mixed batch never perturbs its greedy members.
+
+Unlike ``sample_logits`` (whose ``do_sample``/``top_k``/``top_p`` are
+Python-static and select the traced program), every knob here is a
+traced per-row array: the serving decode program stays ONE compiled
+shape for any mix of greedy and sampled slots — the
+zero-steady-state-retrace pin holds. Filter semantics mirror
+``sample_logits`` exactly (top-k by kth-largest threshold, HF-style
+nucleus keeping the first token past the mass threshold) so a request
+sampled through either path from the same key and logits emits the same
+token.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fold_in_key", "keyed_sample", "keyed_filter_logits"]
+
+
+def fold_in_key(seed, position):
+    """The per-token threefry key: ``fold_in(PRNGKey(seed), position)``.
+
+    Counter-based keying is the whole contract — both arguments may be
+    traced, and the key depends on nothing else, so any replica (or the
+    solo ``generate()`` path) regenerates position P's key bit-exactly.
+    A jax upgrade that changes threefry changes every emitted token;
+    the unit-vector pin in ``tests/unit/test_sampling.py`` breaks
+    loudly when that happens.
+    """
+    return jax.random.fold_in(jax.random.PRNGKey(seed), position)
+
+
+def keyed_filter_logits(logits, temperature, top_k, top_p):
+    """Temperature / top-k / top-p filtering for ONE logits row with
+    every knob traced. ``top_k <= 0`` and ``top_p <= 0`` disable their
+    filters (matching ``sample_logits``'s static gates); masked entries
+    go to ``-inf`` so ``jax.random.categorical`` never picks them."""
+    logits = logits.astype(jnp.float32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    v = logits.shape[-1]
+    # dynamic top-k: threshold at the kth-largest value (the same
+    # `logits < kth` mask lax.top_k produces in sample_logits — ties at
+    # the threshold survive identically); k <= 0 pushes the threshold
+    # to -inf, which nothing is below
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    kth = sorted_desc[jnp.clip(top_k - 1, 0, v - 1)]
+    kth = jnp.where(top_k > 0, kth, -jnp.inf)
+    logits = jnp.where(logits < kth, -jnp.inf, logits)
+    # dynamic nucleus: smallest prefix of the (re-)sorted distribution
+    # whose mass reaches top_p, first token past the threshold kept
+    # (HF-style, same formula as sample_logits); top_p <= 0 maps to 1.0
+    # — `cum - probs < 1` keeps every nonzero-probability token
+    p = jnp.where(top_p > 0.0, top_p, 1.0)
+    sorted2 = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted2, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < p
+    cutoff = jnp.min(jnp.where(keep, sorted2, jnp.inf), axis=-1)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+def _sample_row(logits, seed, position, flag, temperature, top_k, top_p):
+    greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+    key = fold_in_key(seed, position)
+    filtered = keyed_filter_logits(logits, temperature, top_k, top_p)
+    # partitionable threefry, scoped to THIS draw at trace time: the
+    # legacy lowering generates different gumbel bits when GSPMD shards
+    # the logits row (a tp=2 decode program would emit different tokens
+    # than tp=1 from identical keys and logits — the mesh-invariance
+    # half of the contract broken). The partitionable lowering's bits
+    # are a pure per-element function of (key, global index), identical
+    # under any sharding. Legacy rng streams elsewhere keep the default.
+    with jax.threefry_partitionable(True):
+        sampled = jax.random.categorical(key, filtered, axis=-1)
+    return jnp.where(flag > 0, sampled, greedy).astype(jnp.int32)
+
+
+def keyed_sample(logits, seeds, positions, flags, temperatures, top_ks,
+                 top_ps):
+    """Batch keyed sampling: ``logits [N, V]``, everything else ``[N]``.
+
+    Per row: ``flags[i] > 0`` draws a categorical from
+    ``fold_in_key(seeds[i], positions[i])`` over the filtered row;
+    ``flags[i] == 0`` is the plain greedy argmax (idle serving slots and
+    greedy requests in a mixed batch). Returns int32 ``[N]``.
+    """
+    return jax.vmap(_sample_row)(
+        logits, jnp.asarray(seeds, jnp.uint32),
+        jnp.asarray(positions, jnp.int32), jnp.asarray(flags, jnp.int32),
+        jnp.asarray(temperatures, jnp.float32),
+        jnp.asarray(top_ks, jnp.int32), jnp.asarray(top_ps, jnp.float32))

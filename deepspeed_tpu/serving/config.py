@@ -106,6 +106,61 @@ class SpeculativeConfig(DeepSpeedConfigModel):
         return self
 
 
+class SamplingConfig(DeepSpeedConfigModel):
+    """The ``serving.sampling`` block: reproducible keyed sampling on
+    the fixed-slot decode loop. Absent (the default) keyed sampling
+    does not exist — the compiled prefill/decode/chunk programs are
+    byte-identical to previous releases (the standard zero-overhead
+    pin). Present, a request submitted with ``do_sample=True`` and a
+    ``seed`` samples through a counter-based threefry key folded from
+    ``(seed, absolute position)`` INSIDE the compiled program, with
+    temperature/top-k/top-p traced per slot: the emitted token is a
+    pure function of (seed, position, logits), independent of slot
+    index, batch composition, and tp layout — so failover replay,
+    live migration, and trace replay are all bit-exact for sampled
+    streams, exactly as they are for greedy ones.
+
+    One sampling authority per engine: ``serving.do_sample`` (the
+    legacy engine-level sampler, shared-rng and NOT replayable) must
+    stay off, and speculative decoding (whose accept oracle is the
+    greedy stream) cannot be combined with this block."""
+
+    enabled: bool = True
+    # per-request defaults a sampled request inherits when it leaves
+    # temperature/top_k/top_p unset (seed has no default on purpose:
+    # an unseeded do_sample request is not replayable and sheds loudly)
+    default_temperature: float = 1.0
+    default_top_k: int = 0
+    default_top_p: float = 0.0
+
+    @field_validator("default_temperature")
+    @classmethod
+    def _temp(cls, v):
+        if v <= 0:
+            raise ValueError(
+                "serving.sampling.default_temperature must be > 0, "
+                f"got {v}")
+        return v
+
+    @field_validator("default_top_k")
+    @classmethod
+    def _topk(cls, v):
+        if v < 0:
+            raise ValueError(
+                "serving.sampling.default_top_k must be >= 0 "
+                f"(0 = disabled), got {v}")
+        return v
+
+    @field_validator("default_top_p")
+    @classmethod
+    def _topp(cls, v):
+        if not 0.0 <= v <= 1.0:
+            raise ValueError(
+                "serving.sampling.default_top_p must be in [0, 1] "
+                f"(0 = disabled), got {v}")
+        return v
+
+
 class ReplayConfig(DeepSpeedConfigModel):
     """The ``serving.replay`` block: workload-replay defaults consumed by
     :class:`deepspeed_tpu.serving.replay.TraceReplayer` (the trace-driven
@@ -611,6 +666,10 @@ class ServingConfig(DeepSpeedConfigModel):
     top_k: int = 0
     top_p: float = 0.0
     seed: int = 0
+    # ---- reproducible keyed sampling (None = keyed sampling does not
+    # exist; the compiled programs are byte-identical and a do_sample
+    # request sheds `sampling_unsupported`) ----
+    sampling: Optional[SamplingConfig] = None
     # ---- speculative decoding (None = speculation does not exist; the
     # decode program and its compiled HLO are byte-identical) ----
     speculative: Optional[SpeculativeConfig] = None
@@ -698,6 +757,30 @@ class ServingConfig(DeepSpeedConfigModel):
                 "serving.speculative requires greedy decoding "
                 "(do_sample: false): draft acceptance is verified "
                 "against the bit-reproducible greedy token stream")
+        return self
+
+    @model_validator(mode="after")
+    def _sampling_one_authority(self):
+        if self.sampling is not None and self.sampling.enabled:
+            if self.do_sample:
+                # the legacy engine-level sampler draws from ONE shared
+                # rng stream — its tokens depend on dispatch order and
+                # are unreplayable by construction; running both would
+                # leave "which sampler owns this slot" ambiguous
+                raise ValueError(
+                    "serving.sampling requires do_sample: false — the "
+                    "keyed sampler is per-REQUEST (submit with "
+                    "do_sample=True and a seed); the engine-level "
+                    "do_sample knob is the legacy shared-rng sampler")
+            if self.speculative is not None and self.speculative.enabled:
+                # the verify oracle is exact equality against the greedy
+                # stream; rejection-sampling speculation over keyed
+                # draws is the ROADMAP follow-up, not this block
+                raise ValueError(
+                    "serving.sampling cannot be combined with "
+                    "serving.speculative: draft acceptance is verified "
+                    "against the greedy token stream (rejection-sampled "
+                    "speculation is not implemented)")
         return self
 
 

@@ -182,6 +182,21 @@ class ServingEngine:
                        if self._proposer is not None else 0)
         self._verify_fn = None
         self._rng = jax.random.PRNGKey(self.config.seed)
+        # reproducible keyed sampling (serving.sampling): per-slot
+        # sampling state rides the compiled programs as traced arrays —
+        # the key for request R's token at position P folds (R's seed, P)
+        # inside the program, so the emitted token is independent of slot
+        # index, batch composition and tp layout. With the block absent
+        # these arrays do not exist and every program is byte-identical.
+        self._keyed = bool(self.config.sampling
+                           and self.config.sampling.enabled)
+        if self._keyed:
+            n = self.config.decode_slots
+            self._seeds = np.zeros((n,), np.uint32)
+            self._samp_on = np.zeros((n,), np.int32)
+            self._temps = np.ones((n,), np.float32)
+            self._top_ks = np.zeros((n,), np.int32)
+            self._top_ps = np.zeros((n,), np.float32)
         self._step_count = 0
         # speculation counters over the stats window (reset_stats zeroes
         # them WITH the records deque — the bounded records alone would
@@ -255,6 +270,30 @@ class ServingEngine:
         jax, jnp = self._jax, self._jnp
         dmodule, dequant = self._dmodule, self.engine._dequantize
         logits_of = self.engine._logits_of
+        if self._keyed:
+            from deepspeed_tpu.ops.sampling import keyed_sample
+
+            def kfn(qparams, cache, ids, tables, num_valid, seeds, flags,
+                    temps, top_ks, top_ps):
+                params = dequant(qparams)
+                paging = {"block_tables": tables,
+                          "lengths": jnp.zeros((ids.shape[0],), jnp.int32),
+                          "num_valid": num_valid, "prefill": True}
+                out, vars_ = dmodule.apply(
+                    {"params": params, "cache": cache}, ids,
+                    mutable=["cache"], paging=paging)
+                logits = logits_of(out)
+                last = jnp.take_along_axis(
+                    logits, (num_valid - 1)[:, None, None], axis=1)[:, 0]
+                # the first generated token's absolute position is the
+                # prompt length — num_valid itself
+                tok = keyed_sample(last, seeds, num_valid, flags, temps,
+                                   top_ks, top_ps)
+                return tok, vars_["cache"]
+
+            return self.engine.telemetry.watch_jit(
+                jax.jit(kfn, donate_argnums=self._donate()),
+                f"serving.prefill[T={T}]")
 
         def fn(qparams, cache, ids, tables, num_valid, rng):
             params = dequant(qparams)
@@ -278,6 +317,29 @@ class ServingEngine:
         jax, jnp = self._jax, self._jnp
         dmodule, dequant = self._dmodule, self.engine._dequantize
         logits_of = self.engine._logits_of
+        if self._keyed:
+            from deepspeed_tpu.ops.sampling import keyed_sample
+
+            def kfn(qparams, cache, tokens, tables, lengths, seeds, flags,
+                    temps, top_ks, top_ps):
+                params = dequant(qparams)
+                paging = {"block_tables": tables, "lengths": lengths,
+                          "num_valid": jnp.ones_like(lengths),
+                          "prefill": False}
+                out, vars_ = dmodule.apply(
+                    {"params": params, "cache": cache}, tokens,
+                    mutable=["cache"], paging=paging)
+                logits = logits_of(out)[:, -1]
+                # this step emits the token at absolute position
+                # lengths + 1 (lengths tokens are pooled; the pending
+                # last token sits at position lengths)
+                tok = keyed_sample(logits, seeds, lengths + 1, flags,
+                                   temps, top_ks, top_ps)
+                return tok, vars_["cache"]
+
+            return self.engine.telemetry.watch_jit(
+                jax.jit(kfn, donate_argnums=self._donate()),
+                f"serving.decode[slots={self.config.decode_slots}]")
 
         def fn(qparams, cache, tokens, tables, lengths, rng):
             params = dequant(qparams)
@@ -304,6 +366,31 @@ class ServingEngine:
         jax, jnp = self._jax, self._jnp
         dmodule, dequant = self._dmodule, self.engine._dequantize
         logits_of = self.engine._logits_of
+        if self._keyed:
+            from deepspeed_tpu.ops.sampling import keyed_sample
+
+            def kfn(qparams, cache, ids, tables, lengths, num_valid,
+                    seeds, flags, temps, top_ks, top_ps):
+                params = dequant(qparams)
+                paging = {"block_tables": tables, "lengths": lengths,
+                          "num_valid": num_valid, "prefill": False}
+                out, vars_ = dmodule.apply(
+                    {"params": params, "cache": cache}, ids,
+                    mutable=["cache"], paging=paging)
+                logits = logits_of(out)
+                last = jnp.take_along_axis(
+                    logits, (num_valid - 1)[:, None, None], axis=1)[:, 0]
+                # only the FINAL chunk's token is consumed, at absolute
+                # position lengths + num_valid = the full prompt length
+                # — identical to the whole-prompt prefill's fold-in, so
+                # chunked and unchunked admission sample the same token
+                tok = keyed_sample(last, seeds, lengths + num_valid,
+                                   flags, temps, top_ks, top_ps)
+                return tok, vars_["cache"]
+
+            return self.engine.telemetry.watch_jit(
+                jax.jit(kfn, donate_argnums=self._donate()),
+                f"serving.chunk[T={T}]")
 
         def fn(qparams, cache, ids, tables, lengths, num_valid, rng):
             params = dequant(qparams)
@@ -405,6 +492,28 @@ class ServingEngine:
         self._rng, sub = self._jax.random.split(self._rng)
         return sub
 
+    def _req_samp_args(self, req: Request):
+        """The keyed prefill/chunk programs' per-request sampling row
+        ([1]-shaped, matching their batch of one). Greedy requests ride
+        with flag 0 — the argmax leg, bit-identical to the rng path."""
+        jnp = self._jnp
+        on = 1 if req.do_sample else 0
+        return (jnp.asarray([req.seed or 0], jnp.uint32),
+                jnp.asarray([on], jnp.int32),
+                jnp.asarray([req.temperature
+                             if req.temperature is not None else 1.0],
+                            jnp.float32),
+                jnp.asarray([req.top_k or 0], jnp.int32),
+                jnp.asarray([req.top_p or 0.0], jnp.float32))
+
+    def _slot_samp_args(self):
+        """The keyed decode program's per-slot sampling arrays (idle and
+        greedy slots carry flag 0)."""
+        jnp = self._jnp
+        return (jnp.asarray(self._seeds), jnp.asarray(self._samp_on),
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps))
+
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 0, **kwargs) -> Request:
         """Admit one request (non-blocking). Returns the Request; its
@@ -494,12 +603,14 @@ class ServingEngine:
             self._prefill_fns[T] = self._build_prefill(T)
         ids = np.zeros((1, T), np.int32)
         ids[0, :req.prompt_len] = req.prompt
+        tail = (self._req_samp_args(req) if self._keyed
+                else (self._next_rng(),))
         with self._req_span(req, "prefill", bucket=T,
                             prompt_len=req.prompt_len):
             tok, self.cache = self._prefill_fns[T](
                 self.engine.params, self.cache, jnp.asarray(ids),
                 jnp.asarray(table[None]),
-                jnp.asarray([req.prompt_len], jnp.int32), self._next_rng())
+                jnp.asarray([req.prompt_len], jnp.int32), *tail)
             tok = int(np.asarray(tok)[0])
         req.prefill_chunks = 1
         self._slot_live(slot, req, table, tok, done)
@@ -550,12 +661,14 @@ class ServingEngine:
             self._chunk_fns[T] = self._build_chunk(T)
         ids = np.zeros((1, T), np.int32)
         ids[0, :step_len] = req.prompt[pos:pos + step_len]
+        tail = (self._req_samp_args(req) if self._keyed
+                else (self._next_rng(),))
         with self._req_span(req, "prefill_chunk", pos=pos,
                             tokens=step_len, bucket=T):
             tok, self.cache = self._chunk_fns[T](
                 self.engine.params, self.cache, jnp.asarray(ids),
                 jnp.asarray(table[None]), jnp.asarray([pos], jnp.int32),
-                jnp.asarray([step_len], jnp.int32), self._next_rng())
+                jnp.asarray([step_len], jnp.int32), *tail)
             return int(np.asarray(tok)[0])
 
     def _slot_live(self, slot: int, req: Request, table: np.ndarray,
@@ -567,6 +680,8 @@ class ServingEngine:
         self._tables[slot] = table
         self._lengths[slot] = req.prompt_len
         self._last_tokens[slot] = tok
+        if self._keyed:
+            self._set_samp_slot(slot, req)
         if self.prefix is not None:
             # BEFORE any finish: insertion must precede release so a
             # one-token request's blocks park evictable, not freed
@@ -577,6 +692,24 @@ class ServingEngine:
         if finished:
             reason = "eos" if tok == req.eos_token_id else "max_tokens"
             self._finish(req, reason, self.clock(), done)
+
+    def _set_samp_slot(self, slot: int, req: Request):
+        """Load one slot's sampling row from the request's (resolved)
+        knobs — the ONLY per-slot sampler state; the key itself folds
+        from (seed, position) inside the program every step."""
+        self._seeds[slot] = int(req.seed or 0) & 0xFFFFFFFF
+        self._samp_on[slot] = 1 if req.do_sample else 0
+        self._temps[slot] = (req.temperature
+                             if req.temperature is not None else 1.0)
+        self._top_ks[slot] = req.top_k or 0
+        self._top_ps[slot] = req.top_p or 0.0
+
+    def _clear_samp_slot(self, slot: int):
+        self._seeds[slot] = 0
+        self._samp_on[slot] = 0
+        self._temps[slot] = 1.0
+        self._top_ks[slot] = 0
+        self._top_ps[slot] = 0.0
 
     def _cow_copy(self, src: int, dst: int):
         jnp = self._jnp
@@ -592,10 +725,12 @@ class ServingEngine:
         active = [(s, r) for s, r in self.sched.running()
                   if s not in self._prefilling]
         tokens = jnp.asarray(self._last_tokens[:, None])
+        tail = (self._slot_samp_args() if self._keyed
+                else (self._next_rng(),))
         toks, self.cache = self._decode_fn(
             self.engine.params, self.cache, tokens,
             jnp.asarray(self._tables), jnp.asarray(self._lengths),
-            self._next_rng())
+            *tail)
         # the ONE designed host sync per decode step: sampled tokens must
         # reach the host to stream to callers and drive finish logic
         toks = np.asarray(toks)  # graft-lint: disable=GL04
@@ -771,6 +906,8 @@ class ServingEngine:
             self._tables[req.slot] = 0
             self._lengths[req.slot] = 0
             self._last_tokens[req.slot] = 0
+            if self._keyed:
+                self._clear_samp_slot(req.slot)
             self._prefilling.pop(req.slot, None)
             self._pf_tables.pop(req.slot, None)
             self._pf_pos.pop(req.slot, None)
@@ -874,6 +1011,8 @@ class ServingEngine:
             self._tables[req.slot] = 0
             self._lengths[req.slot] = 0
             self._last_tokens[req.slot] = 0
+            if self._keyed:
+                self._clear_samp_slot(req.slot)
             self._prefilling.pop(req.slot, None)
             self._pf_tables.pop(req.slot, None)
             self._pf_pos.pop(req.slot, None)
@@ -936,6 +1075,18 @@ class ServingEngine:
             "length": int(req.length),
             "last_token": int(self._last_tokens[req.slot]),
             "do_sample": bool(self.config.do_sample),
+            # keyed per-request sampling state: the seed and knobs ARE
+            # the whole sampler — position comes from length, so the
+            # spliced slot resumes the stream bit-exactly with no
+            # counter re-derivation (None for greedy requests)
+            "sampling": ({
+                "do_sample": True, "seed": int(req.seed or 0),
+                "temperature": float(req.temperature
+                                     if req.temperature is not None
+                                     else 1.0),
+                "top_k": int(req.top_k or 0),
+                "top_p": float(req.top_p or 0.0),
+            } if req.do_sample else None),
             "block_size": bs,
             "kv_cache_dtype": self.config.kv_cache_dtype or None,
             "tp_shards": tp,
@@ -967,10 +1118,15 @@ class ServingEngine:
         if export is None:
             return None
         rid = request_id or export["request_id"]
+        samp = export.get("sampling")
         if (export["block_size"] != self.config.block_size
                 or (export.get("kv_cache_dtype") or None)
                 != (self.config.kv_cache_dtype or None)
                 or bool(export["do_sample"]) != bool(self.config.do_sample)
+                # a keyed sampled stream can only resume on a replica
+                # whose decode program folds the same keys — a greedy
+                # target would silently continue it greedily
+                or (samp is not None and not self._keyed)
                 or rid in self.sched._live_ids):
             return None
         slot = self.sched.free_slot()
@@ -993,6 +1149,12 @@ class ServingEngine:
                       deadline_ms=(deadline_ms if deadline_ms is not None
                                    else export["deadline_ms"]),
                       stream=stream)
+        if samp is not None:
+            req.do_sample = True
+            req.seed = int(samp["seed"])
+            req.temperature = float(samp["temperature"])
+            req.top_k = int(samp["top_k"])
+            req.top_p = float(samp["top_p"])
         # delivered prefix rides along verbatim — seeded directly, NOT
         # via emit_token (the client already holds these tokens; the
         # stream fires only for tokens decoded after the splice)
@@ -1030,6 +1192,8 @@ class ServingEngine:
         self._tables[slot] = table
         self._lengths[slot] = req.length
         self._last_tokens[slot] = int(export["last_token"])
+        if self._keyed:
+            self._set_samp_slot(slot, req)
         self.resilience.serving_request_begin()
         self.telemetry.emit("serving", "request.migrated_in",
                             step=self._step_count, request_id=rid,
@@ -1051,6 +1215,8 @@ class ServingEngine:
             self._tables[req.slot] = 0
             self._lengths[req.slot] = 0
             self._last_tokens[req.slot] = 0
+            if self._keyed:
+                self._clear_samp_slot(req.slot)
             self._prefilling.pop(req.slot, None)
             self._pf_tables.pop(req.slot, None)
             self._pf_pos.pop(req.slot, None)

@@ -46,9 +46,37 @@ GENERATE_ROUTE = "/v1/generate"
 # admission reason -> HTTP status
 _REASON_STATUS = {
     "auth": 401, "forbidden": 403, "bad_request": 400, "too_large": 413,
+    "sampling_invalid": 400,
     "rate": 429, "tokens": 429, "inflight": 429, "overload": 503,
     "backend_shed": 503,
 }
+
+
+def _validate_sampling(body: dict) -> Optional[str]:
+    """Range-check the keyed-sampling fields of a parsed request body.
+    Returns a reject reason or None. Checked at the DOOR so an
+    out-of-range temperature answers a typed 400, not a backend shed
+    deep in the step loop."""
+    if "do_sample" in body and not isinstance(body["do_sample"], bool):
+        return "sampling_invalid"
+    seed = body.get("seed")
+    if seed is not None and (not isinstance(seed, int)
+                             or isinstance(seed, bool) or seed < 0):
+        return "sampling_invalid"
+    temp = body.get("temperature")
+    if temp is not None and (not isinstance(temp, (int, float))
+                             or isinstance(temp, bool) or temp <= 0):
+        return "sampling_invalid"
+    top_k = body.get("top_k")
+    if top_k is not None and (not isinstance(top_k, int)
+                              or isinstance(top_k, bool) or top_k < 0):
+        return "sampling_invalid"
+    top_p = body.get("top_p")
+    if top_p is not None and (not isinstance(top_p, (int, float))
+                              or isinstance(top_p, bool)
+                              or not 0.0 <= top_p <= 1.0):
+        return "sampling_invalid"
+    return None
 
 
 class _NullTelemetry:
@@ -350,6 +378,19 @@ class ServingGateway:
         }
         if "eos_token_id" in body:
             kwargs["eos_token_id"] = int(body["eos_token_id"])
+        if body.get("do_sample"):
+            # keyed sampling rides through verbatim: the seed IS the
+            # reproducibility contract, so the gateway must not rewrite
+            # or default it — the serving config owns knob defaults
+            kwargs["do_sample"] = True
+            if body.get("seed") is not None:
+                kwargs["seed"] = int(body["seed"])
+            if body.get("temperature") is not None:
+                kwargs["temperature"] = float(body["temperature"])
+            if body.get("top_k") is not None:
+                kwargs["top_k"] = int(body["top_k"])
+            if body.get("top_p") is not None:
+                kwargs["top_p"] = float(body["top_p"])
         if self._routerlike:
             kwargs["priority"] = tenant.priority
         elif trace is not None:
@@ -370,6 +411,10 @@ class ServingGateway:
                 "backend_shed"
         self._gauge_tenant(tenant)
         self._bump(tenant.name, "admitted")
+        if body.get("do_sample"):
+            # per-tenant replay breakdown: how much of this tenant's
+            # admitted traffic is keyed-sampled (stats()/bench read it)
+            self._bump(tenant.name, "sampled")
         self._wake.set()
         return handle, stream, trace, 0.0, ""
 
@@ -495,6 +540,11 @@ class _Handler(BaseHTTPRequestHandler):
         if body is None:
             gw._reject(tenant.name, "bad_request", 400)
             self._error(400, "bad_request", tenant.name)
+            return
+        samp_err = _validate_sampling(body)
+        if samp_err is not None:
+            gw._reject(tenant.name, samp_err, 400)
+            self._error(400, samp_err, tenant.name)
             return
         handle, stream, trace, retry_after, reason = gw.admit(tenant, body)
         if handle is None:

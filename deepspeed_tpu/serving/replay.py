@@ -15,7 +15,10 @@ real request logs convert trivially::
 ``arrival_ts`` is seconds from trace start; ``tenant`` groups arrivals
 that share a prompt prefix of ``prefix_len`` tokens (the prefix-cache /
 shared-system-prompt workload shape); ``priority`` feeds the router's
-degradation ladder; ``deadline_ms`` the admission deadline.
+degradation ladder; ``deadline_ms`` the admission deadline. Optional
+``do_sample``/``temperature``/``top_p``/``seed`` fields request KEYED
+sampling — absent keys mean greedy, so every pre-sampling trace loads
+unchanged, and the per-arrival seed keeps replays bit-deterministic.
 
 **Generators** — :func:`synthesize_trace` samples a nonhomogeneous
 Poisson arrival process by thinning (diurnal sinusoid + burst windows
@@ -70,9 +73,19 @@ class Arrival:
     priority: int = 0
     deadline_ms: float = 0.0
     request_id: str = ""
+    # ---- keyed sampling (optional; absent keys = greedy, so every
+    # pre-sampling trace loads unchanged). ``seed`` makes the sampled
+    # stream bit-reproducible — replaying the same trace twice emits
+    # identical tokens, which is what lets the SLO report compare runs.
+    do_sample: bool = False
+    temperature: float = 0.0  # 0 = serving default
+    top_p: float = 0.0        # 0 = disabled / serving default
+    seed: int = 0
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)
+        # False == 0, so disabled sampling fields drop with the other
+        # defaults and round-trip losslessly
         return {k: v for k, v in d.items() if v not in ("", 0, 0.0)
                 or k in ("arrival_ts", "prompt_len", "max_new_tokens")}
 
@@ -125,7 +138,10 @@ def synthesize_trace(duration_secs: float, *, seed: int,
                      shared_fraction: float = 0.0,
                      shared_prefix_len: int = 0,
                      priorities: int = 1,
-                     deadline_ms: float = 0.0) -> List[Arrival]:
+                     deadline_ms: float = 0.0,
+                     sampled_fraction: float = 0.0,
+                     temperature: float = 0.0,
+                     top_p: float = 0.0) -> List[Arrival]:
     """Sample one arrival trace, fully deterministic given ``seed``.
 
     The instantaneous arrival rate is ``base_rate * (1 +
@@ -135,7 +151,11 @@ def synthesize_trace(duration_secs: float, *, seed: int,
     lognormal (heavy-tailed). With ``tenants > 0``, ``shared_fraction``
     of arrivals join a Zipf-skewed tenant whose prompts share their
     first ``shared_prefix_len`` tokens (the prefix-cache shape);
-    priorities are uniform over ``range(priorities)``."""
+    priorities are uniform over ``range(priorities)``. With
+    ``sampled_fraction > 0`` that fraction of arrivals carry keyed
+    sampling fields (a per-arrival seed plus the given
+    ``temperature``/``top_p``); at 0 no extra rng draws happen, so
+    legacy traces stay bit-identical for the same seed."""
     if base_rate <= 0 or duration_secs <= 0:
         raise ValueError("synthesize_trace needs base_rate > 0 and "
                          f"duration_secs > 0, got {base_rate}/"
@@ -176,6 +196,12 @@ def synthesize_trace(duration_secs: float, *, seed: int,
             tenant = f"t{z if z <= tenants else 1}"
             prefix = int(shared_prefix_len)
         p_lo = max(1, prefix + 1)   # at least one unshared prompt token
+        samp = {}
+        if sampled_fraction > 0 and rng.random() < sampled_fraction:
+            samp = {"do_sample": True,
+                    "seed": int(rng.integers(1, 2**31 - 1)),
+                    "temperature": float(temperature),
+                    "top_p": float(top_p)}
         out.append(Arrival(
             arrival_ts=round(t, 6),
             prompt_len=max(p_lo, _heavy_tail(rng, prompt_len_mean,
@@ -185,7 +211,7 @@ def synthesize_trace(duration_secs: float, *, seed: int,
                                        gen_max),
             tenant=tenant, prefix_len=prefix,
             priority=int(rng.integers(0, max(1, priorities))),
-            deadline_ms=float(deadline_ms)))
+            deadline_ms=float(deadline_ms), **samp))
     return out
 
 
@@ -303,6 +329,13 @@ class TraceReplayer:
         kwargs = dict(max_new_tokens=int(arrival.max_new_tokens),
                       request_id=arrival.request_id or f"replay-{index}",
                       deadline_ms=float(arrival.deadline_ms))
+        if arrival.do_sample:
+            kwargs["do_sample"] = True
+            kwargs["seed"] = int(arrival.seed)
+            if arrival.temperature:
+                kwargs["temperature"] = float(arrival.temperature)
+            if arrival.top_p:
+                kwargs["top_p"] = float(arrival.top_p)
         if self._routerlike:
             kwargs["priority"] = int(arrival.priority)
         if getattr(self.target, "accepts_tenant", False):
@@ -410,6 +443,16 @@ class TraceReplayer:
                 by_tenant.setdefault(tenant or "", []).append(rec)
             out["tenants"] = {tenant: self._reduce(by_tenant[tenant], slo)
                               for tenant in sorted(by_tenant)}
+        if any(r.get("do_sample") for r in recs):
+            # keyed sampling adds in-graph filtering work to every
+            # decode step of a sampled slot: the SLO split keeps the two
+            # populations' TTFT/shed/attainment from masking each other
+            out["sampling"] = {
+                "sampled": self._reduce(
+                    [r for r in recs if r.get("do_sample")], slo),
+                "greedy": self._reduce(
+                    [r for r in recs if not r.get("do_sample")], slo),
+            }
         return out
 
 
@@ -422,13 +465,17 @@ class _HttpHandle:
     is identical either way. Terminal state comes from the server — the
     ``done`` SSE event carries the backend's own record."""
 
-    def __init__(self, request_id: str, prompt_len: int):
+    def __init__(self, request_id: str, prompt_len: int,
+                 do_sample: bool = False):
         self.request_id = request_id
         self.state = "queued"
         self.tokens: List[int] = []
         self.finished = threading.Event()
+        # do_sample is stamped at submit so a REJECTED sampled request
+        # still lands in the report's sampled population
         self._record = {"request_id": request_id, "state": self.state,
                         "reason": None, "prompt_len": prompt_len,
+                        "do_sample": bool(do_sample),
                         "new_tokens": 0, "ttft_ms": None}
 
     @property
@@ -486,15 +533,25 @@ class HttpReplayDriver:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 0, request_id: str = "",
                deadline_ms: float = 0.0, tenant: str = "",
-               **kwargs) -> _HttpHandle:
+               do_sample: bool = False, seed: Optional[int] = None,
+               temperature: Optional[float] = None,
+               top_p: Optional[float] = None, **kwargs) -> _HttpHandle:
         self._count += 1
         request_id = request_id or f"http-{self._count}"
-        handle = _HttpHandle(request_id, len(prompt))
+        handle = _HttpHandle(request_id, len(prompt), do_sample=do_sample)
         body = {"prompt": [int(t) for t in prompt],
                 "max_new_tokens": int(max_new_tokens),
                 "request_id": request_id, "stream": True}
         if deadline_ms:
             body["deadline_ms"] = float(deadline_ms)
+        if do_sample:
+            body["do_sample"] = True
+            if seed is not None:
+                body["seed"] = int(seed)
+            if temperature is not None:
+                body["temperature"] = float(temperature)
+            if top_p is not None:
+                body["top_p"] = float(top_p)
         headers = {"Content-Type": "application/json"}
         key = self.api_keys.get(tenant)
         if key:

@@ -34,6 +34,17 @@ class Request:
     # stream(request, token, done) fires once per generated token, on the
     # scheduler thread, in generation order
     stream: Optional[Callable] = None
+    # ---- keyed sampling (serving.sampling; engine resolves None knobs
+    # to the block's defaults at admission and validates ranges) ----
+    do_sample: bool = False
+    # the request's reproducibility key: with do_sample on, token P is a
+    # pure function of (seed, P, logits) — replayable state, carried
+    # across failover/migration verbatim. None + do_sample = unseeded
+    # legacy sampling, which a keyed engine sheds loudly.
+    seed: Optional[int] = None
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
 
     # ---- runtime state (owned by the scheduler/engine) ----
     state: str = QUEUED
@@ -71,6 +82,19 @@ class Request:
         return len(self.prompt)
 
     @property
+    def keyed(self) -> bool:
+        """Replayable sampled request: every emitted position's token is
+        regenerable bit-exactly from (seed, position) by any replica."""
+        return self.do_sample and self.seed is not None
+
+    @property
+    def positions_emitted(self) -> int:
+        """Generated positions already streamed — with ``length`` and the
+        token list, the ONLY sampler state there is (counter-based keys
+        have no hidden rng to carry across a migration or replay)."""
+        return len(self.tokens)
+
+    @property
     def done(self) -> bool:
         return self.state in (FINISHED, SHED)
 
@@ -86,6 +110,7 @@ class Request:
             "request_id": self.request_id,
             "state": self.state,
             "reason": self.finish_reason,
+            "do_sample": bool(self.do_sample),
             "prompt_len": self.prompt_len,
             "new_tokens": len(self.tokens),
             "queue_ms": round(1e3 * max(

@@ -71,6 +71,15 @@ class RouterRequest:
     eos_token_id: int = -1
     deadline_ms: float = 0.0
     stream: Optional[Callable] = None
+    # ---- keyed sampling: replayable state. A seeded sampled request's
+    # tokens are a pure function of (seed, position, logits), so the
+    # dedupe-splice exactly-once contract extends to it unchanged —
+    # failover replays regenerate the delivered prefix bit-identically.
+    do_sample: bool = False
+    seed: Optional[int] = None
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
 
     # ---- runtime state (owned by the router) ----
     clamp_budget: int = 0         # tier-1 cap pending default resolution
@@ -101,10 +110,16 @@ class RouterRequest:
     def done(self) -> bool:
         return self.state in (rq.FINISHED, rq.SHED)
 
+    @property
+    def keyed(self) -> bool:
+        """Seeded sampled request — bit-exactly replayable anywhere."""
+        return self.do_sample and self.seed is not None
+
     def record(self) -> dict:
         return {
             "request_id": self.request_id, "state": self.state,
             "reason": self.finish_reason, "prompt_len": self.prompt_len,
+            "do_sample": bool(self.do_sample),
             "new_tokens": len(self.tokens), "failovers": self.attempt,
             "ttft_ms": round(1e3 * (self.first_token_ts - self.submit_ts), 3)
             if self.first_token_ts else None,
@@ -218,7 +233,11 @@ class ReplicaRouter:
     def submit(self, prompt, max_new_tokens: int = 0, priority: int = 0,
                request_id: Optional[str] = None, eos_token_id: int = -1,
                deadline_ms: float = 0.0,
-               stream: Optional[Callable] = None) -> RouterRequest:
+               stream: Optional[Callable] = None, do_sample: bool = False,
+               seed: Optional[int] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None) -> RouterRequest:
         """Route one request to a replica (non-blocking). The returned
         handle's ``state`` is ``queued`` on success, or ``shed`` with a
         ``finish_reason`` when the degradation ladder or every routable
@@ -229,7 +248,13 @@ class ReplicaRouter:
             max_new_tokens=int(max_new_tokens),
             request_id=request_id or f"rr-{next(_ids)}",
             priority=int(priority), eos_token_id=int(eos_token_id),
-            deadline_ms=float(deadline_ms), stream=stream)
+            deadline_ms=float(deadline_ms), stream=stream,
+            do_sample=bool(do_sample),
+            seed=int(seed) if seed is not None else None,
+            temperature=float(temperature) if temperature is not None
+            else None,
+            top_k=int(top_k) if top_k is not None else None,
+            top_p=float(top_p) if top_p is not None else None)
         rreq.submit_ts = now
         self._counters["submitted"] += 1
         if self._tracer.enabled:
@@ -280,11 +305,13 @@ class ReplicaRouter:
         for idx in self._candidates(now, exclude):
             h = self.health[idx]
             probe = h.state == TRIPPED
-            if rreq.tokens and self._sampling(idx):
+            if rreq.tokens and self._sampling(idx) and not rreq.keyed:
                 # the dedupe-splice is only sound across bit-reproducible
-                # greedy decodes: a delivered prefix must never resume on
-                # a sampling replica (a request with nothing streamed yet
-                # is fine — there is nothing to splice)
+                # decodes: a delivered prefix must never resume on an
+                # UNSEEDED-sampling replica (a request with nothing
+                # streamed yet is fine — there is nothing to splice). A
+                # KEYED request regenerates its prefix bit-identically
+                # from (seed, position), so it splices like greedy.
                 last_reason = "nondeterministic_replay"
                 continue
             budget = rreq.max_new_tokens
@@ -297,12 +324,19 @@ class ReplicaRouter:
                                   "default_max_new_tokens", 0) or 0
                 budget = (min(int(default), rreq.clamp_budget)
                           if default > 0 else rreq.clamp_budget)
+            # sampling kwargs ride only on sampled requests so legacy
+            # replica doubles (narrow submit signatures) keep working
+            samp_kw = ({"do_sample": True, "seed": rreq.seed,
+                        "temperature": rreq.temperature,
+                        "top_k": rreq.top_k, "top_p": rreq.top_p}
+                       if rreq.do_sample else {})
             try:
                 proxy = self.replicas[idx].submit(
                     rreq.prompt, max_new_tokens=budget,
                     request_id=f"{rreq.request_id}#a{rreq.attempt}",
                     eos_token_id=rreq.eos_token_id,
-                    deadline_ms=deadline_ms, stream=self._shim(rreq))
+                    deadline_ms=deadline_ms, stream=self._shim(rreq),
+                    **samp_kw)
             except Exception as e:
                 if probe:
                     # the half-open probe itself failed: it must count
@@ -598,16 +632,18 @@ class ReplicaRouter:
             if rreq.attempt > self.config.max_failovers:
                 self._shed(rreq, "replica_lost")
                 continue
-            if rreq.tokens and self._sampling(idx):
-                # the delivered prefix was SAMPLED — no survivor can
-                # regenerate it bit-identically, so the replay-splice
+            if rreq.tokens and self._sampling(idx) and not rreq.keyed:
+                # the delivered prefix was UNSEEDED-sampled — no survivor
+                # can regenerate it bit-identically, so the replay-splice
                 # contract is unsatisfiable. With migration available
                 # the KV (and the sampling counters) would have MOVED;
                 # reaching here means the move was attempted and failed
                 # (`migration_failed` — a fault) or was never possible
                 # (`nondeterministic_replay` — policy): dashboards must
                 # tell the two apart, so shed loudly with the reason
-                # split instead of streaming a garbled continuation
+                # split instead of streaming a garbled continuation. A
+                # KEYED prefix is regenerable from (seed, position) by
+                # any survivor, so it falls through to replay below.
                 self._shed(rreq, "migration_failed" if mig == "failed"
                            else "nondeterministic_replay")
                 continue

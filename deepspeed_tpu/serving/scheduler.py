@@ -123,6 +123,9 @@ class ContinuousBatchingScheduler:
         self.stats["submitted"] += 1
         if req.max_new_tokens <= 0:
             req.max_new_tokens = self.config.default_max_new_tokens
+        reason = self._admit_sampling(req)
+        if reason is not None:
+            return self._shed(req, reason, now)
         if req.request_id in self._live_ids:
             # a duplicate id would collide in the block manager mid-admit
             # and crash the serving loop with every other request in
@@ -150,6 +153,33 @@ class ContinuousBatchingScheduler:
         self.stats["queue_peak"] = max(self.stats["queue_peak"],
                                        len(self.queue))
         return True
+
+    def _admit_sampling(self, req: rq.Request) -> Optional[str]:
+        """Admission control for per-request sampling: resolve the
+        ``serving.sampling`` defaults onto the request (so exports,
+        replays and records all carry the EFFECTIVE knobs) and return a
+        shed reason when the request cannot be served reproducibly —
+        ``sampling_unsupported`` (no sampling block on this engine),
+        ``sampling_unseeded`` (do_sample without a seed is unreplayable
+        by construction — the loud shed, never a silent greedy
+        downgrade), or ``sampling_invalid`` (out-of-range knobs)."""
+        if not getattr(req, "do_sample", False):
+            return None
+        sc = getattr(self.config, "sampling", None)
+        if sc is None or not sc.enabled:
+            return "sampling_unsupported"
+        if req.seed is None:
+            return "sampling_unseeded"
+        if req.temperature is None:
+            req.temperature = sc.default_temperature
+        if req.top_k is None:
+            req.top_k = sc.default_top_k
+        if req.top_p is None:
+            req.top_p = sc.default_top_p
+        if (req.seed < 0 or req.temperature <= 0 or req.top_k < 0
+                or not 0.0 <= req.top_p <= 1.0):
+            return "sampling_invalid"
+        return None
 
     def _shed(self, req: rq.Request, reason: str,
               now: Optional[float] = None) -> bool:

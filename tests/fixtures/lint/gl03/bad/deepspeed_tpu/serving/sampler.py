@@ -1,0 +1,19 @@
+"""BAD: host-side rng feeding the decode program. Sampling must be
+keyed in-graph (``ops/sampling.py`` folds the request seed and the
+absolute position into a threefry key): an ``np.random`` draw here
+happens ONCE at trace time, so every decode step of every request
+replays the same "random" perturbation — and the token stream silently
+depends on when the program compiled, not on the request's seed."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def decode_step(logits):
+    # gumbel-max trick done WRONG: the noise is baked into the trace
+    gumbel = np.random.gumbel(size=(64,))
+    return jnp.argmax(logits + gumbel, axis=-1)
+
+
+decode = jax.jit(decode_step)

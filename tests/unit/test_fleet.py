@@ -313,6 +313,42 @@ class TestTraceGenerators:
             synthesize_trace(10, seed=0, base_rate=1.0,
                              diurnal_fraction=1.5)
 
+    def test_sampled_fraction_arrivals_carry_keyed_fields(self, tmp_path):
+        """``sampled_fraction`` marks that share of arrivals with
+        keyed-sampling fields: a per-arrival seed (each its own stream)
+        plus the shared knobs — and the JSONL round trip keeps them."""
+        trace = synthesize_trace(60, seed=17, base_rate=2.0,
+                                 sampled_fraction=0.5, temperature=0.8,
+                                 top_p=0.9)
+        sampled = [a for a in trace if a.do_sample]
+        greedy = [a for a in trace if not a.do_sample]
+        assert sampled and greedy          # really a mix
+        assert all(a.seed > 0 for a in sampled)
+        assert len({a.seed for a in sampled}) == len(sampled)
+        assert all(a.temperature == 0.8 and a.top_p == 0.9
+                   for a in sampled)
+        # greedy arrivals carry NO sampling noise
+        assert all(a.seed == 0 and a.temperature == 0.0 for a in greedy)
+        path = str(tmp_path / "sampled.jsonl")
+        save_trace(path, trace)
+        assert load_trace(path) == trace
+        # the JSONL stays open-format: greedy rows have no sampling keys
+        # at all, so pre-sampling consumers parse the file unchanged
+        with open(path) as f:
+            rows = [json.loads(line) for line in f]
+        assert all("do_sample" not in r and "seed" not in r
+                   for r, a in zip(rows, trace) if not a.do_sample)
+
+    def test_sampled_fraction_zero_is_bit_identical_to_legacy(self):
+        """The no-extra-rng-draws guarantee: at ``sampled_fraction=0``
+        the generator's draw sequence is untouched, so the trace is
+        bit-identical to one synthesized without the knob."""
+        kw = dict(seed=11, base_rate=2.0, tenants=2, shared_fraction=0.5,
+                  shared_prefix_len=4)
+        legacy = synthesize_trace(30, **kw)
+        assert synthesize_trace(30, sampled_fraction=0.0,
+                                temperature=0.8, **kw) == legacy
+
 
 # ---------------------------------------------------------------------------
 # replayer
@@ -391,6 +427,65 @@ class TestTraceReplayer:
                             step_secs=0.5, max_steps=25)
         out = rep.run()
         assert rep.steps == 25 and out["incomplete"] == 1
+
+    def test_sampled_arrivals_thread_seed_and_split_report(self):
+        """Sampled arrivals replay with their seed/knobs threaded to the
+        replica, and ``report()`` splits SLO attainment into sampled vs
+        greedy populations so the keyed-decode overhead cannot hide in
+        the aggregate."""
+
+        class WideReplica(FakeReplica):
+            """FakeReplica with the sampling-aware submit surface the
+            router forwards keyed kwargs through."""
+
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.samp_seen = []
+
+            def submit(self, prompt, max_new_tokens=0, request_id=None,
+                       eos_token_id=-1, deadline_ms=0.0, stream=None,
+                       do_sample=False, seed=None, temperature=None,
+                       top_k=None, top_p=None):
+                if do_sample:
+                    self.samp_seen.append(
+                        {"seed": seed, "temperature": temperature,
+                         "top_p": top_p})
+                return super().submit(prompt,
+                                      max_new_tokens=max_new_tokens,
+                                      request_id=request_id,
+                                      eos_token_id=eos_token_id,
+                                      deadline_ms=deadline_ms,
+                                      stream=stream)
+
+        trace = [Arrival(0.0, 4, 3, do_sample=True, seed=101,
+                         temperature=0.8, top_p=0.9),
+                 Arrival(0.5, 5, 3),
+                 Arrival(1.0, 4, 3, do_sample=True, seed=202),
+                 Arrival(1.5, 6, 3)]
+        clock = ReplayClock()
+        replica = WideReplica(slots=4)
+        router = ReplicaRouter([replica], clock=clock,
+                               telemetry=FakeTelemetry())
+        rep = TraceReplayer(router, trace, clock, step_secs=0.25, seed=3)
+        out = rep.run()
+        assert out["finished"] == 4 and out["shed"] == 0
+        # the per-arrival seeds arrived verbatim, in arrival order
+        assert replica.samp_seen == [
+            {"seed": 101, "temperature": 0.8, "top_p": 0.9},
+            {"seed": 202, "temperature": None, "top_p": None}]
+        split = out["sampling"]
+        assert split["sampled"]["requests"] == 2
+        assert split["greedy"]["requests"] == 2
+        assert split["sampled"]["finished"] == 2
+        assert split["greedy"]["ttft_ms_p95"] is not None
+        # a greedy-only replay carries no sampling block at all — the
+        # report shape is unchanged for pre-sampling consumers
+        clock2 = ReplayClock()
+        router2 = ReplicaRouter([WideReplica(slots=4)], clock=clock2,
+                                telemetry=FakeTelemetry())
+        out2 = TraceReplayer(router2, [Arrival(0.0, 4, 3)], clock2,
+                             step_secs=0.25, seed=3).run()
+        assert "sampling" not in out2
 
     def test_replay_config_defaults_flow(self):
         cfg = ReplayConfig(step_secs=0.5, seed=7, vocab_size=50,
@@ -1346,6 +1441,21 @@ class TestTraceGenCLI:
         res2 = self._gen(*args)
         assert res2.returncode == 0
         assert load_trace(out) == first       # seed-deterministic
+
+    def test_sampled_fraction_flag_emits_keyed_arrivals(self, tmp_path):
+        out = str(tmp_path / "s.jsonl")
+        res = self._gen("--pattern", "poisson", "--duration", "30",
+                        "--rate", "2", "--seed", "11",
+                        "--sampled-fraction", "0.5",
+                        "--temperature", "0.8", "--top-p", "0.9",
+                        "--out", out)
+        assert res.returncode == 0, res.stderr
+        assert "sampled" in res.stderr
+        trace = load_trace(out)
+        sampled = [a for a in trace if a.do_sample]
+        assert sampled and len(sampled) < len(trace)
+        assert all(a.seed > 0 and a.temperature == 0.8 and a.top_p == 0.9
+                   for a in sampled)
 
     def test_stdout_mode_and_bad_burst_spec(self):
         res = self._gen("--pattern", "poisson", "--duration", "5",

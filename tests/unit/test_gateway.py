@@ -440,6 +440,100 @@ class TestHardening:
 
 
 # ---------------------------------------------------------------------------
+class SamplingBackend(FakeBackend):
+    """FakeBackend with the WIDE submit surface: records the sampling
+    kwargs the gateway threads through (and keeps decoding greedily —
+    these tests pin the DOOR, the keyed decode is pinned elsewhere)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.samp_seen = []
+
+    def submit(self, prompt, max_new_tokens=0, request_id=None,
+               eos_token_id=-1, deadline_ms=0.0, stream=None,
+               do_sample=False, seed=None, temperature=None, top_k=None,
+               top_p=None, **kw):
+        if do_sample:
+            self.samp_seen.append({"seed": seed, "temperature": temperature,
+                                   "top_k": top_k, "top_p": top_p})
+        return super().submit(prompt, max_new_tokens=max_new_tokens,
+                              request_id=request_id,
+                              eos_token_id=eos_token_id,
+                              deadline_ms=deadline_ms, stream=stream)
+
+
+class TestSamplingDoor:
+    """``POST /v1/generate`` sampling fields: range-checked AT the door
+    (typed 400 before the backend sees anything), threaded verbatim to
+    ``submit()`` when valid, and counted per tenant."""
+
+    @pytest.fixture()
+    def gw(self):
+        gw = _gw(SamplingBackend(), {"pump": True, "tenants": TENANTS})
+        yield gw
+        gw.close()
+
+    def test_sampled_request_threads_knobs_verbatim(self, gw):
+        resp = _post(gw.url, {"prompt": [1, 2], "max_new_tokens": 3,
+                              "do_sample": True, "seed": 7,
+                              "temperature": 0.8, "top_p": 0.9},
+                     key="acme-key")
+        events = _sse_events(resp)
+        assert [e[0] for e in events] == ["token"] * 3 + ["done"]
+        # the knobs arrived untouched; unset ones stay None — the
+        # gateway never invents defaults (the serving config owns them)
+        assert gw.backend.samp_seen == [
+            {"seed": 7, "temperature": 0.8, "top_k": None, "top_p": 0.9}]
+        assert _wait(lambda:
+                     gw.stats()["tenants"]["acme"].get("sampled") == 1)
+        assert gw.stats()["tenants"]["acme"]["admitted"] == 1
+
+    def test_greedy_request_not_counted_sampled(self, gw):
+        _sse_events(_post(gw.url, {"prompt": [1], "max_new_tokens": 2},
+                          key="acme-key"))
+        assert _wait(lambda:
+                     gw.stats()["tenants"]["acme"].get("admitted") == 1)
+        assert gw.stats()["tenants"]["acme"].get("sampled", 0) == 0
+        assert gw.backend.samp_seen == []
+
+    @pytest.mark.parametrize("fields", [
+        {"seed": -1},                 # negative seed
+        {"seed": 1.5},                # non-int seed
+        {"seed": True},               # bool is not a seed
+        {"seed": "7"},                # string seed
+        {"temperature": 0},           # temperature must be > 0
+        {"temperature": -0.5},
+        {"temperature": "hot"},
+        {"top_k": -1},
+        {"top_k": 2.5},
+        {"top_p": 1.5},               # out of [0, 1]
+        {"top_p": -0.1},
+        {"do_sample": "yes"},         # non-bool flag
+    ])
+    def test_invalid_sampling_typed_400(self, gw, fields):
+        body = {"prompt": [1, 2], "max_new_tokens": 2,
+                "do_sample": True, **fields}
+        code, payload, _ = _post_err(gw.url, body, key="acme-key")
+        assert code == 400
+        assert payload["error"]["reason"] == "sampling_invalid"
+        assert payload["error"]["tenant"] == "acme"
+        # rejected at the door: the backend never saw the request and
+        # nothing was admitted or counted sampled
+        assert gw.backend.submits == 0
+        assert gw.stats()["tenants"]["acme"].get("admitted", 0) == 0
+        assert gw.stats()["tenants"]["acme"].get("sampled", 0) == 0
+
+    def test_valid_knobs_without_do_sample_are_still_checked(self, gw):
+        """Range checks apply even when do_sample is absent: a greedy
+        body carrying a nonsense temperature is a client bug, answered
+        with the same typed 400."""
+        code, payload, _ = _post_err(
+            gw.url, {"prompt": [1], "temperature": -2.0}, key="acme-key")
+        assert code == 400
+        assert payload["error"]["reason"] == "sampling_invalid"
+
+
+# ---------------------------------------------------------------------------
 class TestQuotaEnforcement:
     def test_429_retry_after_metrics_and_spans(self):
         """The acceptance proof: spam's second request inside the bucket
@@ -1004,6 +1098,47 @@ class TestGatewayOverRealEngines:
             toks = [e[1]["token"] for e in events if e[0] == "token"]
             assert toks == direct.tokens
             assert events[-1][0] == "done"
+        finally:
+            gw.destroy()
+
+    def test_sampled_sse_stream_bit_matches_keyed_generate(self):
+        """The sampling contract through the front door: a seeded
+        sampled request over HTTP emits exactly the tokens of the
+        engine's solo keyed ``generate()`` — the gateway threads
+        seed/knobs verbatim and the per-tenant sampled counter ticks."""
+        import jax.numpy as jnp
+
+        gw = _real_gateway(serving={"block_size": 8, "decode_slots": 2,
+                                    "default_max_new_tokens": 8,
+                                    "sampling": {"enabled": True},
+                                    "gateway": {}})
+        try:
+            prompt = [5, 17, 42, 9]
+            engine = gw.backend.engine
+            out = engine.generate(jnp.asarray([prompt]), max_new_tokens=4,
+                                  do_sample=True, seed=7, temperature=0.8,
+                                  top_p=0.9)
+            expect = [int(t) for t in out[0, len(prompt):]]
+            events = []
+            reader = threading.Thread(
+                target=lambda: events.extend(_sse_events(_post(
+                    gw.url, {"prompt": prompt, "max_new_tokens": 4,
+                             "do_sample": True, "seed": 7,
+                             "temperature": 0.8, "top_p": 0.9}))),
+                daemon=True)
+            reader.start()
+            deadline = time.monotonic() + 60
+            while reader.is_alive() and time.monotonic() < deadline:
+                if gw.pending:
+                    gw.step()
+                else:
+                    time.sleep(0.01)
+            reader.join(5)
+            assert not reader.is_alive()
+            toks = [e[1]["token"] for e in events if e[0] == "token"]
+            assert toks == expect
+            assert events[-1][0] == "done"
+            assert gw.stats()["tenants"][ANONYMOUS]["sampled"] == 1
         finally:
             gw.destroy()
 

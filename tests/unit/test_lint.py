@@ -124,6 +124,14 @@ class TestGL03:
                          "float() host sync on traced parameter"):
             assert impurity in msgs, f"GL03 missed {impurity}"
 
+    def test_host_rng_feeding_decode_program_is_flagged(self):
+        # the keyed-sampling regression shape: np.random noise baked
+        # into a jitted decode program at trace time
+        found = by_code(fixture_run("gl03", "bad"), "GL03")
+        hits = [f for f in found if f.path.endswith("serving/sampler.py")]
+        assert hits, "GL03 missed host rng feeding the decode program"
+        assert any("np.random.gumbel" in f.message for f in hits)
+
     def test_host_wrapper_impurity_is_not_flagged(self):
         # the good fixture's host_wrapper calls time.time/print freely
         assert not by_code(fixture_run("gl03", "good"), "GL03")

@@ -982,6 +982,245 @@ class TestMigrationFailover:
         assert r.state == rq.FINISHED and r.replica == 0
 
 
+# ---------------------------------------------------------------------------
+# keyed (seeded) sampled streams: bit-exact failover / migration / replay
+# ---------------------------------------------------------------------------
+def _keyed(seed, pos):
+    """The fakes' keyed decode in miniature: the token at emitted
+    position ``pos`` is a pure function of ``(seed, pos)`` — prompt- and
+    replica-independent, exactly the counter contract ``ops/sampling.py``
+    pins on the real engines. Any replica regenerates the stream
+    bit-identically from the request's replayable ``(seed, positions)``
+    state, which is what makes keyed failover splice like greedy."""
+    return (101 * int(seed) + 13 * pos) % 997
+
+
+class KeyedReplica(FakeReplica):
+    """FakeReplica with the WIDE submit surface (the sampling kwargs the
+    router forwards only for sampled requests) and a keyed decode.
+    ``samp_seen`` records each sampled admission's knobs — the tests'
+    window into what the router actually threaded through."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.samp_seen = []
+
+    def submit(self, prompt, max_new_tokens=0, request_id=None,
+               eos_token_id=-1, deadline_ms=0.0, stream=None,
+               do_sample=False, seed=None, temperature=None, top_k=None,
+               top_p=None):
+        req = super().submit(prompt, max_new_tokens=max_new_tokens,
+                             request_id=request_id,
+                             eos_token_id=eos_token_id,
+                             deadline_ms=deadline_ms, stream=stream)
+        req.do_sample, req.seed = bool(do_sample), seed
+        req.temperature, req.top_k, req.top_p = temperature, top_k, top_p
+        if do_sample:
+            self.samp_seen.append({"seed": seed, "temperature": temperature,
+                                   "top_k": top_k, "top_p": top_p})
+        return req
+
+    def _token(self, req, pos):
+        if getattr(req, "do_sample", False):
+            return _keyed(req.seed, pos)
+        return _greedy(req.prompt, pos)
+
+
+class KeyedMigratable(KeyedReplica, MigratableReplica):
+    """Keyed decode plus the migration surface: the export carries the
+    request's sampling state (seed + knobs + the position counter
+    implicit in ``tokens``) exactly as ``ServingEngine.export_sequence``
+    does, and the import restores it so the target's decode continues
+    the SAME keyed stream."""
+
+    def export_sequence(self, request_id):
+        export = MigratableReplica.export_sequence(self, request_id)
+        if export is None:
+            return None
+        req = next(r for r in self.running if r.request_id == request_id)
+        if getattr(req, "do_sample", False):
+            export["sampling"] = {"do_sample": True, "seed": req.seed,
+                                  "temperature": req.temperature,
+                                  "top_k": req.top_k, "top_p": req.top_p}
+        return export
+
+    def import_sequence(self, export, deadline_ms=None, stream=None,
+                        request_id=None, trace=None):
+        req = MigratableReplica.import_sequence(
+            self, export, deadline_ms=deadline_ms, stream=stream,
+            request_id=request_id, trace=trace)
+        if req is not None:
+            samp = export.get("sampling") or {}
+            req.do_sample = bool(samp.get("do_sample", False))
+            req.seed = samp.get("seed")
+            req.temperature = samp.get("temperature")
+            req.top_k = samp.get("top_k")
+            req.top_p = samp.get("top_p")
+            if req.do_sample:
+                self.samp_seen.append(
+                    {"seed": req.seed, "temperature": req.temperature,
+                     "top_k": req.top_k, "top_p": req.top_p})
+        return req
+
+
+class TestKeyedFailover:
+    """The sampled half of the exactly-once contract: a KEYED (seeded)
+    stream is bit-exactly replayable anywhere, so it fails over, splices
+    and migrates exactly like greedy — and the ``nondeterministic_replay``
+    shed is retired for keyed requests while staying pinned for the
+    legacy unseeded sampler."""
+
+    @pytest.fixture(autouse=True)
+    def _no_chaos_leak(self):
+        yield
+        chaos.clear()
+
+    def test_keyed_crash_replays_bit_exact_exactly_once(self):
+        """Hard crash mid-stream: the survivor REPLAYS the keyed stream
+        from (seed, position) — the delivered prefix regenerates
+        bit-identically (deduped, zero divergence), each position
+        reaches the client exactly once, and nothing sheds."""
+        seen = []
+        router = _router([ChaosReplica(KeyedReplica(), crash_at_step=2),
+                          KeyedReplica()])
+        r = router.submit([1, 2], max_new_tokens=4, do_sample=True,
+                          seed=21, temperature=0.7, top_p=0.9,
+                          stream=lambda _r, t, d: seen.append(t))
+        router.step()
+        assert len(r.tokens) == 1          # a delivered sampled prefix
+        router.drain(max_steps=20)
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert r.tokens == [_keyed(21, p) for p in range(4)]
+        assert seen == r.tokens            # exactly once, in order
+        st = router.stats()
+        assert st["deduped_tokens"] > 0    # the prefix WAS replayed
+        assert st["replay_divergence"] == 0
+        assert "nondeterministic_replay" not in st["shed_reasons"]
+        # the survivor's replay admission carried the full sampling state
+        assert router.replicas[1].samp_seen == [
+            {"seed": 21, "temperature": 0.7, "top_k": None, "top_p": 0.9}]
+
+    def test_keyed_prefix_resumes_on_sampling_survivor(self):
+        """THE seam this PR retires: a delivered prefix used to shed
+        ``nondeterministic_replay`` whenever the survivor had
+        ``config.do_sample`` — keyed requests regenerate their prefix
+        from (seed, position), so they replay straight through the
+        sampling survivor."""
+
+        class KeyedSampling(KeyedReplica):
+            class config:
+                do_sample = True
+
+        router = _router([ChaosReplica(KeyedSampling(), crash_at_step=2),
+                          KeyedSampling()])
+        r = router.submit([1, 2], max_new_tokens=4, do_sample=True,
+                          seed=33)
+        router.drain(max_steps=20)
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert r.tokens == [_keyed(33, p) for p in range(4)]
+        # regression pin: the legacy UNSEEDED sampler on the identical
+        # topology still sheds loudly — the retirement is keyed-only
+        router2 = _router([ChaosReplica(KeyedSampling(), crash_at_step=2),
+                           KeyedSampling()])
+        r2 = router2.submit([1, 2], max_new_tokens=4)
+        router2.drain(max_steps=20)
+        assert r2.state == rq.SHED
+        assert r2.finish_reason == "nondeterministic_replay"
+
+    def test_keyed_breaker_trip_migrates_counters_with_kv(self):
+        """Breaker trip (pool readable) + migration on: the keyed
+        request MOVES — seed, knobs and the position counter travel in
+        the export, the target continues the same stream mid-sequence,
+        and nothing replays (zero dedupe)."""
+        seen = []
+        flaky = ChaosReplica(KeyedMigratable(), fail_step_at=2,
+                             fail_step_times=3)
+        router = _router([flaky, KeyedMigratable()],
+                         failure_threshold=3, migration={"enabled": True})
+        r = router.submit([1, 2], max_new_tokens=4, do_sample=True,
+                          seed=55, temperature=1.2, top_k=9,
+                          stream=lambda _r, t, d: seen.append(t))
+        router.step()
+        assert len(r.tokens) == 1
+        router.drain(max_steps=30)
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert r.tokens == [_keyed(55, p) for p in range(4)]
+        assert seen == r.tokens
+        st = router.stats()
+        assert st["migrations"] == 1 and st["failovers"] == 0
+        assert st["deduped_tokens"] == 0   # moved, not replayed
+        tgt = router.replicas[1]
+        assert tgt.imports == 1 and tgt.submits == 0
+        assert flaky.outs == 1 and not flaky.running
+        # the import restored the full sampling state onto the target
+        assert tgt.samp_seen == [
+            {"seed": 55, "temperature": 1.2, "top_k": 9, "top_p": None}]
+
+    def test_keyed_crash_during_migration_falls_back_bit_exact(self):
+        """Chaos kill between export and the target's commit: the move
+        aborts, and — unlike the unseeded sampler, which sheds
+        ``migration_failed`` here — the keyed request falls back to
+        deterministic REPLAY with exactly-once delivery."""
+        seen = []
+        flaky = ChaosReplica(KeyedMigratable(), fail_step_at=2,
+                             fail_step_times=3, crash_during_migration=1)
+        router = _router([flaky, KeyedMigratable()],
+                         failure_threshold=3, migration={"enabled": True})
+        r = router.submit([1, 2], max_new_tokens=4, do_sample=True,
+                          seed=77, stream=lambda _r, t, d: seen.append(t))
+        router.drain(max_steps=30)
+        assert flaky.migration_exports == 1
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert r.tokens == [_keyed(77, p) for p in range(4)]
+        assert seen == r.tokens
+        st = router.stats()
+        assert st["migrations"] == 0 and st["failovers"] == 1
+        assert st["deduped_tokens"] > 0
+        assert st["replay_divergence"] == 0
+        assert router.replicas[1].imports == 0
+        assert "migration_failed" not in st["shed_reasons"]
+
+    def test_mixed_keyed_and_greedy_failover(self):
+        """A crash with one keyed and one greedy stream in flight: both
+        replay bit-exact on the survivor — the sampled stream is no
+        longer the odd one out."""
+        router = _router([ChaosReplica(KeyedReplica(), crash_at_step=2),
+                          KeyedReplica()], max_failovers=2)
+        kr = router.submit([1, 2], max_new_tokens=4, do_sample=True,
+                           seed=91)
+        gr = router.submit([3, 4], max_new_tokens=4)
+        router.drain(max_steps=30)
+        assert kr.state == rq.FINISHED
+        assert kr.tokens == [_keyed(91, p) for p in range(4)]
+        assert gr.state == rq.FINISHED
+        assert gr.tokens == [_greedy([3, 4], p) for p in range(4)]
+        assert router.stats()["replay_divergence"] == 0
+
+    def test_keyed_migration_target_must_match_sampling_mode(self):
+        """Replica-pairing guard: migration still refuses to move ANY
+        request between an unseeded-sampling replica and a greedy one
+        (the two decode modes are not interchangeable) — the keyed
+        retirement did not loosen that filter."""
+
+        class SamplingKeyedMigratable(KeyedMigratable):
+            class config:
+                do_sample = True
+
+        router = _router(
+            [ChaosReplica(SamplingKeyedMigratable(), fail_step_at=2,
+                          fail_step_times=3), KeyedMigratable()],
+            failure_threshold=3, migration={"enabled": True})
+        r = router.submit([1, 2], max_new_tokens=4, do_sample=True,
+                          seed=13)
+        router.drain(max_steps=30)
+        # no mode-matched target -> the move was never possible; the
+        # KEYED stream still survives, via replay on the greedy peer
+        st = router.stats()
+        assert st["migrations"] == 0
+        assert r.state == rq.FINISHED and r.replica == 1
+        assert r.tokens == [_keyed(13, p) for p in range(4)]
+
+
 class TestBreakerProbes:
     def test_half_open_probe_closes_breaker(self):
         clk = _Clock()
@@ -1663,6 +1902,7 @@ class TestRouterOverRealEngines:
         assert st["replica_states"][1] == "dead"
         router.destroy()
 
+
     def test_init_serving_builds_router_from_config(self):
         import deepspeed_tpu
         import jax.numpy as jnp
@@ -1807,3 +2047,94 @@ class TestRouterOverRealEngines:
         # post-drain gauges match the live surface: all idle
         assert srv.gauges()["slots_busy"] == 0
         assert srv.gauges()["free_blocks"] == srv.num_blocks - 1
+
+
+@pytest.mark.heavy
+class TestKeyedRouterOverRealEngines:
+    """The chaos acceptance of the reproducible-sampling contract on
+    the real substrate: a SEEDED sampled stream killed mid-decode
+    resumes bit-identical to an unfaulted run via full deterministic
+    replay (hard crash — pool unreadable) AND via live KV migration
+    (breaker trip — counters move with the blocks), each position
+    delivered exactly once, with a greedy neighbor in flight the whole
+    time."""
+
+    _KEYED = {"block_size": 8, "decode_slots": 2,
+              "default_max_new_tokens": 4,
+              "sampling": {"enabled": True}}
+
+    def _engines(self):
+        _, e0 = _tiny_engine(serving=self._KEYED)
+        _, e1 = _tiny_engine(serving=self._KEYED)
+        e1.params = e0.params
+        return e0, e1
+
+    def _run(self, replicas, migration=None, cfg=None):
+        from deepspeed_tpu.serving import ServingEngine  # noqa: F401
+
+        router = ReplicaRouter(
+            replicas, config={"max_failovers": 2, **(cfg or {})},
+            migration=migration)
+        rng = np.random.default_rng(23)
+        prompts = [rng.integers(1, 256, 6), rng.integers(1, 256, 9)]
+        streams = ([], [])
+        reqs = (router.submit(prompts[0], max_new_tokens=5,
+                              do_sample=True, seed=41, temperature=0.8,
+                              top_p=0.9,
+                              stream=lambda _r, t, d: streams[0].append(t)),
+                router.submit(prompts[1], max_new_tokens=4,
+                              stream=lambda _r, t, d: streams[1].append(t)))
+        router.drain(max_steps=200)
+        return router, reqs, streams
+
+    def test_sampled_stream_killed_mid_decode_replays_bit_identical(self):
+        from deepspeed_tpu.serving import ServingEngine
+
+        e0, e1 = self._engines()
+        clean, clean_reqs, clean_streams = self._run(
+            [ServingEngine(e0), ServingEngine(e1)])
+        assert clean.stats()["failovers"] == 0
+        clean.destroy()
+        f0, f1 = self._engines()
+        router, reqs, streams = self._run(
+            [ServingEngine(f0),
+             ChaosReplica(ServingEngine(f1), crash_at_step=2)])
+        st = router.stats()
+        assert st["failovers"] >= 1, st
+        for req, cln, seen, cseen in zip(reqs, clean_reqs, streams,
+                                         clean_streams):
+            assert req.state == rq.FINISHED, req.finish_reason
+            assert req.tokens == cln.tokens
+            assert seen == cseen == req.tokens  # exactly once, in order
+        assert st["replay_divergence"] == 0
+        # the retired shed: a keyed stream NEVER dies for being sampled
+        assert "nondeterministic_replay" not in st["shed_reasons"]
+        router.destroy()
+
+    def test_sampled_stream_breaker_trip_migrates_bit_identical(self):
+        """The migration leg: the sampled request's position counter
+        and knobs travel inside the export, so the survivor continues
+        the SAME keyed stream mid-sequence — zero replay, zero dedupe,
+        bit-identical to the unfaulted run."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        e0, e1 = self._engines()
+        clean, clean_reqs, clean_streams = self._run(
+            [ServingEngine(e0), ServingEngine(e1)],
+            cfg={"failure_threshold": 3})
+        clean.destroy()
+        f0, f1 = self._engines()
+        router, reqs, streams = self._run(
+            [ChaosReplica(ServingEngine(f0), fail_step_at=2,
+                          fail_step_times=3), ServingEngine(f1)],
+            migration={"enabled": True}, cfg={"failure_threshold": 3})
+        st = router.stats()
+        assert st["migrations"] >= 1, st
+        assert st["replica_states"][0] == "tripped"
+        for req, cln, seen, cseen in zip(reqs, clean_reqs, streams,
+                                         clean_streams):
+            assert req.state == rq.FINISHED, req.finish_reason
+            assert req.tokens == cln.tokens
+            assert seen == cseen == req.tokens
+        assert st["deduped_tokens"] == 0 and st["replay_divergence"] == 0
+        router.destroy()

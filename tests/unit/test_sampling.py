@@ -1,0 +1,438 @@
+"""The reproducible-sampling contract (``ops/sampling.py`` +
+``serving.sampling``).
+
+Light tier (tiny arrays, no model): the keyed-PRNG unit-vector pin (a
+jax upgrade that changes threefry breaks HERE, loudly), filter
+semantics with every knob traced, the greedy-flag passthrough that
+keeps mixed batches from perturbing greedy members, sharding
+invariance of the draw itself, and the config validators (one sampling
+authority per engine).
+
+Heavy tier (real tiny engines): the four-way bit-identity acceptance —
+the token stream of a seeded sampled request is identical whether it
+decodes solo via ``generate()``, staggered under continuous batching,
+evicted and re-admitted into a DIFFERENT slot via export/import, or on
+a tp=2 mesh vs tp=1 — plus the zero-steady-state-retrace watchdog pin
+with sampling enabled and the zero-overhead HLO pin with it absent.
+"""
+
+import numpy as np
+import pytest
+
+from tests.unit.test_serving import _SERVING, _tiny_serving
+
+_SAMP = {**_SERVING, "sampling": {"enabled": True}}
+
+
+# ---------------------------------------------------------------------------
+# keyed PRNG + filter ops
+# ---------------------------------------------------------------------------
+class TestKeyedPrng:
+    def test_fold_in_unit_vector_pin(self):
+        """The contract's root: fold_in(PRNGKey(7), 5) is this exact
+        key, forever. Positions/seeds traced or concrete, same key."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.ops.sampling import fold_in_key
+
+        key = fold_in_key(7, 5)
+        assert [int(x) for x in np.asarray(key)] == [3583082021, 1947592014]
+        traced = jax.jit(fold_in_key)(jnp.uint32(7), jnp.int32(5))
+        np.testing.assert_array_equal(np.asarray(traced), np.asarray(key))
+        # distinct positions (and seeds) give distinct keys: the
+        # counter actually counts
+        assert not np.array_equal(np.asarray(fold_in_key(7, 6)),
+                                  np.asarray(key))
+        assert not np.array_equal(np.asarray(fold_in_key(8, 5)),
+                                  np.asarray(key))
+
+    def test_keyed_sample_vector_pin(self):
+        """Six positions of seed 11 over one fixed logits row: the
+        emitted tokens, forever. Breaks loudly on any change to the
+        filter math, the fold-in, or the (partitionable) threefry
+        lowering the sharding-invariance contract rides on."""
+        from deepspeed_tpu.ops.sampling import keyed_sample
+
+        row = np.random.default_rng(0).standard_normal(32).astype(np.float32)
+        logits = np.tile(row, (6, 1))
+        toks = keyed_sample(logits, np.full(6, 11), np.arange(6),
+                            np.ones(6), np.ones(6), np.zeros(6),
+                            np.zeros(6))
+        assert [int(t) for t in toks] == [2, 7, 22, 24, 2, 26]
+
+    def test_flag_zero_is_plain_argmax(self):
+        """Greedy rows in a mixed batch: whatever the sampling knobs
+        say, flags == 0 emits the float32 argmax — a sampled neighbor
+        never perturbs a greedy stream."""
+        from deepspeed_tpu.ops.sampling import keyed_sample
+
+        rng = np.random.default_rng(1)
+        logits = rng.standard_normal((4, 64)).astype(np.float32)
+        toks = keyed_sample(logits, np.arange(4), np.arange(4),
+                            np.array([0, 1, 0, 1]), np.full(4, 0.3),
+                            np.full(4, 5), np.full(4, 0.5))
+        expect = logits.argmax(-1)
+        assert int(toks[0]) == int(expect[0])
+        assert int(toks[2]) == int(expect[2])
+
+    def test_batch_composition_invariance_of_the_op(self):
+        """Row i's token depends only on (seed_i, pos_i, logits_i):
+        solo, batched with different neighbors, at a different row
+        index — always the same draw."""
+        from deepspeed_tpu.ops.sampling import keyed_sample
+
+        rng = np.random.default_rng(2)
+        row = rng.standard_normal(48).astype(np.float32)
+        others = rng.standard_normal((3, 48)).astype(np.float32)
+
+        def tok(batch, idx, seeds, poss):
+            n = batch.shape[0]
+            out = keyed_sample(batch, seeds, poss, np.ones(n),
+                               np.full(n, 0.9), np.zeros(n),
+                               np.full(n, 0.95))
+            return int(out[idx])
+
+        solo = tok(row[None], 0, [13], [3])
+        first = tok(np.vstack([row[None], others]), 0,
+                    [13, 1, 2, 3], [3, 0, 1, 2])
+        last = tok(np.vstack([others, row[None]]), 3,
+                   [1, 2, 3, 13], [0, 1, 2, 3])
+        assert solo == first == last
+
+    def test_draw_invariant_to_vocab_sharding(self):
+        """The mesh-invariance half of the contract at the op level: a
+        vocab-sharded logits row draws the exact token the replicated
+        row does (partitionable threefry — the legacy lowering's bits
+        change with the partitioning)."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.ops.sampling import keyed_sample
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        rng = np.random.default_rng(3)
+        logits = rng.standard_normal((2, 256)).astype(np.float32)
+        args = (np.array([7, 11]), np.array([4, 9]), np.ones(2),
+                np.full(2, 0.8), np.zeros(2), np.full(2, 0.9))
+        plain = jax.jit(keyed_sample)(logits, *args)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+        sharded = jax.device_put(logits,
+                                 NamedSharding(mesh, P(None, "model")))
+        out = jax.jit(keyed_sample)(sharded, *args)
+        np.testing.assert_array_equal(np.asarray(plain), np.asarray(out))
+
+
+class TestKeyedFilter:
+    def _filt(self, row, temperature=1.0, top_k=0, top_p=0.0):
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.ops.sampling import keyed_filter_logits
+
+        return np.asarray(keyed_filter_logits(
+            jnp.asarray(row), jnp.float32(temperature), jnp.int32(top_k),
+            jnp.float32(top_p)))
+
+    def test_disabled_knobs_pass_everything(self):
+        row = np.random.default_rng(0).standard_normal(32).astype(np.float32)
+        out = self._filt(row)
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out, row, rtol=1e-6)
+
+    def test_temperature_scales(self):
+        row = np.random.default_rng(1).standard_normal(16).astype(np.float32)
+        np.testing.assert_allclose(self._filt(row, temperature=0.5),
+                                   row / 0.5, rtol=1e-6)
+
+    def test_top_k_keeps_exactly_k(self):
+        row = np.random.default_rng(2).standard_normal(64).astype(np.float32)
+        for k in (1, 5, 17):
+            out = self._filt(row, top_k=k)
+            kept = np.isfinite(out)
+            assert kept.sum() == k
+            # the kept set IS the k largest
+            assert set(np.where(kept)[0]) == set(np.argsort(row)[-k:])
+
+    def test_top_p_nucleus_hf_boundary(self):
+        """HF-style nucleus: the first token past the mass threshold is
+        kept. Checked against a direct numpy reference."""
+        row = np.random.default_rng(3).standard_normal(48).astype(np.float32)
+        for p in (0.1, 0.5, 0.9):
+            out = self._filt(row, top_p=p)
+            order = np.argsort(-row)
+            probs = np.exp(row[order] - row[order].max())
+            probs /= probs.sum()
+            cum = np.cumsum(probs)
+            n_keep = int((cum - probs < p).sum())
+            kept = np.isfinite(out)
+            assert kept.sum() == n_keep, (p, kept.sum(), n_keep)
+            assert set(np.where(kept)[0]) == set(order[:n_keep])
+
+    def test_tiny_top_p_keeps_only_the_argmax(self):
+        row = np.random.default_rng(4).standard_normal(32).astype(np.float32)
+        out = self._filt(row, top_p=1e-9)
+        kept = np.where(np.isfinite(out))[0]
+        assert list(kept) == [int(row.argmax())]
+
+
+class TestSamplingConfig:
+    def test_knob_validation(self):
+        from deepspeed_tpu.serving.config import SamplingConfig
+
+        cfg = SamplingConfig()
+        assert cfg.enabled and cfg.default_temperature == 1.0
+        with pytest.raises(ValueError, match="default_temperature"):
+            SamplingConfig(default_temperature=0.0)
+        with pytest.raises(ValueError, match="default_top_k"):
+            SamplingConfig(default_top_k=-1)
+        with pytest.raises(ValueError, match="default_top_p"):
+            SamplingConfig(default_top_p=1.5)
+
+    def test_one_sampling_authority(self):
+        """`serving.sampling` owns sampling when present: the legacy
+        engine-level sampler and speculative decoding are both refused
+        loudly at config time."""
+        from deepspeed_tpu.serving.config import ServingConfig
+
+        ServingConfig(sampling={"enabled": True})  # fine alone
+        with pytest.raises(ValueError, match="do_sample"):
+            ServingConfig(sampling={"enabled": True}, do_sample=True)
+        with pytest.raises(ValueError, match="speculative"):
+            ServingConfig(sampling={"enabled": True},
+                          speculative={"num_speculative_tokens": 3})
+        # disabled block composes with either (it does not exist)
+        ServingConfig(sampling={"enabled": False}, do_sample=True)
+
+
+# ---------------------------------------------------------------------------
+# the four-way bit-identity acceptance (real engines)
+# ---------------------------------------------------------------------------
+@pytest.mark.heavy
+class TestReproducibleSamplingContract:
+    def _ref(self, engine, prompt, n, seed, **knobs):
+        import jax.numpy as jnp
+
+        out = engine.generate(jnp.asarray([list(prompt)]),
+                              max_new_tokens=n, do_sample=True,
+                              seed=seed, **knobs)
+        return [int(t) for t in out[0, len(prompt):]]
+
+    def test_solo_vs_staggered_continuous_batching(self):
+        """Way 1 + 2: sampled requests staggered under continuous
+        batching (greedy neighbors in the same slots) bit-match the
+        solo ``generate()`` stream, and the greedy neighbors bit-match
+        a sampling-free engine's output."""
+        from deepspeed_tpu.serving import FINISHED, ServingEngine
+
+        _, engine = _tiny_serving(serving=_SAMP)
+        srv = ServingEngine(engine)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, 256, n) for n in (5, 11, 3, 8)]
+        samp = [dict(seed=101, temperature=0.8, top_p=0.9),
+                None,                     # greedy neighbor
+                dict(seed=303, temperature=1.3, top_k=7),
+                dict(seed=404)]           # defaults: temp 1, no filter
+        reqs = []
+        reqs.append(srv.submit(prompts[0], max_new_tokens=5,
+                               do_sample=True, **samp[0]))
+        reqs.append(srv.submit(prompts[1], max_new_tokens=4))
+        srv.step()
+        srv.step()
+        reqs.append(srv.submit(prompts[2], max_new_tokens=5,
+                               do_sample=True, **samp[2]))
+        reqs.append(srv.submit(prompts[3], max_new_tokens=3,
+                               do_sample=True, **samp[3]))
+        srv.drain()
+        for req, p, kn in zip(reqs, prompts, samp):
+            assert req.state == FINISHED, (req.state, req.finish_reason)
+            if kn is None:
+                import jax.numpy as jnp
+
+                out = engine.generate(jnp.asarray([list(p)]),
+                                      max_new_tokens=4, do_sample=False)
+                expect = [int(t) for t in out[0, len(p):]]
+            else:
+                expect = self._ref(engine, p, req.max_new_tokens, **kn)
+            assert req.tokens == expect, (req.request_id, req.tokens,
+                                          expect)
+        # resubmitting the same seeded request later, against a
+        # different batch mix, emits the identical stream
+        again = srv.submit(prompts[0], max_new_tokens=5, do_sample=True,
+                           **samp[0])
+        srv.submit(prompts[1], max_new_tokens=4)
+        srv.drain()
+        assert again.tokens == reqs[0].tokens
+        srv.destroy()
+
+    def test_evict_readmit_different_slot_bit_exact(self):
+        """Way 3: export a sampled stream mid-decode and import it into
+        a peer engine where a DIFFERENT slot index is free — the
+        position counter travels with the request, so the resumed
+        stream bit-matches the uninterrupted solo run."""
+        from deepspeed_tpu.serving import FINISHED, ServingEngine
+
+        _, e0 = _tiny_serving(serving=_SAMP)
+        _, e1 = _tiny_serving(serving=_SAMP)
+        e1.params = e0.params
+        src, dst = ServingEngine(e0), ServingEngine(e1)
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(1, 256, 6)
+        expect = self._ref(e0, prompt, 6, seed=77, temperature=0.9,
+                           top_p=0.95)
+        req = src.submit(prompt, max_new_tokens=6, do_sample=True,
+                         seed=77, temperature=0.9, top_p=0.95)
+        src.step()
+        src.step()
+        assert 0 < len(req.tokens) < 6
+        src_slot = req.slot
+        # occupy the destination's slot 0 so the import lands elsewhere
+        filler = dst.submit(rng.integers(1, 256, 4), max_new_tokens=8)
+        dst.step()
+        moved = dst.import_sequence(src.export_sequence(req.request_id))
+        assert moved is not None and moved.slot != src_slot
+        assert src.migrate_out(req.request_id)
+        dst.drain()
+        assert moved.state == FINISHED and filler.state == FINISHED
+        assert moved.tokens == expect, (moved.tokens, expect)
+        src.destroy()
+        dst.destroy()
+
+    def test_tp2_matches_tp1(self):
+        """Way 4: the same seeded request on a tp=2 mesh — through the
+        serving decode path AND solo generate() — emits the tp=1
+        stream bit-exactly (partitionable threefry: the draw cannot
+        depend on how GSPMD shards the vocab)."""
+        import jax.numpy as jnp
+
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+        from deepspeed_tpu.parallel.topology import reset_topology
+        from deepspeed_tpu.serving import FINISHED, ServingEngine
+
+        reset_topology()
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        e1 = deepspeed_tpu.init_inference(GPT2LMHeadModel(cfg),
+                                          dtype="fp32", seed=0,
+                                          serving=_SAMP)
+        srv1 = ServingEngine(e1)
+        prompt = [5, 17, 42, 9]
+        r1 = srv1.submit(prompt, max_new_tokens=4, do_sample=True,
+                         seed=7, temperature=0.8, top_p=0.9)
+        srv1.drain()
+        assert r1.state == FINISHED
+        gen1 = self._ref(e1, prompt, 4, seed=7, temperature=0.8,
+                         top_p=0.9)
+        srv1.destroy()
+
+        reset_topology()
+        e2 = deepspeed_tpu.init_inference(
+            GPT2LMHeadModel(cfg), dtype="fp32", seed=0, params=e1.params,
+            serving=_SAMP, tensor_parallel={"tp_size": 2})
+        assert e2.mp_world_size == 2
+        srv2 = ServingEngine(e2)
+        r2 = srv2.submit(prompt, max_new_tokens=4, do_sample=True,
+                         seed=7, temperature=0.8, top_p=0.9)
+        srv2.drain()
+        assert r2.state == FINISHED
+        gen2 = self._ref(e2, prompt, 4, seed=7, temperature=0.8,
+                         top_p=0.9)
+        assert r1.tokens == r2.tokens == gen1 == gen2
+        srv2.destroy()
+
+    def test_admission_sheds(self):
+        """The loud-failure seams: no sampling block -> every sampled
+        submit sheds ``sampling_unsupported``; with the block, an
+        UNSEEDED sampled submit sheds ``sampling_unseeded`` (never a
+        silent greedy downgrade) and out-of-range knobs shed
+        ``sampling_invalid``."""
+        from deepspeed_tpu.serving import SHED, ServingEngine
+
+        _, plain = _tiny_serving(serving=_SERVING)
+        srv = ServingEngine(plain)
+        r = srv.submit([1, 2, 3], max_new_tokens=2, do_sample=True,
+                       seed=5)
+        assert r.state == SHED
+        assert r.finish_reason == "sampling_unsupported"
+        srv.destroy()
+
+        _, keyed = _tiny_serving(serving=_SAMP)
+        srv = ServingEngine(keyed)
+        r = srv.submit([1, 2, 3], max_new_tokens=2, do_sample=True)
+        assert r.state == SHED and r.finish_reason == "sampling_unseeded"
+        r = srv.submit([1, 2, 3], max_new_tokens=2, do_sample=True,
+                       seed=5, temperature=-1.0)
+        assert r.state == SHED and r.finish_reason == "sampling_invalid"
+        r = srv.submit([1, 2, 3], max_new_tokens=2, do_sample=True,
+                       seed=5, top_p=1.5)
+        assert r.state == SHED and r.finish_reason == "sampling_invalid"
+        # a well-formed sampled submit still admits on the same engine
+        ok = srv.submit([1, 2, 3], max_new_tokens=2, do_sample=True,
+                        seed=5)
+        srv.drain()
+        assert ok.tokens and len(ok.tokens) == 2
+        srv.destroy()
+
+    def test_zero_steady_state_retraces_with_sampling(self):
+        """The retrace pin holds with sampling ON: every knob is a
+        traced array, so churning keyed/greedy mixes, seeds, and
+        temperatures through the slots compiles NOTHING after warmup."""
+        from deepspeed_tpu.serving import ServingEngine
+
+        _, engine = _tiny_serving(
+            serving=_SAMP,
+            telemetry={"enabled": True, "compile_watchdog": True,
+                       "jsonl": False, "memory": False,
+                       "warmup_steps": 1})
+        srv = ServingEngine(engine)
+        rng = np.random.default_rng(2)
+        for n in (5, 13, 30, 60):
+            srv.submit(rng.integers(1, 256, n), max_new_tokens=2,
+                       do_sample=True, seed=int(n))
+        srv.drain()
+        warm = {k: dict(v) for k, v in
+                engine.telemetry.summary()["per_function"].items()}
+        assert "serving.decode" in warm and "serving.prefill" in warm
+        # steady state: alternating greedy/keyed, fresh seeds and knobs
+        # every submit — none of it may retrace
+        for i, n in enumerate((3, 7, 9, 20, 33, 50, 6, 15)):
+            kw = ({} if i % 2 else
+                  {"do_sample": True, "seed": 1000 + i,
+                   "temperature": 0.5 + 0.1 * i, "top_k": i,
+                   "top_p": 0.9})
+            srv.submit(rng.integers(1, 256, n), max_new_tokens=3, **kw)
+            srv.step()
+        srv.drain()
+        after = engine.telemetry.summary()["per_function"]
+        for fam in ("serving.prefill", "serving.decode"):
+            assert after[fam]["compiles"] == warm[fam]["compiles"], \
+                (fam, warm[fam], after[fam])
+            assert after[fam]["retraces_after_warm"] == \
+                warm[fam]["retraces_after_warm"]
+        srv.destroy()
+
+    def test_decode_hlo_byte_identical_without_sampling(self):
+        """Acceptance (zero-overhead pin): with the sampling block
+        absent OR disabled, the compiled decode program is
+        byte-identical — keyed sampling absent costs nothing."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.serving import ServingEngine
+
+        texts = []
+        for extra in ({}, {"sampling": {"enabled": False}}):
+            _, engine = _tiny_serving(serving={**_SERVING, **extra})
+            srv = ServingEngine(engine)
+            assert not srv._keyed
+            fn = srv._build_decode()
+            tokens = jnp.zeros((srv.config.decode_slots, 1), jnp.int32)
+            tables = jnp.zeros((srv.config.decode_slots,
+                                srv.blocks_per_seq), jnp.int32)
+            lengths = jnp.zeros((srv.config.decode_slots,), jnp.int32)
+            lowered = fn.lower(engine.params, srv.cache, tokens, tables,
+                               lengths, jax.random.PRNGKey(0))
+            texts.append(lowered.compile().as_text())
+            srv.destroy()
+        assert texts[0] == texts[1]

@@ -3,8 +3,9 @@
 The CLI face of ``deepspeed_tpu/serving/replay.py``'s generators: one
 JSONL arrival trace (``arrival_ts`` / ``prompt_len`` /
 ``max_new_tokens`` / ``tenant`` + ``prefix_len`` / ``priority`` /
-``deadline_ms``) to stdout or ``--out``, fully deterministic given
-``--seed``. Patterns::
+``deadline_ms``, plus keyed-sampling fields when
+``--sampled-fraction`` > 0) to stdout or ``--out``, fully
+deterministic given ``--seed``. Patterns::
 
     python tools/trace_gen.py --pattern poisson --duration 60 --rate 2 \\
         --seed 7 --out trace.jsonl
@@ -59,7 +60,9 @@ def build(args) -> list:
         gen_max=args.gen_max,
         tenants=args.tenants, shared_fraction=args.shared_fraction,
         shared_prefix_len=args.prefix_len,
-        priorities=args.priorities, deadline_ms=args.deadline_ms)
+        priorities=args.priorities, deadline_ms=args.deadline_ms,
+        sampled_fraction=args.sampled_fraction,
+        temperature=args.temperature, top_p=args.top_p)
 
 
 def main(argv=None) -> int:
@@ -90,6 +93,16 @@ def main(argv=None) -> int:
                     help="tokens a tenant's prompts share")
     ap.add_argument("--priorities", type=int, default=1)
     ap.add_argument("--deadline-ms", type=float, default=0.0)
+    ap.add_argument("--sampled-fraction", type=float, default=0.0,
+                    help="fraction of arrivals with keyed sampling "
+                         "(per-arrival seed; 0 = all greedy, trace "
+                         "bit-identical to pre-sampling output)")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="sampled arrivals' temperature (0 = serving "
+                         "default)")
+    ap.add_argument("--top-p", type=float, default=0.0,
+                    help="sampled arrivals' nucleus threshold "
+                         "(0 = disabled)")
     ap.add_argument("--out", default=None,
                     help="output path (default: stdout)")
     args = ap.parse_args(argv)
@@ -105,8 +118,10 @@ def main(argv=None) -> int:
         for a in trace:
             print(json.dumps(a.to_json(), separators=(",", ":")))
     shared = sum(1 for a in trace if a.tenant)
+    sampled = sum(1 for a in trace if a.do_sample)
     print(f"# summary: {len(trace)} arrivals over {args.duration}s "
-          f"({len(trace) / args.duration:.2f}/s), {shared} shared-prefix",
+          f"({len(trace) / args.duration:.2f}/s), {shared} shared-prefix, "
+          f"{sampled} sampled",
           file=sys.stderr)
     return 0
 
